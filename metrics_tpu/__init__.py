@@ -1,0 +1,25 @@
+"""metrics_tpu — TPU-native (JAX/XLA) machine-learning metrics.
+
+Re-designed, TPU-first implementation of the capabilities of
+TorchMetrics v0.3.0dev (``arvindmuralie77/metrics``): jittable
+update/compute pairs, pytree metric state, and XLA collective
+synchronization (``psum``/``all_gather`` over device meshes) in place of
+``torch.distributed``.
+"""
+import logging
+
+_logger = logging.getLogger("metrics_tpu")
+_logger.addHandler(logging.StreamHandler())
+_logger.setLevel(logging.INFO)
+
+from metrics_tpu.info import __version__  # noqa: F401, E402
+from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401, E402
+from metrics_tpu.classification import (  # noqa: F401, E402
+    F1,
+    Accuracy,
+    FBeta,
+    HammingDistance,
+    Precision,
+    Recall,
+    StatScores,
+)

@@ -1,0 +1,57 @@
+"""HammingDistance (module). Parity: ``torchmetrics/classification/hamming_distance.py``."""
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hamming_distance import (
+    _hamming_distance_compute,
+    _hamming_distance_update,
+)
+from metrics_tpu.metric import Metric
+
+
+class HammingDistance(Metric):
+    r"""Computes the average Hamming distance (Hamming loss) between targets and predictions.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([[0, 1], [1, 1]])
+        >>> preds = jnp.array([[0, 1], [0, 1]])
+        >>> hamming_distance = HammingDistance()
+        >>> hamming_distance(preds, target)
+        Array(0.25, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        self.add_state("correct", default=jnp.asarray(0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+        if not 0 < threshold < 1:
+            raise ValueError("The `threshold` should lie in the (0,1) interval.")
+        self.threshold = threshold
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        """Accumulate elementwise (dis)agreement counts from a batch."""
+        correct, total = _hamming_distance_update(preds, target, self.threshold)
+
+        self.correct = self.correct + correct
+        self.total = self.total + total
+
+    def compute(self) -> jax.Array:
+        """Hamming distance over all seen batches."""
+        return _hamming_distance_compute(self.correct, self.total)

@@ -1,0 +1,173 @@
+"""StatScores (module) + shared ``_reduce_stat_scores`` averaging helper.
+
+Parity: ``torchmetrics/classification/stat_scores.py``. State is either
+fixed-shape int32 counters (sum-sync via ``psum``) or per-batch lists when
+``reduce='samples'`` / ``mdmc_reduce='samplewise'`` (cat-sync).
+"""
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.stat_scores import _stat_scores_compute, _stat_scores_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+
+class StatScores(Metric):
+    """Computes true/false positives/negatives under configurable reductions.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds  = jnp.array([1, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> stat_scores = StatScores(reduce='macro', num_classes=3)
+        >>> stat_scores(preds, target)
+        Array([[0, 1, 2, 1, 1],
+               [1, 1, 1, 1, 2],
+               [1, 0, 3, 0, 1]], dtype=int32)
+        >>> stat_scores = StatScores(reduce='micro')
+        >>> stat_scores(preds, target)
+        Array([2, 2, 6, 2, 4], dtype=int32)
+    """
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        top_k: Optional[int] = None,
+        reduce: str = "micro",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        mdmc_reduce: Optional[str] = None,
+        is_multiclass: Optional[bool] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        self.reduce = reduce
+        self.mdmc_reduce = mdmc_reduce
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.is_multiclass = is_multiclass
+        self.ignore_index = ignore_index
+        self.top_k = top_k
+
+        if not 0 < threshold < 1:
+            raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")
+
+        if reduce not in ["micro", "macro", "samples"]:
+            raise ValueError(f"The `reduce` {reduce} is not valid.")
+
+        if mdmc_reduce not in [None, "samplewise", "global"]:
+            raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+
+        if reduce == "macro" and (not num_classes or num_classes < 1):
+            raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+
+        if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+        if mdmc_reduce != "samplewise" and reduce != "samples":
+            zeros_shape = [] if reduce == "micro" else (num_classes,)
+            default, reduce_fn = (lambda: jnp.zeros(zeros_shape, dtype=jnp.int32)), "sum"
+        else:
+            default, reduce_fn = (lambda: []), None
+
+        for s in ("tp", "fp", "tn", "fn"):
+            self.add_state(s, default=default(), dist_reduce_fx=reduce_fn)
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        """Accumulate tp/fp/tn/fn from a batch of predictions and targets."""
+        tp, fp, tn, fn = _stat_scores_update(
+            preds,
+            target,
+            reduce=self.reduce,
+            mdmc_reduce=self.mdmc_reduce,
+            threshold=self.threshold,
+            num_classes=self.num_classes,
+            top_k=self.top_k,
+            is_multiclass=self.is_multiclass,
+            ignore_index=self.ignore_index,
+        )
+
+        if self.reduce != "samples" and self.mdmc_reduce != "samplewise":
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
+        else:
+            self.tp.append(tp)
+            self.fp.append(fp)
+            self.tn.append(tn)
+            self.fn.append(fn)
+
+    def _get_final_stats(self) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """Concatenate list states if necessary before compute."""
+        if isinstance(self.tp, list):
+            return (
+                jnp.concatenate(self.tp),
+                jnp.concatenate(self.fp),
+                jnp.concatenate(self.tn),
+                jnp.concatenate(self.fn),
+            )
+        return self.tp, self.fp, self.tn, self.fn
+
+    def compute(self) -> jax.Array:
+        """Return ``(..., 5) = [tp, fp, tn, fn, support]`` over all seen batches."""
+        tp, fp, tn, fn = self._get_final_stats()
+        return _stat_scores_compute(tp, fp, tn, fn)
+
+
+def _reduce_stat_scores(
+    numerator: jax.Array,
+    denominator: jax.Array,
+    weights: Optional[jax.Array],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: int = 0,
+) -> jax.Array:
+    """Average ``numerator/denominator`` scores with zero-division & ignore masking.
+
+    Parity: reference ``classification/stat_scores.py:277-340``. Negative
+    denominators mark ignored classes (NaN under ``average=None``, dropped
+    from averages otherwise); zero denominators score ``zero_division``.
+    """
+    numerator, denominator = numerator.astype(jnp.float32), denominator.astype(jnp.float32)
+    zero_div_mask = denominator == 0
+    ignore_mask = denominator < 0
+
+    if weights is None:
+        weights = jnp.ones_like(denominator)
+    else:
+        weights = weights.astype(jnp.float32)
+
+    numerator = jnp.where(zero_div_mask, float(zero_division), numerator)
+    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    weights = jnp.where(ignore_mask, 0.0, weights)
+
+    if average not in (AverageMethod.MICRO, AverageMethod.NONE, None):
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+
+    scores = weights * (numerator / denominator)
+
+    # sum(weights) == 0 happens if the only present class is ignored with average='weighted'
+    scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
+
+    if mdmc_average == MDMCAverageMethod.SAMPLEWISE:
+        scores = jnp.mean(scores, axis=0)
+        ignore_mask = jnp.sum(ignore_mask, axis=0).astype(bool)
+
+    if average in (AverageMethod.NONE, None):
+        scores = jnp.where(ignore_mask, jnp.nan, scores)
+    else:
+        scores = jnp.sum(scores)
+
+    return scores

@@ -1,0 +1,85 @@
+"""Accuracy (functional). Parity: ``torchmetrics/functional/classification/accuracy.py``."""
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.enums import DataType
+
+
+@partial(jax.jit, static_argnames=("mode", "subset_accuracy"))
+def _accuracy_count(preds, target, mode, subset_accuracy):
+    """Fused (correct, total) counting on canonical inputs — one XLA program per case."""
+    mode = DataType(mode)
+    if mode == DataType.BINARY or (mode == DataType.MULTILABEL and subset_accuracy):
+        correct = jnp.sum(jnp.all(preds == target, axis=1))
+        total = jnp.asarray(target.shape[0])
+    elif mode == DataType.MULTILABEL and not subset_accuracy:
+        correct = jnp.sum(preds == target)
+        total = jnp.asarray(target.size)
+    elif mode == DataType.MULTICLASS or (mode == DataType.MULTIDIM_MULTICLASS and not subset_accuracy):
+        correct = jnp.sum(preds * target)
+        total = jnp.sum(target)
+    elif mode == DataType.MULTIDIM_MULTICLASS and subset_accuracy:
+        sample_correct = jnp.sum(preds * target, axis=(1, 2))
+        correct = jnp.sum(sample_correct == target.shape[2])
+        total = jnp.asarray(target.shape[0])
+
+    return correct.astype(jnp.int32), jnp.asarray(total, dtype=jnp.int32)
+
+
+def _accuracy_update(
+    preds: jax.Array,
+    target: jax.Array,
+    threshold: float,
+    top_k: Optional[int],
+    subset_accuracy: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Canonicalize inputs and count (correct, total) for the detected case.
+
+    Mirrors reference ``functional/classification/accuracy.py:23-55``.
+    """
+    preds, target, mode = _input_format_classification(preds, target, threshold=threshold, top_k=top_k)
+
+    if mode == DataType.MULTILABEL and top_k:
+        raise ValueError("You can not use the `top_k` parameter to calculate accuracy for multi-label inputs.")
+
+    return _accuracy_count(preds, target, mode.value, subset_accuracy)
+
+
+def _accuracy_compute(correct: jax.Array, total: jax.Array) -> jax.Array:
+    return correct.astype(jnp.float32) / total
+
+
+def accuracy(
+    preds: jax.Array,
+    target: jax.Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    subset_accuracy: bool = False,
+) -> jax.Array:
+    r"""Computes accuracy; accepts all classification input cases.
+
+    Args:
+        preds: Predictions from model (probabilities, or labels)
+        target: Ground truth labels
+        threshold: probability threshold for binary/multi-label predictions
+        top_k: top-K accuracy for (multi-dim) multi-class probability inputs
+        subset_accuracy: require whole samples to match for ML/MDMC inputs
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 2, 3])
+        >>> preds = jnp.array([0, 2, 1, 3])
+        >>> accuracy(preds, target)
+        Array(0.5, dtype=float32)
+
+        >>> target = jnp.array([0, 1, 2])
+        >>> preds = jnp.array([[0.1, 0.9, 0], [0.3, 0.1, 0.6], [0.2, 0.5, 0.3]])
+        >>> accuracy(preds, target, top_k=2)
+        Array(0.6666667, dtype=float32)
+    """
+    correct, total = _accuracy_update(preds, target, threshold, top_k, subset_accuracy)
+    return _accuracy_compute(correct, total)

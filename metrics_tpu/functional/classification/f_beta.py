@@ -1,0 +1,134 @@
+"""FBeta / F1 (functional). Parity: ``torchmetrics/functional/classification/f_beta.py``."""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.classification.stat_scores import _reduce_stat_scores
+from metrics_tpu.functional.classification.stat_scores import _stat_scores_update
+from metrics_tpu.utilities.enums import AverageMethod, MDMCAverageMethod
+
+
+def _safe_divide(num: jax.Array, denom: jax.Array) -> jax.Array:
+    """Division that treats 0-denominators as 1 (prevents NaN)."""
+    return num / jnp.where(denom == 0.0, 1.0, denom)
+
+
+def _fbeta_compute(
+    tp: jax.Array,
+    fp: jax.Array,
+    tn: jax.Array,
+    fn: jax.Array,
+    beta: float,
+    ignore_index: Optional[int],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> jax.Array:
+    if average == "micro" and mdmc_average != MDMCAverageMethod.SAMPLEWISE:
+        # mask out -1 sentinel entries (ignored class under macro counting)
+        mask = tp >= 0
+        precision = _safe_divide(jnp.sum(jnp.where(mask, tp, 0)).astype(jnp.float32),
+                                 jnp.sum(jnp.where(mask, tp + fp, 0)).astype(jnp.float32))
+        recall = _safe_divide(jnp.sum(jnp.where(mask, tp, 0)).astype(jnp.float32),
+                              jnp.sum(jnp.where(mask, tp + fn, 0)).astype(jnp.float32))
+    else:
+        precision = _safe_divide(tp.astype(jnp.float32), (tp + fp).astype(jnp.float32))
+        recall = _safe_divide(tp.astype(jnp.float32), (tp + fn).astype(jnp.float32))
+
+    num = (1 + beta ** 2) * precision * recall
+    denom = beta ** 2 * precision + recall
+    denom = jnp.where(denom == 0.0, 1.0, denom)  # avoid division by 0
+
+    if ignore_index is not None:
+        if (
+            average not in (AverageMethod.MICRO.value, AverageMethod.SAMPLES.value)
+            and mdmc_average == MDMCAverageMethod.SAMPLEWISE
+        ):
+            num = num.at[..., ignore_index].set(-1)
+            denom = denom.at[..., ignore_index].set(-1)
+        elif average not in (AverageMethod.MICRO.value, AverageMethod.SAMPLES.value):
+            num = num.at[ignore_index, ...].set(-1)
+            denom = denom.at[ignore_index, ...].set(-1)
+
+    return _reduce_stat_scores(
+        numerator=num,
+        denominator=denom,
+        weights=None if average != "weighted" else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def fbeta(
+    preds: jax.Array,
+    target: jax.Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    is_multiclass: Optional[bool] = None,
+) -> jax.Array:
+    r"""Computes the F-beta score (weighted harmonic mean of precision and recall).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.array([0, 2, 1, 0, 0, 1])
+        >>> fbeta(preds, target, num_classes=3, beta=0.5)
+        Array(0.33333334, dtype=float32)
+    """
+    allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+    allowed_mdmc_average = [None, "samplewise", "global"]
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+
+    if average in ["macro", "weighted", "none", None] and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        is_multiclass=is_multiclass,
+        ignore_index=ignore_index,
+    )
+
+    return _fbeta_compute(tp, fp, tn, fn, beta, ignore_index, average, mdmc_average)
+
+
+def f1(
+    preds: jax.Array,
+    target: jax.Array,
+    beta: float = 1.0,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    is_multiclass: Optional[bool] = None,
+) -> jax.Array:
+    r"""Computes the F1 score (``fbeta`` with beta=1).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 2, 0, 1, 2])
+        >>> preds = jnp.array([0, 2, 1, 0, 0, 1])
+        >>> f1(preds, target, num_classes=3)
+        Array(0.33333334, dtype=float32)
+    """
+    return fbeta(preds, target, 1.0, average, mdmc_average, ignore_index, num_classes, threshold, top_k, is_multiclass)

@@ -1,0 +1,46 @@
+"""Hamming distance (functional). Parity: ``torchmetrics/functional/classification/hamming_distance.py``."""
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+
+
+@jax.jit
+def _hamming_count(preds, target):
+    return jnp.sum(preds == target)
+
+
+def _hamming_distance_update(
+    preds: jax.Array,
+    target: jax.Array,
+    threshold: float = 0.5,
+) -> Tuple[jax.Array, int]:
+    preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
+
+    correct = _hamming_count(preds, target)
+    total = preds.size
+
+    return correct, total
+
+
+def _hamming_distance_compute(correct: jax.Array, total: Union[int, jax.Array]) -> jax.Array:
+    return 1 - correct.astype(jnp.float32) / total
+
+
+def hamming_distance(preds: jax.Array, target: jax.Array, threshold: float = 0.5) -> jax.Array:
+    r"""Computes the average Hamming distance (Hamming loss):
+
+    elementwise disagreement rate between predictions and targets, treating
+    every label of every sample separately.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([[0, 1], [1, 1]])
+        >>> preds = jnp.array([[0, 1], [0, 1]])
+        >>> hamming_distance(preds, target)
+        Array(0.25, dtype=float32)
+    """
+    correct, total = _hamming_distance_update(preds, target, threshold)
+    return _hamming_distance_compute(correct, total)

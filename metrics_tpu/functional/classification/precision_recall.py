@@ -1,0 +1,182 @@
+"""Precision / Recall (functional). Parity: ``torchmetrics/functional/classification/precision_recall.py``."""
+from typing import Optional, Tuple
+
+import jax
+
+from metrics_tpu.classification.stat_scores import _reduce_stat_scores
+from metrics_tpu.functional.classification.stat_scores import _stat_scores_update
+
+
+def _precision_compute(
+    tp: jax.Array,
+    fp: jax.Array,
+    tn: jax.Array,
+    fn: jax.Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> jax.Array:
+    return _reduce_stat_scores(
+        numerator=tp,
+        denominator=tp + fp,
+        weights=None if average != "weighted" else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _recall_compute(
+    tp: jax.Array,
+    fp: jax.Array,
+    tn: jax.Array,
+    fn: jax.Array,
+    average: Optional[str],
+    mdmc_average: Optional[str],
+) -> jax.Array:
+    return _reduce_stat_scores(
+        numerator=tp,
+        denominator=tp + fn,
+        weights=None if average != "weighted" else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+    )
+
+
+def _check_prec_recall_args(
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    num_classes: Optional[int],
+    ignore_index: Optional[int],
+) -> None:
+    allowed_average = ["micro", "macro", "weighted", "samples", "none", None]
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+
+    allowed_mdmc_average = [None, "samplewise", "global"]
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+
+    if average in ["macro", "weighted", "none", None] and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+
+def precision(
+    preds: jax.Array,
+    target: jax.Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    is_multiclass: Optional[bool] = None,
+) -> jax.Array:
+    r"""Computes precision ``TP / (TP + FP)`` under the given averaging.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds  = jnp.array([2, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> precision(preds, target, average='macro', num_classes=3)
+        Array(0.16666667, dtype=float32)
+        >>> precision(preds, target, average='micro')
+        Array(0.25, dtype=float32)
+    """
+    _check_prec_recall_args(average, mdmc_average, num_classes, ignore_index)
+
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        is_multiclass=is_multiclass,
+        ignore_index=ignore_index,
+    )
+
+    return _precision_compute(tp, fp, tn, fn, average, mdmc_average)
+
+
+def recall(
+    preds: jax.Array,
+    target: jax.Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    is_multiclass: Optional[bool] = None,
+) -> jax.Array:
+    r"""Computes recall ``TP / (TP + FN)`` under the given averaging.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds  = jnp.array([2, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> recall(preds, target, average='macro', num_classes=3)
+        Array(0.33333334, dtype=float32)
+        >>> recall(preds, target, average='micro')
+        Array(0.25, dtype=float32)
+    """
+    _check_prec_recall_args(average, mdmc_average, num_classes, ignore_index)
+
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        is_multiclass=is_multiclass,
+        ignore_index=ignore_index,
+    )
+
+    return _recall_compute(tp, fp, tn, fn, average, mdmc_average)
+
+
+def precision_recall(
+    preds: jax.Array,
+    target: jax.Array,
+    average: str = "micro",
+    mdmc_average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    is_multiclass: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    r"""Computes (precision, recall) in one canonicalization pass.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds  = jnp.array([2, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> precision_recall(preds, target, average='macro', num_classes=3)
+        (Array(0.16666667, dtype=float32), Array(0.33333334, dtype=float32))
+    """
+    _check_prec_recall_args(average, mdmc_average, num_classes, ignore_index)
+
+    reduce = "macro" if average in ["weighted", "none", None] else average
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        is_multiclass=is_multiclass,
+        ignore_index=ignore_index,
+    )
+
+    precision_ = _precision_compute(tp, fp, tn, fn, average, mdmc_average)
+    recall_ = _recall_compute(tp, fp, tn, fn, average, mdmc_average)
+    return precision_, recall_

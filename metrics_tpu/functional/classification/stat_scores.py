@@ -1,0 +1,170 @@
+"""Stat scores (tp/fp/tn/fn) — the shared counting core of the classification pack.
+
+Parity: ``torchmetrics/functional/classification/stat_scores.py``. The
+boolean-mask + sum formulation maps directly onto XLA fused reductions.
+"""
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _input_format_classification
+
+
+def _del_column(x: jax.Array, index: int) -> jax.Array:
+    """Delete the column at ``index``."""
+    return jnp.concatenate([x[:, :index], x[:, (index + 1):]], axis=1)
+
+
+def _stat_scores(
+    preds: jax.Array,
+    target: jax.Array,
+    reduce: str = "micro",
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Count tp/fp/tn/fn over the reduce dims of canonical ``(N,C)``/``(N,C,X)`` inputs.
+
+    Output shapes (reference ``functional/classification/stat_scores.py:28-74``):
+    ``(N,C)`` inputs — micro: scalar, macro: ``(C,)``, samples: ``(N,)``;
+    ``(N,C,X)`` inputs — micro: ``(N,)``, macro: ``(N,C)``, samples: ``(N,X)``.
+    """
+    if reduce == "micro":
+        dim = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        dim = 0 if preds.ndim == 2 else 2
+    elif reduce == "samples":
+        dim = 1
+
+    true_pred, false_pred = target == preds, target != preds
+    pos_pred, neg_pred = preds == 1, preds == 0
+
+    tp = jnp.sum(true_pred * pos_pred, axis=dim)
+    fp = jnp.sum(false_pred * pos_pred, axis=dim)
+    tn = jnp.sum(true_pred * neg_pred, axis=dim)
+    fn = jnp.sum(false_pred * neg_pred, axis=dim)
+
+    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnames=("reduce", "mdmc_reduce", "ignore_index"))
+def _stat_scores_count(preds, target, reduce, mdmc_reduce, ignore_index):
+    """Fused counting on canonical inputs — one XLA program per configuration."""
+    if preds.ndim == 3 and mdmc_reduce == "global":
+        preds = jnp.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
+        target = jnp.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
+
+    # Drop the ignored class column when class identity doesn't matter.
+    if ignore_index is not None and reduce != "macro":
+        preds = _del_column(preds, ignore_index)
+        target = _del_column(target, ignore_index)
+
+    tp, fp, tn, fn = _stat_scores(preds, target, reduce=reduce)
+
+    # Mark the ignored class's statistics with -1 sentinels.
+    if ignore_index is not None and reduce == "macro":
+        tp = tp.at[..., ignore_index].set(-1)
+        fp = fp.at[..., ignore_index].set(-1)
+        tn = tn.at[..., ignore_index].set(-1)
+        fn = fn.at[..., ignore_index].set(-1)
+
+    return tp, fp, tn, fn
+
+
+def _stat_scores_update(
+    preds: jax.Array,
+    target: jax.Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    is_multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Canonicalize inputs and compute the tp/fp/tn/fn partial statistics."""
+    preds, target, _ = _input_format_classification(
+        preds, target, threshold=threshold, num_classes=num_classes, is_multiclass=is_multiclass, top_k=top_k
+    )
+
+    if ignore_index is not None and not 0 <= ignore_index < preds.shape[1]:
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[1]} classes")
+
+    if ignore_index is not None and preds.shape[1] == 1:
+        raise ValueError("You can not use `ignore_index` with binary data.")
+
+    if preds.ndim == 3 and not mdmc_reduce:
+        raise ValueError(
+            "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
+        )
+
+    return _stat_scores_count(preds, target, reduce=reduce, mdmc_reduce=mdmc_reduce, ignore_index=ignore_index)
+
+
+def _stat_scores_compute(tp: jax.Array, fp: jax.Array, tn: jax.Array, fn: jax.Array) -> jax.Array:
+    outputs = jnp.concatenate(
+        [
+            tp[..., None],
+            fp[..., None],
+            tn[..., None],
+            fn[..., None],
+            tp[..., None] + fn[..., None],  # support
+        ],
+        axis=-1,
+    )
+    return jnp.where(outputs < 0, -1, outputs)
+
+
+def stat_scores(
+    preds: jax.Array,
+    target: jax.Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    is_multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> jax.Array:
+    """Count true/false positives/negatives (+support) under the given reduction.
+
+    Returns ``(..., 5) = [tp, fp, tn, fn, support]``; shape per ``reduce`` /
+    ``mdmc_reduce`` as in the reference docstring
+    (``functional/classification/stat_scores.py:220-246``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds  = jnp.array([1, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> stat_scores(preds, target, reduce='macro', num_classes=3)
+        Array([[0, 1, 2, 1, 1],
+               [1, 1, 1, 1, 2],
+               [1, 0, 3, 0, 1]], dtype=int32)
+        >>> stat_scores(preds, target, reduce='micro')
+        Array([2, 2, 6, 2, 4], dtype=int32)
+    """
+    if reduce not in ["micro", "macro", "samples"]:
+        raise ValueError(f"The `reduce` {reduce} is not valid.")
+
+    if mdmc_reduce not in [None, "samplewise", "global"]:
+        raise ValueError(f"The `mdmc_reduce` {mdmc_reduce} is not valid.")
+
+    if reduce == "macro" and (not num_classes or num_classes < 1):
+        raise ValueError("When you set `reduce` as 'macro', you have to provide the number of classes.")
+
+    if num_classes and ignore_index is not None and (not 0 <= ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+
+    tp, fp, tn, fn = _stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_reduce,
+        top_k=top_k,
+        threshold=threshold,
+        num_classes=num_classes,
+        is_multiclass=is_multiclass,
+        ignore_index=ignore_index,
+    )
+    return _stat_scores_compute(tp, fp, tn, fn)

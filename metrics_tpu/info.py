@@ -1,0 +1,14 @@
+"""Package metadata for metrics_tpu.
+
+TPU-native (JAX/XLA) re-design of the capabilities of
+``arvindmuralie77/metrics`` (TorchMetrics v0.3.0dev, see
+``/root/reference/torchmetrics/info.py:1``).
+"""
+
+__version__ = "0.1.0"
+__author__ = "metrics_tpu contributors"
+__license__ = "Apache-2.0"
+__docs__ = (
+    "TPU-native machine-learning metrics: jittable update/compute pairs, "
+    "pytree metric state, and XLA collective synchronization over device meshes."
+)

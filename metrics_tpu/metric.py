@@ -1,0 +1,484 @@
+"""Core metric runtime: the stateful ``Metric`` base class.
+
+Behavioral parity with ``torchmetrics/metric.py:29-537`` — state registry
+(``add_state``), forward/update/compute semantics incl. the batch-local
+forward value (``metric.py:147-174``), result caching and
+cache-state/sync/compute/restore (``metric.py:205-236``), reset/persistence/
+pickling, kwargs routing, and the full metric-arithmetic operator surface
+(``metric.py:351-452``).
+
+TPU-native design decisions:
+
+* Metric state is a **pytree of ``jax.Array``s** (or Python lists of arrays
+  for "cat" states) — directly jittable, shardable with
+  ``jax.sharding.NamedSharding``, and trivially checkpointable.
+* Per-metric ``update``/``compute`` logic lives in pure functional pairs
+  (``metrics_tpu.functional``); subclasses here only wire state.
+* Distributed sync keeps the reference's all-gather-then-locally-reduce
+  contract but is pluggable: host-level backends
+  (:mod:`metrics_tpu.parallel.backend`) for replica-per-process setups, and
+  in-program XLA collectives (:mod:`metrics_tpu.parallel.collective`) for
+  SPMD eval loops over a mesh.
+"""
+import functools
+import inspect
+from abc import ABC, abstractmethod
+from collections.abc import Sequence
+from copy import deepcopy
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.parallel.backend import is_distributed_initialized
+from metrics_tpu.utilities.data import (
+    _flatten,
+    apply_to_collection,
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+from metrics_tpu.utilities.distributed import gather_all_tensors
+
+Array = jax.Array
+
+
+class Metric(ABC):
+    """Base class for all metrics.
+
+    Implements ``add_state()``, ``forward()``, ``reset()`` and distributed
+    synchronization. Override ``update()`` and ``compute()``; register state
+    with ``add_state()``.
+
+    State variables are either ``jax.Array``s or empty lists (to which arrays
+    are appended batch-wise).
+
+    Args:
+        compute_on_step:
+            Forward only calls ``update()`` and returns None if this is False.
+        dist_sync_on_step:
+            Synchronize metric state across processes at each ``forward()``
+            before returning the value at the step.
+        process_group:
+            Scope of synchronization (backend-interpreted: subset of processes
+            or a mesh-axis name). Default: the entire world.
+        dist_sync_fn:
+            Callback performing the all-gather of metric state. When None, the
+            active JAX sync backend is used if distributed is initialized.
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        self.dist_sync_on_step = dist_sync_on_step
+        self.compute_on_step = compute_on_step
+        self.process_group = process_group
+        self.dist_sync_fn = dist_sync_fn
+        self._to_sync = True
+
+        self._update_signature = inspect.signature(self.update)
+        self.update = self._wrap_update(self.update)
+        self.compute = self._wrap_compute(self.compute)
+        self._computed = None
+        self._forward_cache = None
+
+        self._defaults: Dict[str, Any] = {}
+        self._persistent: Dict[str, bool] = {}
+        self._reductions: Dict[str, Optional[Callable]] = {}
+
+    def add_state(
+        self,
+        name: str,
+        default: Union[Array, list],
+        dist_reduce_fx: Optional[Union[str, Callable]] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register a metric state variable (reference ``metric.py:88-145``).
+
+        Args:
+            name: attribute name the state will live at (``self.<name>``).
+            default: a ``jax.Array`` or an **empty list**; the reset value.
+            dist_reduce_fx: ``"sum"``, ``"mean"``, ``"cat"``, ``"min"``,
+                ``"max"``, a custom callable, or None. Applied to the
+                cross-process gathered state (stacked ``(world, ...)`` for
+                array states, rank-order flattened for list states).
+            persistent: include this state in ``state_dict()``.
+        """
+        if not isinstance(default, (Array, jnp.ndarray, list)) or (isinstance(default, list) and default):
+            raise ValueError("state variable must be a tensor or any empty list (where you can append tensors)")
+
+        if dist_reduce_fx == "sum":
+            dist_reduce_fx = dim_zero_sum
+        elif dist_reduce_fx == "mean":
+            dist_reduce_fx = dim_zero_mean
+        elif dist_reduce_fx == "cat":
+            dist_reduce_fx = dim_zero_cat
+        elif dist_reduce_fx == "min":
+            dist_reduce_fx = dim_zero_min
+        elif dist_reduce_fx == "max":
+            dist_reduce_fx = dim_zero_max
+        elif dist_reduce_fx is not None and not callable(dist_reduce_fx):
+            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', None]")
+
+        if not isinstance(default, list):
+            default = jnp.asarray(default)
+
+        setattr(self, name, default)
+
+        # for list states keep a distinct empty-list default so appends to the
+        # live state can never alias the registered default
+        self._defaults[name] = [] if isinstance(default, list) else default
+        self._persistent[name] = persistent
+        self._reductions[name] = dist_reduce_fx
+
+    def forward(self, *args: Any, **kwargs: Any):
+        """Update state with the batch; return the batch-local value if
+        ``compute_on_step`` (reference ``metric.py:147-174``)."""
+        self.update(*args, **kwargs)
+        self._forward_cache = None
+
+        if self.compute_on_step:
+            self._to_sync = self.dist_sync_on_step
+
+            # save accumulated state, compute on this batch alone
+            cache = {attr: getattr(self, attr) for attr in self._defaults}
+
+            self.reset()
+            self.update(*args, **kwargs)
+            self._forward_cache = self.compute()
+
+            # restore accumulated state
+            for attr, val in cache.items():
+                setattr(self, attr, val)
+            self._to_sync = True
+            self._computed = None
+
+            return self._forward_cache
+
+    __call__ = forward
+
+    def _sync_dist(self, dist_sync_fn: Callable = gather_all_tensors) -> None:
+        """All-gather every registered state and apply its reduction
+        (reference ``metric.py:176-194``)."""
+        input_dict = {attr: getattr(self, attr) for attr in self._reductions}
+        output_dict = apply_to_collection(
+            input_dict,
+            (Array, jnp.ndarray),
+            dist_sync_fn,
+            group=self.process_group,
+        )
+
+        for attr, reduction_fn in self._reductions.items():
+            # array states stack to (world, ...); list states flatten in rank order
+            if len(output_dict[attr]) and isinstance(output_dict[attr][0], (Array, jnp.ndarray)):
+                output_dict[attr] = jnp.stack(list(output_dict[attr]))
+            elif len(output_dict[attr]) and isinstance(output_dict[attr][0], list):
+                output_dict[attr] = _flatten(output_dict[attr])
+
+            assert callable(reduction_fn) or reduction_fn is None
+            reduced = reduction_fn(output_dict[attr]) if reduction_fn is not None else output_dict[attr]
+            setattr(self, attr, reduced)
+
+    def _wrap_update(self, update: Callable) -> Callable:
+        @functools.wraps(update)
+        def wrapped_func(*args: Any, **kwargs: Any):
+            self._computed = None
+            return update(*args, **kwargs)
+
+        return wrapped_func
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        @functools.wraps(compute)
+        def wrapped_func(*args: Any, **kwargs: Any):
+            if self._computed is not None:
+                return self._computed
+
+            dist_sync_fn = self.dist_sync_fn
+            if dist_sync_fn is None and is_distributed_initialized():
+                dist_sync_fn = gather_all_tensors
+
+            synced = False
+            cache = {}
+            if self._to_sync and dist_sync_fn is not None:
+                # cache prior to syncing so accumulation continues un-synced
+                cache = {attr: getattr(self, attr) for attr in self._defaults}
+                self._sync_dist(dist_sync_fn)
+                synced = True
+
+            self._computed = compute(*args, **kwargs)
+            if synced:
+                for attr, val in cache.items():
+                    setattr(self, attr, val)
+
+            return self._computed
+
+        return wrapped_func
+
+    @abstractmethod
+    def update(self) -> None:
+        """Override to update the metric state from a batch of inputs."""
+
+    @abstractmethod
+    def compute(self):
+        """Override to compute the final value from (synced) state."""
+
+    def reset(self) -> None:
+        """Reset all state variables to their registered defaults."""
+        self._computed = None
+        for attr, default in self._defaults.items():
+            if isinstance(default, list):
+                setattr(self, attr, [])
+            else:
+                # jax arrays are immutable; no deepcopy/device dance needed
+                setattr(self, attr, default)
+
+    def clone(self) -> "Metric":
+        """Make a copy of the metric."""
+        return deepcopy(self)
+
+    def __getstate__(self) -> dict:
+        # drop wrapped bound methods for pickling
+        return {k: v for k, v in self.__dict__.items() if k not in ["update", "compute"]}
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        self.update = self._wrap_update(self.update)
+        self.compute = self._wrap_compute(self.compute)
+
+    def to_device(self, device) -> "Metric":
+        """Move all array states onto ``device`` (analog of ``nn.Module.to``)."""
+        for key in self._defaults:
+            current_val = getattr(self, key)
+            if isinstance(current_val, (Array, jnp.ndarray)):
+                setattr(self, key, jax.device_put(current_val, device))
+            elif isinstance(current_val, Sequence):
+                setattr(self, key, [jax.device_put(v, device) for v in current_val])
+            else:
+                raise TypeError(
+                    "Expected metric state to be either a jax.Array"
+                    f" or a list of jax.Array, but encountered {current_val}"
+                )
+        return self
+
+    def persistent(self, mode: bool = False) -> None:
+        """Post-init toggle: should states be saved in ``state_dict``?"""
+        for key in self._persistent:
+            self._persistent[key] = mode
+
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
+        """Collect persistent states into a checkpointable dict."""
+        destination = {} if destination is None else destination
+        for key in self._defaults:
+            if self._persistent[key]:
+                destination[prefix + key] = getattr(self, key)
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        """Restore states saved by :meth:`state_dict`."""
+        for key in self._defaults:
+            name = prefix + key
+            if name in state_dict:
+                val = state_dict[name]
+                if isinstance(val, list):
+                    setattr(self, key, [jnp.asarray(v) for v in val])
+                else:
+                    setattr(self, key, jnp.asarray(val))
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Filter kwargs to those accepted by this metric's ``update`` signature."""
+        _params = (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD)
+        _sign_params = self._update_signature.parameters
+        filtered_kwargs = {
+            k: v for k, v in kwargs.items() if (k in _sign_params and _sign_params[k].kind not in _params)
+        }
+        if not filtered_kwargs:
+            filtered_kwargs = kwargs
+        return filtered_kwargs
+
+    def __hash__(self) -> int:
+        hash_vals = [self.__class__.__name__]
+        for key in self._defaults:
+            val = getattr(self, key)
+            if isinstance(val, (Array, jnp.ndarray)):
+                hash_vals.append(id(val))
+            elif hasattr(val, "__iter__"):
+                hash_vals.extend(id(v) for v in val)
+            else:
+                hash_vals.append(val)
+        return hash(tuple(hash_vals))
+
+    def __repr__(self) -> str:
+        return f"{self.__class__.__name__}()"
+
+    # ------------------------------------------------------------------
+    # metric arithmetic (reference metric.py:351-452)
+    # ------------------------------------------------------------------
+    def __add__(self, other: Any):
+        return CompositionalMetric(jnp.add, self, other)
+
+    def __and__(self, other: Any):
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __eq__(self, other: Any):
+        return CompositionalMetric(jnp.equal, self, other)
+
+    def __floordiv__(self, other: Any):
+        return CompositionalMetric(jnp.floor_divide, self, other)
+
+    def __ge__(self, other: Any):
+        return CompositionalMetric(jnp.greater_equal, self, other)
+
+    def __gt__(self, other: Any):
+        return CompositionalMetric(jnp.greater, self, other)
+
+    def __le__(self, other: Any):
+        return CompositionalMetric(jnp.less_equal, self, other)
+
+    def __lt__(self, other: Any):
+        return CompositionalMetric(jnp.less, self, other)
+
+    def __matmul__(self, other: Any):
+        return CompositionalMetric(jnp.matmul, self, other)
+
+    def __mod__(self, other: Any):
+        return CompositionalMetric(jnp.fmod, self, other)
+
+    def __mul__(self, other: Any):
+        return CompositionalMetric(jnp.multiply, self, other)
+
+    def __ne__(self, other: Any):
+        return CompositionalMetric(jnp.not_equal, self, other)
+
+    def __or__(self, other: Any):
+        return CompositionalMetric(jnp.bitwise_or, self, other)
+
+    def __pow__(self, other: Any):
+        return CompositionalMetric(jnp.power, self, other)
+
+    def __radd__(self, other: Any):
+        return CompositionalMetric(jnp.add, other, self)
+
+    def __rand__(self, other: Any):
+        # bitwise_and is commutative
+        return CompositionalMetric(jnp.bitwise_and, self, other)
+
+    def __rfloordiv__(self, other: Any):
+        return CompositionalMetric(jnp.floor_divide, other, self)
+
+    def __rmatmul__(self, other: Any):
+        return CompositionalMetric(jnp.matmul, other, self)
+
+    def __rmod__(self, other: Any):
+        return CompositionalMetric(jnp.fmod, other, self)
+
+    def __rmul__(self, other: Any):
+        return CompositionalMetric(jnp.multiply, other, self)
+
+    def __ror__(self, other: Any):
+        return CompositionalMetric(jnp.bitwise_or, other, self)
+
+    def __rpow__(self, other: Any):
+        return CompositionalMetric(jnp.power, other, self)
+
+    def __rsub__(self, other: Any):
+        return CompositionalMetric(jnp.subtract, other, self)
+
+    def __rtruediv__(self, other: Any):
+        return CompositionalMetric(jnp.true_divide, other, self)
+
+    def __rxor__(self, other: Any):
+        return CompositionalMetric(jnp.bitwise_xor, other, self)
+
+    def __sub__(self, other: Any):
+        return CompositionalMetric(jnp.subtract, self, other)
+
+    def __truediv__(self, other: Any):
+        return CompositionalMetric(jnp.true_divide, self, other)
+
+    def __xor__(self, other: Any):
+        return CompositionalMetric(jnp.bitwise_xor, self, other)
+
+    def __abs__(self):
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __inv__(self):
+        return CompositionalMetric(jnp.bitwise_not, self, None)
+
+    def __invert__(self):
+        return self.__inv__()
+
+    def __neg__(self):
+        return CompositionalMetric(_neg, self, None)
+
+    def __pos__(self):
+        return CompositionalMetric(jnp.abs, self, None)
+
+    def __getitem__(self, idx):
+        return CompositionalMetric(lambda x: x[idx], self, None)
+
+
+def _neg(x: Array) -> Array:
+    return -jnp.abs(x)
+
+
+class CompositionalMetric(Metric):
+    """Lazy composition of two metrics (or a metric and a constant) by an operator.
+
+    Parity with reference ``metric.py:459-537``: ``update`` fans out with
+    kwargs filtering, ``compute`` applies the operator to child results, and
+    ``_sync_dist`` is a no-op because children sync themselves.
+    """
+
+    def __init__(
+        self,
+        operator: Callable,
+        metric_a: Union["Metric", int, float, Array],
+        metric_b: Union["Metric", int, float, Array, None],
+    ):
+        super().__init__()
+
+        self.op = operator
+
+        self.metric_a = jnp.asarray(metric_a) if isinstance(metric_a, (Array, jnp.ndarray)) else metric_a
+        self.metric_b = jnp.asarray(metric_b) if isinstance(metric_b, (Array, jnp.ndarray)) else metric_b
+
+    def _sync_dist(self, dist_sync_fn: Optional[Callable] = None) -> None:
+        # No syncing required here; syncing is done in metric_a and metric_b.
+        pass
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+
+    def compute(self) -> Any:
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_name = getattr(self.op, "__name__", repr(self.op))
+        _op_metrics = f"(\n  {_op_name}(\n    {repr(self.metric_a)},\n    {repr(self.metric_b)}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics

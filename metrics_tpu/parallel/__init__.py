@@ -1,0 +1,9 @@
+from metrics_tpu.parallel.backend import (  # noqa: F401
+    MultiHostBackend,
+    SingleProcessBackend,
+    SyncBackend,
+    get_sync_backend,
+    is_distributed_initialized,
+    set_sync_backend,
+)
+from metrics_tpu.parallel.collective import masked_cat_sync, sync_array, sync_state  # noqa: F401

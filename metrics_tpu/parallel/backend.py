@@ -1,0 +1,92 @@
+"""Host-level synchronization backends.
+
+The reference reaches ``torch.distributed`` (NCCL/Gloo process groups) from
+``torchmetrics/utilities/distributed.py:91-118`` and auto-detects an
+initialized default group at ``metric.py:213-216``.  The JAX world has two
+distinct sync regimes, both covered here and in :mod:`metrics_tpu.parallel.collective`:
+
+* **host-level** (this module): each Python process holds replica metric
+  state (multi-host pods via ``jax.distributed``, or simulated ranks in
+  tests).  A :class:`SyncBackend` supplies ``world_size`` and ``gather``.
+* **in-program** (:mod:`collective`): metric state lives inside a jitted
+  SPMD program over a :class:`jax.sharding.Mesh`; sync is ``lax.psum`` /
+  ``lax.all_gather`` on a named mesh axis riding ICI/DCN.
+
+``process_group`` in the reference maps to the ``group`` argument here, which
+backends may interpret (e.g. a mesh axis name or a subset of processes).
+"""
+from abc import ABC, abstractmethod
+from typing import Any, List, Optional
+
+import jax
+
+
+class SyncBackend(ABC):
+    """Strategy object providing DDP-style all-gather of metric state."""
+
+    @property
+    @abstractmethod
+    def world_size(self) -> int:
+        ...
+
+    @abstractmethod
+    def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
+        """Return ``[x_rank0, x_rank1, ...]``, identical on every rank."""
+
+
+class SingleProcessBackend(SyncBackend):
+    """Trivial backend for one process: gather returns ``[x]``."""
+
+    @property
+    def world_size(self) -> int:
+        return 1
+
+    def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
+        return [x]
+
+
+class MultiHostBackend(SyncBackend):
+    """Cross-host gather over DCN via ``jax.experimental.multihost_utils``.
+
+    Requires ``jax.distributed.initialize()`` to have been called. This is the
+    TPU-pod analog of the reference's NCCL all_gather
+    (``distributed.py:115-116``): every host ends with the full list of
+    per-host states.
+    """
+
+    @property
+    def world_size(self) -> int:
+        return jax.process_count()
+
+    def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
+        from jax.experimental import multihost_utils
+
+        stacked = multihost_utils.process_allgather(x)  # (num_processes, ...)
+        return [stacked[i] for i in range(stacked.shape[0])]
+
+
+_BACKEND: Optional[SyncBackend] = None
+
+
+def set_sync_backend(backend: Optional[SyncBackend]) -> None:
+    """Install a process-global sync backend (None restores auto-detection)."""
+    global _BACKEND
+    _BACKEND = backend
+
+
+def get_sync_backend() -> SyncBackend:
+    """Active backend: explicit > multi-host auto-detect > single-process."""
+    if _BACKEND is not None:
+        return _BACKEND
+    if jax.process_count() > 1:
+        return MultiHostBackend()
+    return SingleProcessBackend()
+
+
+def is_distributed_initialized() -> bool:
+    """JAX analog of ``torch.distributed.is_available() and is_initialized()``.
+
+    True when an explicit backend is installed (tests, custom strategies) or
+    the process is part of a multi-host JAX runtime.
+    """
+    return _BACKEND is not None or jax.process_count() > 1

@@ -1,0 +1,520 @@
+"""Input canonicalization and validation for classification/retrieval metrics.
+
+Behavioral parity with ``torchmetrics/utilities/checks.py`` (case taxonomy,
+canonical ``(N, C)`` / ``(N, C, X)`` binary outputs, error conditions), with an
+XLA-first architecture:
+
+* **shape/dtype dispatch** is pure Python over static shapes (mirrors
+  ``checks.py:60-119``) — zero device ops;
+* **value-dependent checks** (label ranges, probability bounds,
+  prob-sum-to-1 — ``checks.py:29-57, 273-276``) read a single jitted
+  *value probe* per input configuration, then compare on the host. Under
+  ``jit`` tracing the probe is skipped — validation is an eager-mode feature,
+  exactly the eager/compiled split SURVEY §2.4 prescribes;
+* the **canonicalizing transform** (threshold / top-k / one-hot / reshape,
+  ``checks.py:414-445``) is one fused ``jax.jit`` program keyed on the static
+  configuration, so XLA sees a single fusible kernel instead of a chain of
+  eagerly-dispatched ops.
+
+``num_classes`` inference from the data maximum (``checks.py:426`` /
+``data.py:63``) is value-dependent; it works eagerly (via the probe) and
+raises a clear error when traced, where the caller must supply
+``num_classes``.
+"""
+from functools import partial
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities.data import _is_concrete, select_topk, to_onehot
+from metrics_tpu.utilities.enums import DataType
+
+
+def _is_floating(x: jax.Array) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def _squeeze_shape(shape: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Shape after removing all size-1 dims except a size-1 leading N (torch squeeze semantics)."""
+    if len(shape) and shape[0] == 1:
+        return (1,) + tuple(s for s in shape[1:] if s != 1)
+    return tuple(s for s in shape if s != 1)
+
+
+class _Probe(NamedTuple):
+    """Host-side scalar summary of the inputs, read from one jitted program."""
+
+    preds_min: float
+    preds_max: float
+    target_min: int
+    target_max: int
+    prob_sum_ok: bool
+
+
+@partial(jax.jit, static_argnames=("p_shape", "t_shape", "check_prob_sum"))
+def _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum):
+    preds = preds.reshape(p_shape).astype(jnp.float32)
+    target = target.reshape(t_shape)
+    pmin, pmax = jnp.min(preds), jnp.max(preds)
+    tmin, tmax = jnp.min(target), jnp.max(target)
+    if check_prob_sum:
+        s = jnp.sum(preds, axis=1)
+        prob_ok = jnp.all(jnp.isclose(s, jnp.ones_like(s)))
+    else:
+        prob_ok = jnp.asarray(True)
+    return pmin, pmax, tmin, tmax, prob_ok
+
+
+def _check_same_shape(pred: jax.Array, target: jax.Array) -> None:
+    """Check that predictions and target have the same shape, else raise error."""
+    if pred.shape != target.shape:
+        raise RuntimeError("Predictions and targets are expected to have the same shape")
+
+
+def _detect_case(
+    p_shape: Tuple[int, ...],
+    t_shape: Tuple[int, ...],
+    preds_float: bool,
+) -> Tuple[DataType, int]:
+    """Static shape/dtype case detection (reference ``checks.py:60-119``).
+
+    Returns the detected case and the implied number of classes.
+    """
+    p_ndim, t_ndim = len(p_shape), len(t_shape)
+
+    if p_ndim == t_ndim:
+        if p_shape != t_shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={p_shape} and `target` with shape={t_shape}."
+            )
+        if p_ndim == 1 and preds_float:
+            case = DataType.BINARY
+        elif p_ndim == 1 and not preds_float:
+            case = DataType.MULTICLASS
+        elif p_ndim > 1 and preds_float:
+            case = DataType.MULTILABEL
+        else:
+            case = DataType.MULTIDIM_MULTICLASS
+
+        implied_classes = int(np.prod(p_shape[1:])) if p_ndim > 1 else 1
+
+    elif p_ndim == t_ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if p_shape[2:] != t_shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+
+        implied_classes = p_shape[1]
+        case = DataType.MULTICLASS if p_ndim == 2 else DataType.MULTIDIM_MULTICLASS
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+
+    return case, implied_classes
+
+
+def _check_num_classes_binary(num_classes: int, is_multiclass: Optional[bool]) -> None:
+    if num_classes > 2:
+        raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+    if num_classes == 2 and not is_multiclass:
+        raise ValueError(
+            "Your data is binary and `num_classes=2`, but `is_multiclass` is not True."
+            " Set it to True if you want to transform binary data to multi-class format."
+        )
+    if num_classes == 1 and is_multiclass:
+        raise ValueError(
+            "You have binary data and have set `is_multiclass=True`, but `num_classes` is 1."
+            " Either set `is_multiclass=None`(default) or set `num_classes=2`"
+            " to transform binary data to multi-class format."
+        )
+
+
+def _check_num_classes_mc(
+    preds_float: bool,
+    probe: Optional[_Probe],
+    num_classes: int,
+    is_multiclass: Optional[bool],
+    implied_classes: int,
+    shapes_equal: bool,
+) -> None:
+    if num_classes == 1 and is_multiclass is not False:
+        raise ValueError(
+            "You have set `num_classes=1`, but predictions are integers."
+            " If you want to convert (multi-dimensional) multi-class data with 2 classes"
+            " to binary/multi-label, set `is_multiclass=False`."
+        )
+    if num_classes > 1:
+        if is_multiclass is False and implied_classes != num_classes:
+            raise ValueError(
+                "You have set `is_multiclass=False`, but the implied number of classes "
+                " (from shape of inputs) does not match `num_classes`. If you are trying to"
+                " transform multi-dim multi-class data with 2 classes to multi-label, `num_classes`"
+                " should be either None or the product of the size of extra dimensions (...)."
+                " See Input Types in Metrics documentation."
+            )
+        if probe is not None and num_classes <= probe.target_max:
+            raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+        if probe is not None and not preds_float and num_classes <= probe.preds_max:
+            raise ValueError("The highest label in `preds` should be smaller than `num_classes`.")
+        if not shapes_equal and num_classes != implied_classes:
+            raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+
+
+def _check_num_classes_ml(num_classes: int, is_multiclass: Optional[bool], implied_classes: int) -> None:
+    if is_multiclass and num_classes != 2:
+        raise ValueError(
+            "Your have set `is_multiclass=True`, but `num_classes` is not equal to 2."
+            " If you are trying to transform multi-label data to 2 class multi-dimensional"
+            " multi-class, you should set `num_classes` to either 2 or None."
+        )
+    if not is_multiclass and num_classes != implied_classes:
+        raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+
+
+def _check_top_k(
+    top_k: int, case: DataType, implied_classes: int, is_multiclass: Optional[bool], preds_float: bool
+) -> None:
+    if case == DataType.BINARY:
+        raise ValueError("You can not use `top_k` parameter with binary data.")
+    if not isinstance(top_k, int) or top_k <= 0:
+        raise ValueError("The `top_k` has to be an integer larger than 0.")
+    if not preds_float:
+        raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+    if is_multiclass is False:
+        raise ValueError("If you set `is_multiclass=False`, you can not set `top_k`.")
+    if case == DataType.MULTILABEL and is_multiclass:
+        raise ValueError(
+            "If you want to transform multi-label data to 2 class multi-dimensional"
+            "multi-class data using `is_multiclass=True`, you can not use `top_k`."
+        )
+    if top_k >= implied_classes:
+        raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+
+def _run_value_checks(
+    probe: _Probe,
+    preds_float: bool,
+    target_float: bool,
+    case: DataType,
+    shapes_equal: bool,
+    implied_classes: int,
+    is_multiclass: Optional[bool],
+) -> None:
+    """Value-level validation from probe scalars (reference ``checks.py:29-57, 81-84, 273-288``)."""
+    if probe.target_min < 0:
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    if not preds_float and probe.preds_min < 0:
+        raise ValueError("If `preds` are integers, they have to be non-negative.")
+    if preds_float and (probe.preds_min < 0 or probe.preds_max > 1):
+        raise ValueError("The `preds` should be probabilities, but values were detected outside of [0,1] range.")
+    if is_multiclass is False and probe.target_max > 1:
+        raise ValueError("If you set `is_multiclass=False`, then `target` should not exceed 1.")
+    if is_multiclass is False and not preds_float and probe.preds_max > 1:
+        raise ValueError("If you set `is_multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
+
+    if shapes_equal and preds_float and probe.target_max > 1:
+        raise ValueError(
+            "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+        )
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and preds_float and not probe.prob_sum_ok:
+        raise ValueError("Probabilities in `preds` must sum up to 1 across the `C` dimension.")
+
+    if not shapes_equal and probe.target_max >= implied_classes:
+        raise ValueError(
+            "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+        )
+
+
+def _check_classification_inputs(
+    preds: jax.Array,
+    target: jax.Array,
+    threshold: float,
+    num_classes: Optional[int],
+    is_multiclass: Optional[bool],
+    top_k: Optional[int],
+    p_shape: Optional[Tuple[int, ...]] = None,
+    t_shape: Optional[Tuple[int, ...]] = None,
+    probe: Optional[_Probe] = None,
+) -> DataType:
+    """Full validation pipeline; returns the detected input case.
+
+    Mirrors reference ``checks.py:207-303``. When ``probe`` is None and the
+    inputs are concrete, a probe is computed internally.
+    """
+    p_shape = p_shape if p_shape is not None else _squeeze_shape(preds.shape)
+    t_shape = t_shape if t_shape is not None else _squeeze_shape(target.shape)
+    preds_float = _is_floating(preds)
+    target_float = _is_floating(target)
+
+    if target_float:
+        raise ValueError("The `target` has to be an integer tensor.")
+    if not 0 < threshold < 1:
+        raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")
+    if (p_shape[0] if p_shape else 0) != (t_shape[0] if t_shape else 0):
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+
+    case, implied_classes = _detect_case(p_shape, t_shape, preds_float)
+    shapes_equal = p_shape == t_shape
+
+    if probe is None and _is_concrete(preds) and _is_concrete(target):
+        check_prob_sum = (
+            case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and preds_float
+        )
+        raw = _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum)
+        probe = _Probe(float(raw[0]), float(raw[1]), int(raw[2]), int(raw[3]), bool(raw[4]))
+
+    if probe is not None:
+        _run_value_checks(probe, preds_float, target_float, case, shapes_equal, implied_classes, is_multiclass)
+
+    if not shapes_equal and is_multiclass is False and implied_classes != 2:
+        raise ValueError(
+            "You have set `is_multiclass=False`, but have more than 2 classes in your data,"
+            " based on the C dimension of `preds`."
+        )
+
+    if num_classes:
+        if case == DataType.BINARY:
+            _check_num_classes_binary(num_classes, is_multiclass)
+        elif case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+            _check_num_classes_mc(preds_float, probe, num_classes, is_multiclass, implied_classes, shapes_equal)
+        elif case == DataType.MULTILABEL:
+            _check_num_classes_ml(num_classes, is_multiclass, implied_classes)
+
+    if top_k is not None:
+        _check_top_k(top_k, case, implied_classes, is_multiclass, preds_float)
+
+    return case
+
+
+@partial(
+    jax.jit,
+    static_argnames=("p_shape", "t_shape", "case", "threshold", "top_k", "num_classes", "is_multiclass"),
+)
+def _canonicalize_jit(preds, target, p_shape, t_shape, case, threshold, top_k, num_classes, is_multiclass):
+    """Fused canonicalizing transform (reference ``checks.py:394-445``), one XLA program."""
+    case = DataType(case) if isinstance(case, str) else case
+    preds = preds.reshape(p_shape)
+    target = target.reshape(t_shape)
+
+    if preds.dtype in (jnp.float16, jnp.bfloat16):
+        preds = preds.astype(jnp.float32)
+
+    if case in (DataType.BINARY, DataType.MULTILABEL) and not top_k:
+        preds = (preds >= threshold).astype(jnp.int32)
+        num_classes = num_classes if not is_multiclass else 2
+
+    if case == DataType.MULTILABEL and top_k:
+        preds = select_topk(preds, top_k)
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or is_multiclass:
+        # dtype re-checked here: the threshold step above may have converted
+        # float preds to ints (reference checks.py:422 relies on the same
+        # lazy re-evaluation)
+        if jnp.issubdtype(preds.dtype, jnp.floating):
+            num_classes = preds.shape[1]
+            preds = select_topk(preds, top_k or 1)
+        else:
+            preds = to_onehot(preds, max(2, int(num_classes)))
+
+        target = to_onehot(target, max(2, int(num_classes)))
+
+        if is_multiclass is False:
+            preds, target = preds[:, 1, ...], target[:, 1, ...]
+
+    if (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and is_multiclass is not False) or is_multiclass:
+        target = target.reshape(target.shape[0], target.shape[1], -1)
+        preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+    else:
+        target = target.reshape(target.shape[0], -1)
+        preds = preds.reshape(preds.shape[0], -1)
+
+    # Some operations above create an extra dimension for MC/binary case - remove it.
+    if preds.ndim > 2 and preds.shape[-1] == 1:
+        preds, target = jnp.squeeze(preds, -1), jnp.squeeze(target, -1)
+
+    return preds.astype(jnp.int32), target.astype(jnp.int32)
+
+
+def _input_format_classification(
+    preds,
+    target,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    is_multiclass: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array, DataType]:
+    """Canonicalize classification inputs to binary ``(N, C)`` or ``(N, C, X)`` int arrays.
+
+    Behavioral parity with reference ``checks.py:306-445`` (see its docstring
+    for the full case table). The transform compiles to a single XLA program
+    per static configuration; validation runs eagerly via the value probe.
+
+    Returns:
+        preds: binary int array ``(N, C)`` or ``(N, C, X)``
+        target: binary int array of the same shape
+        case: the detected :class:`DataType`
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+
+    p_shape = _squeeze_shape(preds.shape)
+    t_shape = _squeeze_shape(target.shape)
+    preds_float = _is_floating(preds)
+
+    concrete = _is_concrete(preds) and _is_concrete(target)
+
+    # Validation (computes the probe when concrete; shape errors always raise).
+    # We recompute the probe here so its values are available for num_classes
+    # inference below.
+    probe = None
+    if concrete:
+        try:
+            case_tmp, _ = _detect_case(p_shape, t_shape, preds_float)
+            check_prob_sum = case_tmp in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and preds_float
+        except ValueError:
+            check_prob_sum = False
+        if not _is_floating(target):
+            raw = _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum)
+            probe = _Probe(float(raw[0]), float(raw[1]), int(raw[2]), int(raw[3]), bool(raw[4]))
+
+    case = _check_classification_inputs(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=num_classes,
+        is_multiclass=is_multiclass,
+        top_k=top_k,
+        p_shape=p_shape,
+        t_shape=t_shape,
+        probe=probe,
+    )
+
+    # Resolve num_classes where the one-hot expansion needs it.
+    nc = num_classes
+    needs_onehot = (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or is_multiclass) and not preds_float
+    if needs_onehot and nc is None:
+        if probe is None:
+            raise ValueError(
+                "`num_classes` is required when label inputs are traced under jit;"
+                " it cannot be inferred from the data maximum."
+            )
+        nc = int(max(probe.preds_max, probe.target_max)) + 1
+
+    preds_c, target_c = _canonicalize_jit(
+        preds,
+        target,
+        p_shape=p_shape,
+        t_shape=t_shape,
+        case=case.value,
+        threshold=float(threshold),
+        top_k=top_k,
+        num_classes=nc,
+        is_multiclass=is_multiclass,
+    )
+    return preds_c, target_c, case
+
+
+def _input_format_classification_one_hot(
+    num_classes: int,
+    preds,
+    target,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Legacy one-hot canonicalization used by dice (reference ``checks.py:448-494``).
+
+    Returns ``(num_classes, -1)``-shaped one-hot preds/target.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+
+    if not (preds.ndim == target.ndim or preds.ndim == target.ndim + 1):
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+
+    return _one_hot_transform_jit(preds, target, num_classes=num_classes, threshold=threshold, multilabel=multilabel)
+
+
+@partial(jax.jit, static_argnames=("num_classes", "threshold", "multilabel"))
+def _one_hot_transform_jit(preds, target, num_classes, threshold, multilabel):
+    if preds.ndim == target.ndim + 1:
+        # multi class probabilities
+        preds = jnp.argmax(preds, axis=1)
+
+    if preds.ndim == target.ndim and jnp.issubdtype(preds.dtype, jnp.integer) and num_classes > 1 and not multilabel:
+        # multi-class
+        preds = to_onehot(preds, num_classes=num_classes)
+        target = to_onehot(target, num_classes=num_classes)
+    elif preds.ndim == target.ndim and jnp.issubdtype(preds.dtype, jnp.floating):
+        # binary or multilabel probabilities
+        preds = (preds >= threshold).astype(jnp.int32)
+
+    # transpose class as first dim and reshape
+    if preds.ndim > 1:
+        preds = jnp.swapaxes(preds, 1, 0)
+        target = jnp.swapaxes(target, 1, 0)
+
+    return preds.reshape(num_classes, -1), target.reshape(num_classes, -1)
+
+
+def _check_retrieval_functional_inputs(preds, target) -> Tuple[jax.Array, jax.Array]:
+    """Validate retrieval preds/target; returns float32 preds and int32 target.
+
+    Parity with reference ``checks.py:497-528`` (error messages preserved).
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+
+    if preds.size == 0 or target.size == 0:
+        raise ValueError("`preds` and `target` must be non-empty")
+
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+
+    if _is_concrete(target) and target.size:
+        tmin, tmax = _min_max_jit(target)
+        if int(tmax) > 1 or int(tmin) < 0:
+            raise ValueError("`target` must be of type `binary`")
+
+    if not _is_floating(preds):
+        raise ValueError("`preds` must be a tensor of floats")
+
+    return preds.astype(jnp.float32), target.astype(jnp.int32)
+
+
+@jax.jit
+def _min_max_jit(x):
+    return jnp.min(x), jnp.max(x)
+
+
+def _check_retrieval_inputs(
+    indexes,
+    preds,
+    target,
+    ignore: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Validate retrieval (indexes, preds, target); parity with ``checks.py:531-565``."""
+    indexes = jnp.asarray(indexes)
+    if ignore is not None:
+        target = jnp.asarray(target)
+        target = target[target != ignore]  # ignore check on values that are ignored
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+
+    if indexes.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+
+    if not jnp.issubdtype(indexes.dtype, jnp.integer) or indexes.dtype == jnp.bool_:
+        raise ValueError("`indexes` must be a tensor of long integers")
+
+    return indexes.astype(jnp.int32), preds, target

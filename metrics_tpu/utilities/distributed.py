@@ -1,0 +1,70 @@
+"""Distributed reduction helpers + host-level gather.
+
+Parity with ``torchmetrics/utilities/distributed.py``:
+
+* ``reduce`` (reference ``:20-40``) and ``class_reduce`` (``:43-88``) are the
+  shared reduction numerics (NaN-to-0 guard included) used by SSIM/PSNR and
+  IoU/dice respectively.
+* ``gather_all_tensors`` (reference ``:91-118``) delegates to the active
+  :class:`~metrics_tpu.parallel.backend.SyncBackend` — multihost allgather
+  over DCN on pods, list-identity on a single process, or an injected
+  strategy in tests.  In-program (jit/shard_map) sync lives in
+  :mod:`metrics_tpu.parallel.collective` instead.
+"""
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.parallel.backend import get_sync_backend
+
+
+def reduce(to_reduce: jax.Array, reduction: str) -> jax.Array:
+    """Reduce an array by a named method: 'elementwise_mean' | 'none' | 'sum'."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(to_reduce)
+    if reduction == "none":
+        return to_reduce
+    if reduction == "sum":
+        return jnp.sum(to_reduce)
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: jax.Array, denom: jax.Array, weights: jax.Array, class_reduction: str = "none") -> jax.Array:
+    """Reduce per-class fractions ``num / denom * weights`` with NaN→0 guard.
+
+    ``class_reduction``: 'micro' | 'macro' | 'weighted' | 'none' | None.
+    """
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    if class_reduction == "micro":
+        fraction = jnp.sum(num) / jnp.sum(denom)
+    else:
+        fraction = num / denom
+
+    # Zero-out NaNs produced by 0-denominator classes.
+    fraction = jnp.where(jnp.isnan(fraction), jnp.zeros_like(fraction), fraction)
+
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        w = weights.astype(jnp.float32)
+        return jnp.sum(fraction * (w / jnp.sum(w)))
+    if class_reduction == "none" or class_reduction is None:
+        return fraction
+
+    raise ValueError(
+        f"Reduction parameter {class_reduction} unknown."
+        f" Choose between one of these: {valid_reduction}"
+    )
+
+
+def gather_all_tensors(result: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
+    """Gather ``result`` from all ranks into a rank-indexed list (identical everywhere).
+
+    Host-level analog of the reference's barrier+all_gather
+    (``distributed.py:104-118``); the collective itself is supplied by the
+    active sync backend.
+    """
+    return get_sync_backend().gather(jnp.asarray(result), group=group)

@@ -1,0 +1,75 @@
+"""String-comparable enums used across the package.
+
+Behavioral parity with the reference's enum layer
+(``torchmetrics/utilities/enums.py:19-83``): case-insensitive string
+comparison, hash by name, and the same taxonomy of input cases and
+averaging methods.
+"""
+from enum import Enum
+from typing import Optional, Union
+
+
+class EnumStr(str, Enum):
+    """String enum whose equality comparison is case-insensitive.
+
+    Example:
+        >>> class MyEnum(EnumStr):
+        ...     ABC = 'abc'
+        >>> MyEnum.from_str('Abc')
+        <MyEnum.ABC: 'abc'>
+        >>> {MyEnum.ABC: 123}
+        {<MyEnum.ABC: 'abc'>: 123}
+    """
+
+    @classmethod
+    def from_str(cls, value: str) -> Optional["EnumStr"]:
+        statuses = [status for status in dir(cls) if not status.startswith("_")]
+        for st in statuses:
+            if st.lower() == value.lower():
+                return getattr(cls, st)
+        return None
+
+    def __eq__(self, other: Union[str, Enum, None]) -> bool:
+        other = other.value if isinstance(other, Enum) else str(other)
+        return self.value.lower() == other.lower()
+
+    def __hash__(self) -> int:
+        return hash(self.name)
+
+
+class DataType(EnumStr):
+    """Classification input case taxonomy.
+
+    >>> "Binary" in list(DataType)
+    True
+    """
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Averaging method for per-class statistics.
+
+    >>> None in list(AverageMethod)
+    True
+    >>> AverageMethod.NONE == None
+    True
+    >>> AverageMethod.NONE == 'none'
+    True
+    """
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = None
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Aggregation over the extra dims of multi-dim multi-class inputs."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"

@@ -1,0 +1,56 @@
+"""Version / availability gating.
+
+Parity with ``torchmetrics/utilities/imports.py:23-68`` — the reference
+gates features on torch versions; we gate on jax/flax instead.
+"""
+import operator
+from importlib import import_module
+from importlib.util import find_spec
+
+
+def _module_available(module_path: str) -> bool:
+    """Check if a module path is importable in this environment.
+
+    >>> _module_available('os')
+    True
+    >>> _module_available('bla.bla')
+    False
+    """
+    try:
+        return find_spec(module_path) is not None
+    except (AttributeError, ModuleNotFoundError, ValueError):
+        return False
+
+
+def _version_tuple(version: str):
+    parts = []
+    for chunk in version.split("."):
+        digits = "".join(ch for ch in chunk if ch.isdigit())
+        if not digits:
+            break
+        parts.append(int(digits))
+    return tuple(parts)
+
+
+def _compare_version(package: str, op, version: str) -> bool:
+    """Compare an installed package's version against a requirement.
+
+    >>> import operator
+    >>> _compare_version("jax", operator.ge, "0.1")
+    True
+    """
+    try:
+        pkg = import_module(package)
+    except ModuleNotFoundError:
+        return False
+    pkg_version = getattr(pkg, "__version__", None)
+    if pkg_version is None:
+        return False
+    return op(_version_tuple(pkg_version), _version_tuple(version))
+
+
+_JAX_AVAILABLE = _module_available("jax")
+_FLAX_AVAILABLE = _module_available("flax")
+_ORBAX_AVAILABLE = _module_available("orbax.checkpoint")
+_JAX_GREATER_EQUAL_0_4 = _compare_version("jax", operator.ge, "0.4.0")
+_PALLAS_AVAILABLE = _module_available("jax.experimental.pallas")

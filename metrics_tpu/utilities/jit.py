@@ -1,0 +1,29 @@
+"""Compilation-cache helpers.
+
+All hot paths in metrics_tpu run under ``jax.jit`` so XLA fuses them and —
+critically for fast cold starts — compiled executables can be served from
+JAX's persistent compilation cache. Call :func:`enable_persistent_cache`
+early (the test suite and ``bench.py`` both do) to make every distinct
+(op, shape) compile a one-time cost across processes.
+"""
+import os
+from typing import Optional
+
+import jax
+
+_ENABLED = False
+
+
+def enable_persistent_cache(path: Optional[str] = None) -> None:
+    """Enable JAX's on-disk compilation cache (idempotent)."""
+    global _ENABLED
+    if _ENABLED:
+        return
+    path = path or os.environ.get("JAX_COMPILATION_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "metrics_tpu_jax_cache"
+    )
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _ENABLED = True

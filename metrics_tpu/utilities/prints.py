@@ -1,0 +1,57 @@
+"""Process-zero-only logging helpers.
+
+Parity with ``torchmetrics/utilities/prints.py:22-49``, but rank
+detection is JAX-native: ``jax.process_index()`` when the JAX
+distributed runtime is up, falling back to the ``LOCAL_RANK`` env var
+so torchrun-style launchers still behave.
+"""
+import logging
+import os
+import warnings
+from functools import wraps
+
+log = logging.getLogger("metrics_tpu")
+
+
+def _get_rank() -> int:
+    try:
+        import jax
+
+        # jax.process_index() is 0 on single-process setups and cheap to call.
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("LOCAL_RANK", 0))
+
+
+def rank_zero_only(fn):
+    @wraps(fn)
+    def wrapped_fn(*args, **kwargs):
+        rank = rank_zero_only.rank
+        if rank is None:
+            # resolved lazily so importing this module never initializes jax
+            rank = rank_zero_only.rank = _get_rank()
+        if rank == 0:
+            return fn(*args, **kwargs)
+
+    return wrapped_fn
+
+
+# LOCAL_RANK (torchrun-style) wins when set; otherwise jax.process_index at first use.
+rank_zero_only.rank = int(os.environ["LOCAL_RANK"]) if "LOCAL_RANK" in os.environ else None
+
+
+def _warn(*args, **kwargs):
+    warnings.warn(*args, **kwargs)
+
+
+def _info(*args, **kwargs):
+    log.info(*args, **kwargs)
+
+
+def _debug(*args, **kwargs):
+    log.debug(*args, **kwargs)
+
+
+rank_zero_debug = rank_zero_only(_debug)
+rank_zero_info = rank_zero_only(_info)
+rank_zero_warn = rank_zero_only(_warn)

@@ -1,0 +1,170 @@
+"""Accuracy tests vs sklearn oracle (mirror of reference ``tests/classification/test_accuracy.py``)."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import accuracy_score as sk_accuracy
+
+from metrics_tpu import Accuracy
+from metrics_tpu.functional import accuracy
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.enums import DataType
+from tests.classification.inputs import _input_binary, _input_binary_prob
+from tests.classification.inputs import _input_multiclass as _input_mcls
+from tests.classification.inputs import _input_multiclass_prob as _input_mcls_prob
+from tests.classification.inputs import _input_multidim_multiclass as _input_mdmc
+from tests.classification.inputs import _input_multidim_multiclass_prob as _input_mdmc_prob
+from tests.classification.inputs import _input_multilabel as _input_mlb
+from tests.classification.inputs import _input_multilabel_multidim as _input_mlmd
+from tests.classification.inputs import _input_multilabel_multidim_prob as _input_mlmd_prob
+from tests.classification.inputs import _input_multilabel_prob as _input_mlb_prob
+from tests.helpers import seed_all
+from tests.helpers.testers import THRESHOLD, MetricTester
+
+seed_all(42)
+
+
+def _sk_accuracy(preds, target, subset_accuracy):
+    sk_preds, sk_target, mode = _input_format_classification(jnp.asarray(preds), jnp.asarray(target), threshold=THRESHOLD)
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+
+    if mode == DataType.MULTIDIM_MULTICLASS and not subset_accuracy:
+        sk_preds, sk_target = np.transpose(sk_preds, (0, 2, 1)), np.transpose(sk_target, (0, 2, 1))
+        sk_preds, sk_target = sk_preds.reshape(-1, sk_preds.shape[2]), sk_target.reshape(-1, sk_target.shape[2])
+    elif mode == DataType.MULTIDIM_MULTICLASS and subset_accuracy:
+        return np.all(sk_preds == sk_target, axis=(1, 2)).mean()
+    elif mode == DataType.MULTILABEL and not subset_accuracy:
+        sk_preds, sk_target = sk_preds.reshape(-1), sk_target.reshape(-1)
+
+    return sk_accuracy(y_true=sk_target, y_pred=sk_preds)
+
+
+@pytest.mark.parametrize(
+    "preds, target, subset_accuracy",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, False),
+        (_input_binary.preds, _input_binary.target, False),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, True),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, False),
+        (_input_mlb.preds, _input_mlb.target, True),
+        (_input_mlb.preds, _input_mlb.target, False),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, False),
+        (_input_mcls.preds, _input_mcls.target, False),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, False),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, True),
+        (_input_mdmc.preds, _input_mdmc.target, False),
+        (_input_mdmc.preds, _input_mdmc.target, True),
+        (_input_mlmd_prob.preds, _input_mlmd_prob.target, True),
+        (_input_mlmd_prob.preds, _input_mlmd_prob.target, False),
+        (_input_mlmd.preds, _input_mlmd.target, True),
+        (_input_mlmd.preds, _input_mlmd.target, False),
+    ],
+)
+class TestAccuracies(MetricTester):
+
+    @pytest.mark.parametrize("ddp", [False, True])
+    @pytest.mark.parametrize("dist_sync_on_step", [False, True])
+    def test_accuracy_class(self, ddp, dist_sync_on_step, preds, target, subset_accuracy):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=Accuracy,
+            sk_metric=partial(_sk_accuracy, subset_accuracy=subset_accuracy),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+        )
+
+    def test_accuracy_fn(self, preds, target, subset_accuracy):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=accuracy,
+            sk_metric=partial(_sk_accuracy, subset_accuracy=subset_accuracy),
+            metric_args={"threshold": THRESHOLD, "subset_accuracy": subset_accuracy},
+        )
+
+
+_l1to4 = [0.1, 0.2, 0.3, 0.4]
+_l1to4t3 = np.array([_l1to4, _l1to4, _l1to4])
+_l1to4t3_mcls = [_l1to4t3.T, _l1to4t3.T, _l1to4t3.T]
+
+# The preds in these examples always put highest probability on class 3, second highest on class 2,
+# third highest on class 1, and lowest on class 0.
+_topk_preds_mcls = np.array([_l1to4t3, _l1to4t3], dtype=np.float32)
+_topk_target_mcls = np.array([[1, 2, 3], [2, 1, 0]])
+
+# Like the MC case, but one sample in each batch is sabotaged with a 0 class prediction.
+_topk_preds_mdmc = np.array([_l1to4t3_mcls, _l1to4t3_mcls], dtype=np.float32)
+_topk_target_mdmc = np.array([[[1, 1, 0], [2, 2, 2], [3, 3, 3]], [[2, 2, 0], [1, 1, 1], [0, 0, 0]]])
+
+
+@pytest.mark.parametrize(
+    "preds, target, exp_result, k, subset_accuracy",
+    [
+        (_topk_preds_mcls, _topk_target_mcls, 1 / 6, 1, False),
+        (_topk_preds_mcls, _topk_target_mcls, 3 / 6, 2, False),
+        (_topk_preds_mcls, _topk_target_mcls, 5 / 6, 3, False),
+        (_topk_preds_mcls, _topk_target_mcls, 1 / 6, 1, True),
+        (_topk_preds_mcls, _topk_target_mcls, 3 / 6, 2, True),
+        (_topk_preds_mcls, _topk_target_mcls, 5 / 6, 3, True),
+        (_topk_preds_mdmc, _topk_target_mdmc, 1 / 6, 1, False),
+        (_topk_preds_mdmc, _topk_target_mdmc, 8 / 18, 2, False),
+        (_topk_preds_mdmc, _topk_target_mdmc, 13 / 18, 3, False),
+        (_topk_preds_mdmc, _topk_target_mdmc, 1 / 6, 1, True),
+        (_topk_preds_mdmc, _topk_target_mdmc, 2 / 6, 2, True),
+        (_topk_preds_mdmc, _topk_target_mdmc, 3 / 6, 3, True),
+    ],
+)
+def test_topk_accuracy(preds, target, exp_result, k, subset_accuracy):
+    topk = Accuracy(top_k=k, subset_accuracy=subset_accuracy)
+
+    for batch in range(preds.shape[0]):
+        topk(jnp.asarray(preds[batch]), jnp.asarray(target[batch]))
+
+    assert topk.compute() == pytest.approx(exp_result)
+
+    total_samples = target.shape[0] * target.shape[1]
+
+    preds = preds.reshape(total_samples, 4, -1)
+    target = target.reshape(total_samples, -1)
+
+    assert accuracy(jnp.asarray(preds).squeeze(), jnp.asarray(target).squeeze(), top_k=k,
+                    subset_accuracy=subset_accuracy) == pytest.approx(exp_result)
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target),
+        (_input_binary.preds, _input_binary.target),
+        (_input_mlb_prob.preds, _input_mlb_prob.target),
+        (_input_mlb.preds, _input_mlb.target),
+        (_input_mcls.preds, _input_mcls.target),
+        (_input_mdmc.preds, _input_mdmc.target),
+        (_input_mlmd_prob.preds, _input_mlmd_prob.target),
+        (_input_mlmd.preds, _input_mlmd.target),
+    ],
+)
+def test_topk_accuracy_wrong_input_types(preds, target):
+    topk = Accuracy(top_k=1)
+
+    with pytest.raises(ValueError):
+        topk(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+
+    with pytest.raises(ValueError):
+        accuracy(jnp.asarray(preds[0]), jnp.asarray(target[0]), top_k=1)
+
+
+@pytest.mark.parametrize("top_k, threshold", [(0, 0.5), (None, 1.5)])
+def test_wrong_params(top_k, threshold):
+    preds, target = _input_mcls_prob.preds, _input_mcls_prob.target
+
+    with pytest.raises(ValueError):
+        acc = Accuracy(threshold=threshold, top_k=top_k)
+        acc(jnp.asarray(preds), jnp.asarray(target))
+        acc.compute()
+
+    with pytest.raises(ValueError):
+        accuracy(jnp.asarray(preds), jnp.asarray(target), threshold=threshold, top_k=top_k)

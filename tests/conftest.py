@@ -1,0 +1,41 @@
+"""Test session setup: force a local 8-device virtual CPU platform.
+
+TPU analog of the reference's 2-process Gloo pool
+(``tests/helpers/testers.py:24-47``): collective/mesh tests run against
+``--xla_force_host_platform_device_count=8`` fake devices in one process;
+real-pod runs are a separate CI tier.
+
+Note: this environment's site hook registers a remote TPU ("axon") backend
+and forces ``jax_platforms="axon,cpu"`` at interpreter start — every op
+would otherwise run through a high-latency tunnel. We override back to the
+local CPU here, which must happen via ``jax.config`` (the env var alone is
+overridden by the site hook).
+"""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Persistent compilation cache: compiled programs are reused across pytest
+# processes (and build rounds), making cold starts cheap.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(os.path.dirname(__file__), "..", ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from metrics_tpu.utilities.jit import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache(os.environ["JAX_COMPILATION_CACHE_DIR"])
+
+
+def _assert_cpu():
+    devs = jax.devices()
+    assert devs[0].platform == "cpu", f"tests must run on local CPU, got {devs}"
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+
+
+_assert_cpu()

@@ -1,0 +1,8 @@
+import random
+
+import numpy as np
+
+
+def seed_all(seed: int = 42) -> None:
+    random.seed(seed)
+    np.random.seed(seed)

@@ -1,0 +1,368 @@
+"""MetricTester harness.
+
+Re-design of the reference's ``tests/helpers/testers.py``: instead of a
+2-process Gloo pool, DDP-style ranks are simulated with **threads running in
+lockstep** — each rank owns a metric replica and processes its interleaved
+share of batches; state sync happens through a barrier-synchronized
+:class:`VirtualDDPGroup` installed as the package's sync backend.  This
+reproduces the reference's SPMD semantics (same-order collective calls,
+identical synced state on every rank) in one process.  The real XLA
+collective path (``lax.psum``/``all_gather`` under ``shard_map``) is covered
+by ``tests/parallel/``.
+"""
+import pickle
+import threading
+from functools import partial
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu import Metric
+from metrics_tpu.parallel.backend import SyncBackend, set_sync_backend
+
+NUM_PROCESSES = 2
+NUM_BATCHES = 10
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+_RANK = threading.local()
+
+
+class VirtualDDPGroup(SyncBackend):
+    """Barrier-synchronized all-gather across simulated ranks (threads).
+
+    Each rank's k-th ``gather`` call writes into slot k and blocks until all
+    ranks contributed, then every rank receives the rank-ordered list —
+    exactly the contract of the reference's ``gather_all_tensors``
+    (``utilities/distributed.py:91-118``).
+    """
+
+    def __init__(self, world_size: int):
+        self._world = world_size
+        self._barrier = threading.Barrier(world_size)
+        self._slots = {}
+        self._counters = {}
+        self._lock = threading.Lock()
+
+    @property
+    def world_size(self) -> int:
+        return self._world
+
+    def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
+        rank = _RANK.rank
+        call_id = self._counters.get(rank, 0)
+        self._counters[rank] = call_id + 1
+        with self._lock:
+            slot = self._slots.setdefault(call_id, [None] * self._world)
+        slot[rank] = x
+        self._barrier.wait()
+        return list(slot)
+
+    def abort(self) -> None:
+        self._barrier.abort()
+
+
+def run_virtual_ddp(world_size: int, fn: Callable, *args: Any, **kwargs: Any) -> None:
+    """Run ``fn(rank, world_size, *args, **kwargs)`` on every simulated rank."""
+    group = VirtualDDPGroup(world_size)
+    set_sync_backend(group)
+    errors: List[Optional[BaseException]] = [None] * world_size
+
+    def worker(rank: int) -> None:
+        _RANK.rank = rank
+        try:
+            fn(rank, world_size, *args, **kwargs)
+        except BaseException as err:  # noqa: BLE001 - re-raised below
+            errors[rank] = err
+            group.abort()
+
+    try:
+        threads = [threading.Thread(target=worker, args=(r,)) for r in range(world_size)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        set_sync_backend(None)
+
+    real = [e for e in errors if e is not None and not isinstance(e, threading.BrokenBarrierError)]
+    if real:
+        raise real[0]
+    broken = [e for e in errors if e is not None]
+    if broken:
+        raise broken[0]
+
+
+def _assert_allclose(result, sk_result, atol: float = 1e-8) -> None:
+    """Recursively assert closeness between metric output and the oracle."""
+    if isinstance(result, (jax.Array, jnp.ndarray)):
+        assert np.allclose(np.asarray(result), np.asarray(sk_result), atol=atol, equal_nan=True), (
+            f"mismatch: {result} vs {sk_result}"
+        )
+    elif isinstance(result, (tuple, list)):
+        for res, sk_res in zip(result, sk_result):
+            _assert_allclose(res, sk_res, atol=atol)
+    else:
+        raise ValueError("Unknown format for comparison")
+
+
+def _assert_array(result) -> None:
+    """Recursively check that a result consists only of jax arrays."""
+    if isinstance(result, (list, tuple)):
+        for res in result:
+            _assert_array(res)
+    else:
+        assert isinstance(result, (jax.Array, jnp.ndarray)), f"not an array: {type(result)}"
+
+
+def _pick(v, i):
+    return jnp.asarray(v[i]) if isinstance(v, np.ndarray) else v
+
+
+def _class_test(
+    rank: int,
+    worldsize: int,
+    preds: np.ndarray,
+    target: np.ndarray,
+    metric_class,
+    sk_metric: Callable,
+    dist_sync_on_step: bool,
+    metric_args: Optional[dict] = None,
+    check_dist_sync_on_step: bool = True,
+    check_batch: bool = True,
+    atol: float = 1e-8,
+    **kwargs_update: Any,
+):
+    """Compare a class metric against an oracle, batch-wise and after aggregation.
+
+    Mirrors reference ``testers.py:72-160``: pickle round-trip, interleaved
+    batch sharding (rank r takes batches ``range(rank, NUM_BATCHES, worldsize)``),
+    per-step value vs oracle (union of ranks' batches when syncing on step,
+    local batch otherwise), and final ``compute()`` vs oracle on all batches.
+    """
+    if not metric_args:
+        metric_args = {}
+
+    metric = metric_class(
+        compute_on_step=check_dist_sync_on_step or check_batch,
+        dist_sync_on_step=dist_sync_on_step,
+        **metric_args,
+    )
+
+    # verify metric works after pickle round-trip
+    pickled_metric = pickle.dumps(metric)
+    metric = pickle.loads(pickled_metric)
+
+    for i in range(rank, NUM_BATCHES, worldsize):
+        batch_kwargs_update = {k: _pick(v, i) for k, v in kwargs_update.items()}
+
+        batch_result = metric(jnp.asarray(preds[i]), jnp.asarray(target[i]), **batch_kwargs_update)
+
+        if metric.dist_sync_on_step and check_dist_sync_on_step and rank == 0:
+            ddp_preds = np.concatenate([preds[i + r] for r in range(worldsize)])
+            ddp_target = np.concatenate([target[i + r] for r in range(worldsize)])
+            ddp_kwargs_upd = {
+                k: np.concatenate([v[i + r] for r in range(worldsize)]) if isinstance(v, np.ndarray) else v
+                for k, v in kwargs_update.items()
+            }
+            sk_batch_result = sk_metric(ddp_preds, ddp_target, **ddp_kwargs_upd)
+            _assert_allclose(batch_result, sk_batch_result, atol=atol)
+        elif check_batch and not metric.dist_sync_on_step:
+            batch_kwargs_np = {k: (v[i] if isinstance(v, np.ndarray) else v) for k, v in kwargs_update.items()}
+            sk_batch_result = sk_metric(preds[i], target[i], **batch_kwargs_np)
+            _assert_allclose(batch_result, sk_batch_result, atol=atol)
+
+    # check on all batches on all ranks
+    result = metric.compute()
+    _assert_array(result)
+
+    total_preds = np.concatenate([preds[i] for i in range(NUM_BATCHES)])
+    total_target = np.concatenate([target[i] for i in range(NUM_BATCHES)])
+    total_kwargs_update = {
+        k: np.concatenate([v[i] for i in range(NUM_BATCHES)]) if isinstance(v, np.ndarray) else v
+        for k, v in kwargs_update.items()
+    }
+    sk_result = sk_metric(total_preds, total_target, **total_kwargs_update)
+
+    _assert_allclose(result, sk_result, atol=atol)
+
+
+def _functional_test(
+    preds: np.ndarray,
+    target: np.ndarray,
+    metric_functional: Callable,
+    sk_metric: Callable,
+    metric_args: Optional[dict] = None,
+    atol: float = 1e-8,
+    **kwargs_update: Any,
+):
+    """Per-batch comparison of a stateless functional against the oracle."""
+    if not metric_args:
+        metric_args = {}
+
+    metric = partial(metric_functional, **metric_args)
+
+    for i in range(NUM_BATCHES):
+        extra_kwargs = {k: _pick(v, i) for k, v in kwargs_update.items()}
+        result = metric(jnp.asarray(preds[i]), jnp.asarray(target[i]), **extra_kwargs)
+        extra_kwargs_np = {k: (v[i] if isinstance(v, np.ndarray) else v) for k, v in kwargs_update.items()}
+        sk_result = sk_metric(preds[i], target[i], **extra_kwargs_np)
+
+        _assert_allclose(result, sk_result, atol=atol)
+
+
+def _assert_half_support(
+    metric_module: Metric,
+    metric_functional: Callable,
+    preds: np.ndarray,
+    target: np.ndarray,
+):
+    """Check a metric accepts half-precision (bfloat16) probability inputs."""
+    y_hat = jnp.asarray(preds[0])
+    y = jnp.asarray(target[0])
+    if jnp.issubdtype(y_hat.dtype, jnp.floating):
+        y_hat = y_hat.astype(jnp.bfloat16)
+    if jnp.issubdtype(y.dtype, jnp.floating):
+        y = y.astype(jnp.bfloat16)
+    _assert_array(metric_module(y_hat, y))
+    _assert_array(metric_functional(y_hat, y))
+
+
+class MetricTester:
+    """Base class for metric test suites (reference ``testers.py:230-401``).
+
+    Subclass and call ``run_class_metric_test`` / ``run_functional_metric_test``
+    inside test methods. DDP mode runs :data:`NUM_PROCESSES` lockstep threads.
+    """
+
+    atol = 1e-8
+
+    def run_functional_metric_test(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_functional: Callable,
+        sk_metric: Callable,
+        metric_args: Optional[dict] = None,
+        **kwargs_update: Any,
+    ):
+        _functional_test(
+            preds=preds,
+            target=target,
+            metric_functional=metric_functional,
+            sk_metric=sk_metric,
+            metric_args=metric_args,
+            atol=self.atol,
+            **kwargs_update,
+        )
+
+    def run_class_metric_test(
+        self,
+        ddp: bool,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_class,
+        sk_metric: Callable,
+        dist_sync_on_step: bool,
+        metric_args: Optional[dict] = None,
+        check_dist_sync_on_step: bool = True,
+        check_batch: bool = True,
+        **kwargs_update: Any,
+    ):
+        if not metric_args:
+            metric_args = {}
+        if ddp:
+            run_virtual_ddp(
+                NUM_PROCESSES,
+                partial(
+                    _class_test,
+                    preds=preds,
+                    target=target,
+                    metric_class=metric_class,
+                    sk_metric=sk_metric,
+                    dist_sync_on_step=dist_sync_on_step,
+                    metric_args=metric_args,
+                    check_dist_sync_on_step=check_dist_sync_on_step,
+                    check_batch=check_batch,
+                    atol=self.atol,
+                    **kwargs_update,
+                ),
+            )
+        else:
+            _class_test(
+                0,
+                1,
+                preds=preds,
+                target=target,
+                metric_class=metric_class,
+                sk_metric=sk_metric,
+                dist_sync_on_step=dist_sync_on_step,
+                metric_args=metric_args,
+                check_dist_sync_on_step=check_dist_sync_on_step,
+                check_batch=check_batch,
+                atol=self.atol,
+                **kwargs_update,
+            )
+
+    def run_precision_test_cpu(
+        self,
+        preds: np.ndarray,
+        target: np.ndarray,
+        metric_module,
+        metric_functional: Callable,
+        metric_args: Optional[dict] = None,
+    ):
+        metric_args = metric_args or {}
+        _assert_half_support(
+            metric_module(**metric_args), partial(metric_functional, **metric_args), preds, target
+        )
+
+
+class DummyMetric(Metric):
+    name = "Dummy"
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("x", jnp.asarray(0.0), dist_reduce_fx=None)
+
+    def update(self):
+        pass
+
+    def compute(self):
+        pass
+
+
+class DummyListMetric(Metric):
+    name = "DummyList"
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("x", list(), dist_reduce_fx=None)
+
+    def update(self):
+        pass
+
+    def compute(self):
+        pass
+
+
+class DummyMetricSum(DummyMetric):
+
+    def update(self, x):
+        self.x = self.x + x
+
+    def compute(self):
+        return self.x
+
+
+class DummyMetricDiff(DummyMetric):
+
+    def update(self, y):
+        self.x = self.x - y
+
+    def compute(self):
+        return self.x
