@@ -17,8 +17,12 @@ from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401, E402
 from metrics_tpu.classification import (  # noqa: F401, E402
     F1,
     Accuracy,
+    CohenKappa,
+    ConfusionMatrix,
     FBeta,
     HammingDistance,
+    IoU,
+    MatthewsCorrcoef,
     Precision,
     Recall,
     StatScores,

@@ -1,0 +1,54 @@
+"""CohenKappa (module). Parity: ``torchmetrics/classification/cohen_kappa.py``."""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.cohen_kappa import _cohen_kappa_compute, _cohen_kappa_update
+from metrics_tpu.metric import Metric
+
+
+class CohenKappa(Metric):
+    r"""Cohen's kappa: inter-annotator agreement corrected for chance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> cohenkappa = CohenKappa(num_classes=2)
+        >>> cohenkappa(preds, target)
+        Array(0.5, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        num_classes: int,
+        weights: Optional[str] = None,
+        threshold: float = 0.5,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+        )
+        self.num_classes = num_classes
+        self.weights = weights
+        self.threshold = threshold
+
+        allowed_weights = ("linear", "quadratic", "none", None)
+        if self.weights not in allowed_weights:
+            raise ValueError(f"Argument weights needs to one of the following: {allowed_weights}")
+
+        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        """Accumulate the batch confusion counts."""
+        confmat = _cohen_kappa_update(preds, target, self.num_classes, self.threshold)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> jax.Array:
+        """Cohen's kappa over all seen batches."""
+        return _cohen_kappa_compute(self.confmat, self.weights)

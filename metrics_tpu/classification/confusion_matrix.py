@@ -1,0 +1,65 @@
+"""ConfusionMatrix (module). Parity: ``torchmetrics/classification/confusion_matrix.py``."""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import (
+    _confusion_matrix_compute,
+    _confusion_matrix_update,
+)
+from metrics_tpu.metric import Metric
+
+
+class ConfusionMatrix(Metric):
+    """Computes the confusion matrix; state is a fixed-shape ``(C, C)`` (or
+    ``(C, 2, 2)`` multilabel) counter — cheap ``psum`` sync.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> confmat = ConfusionMatrix(num_classes=2)
+        >>> confmat(preds, target)
+        Array([[2., 0.],
+               [1., 1.]], dtype=float32)
+    """
+
+    def __init__(
+        self,
+        num_classes: int,
+        normalize: Optional[str] = None,
+        threshold: float = 0.5,
+        multilabel: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+        )
+        self.num_classes = num_classes
+        self.normalize = normalize
+        self.threshold = threshold
+        self.multilabel = multilabel
+
+        allowed_normalize = ("true", "pred", "all", "none", None)
+        assert self.normalize in allowed_normalize, (
+            f"Argument average needs to one of the following: {allowed_normalize}"
+        )
+
+        default = jnp.zeros((num_classes, 2, 2), jnp.int32) if multilabel else jnp.zeros(
+            (num_classes, num_classes), jnp.int32
+        )
+        self.add_state("confmat", default=default, dist_reduce_fx="sum")
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        """Accumulate the batch confusion counts."""
+        confmat = _confusion_matrix_update(preds, target, self.num_classes, self.threshold, self.multilabel)
+        self.confmat = self.confmat + confmat
+
+    def compute(self) -> jax.Array:
+        """Confusion matrix over all seen batches (optionally normalized)."""
+        return _confusion_matrix_compute(self.confmat, self.normalize)

@@ -1,0 +1,98 @@
+"""Confusion matrix (functional). Parity: ``torchmetrics/functional/classification/confusion_matrix.py``.
+
+The count is a static-length ``jnp.bincount`` of ``target * C + preds`` —
+a fixed-shape scatter-add that XLA lowers efficiently (SURVEY §7 step 5).
+"""
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities import rank_zero_warn
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.data import _is_concrete
+from metrics_tpu.utilities.enums import DataType
+
+
+@partial(jax.jit, static_argnames=("num_classes", "multilabel", "argmax_first"))
+def _confmat_count(preds, target, num_classes, multilabel, argmax_first):
+    if argmax_first:
+        preds = jnp.argmax(preds, axis=1)
+        target = jnp.argmax(target, axis=1)
+
+    if multilabel:
+        unique_mapping = ((2 * target + preds) + 4 * jnp.arange(num_classes)).flatten()
+        minlength = 4 * num_classes
+    else:
+        unique_mapping = (target.reshape(-1) * num_classes + preds.reshape(-1)).astype(jnp.int32)
+        minlength = num_classes ** 2
+
+    bins = jnp.bincount(unique_mapping, length=minlength)
+    if multilabel:
+        return bins.reshape(num_classes, 2, 2)
+    return bins.reshape(num_classes, num_classes)
+
+
+def _confusion_matrix_update(
+    preds: jax.Array, target: jax.Array, num_classes: int, threshold: float = 0.5, multilabel: bool = False
+) -> jax.Array:
+    preds, target, mode = _input_format_classification(preds, target, threshold)
+    argmax_first = mode not in (DataType.BINARY, DataType.MULTILABEL)
+    # Fixed-length bincount silently drops out-of-range indices under jit, so
+    # the out-of-range-label error (which torch hits via a reshape failure)
+    # must be raised here in the eager path.
+    if not multilabel and _is_concrete(target):
+        t_lab = jnp.argmax(target, axis=1) if argmax_first else target
+        p_lab = jnp.argmax(preds, axis=1) if argmax_first else preds
+        max_label = max(int(jnp.max(t_lab)), int(jnp.max(p_lab)))
+        if max_label >= num_classes:
+            raise ValueError(
+                f"Detected class label {max_label} which is larger than or equal to"
+                f" `num_classes`={num_classes} in the confusion matrix computation."
+            )
+    return _confmat_count(preds, target, num_classes, multilabel, argmax_first)
+
+
+def _confusion_matrix_compute(confmat: jax.Array, normalize: Optional[str] = None) -> jax.Array:
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    assert normalize in allowed_normalize, f"Argument average needs to one of the following: {allowed_normalize}"
+    confmat = confmat.astype(jnp.float32)
+    if normalize is not None and normalize != "none":
+        if normalize == "true":
+            cm = confmat / jnp.sum(confmat, axis=1, keepdims=True)
+        elif normalize == "pred":
+            cm = confmat / jnp.sum(confmat, axis=0, keepdims=True)
+        elif normalize == "all":
+            cm = confmat / jnp.sum(confmat)
+        nan_elements = int(jnp.sum(jnp.isnan(cm)))
+        if nan_elements != 0:
+            cm = jnp.nan_to_num(cm, nan=0.0)
+            rank_zero_warn(f"{nan_elements} nan values found in confusion matrix have been replaced with zeros.")
+        return cm
+    return confmat
+
+
+def confusion_matrix(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    threshold: float = 0.5,
+    multilabel: bool = False,
+) -> jax.Array:
+    """Computes the confusion matrix; binary/multiclass/multilabel inputs.
+
+    ``normalize``: None | 'true' (over targets) | 'pred' (over predictions) |
+    'all'. For multilabel the result is ``(C, 2, 2)``, else ``(C, C)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> confusion_matrix(preds, target, num_classes=2)
+        Array([[2., 0.],
+               [1., 1.]], dtype=float32)
+    """
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold, multilabel)
+    return _confusion_matrix_compute(confmat, normalize)

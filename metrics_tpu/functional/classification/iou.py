@@ -1,0 +1,54 @@
+"""Intersection over union / Jaccard (functional). Parity: ``torchmetrics/functional/classification/iou.py``."""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+from metrics_tpu.utilities.data import get_num_classes
+from metrics_tpu.utilities.distributed import reduce
+
+
+def _iou_from_confmat(
+    confmat: jax.Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    reduction: str = "elementwise_mean",
+) -> jax.Array:
+    intersection = jnp.diag(confmat)
+    union = jnp.sum(confmat, axis=0) + jnp.sum(confmat, axis=1) - intersection
+
+    # Classes absent from both target AND pred (union == 0) score absent_score.
+    scores = intersection.astype(jnp.float32) / union.astype(jnp.float32)
+    scores = jnp.where(union == 0, absent_score, scores)
+
+    # Remove the ignored class index from the scores.
+    if ignore_index is not None and 0 <= ignore_index < num_classes:
+        scores = jnp.concatenate([scores[:ignore_index], scores[ignore_index + 1:]])
+    return reduce(scores, reduction=reduction)
+
+
+def iou(
+    preds: jax.Array,
+    target: jax.Array,
+    ignore_index: Optional[int] = None,
+    absent_score: float = 0.0,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    reduction: str = "elementwise_mean",
+) -> jax.Array:
+    r"""Intersection over union (Jaccard index) from the confusion matrix.
+
+    ``reduction``: 'elementwise_mean' | 'sum' | 'none'.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> iou(preds, target)
+        Array(0.58333343, dtype=float32)
+    """
+    num_classes = get_num_classes(preds=preds, target=target, num_classes=num_classes)
+    confmat = _confusion_matrix_update(preds, target, num_classes, threshold)
+    return _iou_from_confmat(confmat, num_classes, ignore_index, absent_score, reduction)

@@ -1,0 +1,34 @@
+"""Matthews correlation coefficient (functional). Parity: ``torchmetrics/functional/classification/matthews_corrcoef.py``."""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.confusion_matrix import _confusion_matrix_update
+
+_matthews_corrcoef_update = _confusion_matrix_update
+
+
+def _matthews_corrcoef_compute(confmat: jax.Array) -> jax.Array:
+    tk = jnp.sum(confmat, axis=0).astype(jnp.float32)
+    pk = jnp.sum(confmat, axis=1).astype(jnp.float32)
+    c = jnp.trace(confmat).astype(jnp.float32)
+    s = jnp.sum(confmat).astype(jnp.float32)
+    return (c * s - jnp.sum(tk * pk)) / (jnp.sqrt(s ** 2 - jnp.sum(pk * pk)) * jnp.sqrt(s ** 2 - jnp.sum(tk * tk)))
+
+
+def matthews_corrcoef(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    threshold: float = 0.5,
+) -> jax.Array:
+    r"""Matthews correlation coefficient from the confusion-matrix marginals.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0, 1, 0, 0])
+        >>> matthews_corrcoef(preds, target, num_classes=2)
+        Array(0.5773503, dtype=float32)
+    """
+    confmat = _matthews_corrcoef_update(preds, target, num_classes, threshold)
+    return _matthews_corrcoef_compute(confmat)

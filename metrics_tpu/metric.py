@@ -302,16 +302,10 @@ class Metric(ABC):
         return filtered_kwargs
 
     def __hash__(self) -> int:
-        hash_vals = [self.__class__.__name__]
-        for key in self._defaults:
-            val = getattr(self, key)
-            if isinstance(val, (Array, jnp.ndarray)):
-                hash_vals.append(id(val))
-            elif hasattr(val, "__iter__"):
-                hash_vals.extend(id(v) for v in val)
-            else:
-                hash_vals.append(val)
-        return hash(tuple(hash_vals))
+        # Identity-based: unique per instance (XLA may deduplicate identical
+        # constant state arrays across metrics, so state ids can collide) and
+        # stable across update()/reset() so metrics stay findable in sets/dicts.
+        return hash((self.__class__.__name__, id(self)))
 
     def __repr__(self) -> str:
         return f"{self.__class__.__name__}()"

@@ -1,0 +1,234 @@
+"""Metric arithmetic tests (mirror of reference ``tests/bases/test_composition.py``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.metric import CompositionalMetric
+
+
+class DummyMetric(Metric):
+    def __init__(self, val_to_return):
+        super().__init__()
+        self.add_state("_num_updates", jnp.asarray(0), dist_reduce_fx="sum")
+        self._val_to_return = val_to_return
+
+    def update(self, *args, **kwargs) -> None:
+        self._num_updates = self._num_updates + 1
+
+    def compute(self):
+        return jnp.asarray(self._val_to_return)
+
+
+@pytest.mark.parametrize(
+    ["second_operand", "expected_result"],
+    [(DummyMetric(2), 4), (2, 4), (2.0, 4.0), (jnp.asarray(2), 4)],
+)
+def test_metrics_add(second_operand, expected_result):
+    first_metric = DummyMetric(2)
+    final_add = first_metric + second_operand
+    final_radd = second_operand + first_metric
+
+    assert isinstance(final_add, CompositionalMetric)
+    assert isinstance(final_radd, CompositionalMetric)
+
+    final_add.update()
+    final_radd.update()
+    assert np.allclose(expected_result, final_add.compute())
+    assert np.allclose(expected_result, final_radd.compute())
+
+
+@pytest.mark.parametrize(
+    ["second_operand", "expected_result"], [(DummyMetric(3), 2), (3, 2), (3.0, 2.0)]
+)
+def test_metrics_floordiv(second_operand, expected_result):
+    first_metric = DummyMetric(8)
+    final_floordiv = first_metric // second_operand
+    assert isinstance(final_floordiv, CompositionalMetric)
+    final_floordiv.update()
+    assert np.allclose(expected_result, final_floordiv.compute())
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(DummyMetric(2), 6), (2, 6), (2.0, 6.0)])
+def test_metrics_mul(second_operand, expected_result):
+    first_metric = DummyMetric(3)
+    final_mul = first_metric * second_operand
+    final_rmul = second_operand * first_metric
+    final_mul.update()
+    final_rmul.update()
+    assert np.allclose(expected_result, final_mul.compute())
+    assert np.allclose(expected_result, final_rmul.compute())
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(DummyMetric(2), 1), (2, 1), (2.0, 1.0)])
+def test_metrics_mod(second_operand, expected_result):
+    first_metric = DummyMetric(5)
+    final_mod = first_metric % second_operand
+    final_mod.update()
+    assert np.allclose(expected_result, final_mod.compute())
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(DummyMetric(2), 4), (2, 4), (2.0, 4.0)])
+def test_metrics_pow(second_operand, expected_result):
+    first_metric = DummyMetric(2)
+    final_pow = first_metric ** second_operand
+    final_pow.update()
+    assert np.allclose(expected_result, final_pow.compute())
+
+
+@pytest.mark.parametrize(["first_operand", "expected_result"], [(5, 2), (5.0, 2.0)])
+def test_metrics_rfloordiv(first_operand, expected_result):
+    second_operand = DummyMetric(2)
+    final_rfloordiv = first_operand // second_operand
+    final_rfloordiv.update()
+    assert np.allclose(expected_result, final_rfloordiv.compute())
+
+
+@pytest.mark.parametrize(["first_operand", "expected_result"], [(2, 8), (2.0, 8.0)])
+def test_metrics_rpow(first_operand, expected_result):
+    second_operand = DummyMetric(3)
+    final_rpow = first_operand ** second_operand
+    final_rpow.update()
+    assert np.allclose(expected_result, final_rpow.compute())
+
+
+@pytest.mark.parametrize(["first_operand", "expected_result"], [(3, 1), (3.0, 1.0)])
+def test_metrics_rsub(first_operand, expected_result):
+    second_operand = DummyMetric(2)
+    final_rsub = first_operand - second_operand
+    final_rsub.update()
+    assert np.allclose(expected_result, final_rsub.compute())
+
+
+@pytest.mark.parametrize(["first_operand", "expected_result"], [(6, 2.0), (6.0, 2.0)])
+def test_metrics_rtruediv(first_operand, expected_result):
+    second_operand = DummyMetric(3)
+    final_rtruediv = first_operand / second_operand
+    final_rtruediv.update()
+    assert np.allclose(expected_result, final_rtruediv.compute())
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(DummyMetric(2), 1), (2, 1), (2.0, 1.0)])
+def test_metrics_sub(second_operand, expected_result):
+    first_metric = DummyMetric(3)
+    final_sub = first_metric - second_operand
+    final_sub.update()
+    assert np.allclose(expected_result, final_sub.compute())
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(DummyMetric(3), 2.0), (3, 2.0), (3.0, 2.0)])
+def test_metrics_truediv(second_operand, expected_result):
+    first_metric = DummyMetric(6)
+    final_truediv = first_metric / second_operand
+    final_truediv.update()
+    assert np.allclose(expected_result, final_truediv.compute())
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(DummyMetric(1), 0), (1, 0)])
+def test_metrics_xor(second_operand, expected_result):
+    first_metric = DummyMetric(1)
+    final_xor = first_metric ^ second_operand
+    final_rxor = second_operand ^ first_metric
+    final_xor.update()
+    final_rxor.update()
+    assert np.allclose(expected_result, final_xor.compute())
+    assert np.allclose(expected_result, final_rxor.compute())
+
+
+@pytest.mark.parametrize(["second_operand", "expected_result"], [(DummyMetric(1), 1), (1, 1)])
+def test_metrics_and_or(second_operand, expected_result):
+    first_metric = DummyMetric(1)
+    final_and = first_metric & second_operand
+    final_or = first_metric | second_operand
+    final_and.update()
+    final_or.update()
+    assert np.allclose(expected_result, final_and.compute())
+    assert np.allclose(expected_result, final_or.compute())
+
+
+@pytest.mark.parametrize(
+    ["second_operand", "expected_result"],
+    [(DummyMetric(2), False), (2, False), (2.0, False)],
+)
+def test_metrics_eq_ne(second_operand, expected_result):
+    first_metric = DummyMetric(3)
+    final_eq = first_metric == second_operand
+    final_ne = first_metric != second_operand
+    final_eq.update()
+    final_ne.update()
+    assert bool(final_eq.compute()) == expected_result
+    assert bool(final_ne.compute()) != expected_result
+
+
+@pytest.mark.parametrize(
+    ["second_operand", "expected_result"],
+    [(DummyMetric(2), True), (2, True), (2.0, True)],
+)
+def test_metrics_comparisons(second_operand, expected_result):
+    first_metric = DummyMetric(3)
+    final_gt = first_metric > second_operand
+    final_ge = first_metric >= second_operand
+    final_lt = first_metric < second_operand
+    final_le = first_metric <= second_operand
+    for m in (final_gt, final_ge, final_lt, final_le):
+        m.update()
+    assert bool(final_gt.compute()) is True
+    assert bool(final_ge.compute()) is True
+    assert bool(final_lt.compute()) is False
+    assert bool(final_le.compute()) is False
+
+
+def test_metrics_abs_neg_pos_invert():
+    m = DummyMetric(-2)
+    final_abs = abs(m)
+    final_neg = -m
+    final_pos = +m
+    for f in (final_abs, final_neg, final_pos):
+        f.update()
+    assert np.allclose(2, final_abs.compute())
+    assert np.allclose(-2, final_neg.compute())  # -abs(x)
+    assert np.allclose(2, final_pos.compute())
+
+    b = DummyMetric(1)
+    final_inv = ~b
+    final_inv.update()
+    assert np.allclose(-2, final_inv.compute())  # bitwise_not(1) == -2
+
+
+def test_metrics_matmul():
+    first_metric = DummyMetric([2, 2, 2])
+    second = jnp.asarray([4, 4, 4])
+    final_matmul = first_metric @ second
+    final_matmul.update()
+    assert np.allclose(24, final_matmul.compute())
+
+
+def test_metrics_getitem():
+    first_metric = DummyMetric([1, 2, 3])
+    final_getitem = first_metric[1]
+    final_getitem.update()
+    assert np.allclose(2, final_getitem.compute())
+
+
+def test_compositional_metrics_update():
+    """Composition updates both child metrics with kwargs routing."""
+    compos = DummyMetric(5) + DummyMetric(4)
+
+    assert isinstance(compos, CompositionalMetric)
+    compos.update()
+    compos.update()
+    compos.update()
+
+    assert isinstance(compos.metric_a, DummyMetric)
+    assert isinstance(compos.metric_b, DummyMetric)
+
+    assert compos.metric_a._num_updates == 3
+    assert compos.metric_b._num_updates == 3
+
+
+def test_compositional_reset():
+    compos = DummyMetric(5) + DummyMetric(4)
+    compos.update()
+    compos.reset()
+    assert compos.metric_a._num_updates == 0
+    assert compos.metric_b._num_updates == 0

@@ -1,0 +1,103 @@
+"""Distributed sync semantics (mirror of reference ``tests/bases/test_ddp.py``).
+
+The reference runs 2 Gloo processes; here the same SPMD semantics run as
+lockstep threads against the :class:`VirtualDDPGroup` backend, and the real
+XLA collective path is covered in ``tests/parallel/test_collective.py``.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from tests.helpers import seed_all
+from tests.helpers.testers import DummyMetric, run_virtual_ddp
+
+seed_all(42)
+
+NUM_PROCESSES = 2
+
+
+def _test_ddp_sum(rank: int, worldsize: int):
+    dummy = DummyMetric()
+    dummy._reductions = {"foo": jnp.sum}
+    dummy.foo = jnp.asarray(1)
+    dummy._sync_dist()
+
+    assert dummy.foo == worldsize
+
+
+def _test_ddp_cat(rank: int, worldsize: int):
+    dummy = DummyMetric()
+    dummy._reductions = {"foo": jnp.concatenate}
+    dummy.foo = [jnp.asarray([1.0])]
+    dummy._sync_dist()
+
+    assert np.allclose(np.asarray(dummy.foo), np.asarray([1.0, 1.0]))
+
+
+def _test_ddp_sum_cat(rank: int, worldsize: int):
+    dummy = DummyMetric()
+    dummy._reductions = {"foo": jnp.concatenate, "bar": jnp.sum}
+    dummy.foo = [jnp.asarray([1.0])]
+    dummy.bar = jnp.asarray(1)
+    dummy._sync_dist()
+
+    assert np.allclose(np.asarray(dummy.foo), np.asarray([1.0, 1.0]))
+    assert dummy.bar == worldsize
+
+
+@pytest.mark.parametrize("process", [_test_ddp_cat, _test_ddp_sum, _test_ddp_sum_cat])
+def test_ddp(process):
+    run_virtual_ddp(NUM_PROCESSES, process)
+
+
+def _test_rank_local_values(rank: int, worldsize: int):
+    """Each rank contributes its own value; sync must see rank order."""
+
+    class RankMetric(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("vals", [], dist_reduce_fx=None)
+
+        def update(self, x):
+            self.vals.append(x)
+
+        def compute(self):
+            return self.vals
+
+    m = RankMetric()
+    m.update(jnp.asarray([float(rank)]))
+    out = m.compute()
+    # gathered list states flatten in rank order
+    assert np.allclose(np.concatenate([np.asarray(v) for v in out]), np.arange(worldsize, dtype=float))
+
+
+def test_list_state_rank_order():
+    run_virtual_ddp(NUM_PROCESSES, _test_rank_local_values)
+
+
+def _test_sync_preserves_accumulation(rank: int, worldsize: int):
+    """compute() syncs, but local accumulation continues un-synced after."""
+
+    class SumMetric(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("s", jnp.asarray(0), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.s = self.s + x
+
+        def compute(self):
+            return self.s
+
+    m = SumMetric()
+    m.update(jnp.asarray(1))
+    assert m.compute() == worldsize  # synced: 1 from each rank
+    # local state must be restored to the un-synced value
+    m.update(jnp.asarray(1))
+    m._computed = None
+    assert m.compute() == 2 * worldsize
+
+
+def test_sync_restores_local_state():
+    run_virtual_ddp(NUM_PROCESSES, _test_sync_preserves_accumulation)

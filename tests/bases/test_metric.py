@@ -1,0 +1,233 @@
+"""Core Metric runtime unit tests (mirror of reference ``tests/bases/test_metric.py``)."""
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from tests.helpers import seed_all
+from tests.helpers.testers import DummyListMetric, DummyMetric, DummyMetricDiff, DummyMetricSum
+
+seed_all(42)
+
+
+def test_inherit():
+    DummyMetric()
+
+
+def test_add_state():
+    a = DummyMetric()
+
+    a.add_state("a", jnp.asarray(0), "sum")
+    assert np.allclose(a._reductions["a"](jnp.asarray([1, 1])), 2)
+
+    a.add_state("b", jnp.asarray(0), "mean")
+    assert np.allclose(a._reductions["b"](jnp.asarray([1.0, 2.0])), 1.5)
+
+    a.add_state("c", jnp.asarray(0), "cat")
+    assert a._reductions["c"]([jnp.asarray([1]), jnp.asarray([1])]).shape == (2,)
+
+    with pytest.raises(ValueError):
+        a.add_state("d1", jnp.asarray(0), "xyz")
+
+    with pytest.raises(ValueError):
+        a.add_state("d2", jnp.asarray(0), 42)
+
+    with pytest.raises(ValueError):
+        a.add_state("d3", [jnp.asarray(0)], "sum")
+
+    with pytest.raises(ValueError):
+        a.add_state("d4", 42, "sum")
+
+    def custom_fx(x):
+        return -1
+
+    a.add_state("e", jnp.asarray(0), custom_fx)
+    assert a._reductions["e"](jnp.asarray([1, 1])) == -1
+
+
+def test_add_state_persistent():
+    a = DummyMetric()
+
+    a.add_state("a", jnp.asarray(0), "sum", persistent=True)
+    assert "a" in a.state_dict()
+
+    a.add_state("b", jnp.asarray(0), "sum", persistent=False)
+    assert "b" not in a.state_dict()
+
+
+def test_reset():
+    class A(DummyMetric):
+        pass
+
+    class B(DummyListMetric):
+        pass
+
+    a = A()
+    assert a.x == 0
+    a.x = jnp.asarray(5)
+    a.reset()
+    assert a.x == 0
+
+    b = B()
+    assert isinstance(b.x, list) and len(b.x) == 0
+    b.x = jnp.asarray(5)
+    b.reset()
+    assert isinstance(b.x, list) and len(b.x) == 0
+
+
+def test_reset_compute():
+    a = DummyMetricSum()
+    assert a.x == 0
+    a.update(jnp.asarray(5))
+    assert a.compute() == 5
+    a.reset()
+    assert a.compute() == 0
+
+
+def test_update():
+    class A(DummyMetric):
+        def update(self, x):
+            self.x = self.x + x
+
+    a = A()
+    assert a.x == 0
+    assert a._computed is None
+    a.update(1)
+    assert a._computed is None
+    assert a.x == 1
+    a.update(2)
+    assert a.x == 3
+    assert a._computed is None
+
+
+def test_compute():
+    class A(DummyMetric):
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    a = A()
+    assert a.compute() == 0
+    assert a.x == 0
+    a.update(1)
+    assert a._computed is None
+    assert a.compute() == 1
+    assert a._computed == 1
+    a.update(2)
+    assert a._computed is None
+    assert a.compute() == 3
+    assert a._computed == 3
+
+    # called without update, should return cached value
+    a._computed = 5
+    assert a.compute() == 5
+
+
+def test_hash():
+    b1 = DummyMetric()
+    b2 = DummyMetric()
+    assert hash(b1) != hash(b2)
+
+    m1 = DummyListMetric()
+    m2 = DummyListMetric()
+    assert hash(m1) != hash(m2)
+    assert isinstance(m1.x, list) and len(m1.x) == 0
+    m1.x.append(jnp.asarray(5))
+    hash(m1)  # .x is list of arrays
+
+
+def test_forward():
+    class A(DummyMetric):
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    a = A()
+    assert a(5) == 5
+    assert a._forward_cache == 5
+
+    assert a(8) == 8
+    assert a._forward_cache == 8
+
+    assert a.compute() == 13
+
+
+def test_forward_no_compute_on_step():
+    class A(DummyMetric):
+        def update(self, x):
+            self.x = self.x + x
+
+        def compute(self):
+            return self.x
+
+    a = A()
+    a.compute_on_step = False
+    assert a(5) is None
+    assert a.compute() == 5
+
+
+def test_pickle(tmpdir):
+    a = DummyMetricSum()
+    a.update(1)
+
+    metric_pickled = pickle.dumps(a)
+    metric_loaded = pickle.loads(metric_pickled)
+    assert metric_loaded.compute() == 1
+
+    metric_loaded.update(5)
+    assert metric_loaded.compute() == 6
+
+
+def test_state_dict():
+    """Test that metric states can be removed and added to state dict."""
+    metric = DummyMetric()
+    assert metric.state_dict() == {}
+    metric.persistent(True)
+    assert np.allclose(metric.state_dict()["x"], 0)
+    metric.persistent(False)
+    assert metric.state_dict() == {}
+
+
+def test_load_state_dict():
+    metric = DummyMetricSum()
+    metric.persistent(True)
+    metric.update(5)
+    sd = metric.state_dict()
+
+    metric2 = DummyMetricSum()
+    metric2.load_state_dict(sd)
+    assert metric2.compute() == 5
+
+
+def test_clone():
+    metric = DummyMetricSum()
+    metric.update(5)
+    cloned = metric.clone()
+    assert cloned.compute() == 5
+    cloned.update(2)
+    assert cloned.compute() == 7
+    assert metric.compute() == 5
+
+
+def test_filter_kwargs():
+    class A(DummyMetric):
+        def update(self, x, y):
+            pass
+
+    a = A()
+    assert a._filter_kwargs(x=1, y=2, z=3) == {"x": 1, "y": 2}
+    assert a._filter_kwargs(z=3) == {"z": 3}  # nothing matched -> passthrough
+
+
+def test_child_metric_state_dict():
+    """Metrics nested in containers expose their persistent state with prefixes."""
+    metric = DummyMetric()
+    metric.persistent(True)
+    sd = metric.state_dict(prefix="child.")
+    assert "child.x" in sd

@@ -1,0 +1,130 @@
+"""ConfusionMatrix tests vs sklearn (mirror of reference ``tests/classification/test_confusion_matrix.py``)."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import confusion_matrix as sk_confusion_matrix
+from sklearn.metrics import multilabel_confusion_matrix as sk_multilabel_confusion_matrix
+
+from metrics_tpu import ConfusionMatrix
+from metrics_tpu.functional import confusion_matrix
+from tests.classification.inputs import _input_binary, _input_binary_prob
+from tests.classification.inputs import _input_multiclass as _input_mcls
+from tests.classification.inputs import _input_multiclass_prob as _input_mcls_prob
+from tests.classification.inputs import _input_multidim_multiclass as _input_mdmc
+from tests.classification.inputs import _input_multidim_multiclass_prob as _input_mdmc_prob
+from tests.classification.inputs import _input_multilabel as _input_mlb
+from tests.classification.inputs import _input_multilabel_prob as _input_mlb_prob
+from tests.helpers import seed_all
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+seed_all(42)
+
+
+def _sk_cm_binary_prob(preds, target, normalize=None):
+    sk_preds = (preds.reshape(-1) >= THRESHOLD).astype(np.uint8)
+    sk_target = target.reshape(-1)
+    return sk_confusion_matrix(y_true=sk_target, y_pred=sk_preds, normalize=normalize)
+
+
+def _sk_cm_binary(preds, target, normalize=None):
+    return sk_confusion_matrix(y_true=target.reshape(-1), y_pred=preds.reshape(-1), normalize=normalize)
+
+
+def _normalize_ml_cm(cm, normalize):
+    if normalize is not None:
+        if normalize == "true":
+            cm = cm / cm.sum(axis=1, keepdims=True)
+        elif normalize == "pred":
+            cm = cm / cm.sum(axis=0, keepdims=True)
+        elif normalize == "all":
+            cm = cm / cm.sum()
+        cm[np.isnan(cm)] = 0
+    return cm
+
+
+def _sk_cm_multilabel_prob(preds, target, normalize=None):
+    sk_preds = (preds >= THRESHOLD).astype(np.uint8)
+    cm = sk_multilabel_confusion_matrix(y_true=target, y_pred=sk_preds)
+    return _normalize_ml_cm(cm, normalize)
+
+
+def _sk_cm_multilabel(preds, target, normalize=None):
+    cm = sk_multilabel_confusion_matrix(y_true=target, y_pred=preds)
+    return _normalize_ml_cm(cm, normalize)
+
+
+def _sk_cm_multiclass_prob(preds, target, normalize=None):
+    sk_preds = np.argmax(preds, axis=len(preds.shape) - 1).reshape(-1)
+    return sk_confusion_matrix(y_true=target.reshape(-1), y_pred=sk_preds, normalize=normalize)
+
+
+def _sk_cm_multiclass(preds, target, normalize=None):
+    return sk_confusion_matrix(y_true=target.reshape(-1), y_pred=preds.reshape(-1), normalize=normalize)
+
+
+def _sk_cm_multidim_multiclass_prob(preds, target, normalize=None):
+    sk_preds = np.argmax(preds, axis=len(preds.shape) - 2).reshape(-1)
+    return sk_confusion_matrix(y_true=target.reshape(-1), y_pred=sk_preds, normalize=normalize)
+
+
+def _sk_cm_multidim_multiclass(preds, target, normalize=None):
+    return sk_confusion_matrix(y_true=target.reshape(-1), y_pred=preds.reshape(-1), normalize=normalize)
+
+
+@pytest.mark.parametrize("normalize", ["true", "pred", "all", None])
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes, multilabel",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_cm_binary_prob, 2, False),
+        (_input_binary.preds, _input_binary.target, _sk_cm_binary, 2, False),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, _sk_cm_multilabel_prob, NUM_CLASSES, True),
+        (_input_mlb.preds, _input_mlb.target, _sk_cm_multilabel, NUM_CLASSES, True),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, _sk_cm_multiclass_prob, NUM_CLASSES, False),
+        (_input_mcls.preds, _input_mcls.target, _sk_cm_multiclass, NUM_CLASSES, False),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, _sk_cm_multidim_multiclass_prob, NUM_CLASSES, False),
+        (_input_mdmc.preds, _input_mdmc.target, _sk_cm_multidim_multiclass, NUM_CLASSES, False),
+    ],
+)
+class TestConfusionMatrix(MetricTester):
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_confusion_matrix(self, normalize, preds, target, sk_metric, num_classes, multilabel, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=ConfusionMatrix,
+            sk_metric=partial(sk_metric, normalize=normalize),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={
+                "num_classes": num_classes,
+                "threshold": THRESHOLD,
+                "normalize": normalize,
+                "multilabel": multilabel,
+            },
+        )
+
+    def test_confusion_matrix_functional(self, normalize, preds, target, sk_metric, num_classes, multilabel):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=confusion_matrix,
+            sk_metric=partial(sk_metric, normalize=normalize),
+            metric_args={
+                "num_classes": num_classes,
+                "threshold": THRESHOLD,
+                "normalize": normalize,
+                "multilabel": multilabel,
+            },
+        )
+
+
+def test_warning_on_nan(tmpdir):
+    preds = jnp.asarray(np.random.randint(3, size=20))
+    target = jnp.asarray(np.random.randint(3, size=20))
+
+    with pytest.warns(UserWarning, match=".* nan values found in confusion matrix have been replaced with zeros."):
+        confusion_matrix(preds, target, num_classes=5, normalize="true")

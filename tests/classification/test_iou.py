@@ -1,0 +1,179 @@
+"""IoU tests vs sklearn jaccard_score (mirror of reference ``tests/classification/test_iou.py``)."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import jaccard_score as sk_jaccard_score
+
+from metrics_tpu import IoU
+from metrics_tpu.functional import iou
+from tests.classification.inputs import _input_binary, _input_binary_prob
+from tests.classification.inputs import _input_multiclass as _input_mcls
+from tests.classification.inputs import _input_multiclass_prob as _input_mcls_prob
+from tests.classification.inputs import _input_multidim_multiclass as _input_mdmc
+from tests.classification.inputs import _input_multidim_multiclass_prob as _input_mdmc_prob
+from tests.classification.inputs import _input_multilabel as _input_mlb
+from tests.classification.inputs import _input_multilabel_prob as _input_mlb_prob
+from tests.helpers import seed_all
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+seed_all(42)
+
+
+def _sk_iou_binary_prob(preds, target, average=None):
+    sk_preds = (preds.reshape(-1) >= THRESHOLD).astype(np.uint8)
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=sk_preds, average=average)
+
+
+def _sk_iou_binary(preds, target, average=None):
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=preds.reshape(-1), average=average)
+
+
+def _sk_iou_multilabel_prob(preds, target, average=None):
+    sk_preds = (preds.reshape(-1) >= THRESHOLD).astype(np.uint8)
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=sk_preds, average=average)
+
+
+def _sk_iou_multilabel(preds, target, average=None):
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=preds.reshape(-1), average=average)
+
+
+def _sk_iou_multiclass_prob(preds, target, average=None):
+    sk_preds = np.argmax(preds, axis=len(preds.shape) - 1).reshape(-1)
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=sk_preds, average=average)
+
+
+def _sk_iou_multiclass(preds, target, average=None):
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=preds.reshape(-1), average=average)
+
+
+def _sk_iou_multidim_multiclass_prob(preds, target, average=None):
+    sk_preds = np.argmax(preds, axis=len(preds.shape) - 2).reshape(-1)
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=sk_preds, average=average)
+
+
+def _sk_iou_multidim_multiclass(preds, target, average=None):
+    return sk_jaccard_score(y_true=target.reshape(-1), y_pred=preds.reshape(-1), average=average)
+
+
+@pytest.mark.parametrize("reduction", ["elementwise_mean", "none"])
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_iou_binary_prob, 2),
+        (_input_binary.preds, _input_binary.target, _sk_iou_binary, 2),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, _sk_iou_multilabel_prob, 2),
+        (_input_mlb.preds, _input_mlb.target, _sk_iou_multilabel, 2),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, _sk_iou_multiclass_prob, NUM_CLASSES),
+        (_input_mcls.preds, _input_mcls.target, _sk_iou_multiclass, NUM_CLASSES),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, _sk_iou_multidim_multiclass_prob, NUM_CLASSES),
+        (_input_mdmc.preds, _input_mdmc.target, _sk_iou_multidim_multiclass, NUM_CLASSES),
+    ],
+)
+class TestIoU(MetricTester):
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_iou_class(self, reduction, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        average = "macro" if reduction == "elementwise_mean" else None  # convert tags
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=IoU,
+            sk_metric=partial(sk_metric, average=average),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD, "reduction": reduction},
+        )
+
+    def test_iou_functional(self, reduction, preds, target, sk_metric, num_classes):
+        average = "macro" if reduction == "elementwise_mean" else None  # convert tags
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=iou,
+            sk_metric=partial(sk_metric, average=average),
+            metric_args={"num_classes": num_classes, "threshold": THRESHOLD, "reduction": reduction},
+        )
+
+
+@pytest.mark.parametrize(
+    ["half_ones", "reduction", "ignore_index", "expected"],
+    [
+        (False, "none", None, [1, 1, 1]),
+        (False, "elementwise_mean", None, [1]),
+        (False, "none", 0, [1, 1]),
+        (True, "none", None, [0.5, 0.5, 0.5]),
+        (True, "elementwise_mean", None, [0.5]),
+        (True, "none", 0, [0.5, 0.5]),
+    ],
+)
+def test_iou(half_ones, reduction, ignore_index, expected):
+    preds = (np.arange(120) % 3).reshape(-1, 1)
+    target = (np.arange(120) % 3).reshape(-1, 1)
+    if half_ones:
+        preds[:60] = 1
+    iou_val = iou(
+        preds=jnp.asarray(preds),
+        target=jnp.asarray(target),
+        ignore_index=ignore_index,
+        reduction=reduction,
+    )
+    assert np.allclose(np.asarray(iou_val), np.asarray(expected), atol=1e-9)
+
+
+@pytest.mark.parametrize(
+    ["pred", "target", "ignore_index", "absent_score", "num_classes", "expected"],
+    [
+        # -1 distinguishes the absent score from valid [0, 1] scores
+        ([0], [0], None, -1.0, 2, [1.0, -1.0]),
+        ([0, 0], [0, 0], None, -1.0, 2, [1.0, -1.0]),
+        ([0], [0], None, -1.0, 1, [1.0]),
+        ([1], [1], None, -1.0, 2, [-1.0, 1.0]),
+        ([1, 1], [1, 1], None, -1.0, 2, [-1.0, 1.0]),
+        ([1], [1], 0, -1.0, 2, [1.0]),
+        ([0, 2], [0, 2], None, -1.0, 3, [1.0, -1.0, 1.0]),
+        ([2, 0], [2, 0], None, -1.0, 3, [1.0, -1.0, 1.0]),
+        ([0, 1], [0, 1], None, -1.0, 3, [1.0, 1.0, -1.0]),
+        ([1, 0], [1, 0], None, -1.0, 3, [1.0, 1.0, -1.0]),
+        ([0, 1], [0, 0], None, -1.0, 3, [0.5, 0.0, -1.0]),
+        ([0, 0], [0, 1], None, -1.0, 3, [0.5, 0.0, -1.0]),
+        ([0, 2], [0, 2], None, 1.0, 3, [1.0, 1.0, 1.0]),
+        ([0, 2], [0, 2], 0, 1.0, 3, [1.0, 1.0]),
+    ],
+)
+def test_iou_absent_score(pred, target, ignore_index, absent_score, num_classes, expected):
+    iou_val = iou(
+        preds=jnp.asarray(pred),
+        target=jnp.asarray(target),
+        ignore_index=ignore_index,
+        absent_score=absent_score,
+        num_classes=num_classes,
+        reduction="none",
+    )
+    assert np.allclose(np.asarray(iou_val), np.asarray(expected))
+
+
+@pytest.mark.parametrize(
+    ["pred", "target", "ignore_index", "num_classes", "reduction", "expected"],
+    [
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], None, 3, "none", [1, 1 / 2, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], -1, 3, "none", [1, 1 / 2, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 255, 3, "none", [1, 1 / 2, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 0, 3, "none", [1 / 2, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 1, 3, "none", [1, 2 / 3]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 2, 3, "none", [1, 1 / 2]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 0, 3, "elementwise_mean", [7 / 12]),
+        ([0, 1, 1, 2, 2], [0, 1, 2, 2, 2], 0, 3, "sum", [7 / 6]),
+    ],
+)
+def test_iou_ignore_index(pred, target, ignore_index, num_classes, reduction, expected):
+    iou_val = iou(
+        preds=jnp.asarray(pred),
+        target=jnp.asarray(target),
+        ignore_index=ignore_index,
+        num_classes=num_classes,
+        reduction=reduction,
+    )
+    assert np.allclose(np.asarray(iou_val), np.asarray(expected))

@@ -1,0 +1,273 @@
+"""Precision/Recall tests vs sklearn (mirror of reference ``tests/classification/test_precision_recall.py``)."""
+from functools import partial
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import precision_score, recall_score
+
+from metrics_tpu import Metric, Precision, Recall
+from metrics_tpu.functional import precision, precision_recall, recall
+from metrics_tpu.utilities.checks import _input_format_classification
+from tests.classification.inputs import _input_binary, _input_binary_prob
+from tests.classification.inputs import _input_multiclass as _input_mcls
+from tests.classification.inputs import _input_multiclass_prob as _input_mcls_prob
+from tests.classification.inputs import _input_multidim_multiclass as _input_mdmc
+from tests.classification.inputs import _input_multidim_multiclass_prob as _input_mdmc_prob
+from tests.classification.inputs import _input_multilabel as _input_mlb
+from tests.classification.inputs import _input_multilabel_prob as _input_mlb_prob
+from tests.helpers import seed_all
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+seed_all(42)
+
+
+def _sk_prec_recall(preds, target, sk_fn, num_classes, average, is_multiclass, ignore_index, mdmc_average=None):
+    if average == "none":
+        average = None
+    if num_classes == 1:
+        average = "binary"
+
+    labels = list(range(num_classes))
+    try:
+        labels.remove(ignore_index)
+    except ValueError:
+        pass
+
+    sk_preds, sk_target, _ = _input_format_classification(
+        jnp.asarray(preds), jnp.asarray(target), THRESHOLD, num_classes=num_classes, is_multiclass=is_multiclass
+    )
+    sk_preds, sk_target = np.asarray(sk_preds), np.asarray(sk_target)
+
+    sk_scores = sk_fn(sk_target, sk_preds, average=average, zero_division=0, labels=labels)
+
+    if len(labels) != num_classes and not average:
+        sk_scores = np.insert(sk_scores, ignore_index, np.nan)
+
+    return sk_scores
+
+
+def _sk_prec_recall_multidim_multiclass(
+    preds, target, sk_fn, num_classes, average, is_multiclass, ignore_index, mdmc_average
+):
+    preds, target, _ = _input_format_classification(
+        jnp.asarray(preds), jnp.asarray(target), threshold=THRESHOLD, num_classes=num_classes,
+        is_multiclass=is_multiclass
+    )
+    preds, target = np.asarray(preds), np.asarray(target)
+
+    if mdmc_average == "global":
+        preds = np.transpose(preds, (0, 2, 1)).reshape(-1, preds.shape[1])
+        target = np.transpose(target, (0, 2, 1)).reshape(-1, target.shape[1])
+
+        return _sk_prec_recall(preds, target, sk_fn, num_classes, average, False, ignore_index)
+    if mdmc_average == "samplewise":
+        scores = []
+        for i in range(preds.shape[0]):
+            pred_i = preds[i, ...].T
+            target_i = target[i, ...].T
+            scores_i = _sk_prec_recall(pred_i, target_i, sk_fn, num_classes, average, False, ignore_index)
+            scores.append(np.expand_dims(scores_i, 0))
+
+        return np.concatenate(scores).mean(axis=0)
+
+
+@pytest.mark.parametrize("metric, fn_metric", [(Precision, precision), (Recall, recall)])
+@pytest.mark.parametrize(
+    "average, mdmc_average, num_classes, ignore_index, match_str",
+    [
+        ("wrong", None, None, None, "`average`"),
+        ("micro", "wrong", None, None, "`mdmc"),
+        ("macro", None, None, None, "number of classes"),
+        ("macro", None, 1, 0, "ignore_index"),
+    ],
+)
+def test_wrong_params(metric, fn_metric, average, mdmc_average, num_classes, ignore_index, match_str):
+    with pytest.raises(ValueError, match=match_str):
+        metric(average=average, mdmc_average=mdmc_average, num_classes=num_classes, ignore_index=ignore_index)
+
+    with pytest.raises(ValueError, match=match_str):
+        fn_metric(
+            jnp.asarray(_input_binary.preds[0]),
+            jnp.asarray(_input_binary.target[0]),
+            average=average,
+            mdmc_average=mdmc_average,
+            num_classes=num_classes,
+            ignore_index=ignore_index,
+        )
+
+    with pytest.raises(ValueError, match=match_str):
+        precision_recall(
+            jnp.asarray(_input_binary.preds[0]),
+            jnp.asarray(_input_binary.target[0]),
+            average=average,
+            mdmc_average=mdmc_average,
+            num_classes=num_classes,
+            ignore_index=ignore_index,
+        )
+
+
+@pytest.mark.parametrize("metric_class, metric_fn", [(Recall, recall), (Precision, precision)])
+def test_zero_division(metric_class, metric_fn):
+    """Zero-division classes score 0."""
+    preds = jnp.asarray([1, 2, 1, 1])
+    target = jnp.asarray([2, 1, 2, 1])
+
+    cl_metric = metric_class(average="none", num_classes=3)
+    cl_metric(preds, target)
+
+    result_cl = cl_metric.compute()
+    result_fn = metric_fn(preds, target, average="none", num_classes=3)
+
+    assert result_cl[0] == result_fn[0] == 0
+
+
+@pytest.mark.parametrize("metric_class, metric_fn", [(Recall, recall), (Precision, precision)])
+def test_no_support(metric_class, metric_fn):
+    """Only present class ignored + average='weighted': sum of weights is 0 -> score 0."""
+    preds = jnp.asarray([1, 1, 0, 0])
+    target = jnp.asarray([0, 0, 0, 0])
+
+    cl_metric = metric_class(average="weighted", num_classes=2, ignore_index=0)
+    cl_metric(preds, target)
+
+    result_cl = cl_metric.compute()
+    result_fn = metric_fn(preds, target, average="weighted", num_classes=2, ignore_index=0)
+
+    assert result_cl == result_fn == 0
+
+
+@pytest.mark.parametrize(
+    "metric_class, metric_fn, sk_fn", [(Recall, recall, recall_score), (Precision, precision, precision_score)]
+)
+@pytest.mark.parametrize("average", ["micro", "macro", None, "weighted", "samples"])
+@pytest.mark.parametrize("ignore_index", [None, 0])
+@pytest.mark.parametrize(
+    "preds, target, num_classes, is_multiclass, mdmc_average, sk_wrapper",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, 1, None, None, _sk_prec_recall),
+        (_input_binary.preds, _input_binary.target, 1, False, None, _sk_prec_recall),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, NUM_CLASSES, None, None, _sk_prec_recall),
+        (_input_mlb.preds, _input_mlb.target, NUM_CLASSES, False, None, _sk_prec_recall),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, NUM_CLASSES, None, None, _sk_prec_recall),
+        (_input_mcls.preds, _input_mcls.target, NUM_CLASSES, None, None, _sk_prec_recall),
+        (_input_mdmc.preds, _input_mdmc.target, NUM_CLASSES, None, "global", _sk_prec_recall_multidim_multiclass),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, NUM_CLASSES, None, "global",
+         _sk_prec_recall_multidim_multiclass),
+        (_input_mdmc.preds, _input_mdmc.target, NUM_CLASSES, None, "samplewise", _sk_prec_recall_multidim_multiclass),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, NUM_CLASSES, None, "samplewise",
+         _sk_prec_recall_multidim_multiclass),
+    ],
+)
+class TestPrecisionRecall(MetricTester):
+
+    @pytest.mark.parametrize("ddp", [False])
+    @pytest.mark.parametrize("dist_sync_on_step", [False])
+    def test_precision_recall_class(
+        self,
+        ddp: bool,
+        dist_sync_on_step: bool,
+        preds,
+        target,
+        sk_wrapper: Callable,
+        metric_class: Metric,
+        metric_fn: Callable,
+        sk_fn: Callable,
+        is_multiclass: Optional[bool],
+        num_classes: Optional[int],
+        average: str,
+        mdmc_average: Optional[str],
+        ignore_index: Optional[int],
+    ):
+        if num_classes == 1 and average != "micro":
+            pytest.skip("Only test binary data for 'micro' avg (equivalent of 'binary' in sklearn)")
+
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("Skipping ignore_index test with binary inputs.")
+
+        if average == "weighted" and ignore_index is not None and mdmc_average is not None:
+            pytest.skip("Ignore special case where we are ignoring entire sample for 'weighted' average")
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=metric_class,
+            sk_metric=partial(
+                sk_wrapper,
+                sk_fn=sk_fn,
+                average=average,
+                num_classes=num_classes,
+                is_multiclass=is_multiclass,
+                ignore_index=ignore_index,
+                mdmc_average=mdmc_average,
+            ),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "is_multiclass": is_multiclass,
+                "ignore_index": ignore_index,
+                "mdmc_average": mdmc_average,
+            },
+            check_dist_sync_on_step=True,
+            check_batch=True,
+        )
+
+    def test_precision_recall_fn(
+        self,
+        preds,
+        target,
+        sk_wrapper: Callable,
+        metric_class: Metric,
+        metric_fn: Callable,
+        sk_fn: Callable,
+        is_multiclass: Optional[bool],
+        num_classes: Optional[int],
+        average: str,
+        mdmc_average: Optional[str],
+        ignore_index: Optional[int],
+    ):
+        if num_classes == 1 and average != "micro":
+            pytest.skip("Only test binary data for 'micro' avg (equivalent of 'binary' in sklearn)")
+
+        if ignore_index is not None and preds.ndim == 2:
+            pytest.skip("Skipping ignore_index test with binary inputs.")
+
+        if average == "weighted" and ignore_index is not None and mdmc_average is not None:
+            pytest.skip("Ignore special case where we are ignoring entire sample for 'weighted' average")
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=metric_fn,
+            sk_metric=partial(
+                sk_wrapper,
+                sk_fn=sk_fn,
+                average=average,
+                num_classes=num_classes,
+                is_multiclass=is_multiclass,
+                ignore_index=ignore_index,
+                mdmc_average=mdmc_average,
+            ),
+            metric_args={
+                "num_classes": num_classes,
+                "average": average,
+                "threshold": THRESHOLD,
+                "is_multiclass": is_multiclass,
+                "ignore_index": ignore_index,
+                "mdmc_average": mdmc_average,
+            },
+        )
+
+
+def test_precision_recall_joint():
+    """precision_recall returns the same as the individual functionals."""
+    preds = jnp.asarray(_input_mcls_prob.preds[0])
+    target = jnp.asarray(_input_mcls_prob.target[0])
+
+    prec, rec = precision_recall(preds, target, average="macro", num_classes=NUM_CLASSES)
+    assert np.allclose(prec, precision(preds, target, average="macro", num_classes=NUM_CLASSES))
+    assert np.allclose(rec, recall(preds, target, average="macro", num_classes=NUM_CLASSES))
