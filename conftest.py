@@ -1,0 +1,14 @@
+"""Root pytest setup so doctest runs (``--doctest-modules metrics_tpu``)
+use the same deterministic local-CPU platform as the test suite
+(see ``tests/conftest.py`` for the rationale)."""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+collect_ignore = ["setup.py"]

@@ -27,3 +27,12 @@ from metrics_tpu.classification import (  # noqa: F401, E402
     Recall,
     StatScores,
 )
+from metrics_tpu.regression import (  # noqa: F401, E402
+    PSNR,
+    SSIM,
+    ExplainedVariance,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    R2Score,
+)

@@ -7,3 +7,11 @@ from metrics_tpu.functional.classification.iou import iou  # noqa: F401
 from metrics_tpu.functional.classification.matthews_corrcoef import matthews_corrcoef  # noqa: F401
 from metrics_tpu.functional.classification.precision_recall import precision, precision_recall, recall  # noqa: F401
 from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
+from metrics_tpu.functional.regression.explained_variance import explained_variance  # noqa: F401
+from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error  # noqa: F401
+from metrics_tpu.functional.regression.mean_relative_error import mean_relative_error  # noqa: F401
+from metrics_tpu.functional.regression.mean_squared_error import mean_squared_error  # noqa: F401
+from metrics_tpu.functional.regression.mean_squared_log_error import mean_squared_log_error  # noqa: F401
+from metrics_tpu.functional.regression.psnr import psnr  # noqa: F401
+from metrics_tpu.functional.regression.r2score import r2score  # noqa: F401
+from metrics_tpu.functional.regression.ssim import ssim  # noqa: F401

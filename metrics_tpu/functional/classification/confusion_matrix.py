@@ -34,18 +34,24 @@ def _confmat_count(preds, target, num_classes, multilabel, argmax_first):
     return bins.reshape(num_classes, num_classes)
 
 
+@partial(jax.jit, static_argnames=("argmax_first",))
+def _max_label_probe(preds, target, argmax_first):
+    if argmax_first:
+        preds = jnp.argmax(preds, axis=1)
+        target = jnp.argmax(target, axis=1)
+    return jnp.maximum(jnp.max(preds), jnp.max(target))
+
+
 def _confusion_matrix_update(
     preds: jax.Array, target: jax.Array, num_classes: int, threshold: float = 0.5, multilabel: bool = False
 ) -> jax.Array:
-    preds, target, mode = _input_format_classification(preds, target, threshold)
+    preds, target, mode = _input_format_classification(preds, target, threshold, _num_classes_hint=num_classes)
     argmax_first = mode not in (DataType.BINARY, DataType.MULTILABEL)
     # Fixed-length bincount silently drops out-of-range indices under jit, so
     # the out-of-range-label error (which torch hits via a reshape failure)
-    # must be raised here in the eager path.
+    # must be raised here in the eager path — one fused probe, one host read.
     if not multilabel and _is_concrete(target):
-        t_lab = jnp.argmax(target, axis=1) if argmax_first else target
-        p_lab = jnp.argmax(preds, axis=1) if argmax_first else preds
-        max_label = max(int(jnp.max(t_lab)), int(jnp.max(p_lab)))
+        max_label = int(_max_label_probe(preds, target, argmax_first))
         if max_label >= num_classes:
             raise ValueError(
                 f"Detected class label {max_label} which is larger than or equal to"
@@ -65,10 +71,13 @@ def _confusion_matrix_compute(confmat: jax.Array, normalize: Optional[str] = Non
             cm = confmat / jnp.sum(confmat, axis=0, keepdims=True)
         elif normalize == "all":
             cm = confmat / jnp.sum(confmat)
-        nan_elements = int(jnp.sum(jnp.isnan(cm)))
-        if nan_elements != 0:
-            cm = jnp.nan_to_num(cm, nan=0.0)
-            rank_zero_warn(f"{nan_elements} nan values found in confusion matrix have been replaced with zeros.")
+        if _is_concrete(cm):
+            nan_elements = int(jnp.sum(jnp.isnan(cm)))
+            if nan_elements != 0:
+                rank_zero_warn(f"{nan_elements} nan values found in confusion matrix have been replaced with zeros.")
+        # unconditional so the replacement also happens under jit (where the
+        # count cannot be read back for the warning)
+        cm = jnp.nan_to_num(cm, nan=0.0)
         return cm
     return confmat
 

@@ -1,0 +1,8 @@
+from metrics_tpu.functional.regression.explained_variance import explained_variance  # noqa: F401
+from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error  # noqa: F401
+from metrics_tpu.functional.regression.mean_relative_error import mean_relative_error  # noqa: F401
+from metrics_tpu.functional.regression.mean_squared_error import mean_squared_error  # noqa: F401
+from metrics_tpu.functional.regression.mean_squared_log_error import mean_squared_log_error  # noqa: F401
+from metrics_tpu.functional.regression.psnr import psnr  # noqa: F401
+from metrics_tpu.functional.regression.r2score import r2score  # noqa: F401
+from metrics_tpu.functional.regression.ssim import ssim  # noqa: F401

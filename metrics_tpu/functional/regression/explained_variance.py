@@ -1,0 +1,102 @@
+"""Explained variance. Parity: ``torchmetrics/functional/regression/explained_variance.py``.
+
+State is the 5-moment-accumulator design of the reference
+(``regression/explained_variance.py:101-105``) so sync is a cheap ``psum``;
+the masked in-place writes of ``_explained_variance_compute`` become nested
+``jnp.where`` selects (same zero-division semantics, jit-safe).
+"""
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+
+def _explained_variance_update(
+    preds: jax.Array, target: jax.Array
+) -> Tuple[int, jax.Array, jax.Array, jax.Array, jax.Array]:
+    _check_same_shape(preds, target)
+
+    n_obs = preds.shape[0]
+    diff = target - preds
+    sum_error = jnp.sum(diff, axis=0)
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+
+    sum_target = jnp.sum(target, axis=0)
+    sum_squared_target = jnp.sum(target * target, axis=0)
+
+    return n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target
+
+
+def _explained_variance_compute(
+    n_obs,
+    sum_error: jax.Array,
+    sum_squared_error: jax.Array,
+    sum_target: jax.Array,
+    sum_squared_target: jax.Array,
+    multioutput: str = "uniform_average",
+) -> Union[jax.Array, Sequence[jax.Array]]:
+    diff_avg = sum_error / n_obs
+    numerator = sum_squared_error / n_obs - diff_avg * diff_avg
+
+    target_avg = sum_target / n_obs
+    denominator = sum_squared_target / n_obs - target_avg * target_avg
+
+    # zero-division conventions of the reference: num==0 -> 1, den==0 -> 0
+    nonzero_numerator = numerator != 0
+    nonzero_denominator = denominator != 0
+    safe_den = jnp.where(nonzero_denominator, denominator, jnp.ones_like(denominator))
+    output_scores = jnp.where(
+        nonzero_numerator & nonzero_denominator,
+        1.0 - numerator / safe_den,
+        jnp.where(nonzero_numerator & ~nonzero_denominator, 0.0, 1.0),
+    )
+
+    if multioutput == "raw_values":
+        return output_scores
+    if multioutput == "uniform_average":
+        return jnp.mean(output_scores)
+    if multioutput == "variance_weighted":
+        denom_sum = jnp.sum(denominator)
+        return jnp.sum(denominator / denom_sum * output_scores)
+    raise ValueError(
+        "Argument `multioutput` must be either `raw_values`,"
+        f" `uniform_average` or `variance_weighted`. Received {multioutput}."
+    )
+
+
+def explained_variance(
+    preds: jax.Array,
+    target: jax.Array,
+    multioutput: str = "uniform_average",
+) -> Union[jax.Array, Sequence[jax.Array]]:
+    """Computes explained variance.
+
+    Args:
+        preds: estimated labels
+        target: ground truth labels
+        multioutput: one of ``'raw_values'``, ``'uniform_average'`` (default),
+            ``'variance_weighted'``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3., -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> explained_variance(preds, target)
+        Array(0.95717347, dtype=float32)
+
+        >>> target = jnp.array([[0.5, 1], [-1, 1], [7, -6]])
+        >>> preds = jnp.array([[0., 2], [-1, 2], [8, -5]])
+        >>> explained_variance(preds, target, multioutput='raw_values')
+        Array([0.96774197, 1.        ], dtype=float32)
+    """
+    n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
+    return _explained_variance_compute(
+        n_obs,
+        sum_error,
+        sum_squared_error,
+        sum_target,
+        sum_squared_target,
+        multioutput,
+    )

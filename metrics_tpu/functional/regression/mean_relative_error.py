@@ -1,0 +1,43 @@
+"""Mean relative error. Parity: ``torchmetrics/functional/regression/mean_relative_error.py``.
+
+The reference guards zero denominators by an in-place masked write
+(``mean_relative_error.py:22-29``); JAX arrays are immutable so the guard is a
+``jnp.where`` — identical semantics, and XLA fuses it into the elementwise
+kernel.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+
+def _mean_relative_error_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, int]:
+    _check_same_shape(preds, target)
+    target_nz = jnp.where(target == 0, jnp.ones_like(target), target)
+    sum_rltv_error = jnp.sum(jnp.abs((preds - target) / target_nz))
+    n_obs = target.size
+    return sum_rltv_error, n_obs
+
+
+def _mean_relative_error_compute(sum_rltv_error: jax.Array, n_obs) -> jax.Array:
+    return sum_rltv_error / n_obs
+
+
+def mean_relative_error(preds: jax.Array, target: jax.Array) -> jax.Array:
+    """Computes mean relative error.
+
+    Args:
+        preds: estimated labels
+        target: ground truth labels
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0., 1, 2, 3])
+        >>> y = jnp.array([0., 1, 2, 2])
+        >>> mean_relative_error(x, y)
+        Array(0.125, dtype=float32)
+    """
+    sum_rltv_error, n_obs = _mean_relative_error_update(preds, target)
+    return _mean_relative_error_compute(sum_rltv_error, n_obs)

@@ -1,0 +1,37 @@
+"""Mean squared error. Parity: ``torchmetrics/functional/regression/mean_squared_error.py``."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+
+
+def _mean_squared_error_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, int]:
+    _check_same_shape(preds, target)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff)
+    n_obs = target.size
+    return sum_squared_error, n_obs
+
+
+def _mean_squared_error_compute(sum_squared_error: jax.Array, n_obs) -> jax.Array:
+    return sum_squared_error / n_obs
+
+
+def mean_squared_error(preds: jax.Array, target: jax.Array) -> jax.Array:
+    """Computes mean squared error.
+
+    Args:
+        preds: estimated labels
+        target: ground truth labels
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0., 1, 2, 3])
+        >>> y = jnp.array([0., 1, 2, 2])
+        >>> mean_squared_error(x, y)
+        Array(0.25, dtype=float32)
+    """
+    sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
+    return _mean_squared_error_compute(sum_squared_error, n_obs)

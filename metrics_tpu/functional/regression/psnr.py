@@ -1,0 +1,84 @@
+"""Peak signal-to-noise ratio. Parity: ``torchmetrics/functional/regression/psnr.py``."""
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.distributed import reduce
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+def _psnr_compute(
+    sum_squared_error: jax.Array,
+    n_obs: jax.Array,
+    data_range: jax.Array,
+    base: float = 10.0,
+    reduction: str = "elementwise_mean",
+) -> jax.Array:
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / n_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(jnp.asarray(base)))
+    return reduce(psnr_vals, reduction=reduction)
+
+
+def _psnr_update(
+    preds: jax.Array,
+    target: jax.Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    if dim is None:
+        sum_squared_error = jnp.sum((preds - target) ** 2)
+        n_obs = jnp.asarray(target.size)
+        return sum_squared_error, n_obs
+
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    if not dim_list:
+        n_obs = jnp.asarray(target.size)
+    else:
+        n_obs = 1
+        for d in dim_list:
+            n_obs *= target.shape[d]
+        n_obs = jnp.broadcast_to(jnp.asarray(n_obs), sum_squared_error.shape)
+
+    return sum_squared_error, n_obs
+
+
+def psnr(
+    preds: jax.Array,
+    target: jax.Array,
+    data_range: Optional[float] = None,
+    base: float = 10.0,
+    reduction: str = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> jax.Array:
+    """Computes the peak signal-to-noise ratio.
+
+    Args:
+        preds: estimated signal
+        target: ground truth signal
+        data_range: the range of the data. If None, determined from the data
+            (max - min); must be given when ``dim`` is not None.
+        base: a base of a logarithm to use.
+        reduction: ``'elementwise_mean'`` | ``'sum'`` | ``'none'``.
+        dim: dimensions to reduce PSNR scores over; None reduces over all.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
+        >>> psnr(pred, target)
+        Array(2.552725, dtype=float32)
+    """
+    if dim is None and reduction != "elementwise_mean":
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range = jnp.max(target) - jnp.min(target)
+    else:
+        data_range = jnp.asarray(float(data_range))
+    sum_squared_error, n_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, n_obs, data_range, base=base, reduction=reduction)

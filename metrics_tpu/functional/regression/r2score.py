@@ -1,0 +1,103 @@
+"""R2 score. Parity: ``torchmetrics/functional/regression/r2score.py``."""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+def _r2score_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array, int]:
+    _check_same_shape(preds, target)
+    if preds.ndim > 2:
+        raise ValueError(
+            "Expected both prediction and target to be 1D or 2D tensors,"
+            f" but received tensors with dimension {preds.shape}"
+        )
+    if preds.shape[0] < 2:
+        raise ValueError("Needs at least two samples to calculate r2 score.")
+
+    sum_error = jnp.sum(target, axis=0)
+    sum_squared_error = jnp.sum(target * target, axis=0)
+    diff = target - preds
+    residual = jnp.sum(diff * diff, axis=0)
+    total = target.shape[0]
+
+    return sum_squared_error, sum_error, residual, total
+
+
+def _r2score_compute(
+    sum_squared_error: jax.Array,
+    sum_error: jax.Array,
+    residual: jax.Array,
+    total,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> jax.Array:
+    mean_error = sum_error / total
+    diff = sum_squared_error - sum_error * mean_error
+    raw_scores = 1 - (residual / diff)
+
+    if multioutput == "raw_values":
+        r2score = raw_scores
+    elif multioutput == "uniform_average":
+        r2score = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        diff_sum = jnp.sum(diff)
+        r2score = jnp.sum(diff / diff_sum * raw_scores)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`,"
+            f" `uniform_average` or `variance_weighted`. Received {multioutput}."
+        )
+
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+
+    if adjusted != 0:
+        total = int(total)
+        if adjusted > total - 1:
+            rank_zero_warn(
+                "More independent regressions than data points in"
+                " adjusted r2 score. Falls back to standard r2 score.",
+                UserWarning,
+            )
+        elif adjusted == total - 1:
+            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+        else:
+            r2score = 1 - (1 - r2score) * (total - 1) / (total - adjusted - 1)
+    return r2score
+
+
+def r2score(
+    preds: jax.Array,
+    target: jax.Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> jax.Array:
+    r"""Computes r2 score (coefficient of determination):
+
+    .. math:: R^2 = 1 - \frac{SS_{res}}{SS_{tot}}
+
+    Args:
+        preds: estimated labels
+        target: ground truth labels
+        adjusted: number of independent regressors for the adjusted score.
+        multioutput: one of ``'raw_values'``, ``'uniform_average'`` (default),
+            ``'variance_weighted'``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3., -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> r2score(preds, target)
+        Array(0.94860816, dtype=float32)
+
+        >>> target = jnp.array([[0.5, 1], [-1, 1], [7, -6]])
+        >>> preds = jnp.array([[0., 2], [-1, 2], [8, -5]])
+        >>> r2score(preds, target, multioutput='raw_values')
+        Array([0.96543777, 0.90816325], dtype=float32)
+    """
+    sum_squared_error, sum_error, residual, total = _r2score_update(preds, target)
+    return _r2score_compute(sum_squared_error, sum_error, residual, total, adjusted, multioutput)

@@ -1,0 +1,7 @@
+from metrics_tpu.regression.explained_variance import ExplainedVariance  # noqa: F401
+from metrics_tpu.regression.mean_absolute_error import MeanAbsoluteError  # noqa: F401
+from metrics_tpu.regression.mean_squared_error import MeanSquaredError  # noqa: F401
+from metrics_tpu.regression.mean_squared_log_error import MeanSquaredLogError  # noqa: F401
+from metrics_tpu.regression.psnr import PSNR  # noqa: F401
+from metrics_tpu.regression.r2score import R2Score  # noqa: F401
+from metrics_tpu.regression.ssim import SSIM  # noqa: F401

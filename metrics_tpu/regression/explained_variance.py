@@ -1,0 +1,89 @@
+"""ExplainedVariance (module). Parity: ``torchmetrics/regression/explained_variance.py``.
+
+State is the 5-moment-accumulator design (reference ``:101-105``) so sync is a
+cheap ``psum`` regardless of dataset size.
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.explained_variance import (
+    _explained_variance_compute,
+    _explained_variance_update,
+)
+from metrics_tpu.metric import Metric
+
+
+class ExplainedVariance(Metric):
+    r"""Computes explained variance:
+
+    .. math:: \text{ExplainedVariance} = 1 - \frac{\text{Var}(y - \hat{y})}{\text{Var}(y)}
+
+    Args:
+        multioutput: one of ``'raw_values'``, ``'uniform_average'`` (default),
+            ``'variance_weighted'``.
+        compute_on_step: forward only calls ``update()`` and returns None if False.
+        dist_sync_on_step: sync state across processes at each ``forward()``.
+        process_group: scope of synchronization.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([3., -0.5, 2, 7])
+        >>> preds = jnp.array([2.5, 0.0, 2, 8])
+        >>> explained_variance = ExplainedVariance()
+        >>> explained_variance(preds, target)
+        Array(0.95717347, dtype=float32)
+
+        >>> target = jnp.array([[0.5, 1], [-1, 1], [7, -6]])
+        >>> preds = jnp.array([[0., 2], [-1, 2], [8, -5]])
+        >>> explained_variance = ExplainedVariance(multioutput='raw_values')
+        >>> explained_variance(preds, target)
+        Array([0.96774197, 1.        ], dtype=float32)
+    """
+
+    def __init__(
+        self,
+        multioutput: str = "uniform_average",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+        )
+        allowed_multioutput = ("raw_values", "uniform_average", "variance_weighted")
+        if multioutput not in allowed_multioutput:
+            raise ValueError(
+                f"Invalid input to argument `multioutput`. Choose one of the following: {allowed_multioutput}"
+            )
+        self.multioutput = multioutput
+        self.add_state("sum_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_target", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n_obs", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        """Update state with predictions and targets."""
+        n_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(
+            preds, target
+        )
+        self.n_obs = self.n_obs + n_obs
+        self.sum_error = self.sum_error + sum_error
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.sum_target = self.sum_target + sum_target
+        self.sum_squared_target = self.sum_squared_target + sum_squared_target
+
+    def compute(self) -> jax.Array:
+        """Computes explained variance over state."""
+        return _explained_variance_compute(
+            self.n_obs,
+            self.sum_error,
+            self.sum_squared_error,
+            self.sum_target,
+            self.sum_squared_target,
+            self.multioutput,
+        )

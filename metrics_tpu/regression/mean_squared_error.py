@@ -1,0 +1,48 @@
+"""MeanSquaredError (module). Parity: ``torchmetrics/regression/mean_squared_error.py``."""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.mean_squared_error import (
+    _mean_squared_error_compute,
+    _mean_squared_error_update,
+)
+from metrics_tpu.metric import Metric
+
+
+class MeanSquaredError(Metric):
+    """Computes mean squared error; scalar sum/count states — cheap ``psum`` sync.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([2.5, 5.0, 4.0, 8.0])
+        >>> preds = jnp.array([3.0, 5.0, 2.5, 7.0])
+        >>> mean_squared_error = MeanSquaredError()
+        >>> mean_squared_error(preds, target)
+        Array(0.875, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+        )
+        self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        """Update state with predictions and targets."""
+        sum_squared_error, n_obs = _mean_squared_error_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + n_obs
+
+    def compute(self) -> jax.Array:
+        """Computes mean squared error over state."""
+        return _mean_squared_error_compute(self.sum_squared_error, self.total)

@@ -1,0 +1,107 @@
+"""PSNR (module). Parity: ``torchmetrics/regression/psnr.py``.
+
+The reference's dual-mode state design is preserved: ``dim=None`` uses scalar
+sum/count states (``psum`` sync); ``dim`` set uses list states (all-gather
+sync). ``data_range=None`` tracks running min/max of the target — the only
+metric using custom min/max reductions (reference ``psnr.py:105-106``).
+"""
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.psnr import _psnr_compute, _psnr_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+class PSNR(Metric):
+    r"""Computes peak signal-to-noise ratio (PSNR):
+
+    .. math:: \text{PSNR}(I, J) = 10 * \log_{10} \left(\frac{\max(I)^2}{\text{MSE}(I, J)}\right)
+
+    Args:
+        data_range: the range of the data. If None, determined from the data
+            (max - min); must be given when ``dim`` is not None.
+        base: a base of a logarithm to use.
+        reduction: ``'elementwise_mean'`` | ``'sum'`` | ``'none'``.
+        dim: dimensions to reduce PSNR scores over; None reduces over all
+            dimensions and batches.
+        compute_on_step: forward only calls ``update()`` and returns None if False.
+        dist_sync_on_step: sync state across processes at each ``forward()``.
+        process_group: scope of synchronization.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> psnr = PSNR()
+        >>> preds = jnp.array([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
+        >>> psnr(preds, target)
+        Array(2.552725, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        data_range: Optional[float] = None,
+        base: float = 10.0,
+        reduction: str = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+        )
+
+        if dim is None and reduction != "elementwise_mean":
+            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+
+        if dim is None:
+            self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        else:
+            self.add_state("sum_squared_error", default=[])
+            self.add_state("total", default=[])
+
+        if data_range is None:
+            if dim is not None:
+                raise ValueError("The `data_range` must be given when `dim` is not None.")
+
+            self.data_range = None
+            self.add_state("min_target", default=jnp.asarray(0.0), dist_reduce_fx="min")
+            self.add_state("max_target", default=jnp.asarray(0.0), dist_reduce_fx="max")
+        else:
+            self.data_range = jnp.asarray(float(data_range))
+        self.base = base
+        self.reduction = reduction
+        self.dim = tuple(dim) if isinstance(dim, Sequence) else dim
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        """Update state with predictions and targets."""
+        sum_squared_error, n_obs = _psnr_update(preds, target, dim=self.dim)
+        if self.dim is None:
+            if self.data_range is None:
+                # keep track of min and max target values
+                self.min_target = jnp.minimum(jnp.min(target), self.min_target)
+                self.max_target = jnp.maximum(jnp.max(target), self.max_target)
+
+            self.sum_squared_error = self.sum_squared_error + sum_squared_error
+            self.total = self.total + n_obs
+        else:
+            self.sum_squared_error.append(sum_squared_error)
+            self.total.append(n_obs)
+
+    def compute(self) -> jax.Array:
+        """Compute peak signal-to-noise ratio over state."""
+        data_range = self.data_range if self.data_range is not None else self.max_target - self.min_target
+
+        if self.dim is None:
+            sum_squared_error = self.sum_squared_error
+            total = self.total
+        else:
+            sum_squared_error = jnp.concatenate([jnp.ravel(v) for v in self.sum_squared_error])
+            total = jnp.concatenate([jnp.ravel(v) for v in self.total])
+        return _psnr_compute(sum_squared_error, total, data_range, base=self.base, reduction=self.reduction)

@@ -1,0 +1,83 @@
+"""SSIM (module). Parity: ``torchmetrics/regression/ssim.py``.
+
+Keeps the reference's list-state design (all preds/targets buffered,
+``dist_reduce_fx=None`` → all-gather + concat sync).
+"""
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.ssim import _ssim_compute, _ssim_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+
+class SSIM(Metric):
+    """Computes Structural Similarity Index Measure (SSIM).
+
+    Args:
+        kernel_size: size of the gaussian kernel.
+        sigma: standard deviation of the gaussian kernel.
+        reduction: ``'elementwise_mean'`` | ``'sum'`` | ``'none'``.
+        data_range: range of the image; if None, determined from the images.
+        k1: first SSIM stability constant.
+        k2: second SSIM stability constant.
+        compute_on_step: forward only calls ``update()`` and returns None if False.
+        dist_sync_on_step: sync state across processes at each ``forward()``.
+        process_group: scope of synchronization.
+
+    Example:
+        >>> import jax
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(42), (16, 1, 16, 16))
+        >>> target = preds * 0.75
+        >>> ssim = SSIM()
+        >>> float(ssim(preds, target)) > 0.91
+        True
+    """
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: str = "elementwise_mean",
+        data_range: Optional[float] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+        )
+        rank_zero_warn(
+            "Metric `SSIM` will save all targets and"
+            " predictions in buffer. For large datasets this may lead"
+            " to large memory footprint."
+        )
+
+        self.add_state("y", default=[], dist_reduce_fx=None)
+        self.add_state("y_pred", default=[], dist_reduce_fx=None)
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.reduction = reduction
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        """Update state with predictions and targets."""
+        preds, target = _ssim_update(preds, target)
+        self.y_pred.append(preds)
+        self.y.append(target)
+
+    def compute(self) -> jax.Array:
+        """Computes SSIM over state."""
+        preds = jnp.concatenate(self.y_pred, axis=0)
+        target = jnp.concatenate(self.y, axis=0)
+        return _ssim_compute(
+            preds, target, self.kernel_size, self.sigma, self.reduction, self.data_range, self.k1, self.k2
+        )

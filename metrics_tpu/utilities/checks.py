@@ -351,6 +351,7 @@ def _input_format_classification(
     top_k: Optional[int] = None,
     num_classes: Optional[int] = None,
     is_multiclass: Optional[bool] = None,
+    _num_classes_hint: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, DataType]:
     """Canonicalize classification inputs to binary ``(N, C)`` or ``(N, C, X)`` int arrays.
 
@@ -402,12 +403,18 @@ def _input_format_classification(
     nc = num_classes
     needs_onehot = (case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) or is_multiclass) and not preds_float
     if needs_onehot and nc is None:
-        if probe is None:
+        if probe is not None:
+            nc = int(max(probe.preds_max, probe.target_max)) + 1
+        elif _num_classes_hint is not None:
+            # trace-time fallback for callers (e.g. the confusion-matrix
+            # family) that know the class count but must not engage the
+            # `num_classes` validation path, for reference parity
+            nc = _num_classes_hint
+        else:
             raise ValueError(
                 "`num_classes` is required when label inputs are traced under jit;"
                 " it cannot be inferred from the data maximum."
             )
-        nc = int(max(probe.preds_max, probe.target_max)) + 1
 
     preds_c, target_c = _canonicalize_jit(
         preds,

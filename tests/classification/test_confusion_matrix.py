@@ -128,3 +128,22 @@ def test_warning_on_nan(tmpdir):
 
     with pytest.warns(UserWarning, match=".* nan values found in confusion matrix have been replaced with zeros."):
         confusion_matrix(preds, target, num_classes=5, normalize="true")
+
+
+def test_confusion_matrix_jittable():
+    """The whole confmat family must trace under jit when num_classes is given
+    (regression: the hint was dropped before input canonicalization)."""
+    import jax
+
+    preds_lab = jnp.array([0, 1, 2, 1])
+    target_lab = jnp.array([1, 1, 0, 2])
+
+    jitted = jax.jit(partial(confusion_matrix, num_classes=3))
+    expected = confusion_matrix(preds_lab, target_lab, num_classes=3)
+    assert np.allclose(np.asarray(jitted(preds_lab, target_lab)), np.asarray(expected))
+
+    jitted_norm = jax.jit(partial(confusion_matrix, num_classes=3, normalize="true"))
+    expected_norm = confusion_matrix(preds_lab, target_lab, num_classes=3, normalize="true")
+    result_norm = jitted_norm(preds_lab, target_lab)
+    assert not np.any(np.isnan(np.asarray(result_norm)))
+    assert np.allclose(np.asarray(result_norm), np.asarray(expected_norm))

@@ -1,0 +1,77 @@
+"""ExplainedVariance vs sklearn (mirror of reference ``tests/regression/test_explained_variance.py``)."""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import explained_variance_score
+
+from metrics_tpu import ExplainedVariance
+from metrics_tpu.functional import explained_variance
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+seed_all(42)
+
+num_targets = 5
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_single_target_inputs = Input(
+    preds=np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+)
+
+_multi_target_inputs = Input(
+    preds=np.random.rand(NUM_BATCHES, BATCH_SIZE, num_targets).astype(np.float32),
+    target=np.random.rand(NUM_BATCHES, BATCH_SIZE, num_targets).astype(np.float32),
+)
+
+
+def _single_target_sk_metric(preds, target, sk_fn=explained_variance_score):
+    return sk_fn(target.reshape(-1), preds.reshape(-1))
+
+
+def _multi_target_sk_metric(preds, target, sk_fn=explained_variance_score):
+    return sk_fn(target.reshape(-1, num_targets), preds.reshape(-1, num_targets))
+
+
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average", "variance_weighted"])
+@pytest.mark.parametrize(
+    "preds, target, sk_metric",
+    [
+        (_single_target_inputs.preds, _single_target_inputs.target, _single_target_sk_metric),
+        (_multi_target_inputs.preds, _multi_target_inputs.target, _multi_target_sk_metric),
+    ],
+)
+class TestExplainedVariance(MetricTester):
+    atol = 1e-4  # fp32 moment accumulators vs sklearn's direct fp64 formula
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_explained_variance(self, multioutput, preds, target, sk_metric, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=ExplainedVariance,
+            sk_metric=partial(sk_metric, sk_fn=partial(explained_variance_score, multioutput=multioutput)),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args=dict(multioutput=multioutput),
+        )
+
+    def test_explained_variance_functional(self, multioutput, preds, target, sk_metric):
+        self.run_functional_metric_test(
+            preds=preds,
+            target=target,
+            metric_functional=explained_variance,
+            sk_metric=partial(sk_metric, sk_fn=partial(explained_variance_score, multioutput=multioutput)),
+            metric_args=dict(multioutput=multioutput),
+        )
+
+
+def test_error_on_different_shape():
+    metric = ExplainedVariance()
+    with pytest.raises(RuntimeError, match="Predictions and targets are expected to have the same shape"):
+        metric(jnp.zeros(100), jnp.zeros(50))

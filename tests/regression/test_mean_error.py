@@ -1,0 +1,103 @@
+"""Mean error metrics vs sklearn (mirror of reference ``tests/regression/test_mean_error.py``)."""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import mean_absolute_error as sk_mean_absolute_error
+from sklearn.metrics import mean_squared_error as sk_mean_squared_error
+from sklearn.metrics import mean_squared_log_error as sk_mean_squared_log_error
+
+from metrics_tpu import MeanAbsoluteError, MeanSquaredError, MeanSquaredLogError
+from metrics_tpu.functional import (
+    mean_absolute_error,
+    mean_relative_error,
+    mean_squared_error,
+    mean_squared_log_error,
+)
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+seed_all(42)
+
+num_targets = 5
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_single_target_inputs = Input(
+    preds=np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+    target=np.random.rand(NUM_BATCHES, BATCH_SIZE).astype(np.float32),
+)
+
+_multi_target_inputs = Input(
+    preds=np.random.rand(NUM_BATCHES, BATCH_SIZE, num_targets).astype(np.float32),
+    target=np.random.rand(NUM_BATCHES, BATCH_SIZE, num_targets).astype(np.float32),
+)
+
+
+def _single_target_sk_metric(preds, target, sk_fn=sk_mean_squared_error):
+    return sk_fn(preds.reshape(-1), target.reshape(-1))
+
+
+def _multi_target_sk_metric(preds, target, sk_fn=sk_mean_squared_error):
+    return sk_fn(preds.reshape(-1, num_targets), target.reshape(-1, num_targets))
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric",
+    [
+        (_single_target_inputs.preds, _single_target_inputs.target, _single_target_sk_metric),
+        (_multi_target_inputs.preds, _multi_target_inputs.target, _multi_target_sk_metric),
+    ],
+)
+@pytest.mark.parametrize(
+    "metric_class, metric_functional, sk_fn",
+    [
+        (MeanSquaredError, mean_squared_error, lambda p, t: sk_mean_squared_error(t, p)),
+        (MeanAbsoluteError, mean_absolute_error, lambda p, t: sk_mean_absolute_error(t, p)),
+        (MeanSquaredLogError, mean_squared_log_error, lambda p, t: sk_mean_squared_log_error(t, p)),
+    ],
+)
+class TestMeanError(MetricTester):
+    atol = 1e-5  # fp32 accumulation vs sklearn's fp64
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_mean_error_class(
+        self, preds, target, sk_metric, metric_class, metric_functional, sk_fn, ddp, dist_sync_on_step
+    ):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=metric_class,
+            sk_metric=partial(sk_metric, sk_fn=sk_fn),
+            dist_sync_on_step=dist_sync_on_step,
+        )
+
+    def test_mean_error_functional(self, preds, target, sk_metric, metric_class, metric_functional, sk_fn):
+        self.run_functional_metric_test(
+            preds=preds,
+            target=target,
+            metric_functional=metric_functional,
+            sk_metric=partial(sk_metric, sk_fn=sk_fn),
+        )
+
+    def test_mean_error_half_cpu(self, preds, target, sk_metric, metric_class, metric_functional, sk_fn):
+        self.run_precision_test_cpu(preds, target, metric_class, metric_functional)
+
+
+def test_mean_relative_error():
+    preds = np.random.rand(BATCH_SIZE).astype(np.float32)
+    target = np.random.rand(BATCH_SIZE).astype(np.float32)
+    expected = np.mean(np.abs((preds - target) / np.where(target == 0, 1.0, target)))
+    result = mean_relative_error(jnp.asarray(preds), jnp.asarray(target))
+    assert np.allclose(float(result), expected, atol=1e-6)
+
+
+@pytest.mark.parametrize("metric_class", [MeanSquaredError, MeanAbsoluteError, MeanSquaredLogError])
+def test_error_on_different_shape(metric_class):
+    metric = metric_class()
+    with pytest.raises(RuntimeError, match="Predictions and targets are expected to have the same shape"):
+        metric(jnp.zeros(100), jnp.zeros(50))

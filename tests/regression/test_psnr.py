@@ -1,0 +1,131 @@
+"""PSNR tests (mirror of reference ``tests/regression/test_psnr.py``).
+
+The reference uses ``skimage.metrics.peak_signal_noise_ratio`` as oracle;
+skimage is not in this environment so the oracle is the same closed-form
+``10*log10(data_range^2 / mse)`` in numpy fp64.
+"""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import PSNR
+from metrics_tpu.functional import psnr
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+seed_all(42)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_input_size = (NUM_BATCHES, BATCH_SIZE, 32, 32)
+_inputs = [
+    Input(
+        preds=np.random.randint(n_cls_pred, size=_input_size).astype(np.float32),
+        target=np.random.randint(n_cls_target, size=_input_size).astype(np.float32),
+    )
+    for n_cls_pred, n_cls_target in [(10, 10), (5, 10), (10, 5)]
+]
+
+
+def _np_psnr(preds, target, data_range):
+    mse = np.mean((np.asarray(preds, dtype=np.float64) - np.asarray(target, dtype=np.float64)) ** 2)
+    return 10 * np.log10(data_range ** 2 / mse)
+
+
+def _to_psnr_inputs(value, dim):
+    batches = value[None] if value.ndim == len(_input_size) - 1 else value
+
+    if dim is None:
+        return [batches]
+
+    num_dims = np.size(dim)
+    if not num_dims:
+        return batches
+
+    inputs = []
+    for batch in batches:
+        batch = np.moveaxis(batch, dim, tuple(np.arange(-num_dims, 0)))
+        psnr_input_shape = batch.shape[-num_dims:]
+        inputs.extend(batch.reshape(-1, *psnr_input_shape))
+    return inputs
+
+
+def _sk_psnr(preds, target, data_range, reduction, dim):
+    sk_preds_lists = _to_psnr_inputs(preds, dim=dim)
+    sk_target_lists = _to_psnr_inputs(target, dim=dim)
+    np_reduce_map = {"elementwise_mean": np.mean, "none": np.array, "sum": np.sum}
+    return np_reduce_map[reduction]([
+        _np_psnr(sk_preds, sk_target, data_range)
+        for sk_target, sk_preds in zip(sk_target_lists, sk_preds_lists)
+    ])
+
+
+def _base_e_sk_psnr(preds, target, data_range, reduction, dim):
+    return _sk_psnr(preds, target, data_range, reduction, dim) * np.log(10)
+
+
+@pytest.mark.parametrize(
+    "preds, target, data_range, reduction, dim",
+    [
+        (_inputs[0].preds, _inputs[0].target, 10, "elementwise_mean", None),
+        (_inputs[1].preds, _inputs[1].target, 10, "elementwise_mean", None),
+        (_inputs[2].preds, _inputs[2].target, 5, "elementwise_mean", None),
+        (_inputs[2].preds, _inputs[2].target, 5, "elementwise_mean", 1),
+        (_inputs[2].preds, _inputs[2].target, 5, "elementwise_mean", (1, 2)),
+        (_inputs[2].preds, _inputs[2].target, 5, "sum", (1, 2)),
+    ],
+)
+@pytest.mark.parametrize(
+    "base, sk_metric",
+    [
+        (10.0, _sk_psnr),
+        (2.718281828459045, _base_e_sk_psnr),
+    ],
+)
+class TestPSNR(MetricTester):
+    atol = 1e-4  # fp32 log-space math vs fp64 oracle
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_psnr(self, preds, target, data_range, base, reduction, dim, sk_metric, ddp, dist_sync_on_step):
+        _args = {"data_range": data_range, "base": base, "reduction": reduction, "dim": dim}
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=PSNR,
+            sk_metric=partial(sk_metric, data_range=data_range, reduction=reduction, dim=dim),
+            metric_args=_args,
+            dist_sync_on_step=dist_sync_on_step,
+        )
+
+    def test_psnr_functional(self, preds, target, sk_metric, data_range, base, reduction, dim):
+        _args = {"data_range": data_range, "base": base, "reduction": reduction, "dim": dim}
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=psnr,
+            sk_metric=partial(sk_metric, data_range=data_range, reduction=reduction, dim=dim),
+            metric_args=_args,
+        )
+
+
+@pytest.mark.parametrize("reduction", ["none", "sum"])
+def test_reduction_for_dim_none(reduction):
+    match = f"The `reduction={reduction}` will not have any effect when `dim` is None."
+    with pytest.warns(UserWarning, match=match):
+        PSNR(reduction=reduction, dim=None)
+
+    with pytest.warns(UserWarning, match=match):
+        psnr(jnp.ones(10), jnp.ones(10), reduction=reduction, dim=None)
+
+
+def test_missing_data_range():
+    with pytest.raises(ValueError, match="The `data_range` must be given when `dim` is not None."):
+        PSNR(data_range=None, dim=0)
+
+    with pytest.raises(ValueError, match="The `data_range` must be given when `dim` is not None."):
+        psnr(jnp.ones(10), jnp.zeros(10), data_range=None, dim=0)

@@ -1,0 +1,127 @@
+"""SSIM tests (mirror of reference ``tests/regression/test_ssim.py``).
+
+The reference uses ``skimage.metrics.structural_similarity`` as oracle;
+skimage is not in this environment so the oracle is an independent numpy/
+scipy implementation of gaussian-weighted SSIM (separable kernel, reflect
+padding, population moments) in fp64.
+"""
+from collections import namedtuple
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy.signal import convolve2d
+
+from metrics_tpu import SSIM
+from metrics_tpu.functional import ssim
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, MetricTester
+
+seed_all(42)
+
+Input = namedtuple("Input", ["preds", "target"])
+
+_inputs = []
+for size, channel, coef in [
+    (12, 3, 0.9),
+    (13, 1, 0.8),
+    (14, 1, 0.7),
+    (15, 3, 0.6),
+]:
+    preds = np.random.rand(NUM_BATCHES, BATCH_SIZE, channel, size, size).astype(np.float32)
+    _inputs.append(Input(preds=preds, target=(preds * coef).astype(np.float32)))
+
+
+def _np_gaussian_kernel(kernel_size=11, sigma=1.5):
+    dist = np.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1, dtype=np.float64)
+    gauss = np.exp(-((dist / sigma) ** 2) / 2)
+    gauss = gauss / gauss.sum()
+    return np.outer(gauss, gauss)
+
+
+def _np_ssim(preds, target, data_range=None, kernel_size=11, sigma=1.5, k1=0.01, k2=0.03):
+    """Gaussian-weighted SSIM in fp64 over a batch of (C, H, W) images."""
+    preds = np.asarray(preds, dtype=np.float64)
+    target = np.asarray(target, dtype=np.float64)
+    if data_range is None:
+        data_range = max(preds.max() - preds.min(), target.max() - target.min())
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    kernel = _np_gaussian_kernel(kernel_size, sigma)
+    pad = (kernel_size - 1) // 2
+
+    def filt(img):
+        padded = np.pad(img, pad, mode="reflect")
+        return convolve2d(padded, kernel, mode="valid")
+
+    vals = []
+    for b in range(preds.shape[0]):
+        for c in range(preds.shape[1]):
+            p, t = preds[b, c], target[b, c]
+            mu_p, mu_t = filt(p), filt(t)
+            e_pp, e_tt, e_pt = filt(p * p), filt(t * t), filt(p * t)
+            sigma_p = e_pp - mu_p ** 2
+            sigma_t = e_tt - mu_t ** 2
+            sigma_pt = e_pt - mu_p * mu_t
+            ssim_map = ((2 * mu_p * mu_t + c1) * (2 * sigma_pt + c2)) / (
+                (mu_p ** 2 + mu_t ** 2 + c1) * (sigma_p + sigma_t + c2)
+            )
+            vals.append(ssim_map[pad:-pad, pad:-pad])
+    return np.mean(vals)
+
+
+@pytest.mark.parametrize(
+    "preds, target",
+    [(i.preds, i.target) for i in _inputs],
+)
+class TestSSIM(MetricTester):
+    atol = 6e-4  # fp32 conv path vs fp64 oracle
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_ssim(self, preds, target, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp,
+            preds,
+            target,
+            SSIM,
+            partial(_np_ssim, data_range=1.0),
+            metric_args={"data_range": 1.0},
+            dist_sync_on_step=dist_sync_on_step,
+        )
+
+    def test_ssim_functional(self, preds, target):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            ssim,
+            partial(_np_ssim, data_range=1.0),
+            metric_args={"data_range": 1.0},
+        )
+
+
+@pytest.mark.parametrize(
+    ["pred", "target", "kernel", "sigma"],
+    [
+        ([1, 16, 16], [1, 16, 16], [11, 11], [1.5, 1.5]),  # len(shape)
+        ([1, 1, 16, 16], [1, 1, 16, 16], [11, 11], [1.5]),  # len(kernel), len(sigma)
+        ([1, 1, 16, 16], [1, 1, 16, 16], [11], [1.5, 1.5]),  # len(kernel), len(sigma)
+        ([1, 1, 16, 16], [1, 1, 16, 16], [11], [1.5]),  # len(kernel), len(sigma)
+        ([1, 1, 16, 16], [1, 1, 16, 16], [11, 0], [1.5, 1.5]),  # invalid kernel input
+        ([1, 1, 16, 16], [1, 1, 16, 16], [11, 10], [1.5, 1.5]),  # invalid kernel input
+        ([1, 1, 16, 16], [1, 1, 16, 16], [11, -11], [1.5, 1.5]),  # invalid kernel input
+        ([1, 1, 16, 16], [1, 1, 16, 16], [11, 11], [1.5, 0]),  # invalid sigma input
+        ([1, 1, 16, 16], [1, 1, 16, 16], [11, 0], [1.5, -1.5]),  # invalid sigma input
+    ],
+)
+def test_ssim_invalid_inputs(pred, target, kernel, sigma):
+    pred_t = jnp.zeros(pred)
+    target_t = jnp.zeros(target)
+    with pytest.raises(ValueError):
+        ssim(pred_t, target_t, kernel, sigma)
+
+
+def test_ssim_different_dtypes():
+    with pytest.raises(TypeError):
+        ssim(jnp.zeros((1, 1, 16, 16), jnp.float32), jnp.zeros((1, 1, 16, 16), jnp.bfloat16))
