@@ -15,8 +15,12 @@ _logger.setLevel(logging.INFO)
 from metrics_tpu.info import __version__  # noqa: F401, E402
 from metrics_tpu.metric import CompositionalMetric, Metric  # noqa: F401, E402
 from metrics_tpu.classification import (  # noqa: F401, E402
+    AUC,
+    AUROC,
     F1,
+    ROC,
     Accuracy,
+    AveragePrecision,
     CohenKappa,
     ConfusionMatrix,
     FBeta,
@@ -24,6 +28,7 @@ from metrics_tpu.classification import (  # noqa: F401, E402
     IoU,
     MatthewsCorrcoef,
     Precision,
+    PrecisionRecallCurve,
     Recall,
     StatScores,
 )

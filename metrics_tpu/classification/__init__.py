@@ -1,4 +1,7 @@
 from metrics_tpu.classification.accuracy import Accuracy  # noqa: F401
+from metrics_tpu.classification.auc import AUC  # noqa: F401
+from metrics_tpu.classification.auroc import AUROC  # noqa: F401
+from metrics_tpu.classification.average_precision import AveragePrecision  # noqa: F401
 from metrics_tpu.classification.cohen_kappa import CohenKappa  # noqa: F401
 from metrics_tpu.classification.confusion_matrix import ConfusionMatrix  # noqa: F401
 from metrics_tpu.classification.f_beta import F1, FBeta  # noqa: F401
@@ -6,4 +9,6 @@ from metrics_tpu.classification.hamming_distance import HammingDistance  # noqa:
 from metrics_tpu.classification.iou import IoU  # noqa: F401
 from metrics_tpu.classification.matthews_corrcoef import MatthewsCorrcoef  # noqa: F401
 from metrics_tpu.classification.precision_recall import Precision, Recall  # noqa: F401
+from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve  # noqa: F401
+from metrics_tpu.classification.roc import ROC  # noqa: F401
 from metrics_tpu.classification.stat_scores import StatScores  # noqa: F401

@@ -1,0 +1,55 @@
+"""AUC (module). Parity: ``torchmetrics/classification/auc.py``."""
+from typing import Any, Optional
+
+import jax
+
+from metrics_tpu.functional.classification.auc import _auc_compute, _auc_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities import rank_zero_warn
+from metrics_tpu.utilities.data import dim_zero_cat
+
+
+class AUC(Metric):
+    """Computes Area Under the Curve from accumulated ``(x, y)`` points.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> auc = AUC()
+        >>> auc(jnp.array([0, 1, 2, 3]), jnp.array([0, 1, 2, 2]))
+        Array(4., dtype=float32)
+    """
+
+    def __init__(
+        self,
+        reorder: bool = False,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+        )
+
+        self.reorder = reorder
+
+        self.add_state("x", default=[], dist_reduce_fx=None)
+        self.add_state("y", default=[], dist_reduce_fx=None)
+
+        rank_zero_warn(
+            "Metric `AUC` will save all targets and predictions in buffer."
+            " For large datasets this may lead to large memory footprint."
+        )
+
+    def update(self, x: jax.Array, y: jax.Array) -> None:
+        """Append the batch of curve points."""
+        x, y = _auc_update(x, y)
+        self.x.append(x)
+        self.y.append(y)
+
+    def compute(self) -> jax.Array:
+        """AUC over all accumulated points."""
+        x = dim_zero_cat(self.x)
+        y = dim_zero_cat(self.y)
+        return _auc_compute(x, y, reorder=self.reorder)

@@ -1,0 +1,67 @@
+"""PrecisionRecallCurve (module). Parity: ``torchmetrics/classification/precision_recall_curve.py``."""
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities import rank_zero_warn
+from metrics_tpu.utilities.data import dim_zero_cat
+
+
+class PrecisionRecallCurve(Metric):
+    """Computes precision-recall pairs for different thresholds.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0, 1, 2, 3])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> pr_curve = PrecisionRecallCurve(pos_label=1)
+        >>> precision, recall, thresholds = pr_curve(pred, target)
+        >>> precision
+        Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
+    """
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        pos_label: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+        )
+
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+        rank_zero_warn(
+            "Metric `PrecisionRecallCurve` will save all targets and predictions in buffer."
+            " For large datasets this may lead to large memory footprint."
+        )
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        """Append the canonicalized batch to the curve buffers."""
+        preds, target, num_classes, pos_label = _precision_recall_curve_update(
+            preds, target, self.num_classes, self.pos_label
+        )
+        self.preds.append(preds)
+        self.target.append(target)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+
+    def compute(self) -> Union[Tuple[jax.Array, ...], Tuple[List[jax.Array], ...]]:
+        """``(precision, recall, thresholds)`` over all seen batches."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _precision_recall_curve_compute(preds, target, self.num_classes, self.pos_label)

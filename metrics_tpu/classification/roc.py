@@ -1,0 +1,69 @@
+"""ROC (module). Parity: ``torchmetrics/classification/roc.py``.
+
+List ("cat") states store every batch; cross-device sync all-gathers in rank
+order — the expensive family flagged in SURVEY §2.6b.
+"""
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+
+from metrics_tpu.functional.classification.roc import _roc_compute, _roc_update
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities import rank_zero_warn
+from metrics_tpu.utilities.data import dim_zero_cat
+
+
+class ROC(Metric):
+    """Computes the Receiver Operating Characteristic.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0, 1, 2, 3])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> roc = ROC(pos_label=1)
+        >>> fpr, tpr, thresholds = roc(pred, target)
+        >>> fpr
+        Array([0., 0., 0., 0., 1.], dtype=float32)
+    """
+
+    def __init__(
+        self,
+        num_classes: Optional[int] = None,
+        pos_label: Optional[int] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+        rank_zero_warn(
+            "Metric `ROC` will save all targets and predictions in buffer."
+            " For large datasets this may lead to large memory footprint."
+        )
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        """Append the canonicalized batch to the curve buffers."""
+        preds, target, num_classes, pos_label = _roc_update(preds, target, self.num_classes, self.pos_label)
+        self.preds.append(preds)
+        self.target.append(target)
+        self.num_classes = num_classes
+        self.pos_label = pos_label
+
+    def compute(self) -> Union[Tuple[jax.Array, ...], Tuple[List[jax.Array], ...]]:
+        """``(fpr, tpr, thresholds)`` over all seen batches (per-class lists
+        for multiclass/multilabel)."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return _roc_compute(preds, target, self.num_classes, self.pos_label)

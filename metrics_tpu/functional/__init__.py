@@ -1,4 +1,7 @@
 from metrics_tpu.functional.classification.accuracy import accuracy  # noqa: F401
+from metrics_tpu.functional.classification.auc import auc  # noqa: F401
+from metrics_tpu.functional.classification.auroc import auroc  # noqa: F401
+from metrics_tpu.functional.classification.average_precision import average_precision  # noqa: F401
 from metrics_tpu.functional.classification.cohen_kappa import cohen_kappa  # noqa: F401
 from metrics_tpu.functional.classification.confusion_matrix import confusion_matrix  # noqa: F401
 from metrics_tpu.functional.classification.f_beta import f1, fbeta  # noqa: F401
@@ -6,6 +9,8 @@ from metrics_tpu.functional.classification.hamming_distance import hamming_dista
 from metrics_tpu.functional.classification.iou import iou  # noqa: F401
 from metrics_tpu.functional.classification.matthews_corrcoef import matthews_corrcoef  # noqa: F401
 from metrics_tpu.functional.classification.precision_recall import precision, precision_recall, recall  # noqa: F401
+from metrics_tpu.functional.classification.precision_recall_curve import precision_recall_curve  # noqa: F401
+from metrics_tpu.functional.classification.roc import roc  # noqa: F401
 from metrics_tpu.functional.classification.stat_scores import stat_scores  # noqa: F401
 from metrics_tpu.functional.regression.explained_variance import explained_variance  # noqa: F401
 from metrics_tpu.functional.regression.mean_absolute_error import mean_absolute_error  # noqa: F401

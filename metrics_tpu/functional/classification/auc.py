@@ -1,0 +1,65 @@
+"""Area under an arbitrary curve via the trapezoidal rule (functional).
+
+Parity: ``torchmetrics/functional/classification/auc.py``. The reference's
+``_stable_1d_sort`` padding workaround dissolves on XLA (stable argsort);
+direction detection needs two host reads of a fused reduction.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import _stable_1d_sort
+
+
+def _auc_update(x: jax.Array, y: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Parity: reference ``auc.py:22-33``."""
+    if x.ndim > 1 or y.ndim > 1:
+        raise ValueError(
+            f"Expected both `x` and `y` tensor to be 1d, but got tensors with dimention {x.ndim} and {y.ndim}"
+        )
+    if x.size != y.size:
+        raise ValueError(
+            f"Expected the same number of elements in `x` and `y` tensor but received {x.size} and {y.size}"
+        )
+    return x, y
+
+
+def _auc_compute(x: jax.Array, y: jax.Array, reorder: bool = False) -> jax.Array:
+    """Parity: reference ``auc.py:36-52`` (direction-aware trapezoid)."""
+    if reorder:
+        x, x_idx = _stable_1d_sort(x)
+        y = y[x_idx]
+
+    dx = x[1:] - x[:-1]
+    if bool(jnp.any(dx < 0)):
+        if bool(jnp.all(dx <= 0)):
+            direction = -1.0
+        else:
+            raise ValueError(
+                "The `x` tensor is neither increasing or decreasing. Try setting the reorder argument to `True`."
+            )
+    else:
+        direction = 1.0
+    return direction * jnp.trapezoid(y, x)
+
+
+def auc(x: jax.Array, y: jax.Array, reorder: bool = False) -> jax.Array:
+    """Computes Area Under the Curve (AUC) using the trapezoidal rule.
+
+    Args:
+        x: x-coordinates
+        y: y-coordinates
+        reorder: if True, sorts ``x`` (stably) before integrating
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([0, 1, 2, 3])
+        >>> y = jnp.array([0, 1, 2, 2])
+        >>> auc(x, y)
+        Array(4., dtype=float32)
+        >>> auc(x, y, reorder=True)
+        Array(4., dtype=float32)
+    """
+    x, y = _auc_update(x, y)
+    return _auc_compute(x, y, reorder=reorder)

@@ -1,0 +1,163 @@
+"""Area Under the ROC Curve (functional).
+
+Parity: ``torchmetrics/functional/classification/auroc.py``. The reference's
+``_TORCH_LOWER_1_6`` gate on ``torch.bucketize`` dissolves —
+``jnp.searchsorted`` is always available; the partial-AUC interpolation is a
+searchsorted + lerp like the reference's ``bucketize`` + ``lerp``
+(``auroc.py:118-133``).
+"""
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.auc import _auc_compute
+from metrics_tpu.functional.classification.roc import roc
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.enums import AverageMethod, DataType
+
+
+def _auroc_update(preds: jax.Array, target: jax.Array):
+    """Validate input and detect its mode; parity: reference ``auroc.py:26-39``.
+
+    The multidim-multiclass reshape happens inside the curve canonicalizer
+    (``_precision_recall_curve_update``), so only the deep multilabel case is
+    reshaped here, exactly as in the reference.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    # use _input_format_classification for validating the input and get the mode of data
+    _, _, mode = _input_format_classification(preds, target)
+
+    if mode == DataType.MULTIDIM_MULTICLASS and preds.ndim == target.ndim + 1:
+        # reshape here (not only in the curve canonicalizer) so the stateful
+        # AUROC class can concatenate batches whose trailing dims differ
+        n_classes = preds.shape[1]
+        preds = jnp.swapaxes(preds, 0, 1).reshape(n_classes, -1).T
+        target = target.reshape(-1)
+    if mode == DataType.MULTILABEL and preds.ndim > 2:
+        n_classes = preds.shape[1]
+        preds = jnp.swapaxes(preds, 0, 1).reshape(n_classes, -1).T
+        target = jnp.swapaxes(target, 0, 1).reshape(n_classes, -1).T
+
+    return preds, target, mode
+
+
+def _auroc_compute(
+    preds: jax.Array,
+    target: jax.Array,
+    mode: DataType,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> jax.Array:
+    """Parity: reference ``auroc.py:42-133``."""
+    # binary mode override num_classes
+    if mode == DataType.BINARY:
+        num_classes = 1
+
+    if max_fpr is not None:
+        if not isinstance(max_fpr, float) or not 0 < max_fpr <= 1:
+            raise ValueError(f"`max_fpr` should be a float in range (0, 1], got: {max_fpr}")
+
+        # max_fpr parameter is only supported for binary
+        if mode != DataType.BINARY:
+            raise ValueError(
+                f"Partial AUC computation not available in"
+                f" multilabel/multiclass setting, 'max_fpr' must be"
+                f" set to `None`, received `{max_fpr}`."
+            )
+
+    # calculate fpr, tpr
+    if mode == DataType.MULTILABEL:
+        if average == AverageMethod.MICRO:
+            fpr, tpr, _ = roc(preds.reshape(-1), target.reshape(-1), 1, pos_label, sample_weights)
+        else:
+            # for multilabel we iteratively evaluate roc in a binary fashion
+            output = [
+                roc(preds[:, i], target[:, i], num_classes=1, pos_label=1, sample_weights=sample_weights)
+                for i in range(num_classes)
+            ]
+            fpr = [o[0] for o in output]
+            tpr = [o[1] for o in output]
+    else:
+        fpr, tpr, _ = roc(preds, target, num_classes, pos_label, sample_weights)
+
+    # calculate standard roc auc score
+    if max_fpr is None or max_fpr == 1:
+        if mode == DataType.MULTILABEL and average == AverageMethod.MICRO:
+            pass
+        elif num_classes != 1:
+            # calculate auc scores per class
+            auc_scores = [_auc_compute(x, y) for x, y in zip(fpr, tpr)]
+
+            # calculate average
+            if average == AverageMethod.NONE:
+                return auc_scores
+            if average == AverageMethod.MACRO:
+                return jnp.mean(jnp.stack(auc_scores))
+            if average == AverageMethod.WEIGHTED:
+                if mode == DataType.MULTILABEL:
+                    support = jnp.sum(target, axis=0)
+                else:
+                    support = jnp.bincount(target.reshape(-1).astype(jnp.int32), length=num_classes)
+                return jnp.sum(jnp.stack(auc_scores) * support / support.sum())
+
+            allowed_average = (AverageMethod.NONE.value, AverageMethod.MACRO.value, AverageMethod.WEIGHTED.value)
+            raise ValueError(
+                f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
+            )
+
+        return _auc_compute(fpr, tpr)
+
+    max_fpr_t = jnp.asarray(max_fpr, dtype=fpr.dtype)
+    # Add a single point at max_fpr and interpolate its tpr value
+    stop = int(jnp.searchsorted(fpr, max_fpr_t, side="right"))
+    weight = (max_fpr_t - fpr[stop - 1]) / (fpr[stop] - fpr[stop - 1])
+    interp_tpr = tpr[stop - 1] + weight * (tpr[stop] - tpr[stop - 1])
+    tpr = jnp.concatenate([tpr[:stop], interp_tpr.reshape(1)])
+    fpr = jnp.concatenate([fpr[:stop], max_fpr_t.reshape(1)])
+
+    # Compute partial AUC
+    partial_auc = _auc_compute(fpr, tpr)
+
+    # McClish correction: standardize result to be 0.5 if non-discriminant
+    # and 1 if maximal
+    min_area = 0.5 * max_fpr**2
+    max_area = max_fpr
+    return 0.5 * (1 + (partial_auc - min_area) / (max_area - min_area))
+
+
+def auroc(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> jax.Array:
+    """Compute Area Under the Receiver Operating Characteristic Curve (ROC AUC).
+
+    Args:
+        preds: predictions from model (logits or probabilities)
+        target: ground truth labels
+        num_classes: number of classes (binary problems may omit it)
+        pos_label: the positive class; defaults to 1 for binary input
+        average: ``'micro'`` (multilabel only) | ``'macro'`` | ``'weighted'``
+            | ``None`` (per-class scores)
+        max_fpr: if set, standardized partial AUC over ``[0, max_fpr]``
+            (binary only)
+        sample_weights: sample weights for each data point
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.13, 0.26, 0.08, 0.19, 0.34])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> auroc(preds, target, pos_label=1)
+        Array(0.5, dtype=float32)
+    """
+    preds, target, mode = _auroc_update(preds, target)
+    return _auroc_compute(preds, target, mode, num_classes, pos_label, average, max_fpr, sample_weights)

@@ -1,0 +1,76 @@
+"""Average precision (functional).
+
+Parity: ``torchmetrics/functional/classification/average_precision.py`` — the
+step-function integral of the precision-recall curve.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _precision_recall_curve_compute,
+    _precision_recall_curve_update,
+)
+
+
+def _average_precision_update(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, int, int]:
+    """Parity: reference ``average_precision.py:25-31``."""
+    return _precision_recall_curve_update(preds, target, num_classes, pos_label)
+
+
+def _average_precision_compute(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[jax.Array], jax.Array]:
+    """Parity: reference ``average_precision.py:34-52``; works because the
+    last precision entry from the curve is guaranteed to be 1. Unlike the
+    reference (which leaves ``sample_weights`` as a todo), the weights are
+    forwarded to the curve computation."""
+    precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)
+    if num_classes == 1:
+        return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])
+
+    res = []
+    for p, r in zip(precision, recall):
+        res.append(-jnp.sum((r[1:] - r[:-1]) * p[:-1]))
+    return res
+
+
+def average_precision(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[List[jax.Array], jax.Array]:
+    """Computes the average precision score.
+
+    Args:
+        preds: predictions from model (logits or probabilities)
+        target: ground truth values
+        num_classes: number of classes (binary problems may omit it)
+        pos_label: the positive class; defaults to 1 for binary input and
+            must stay ``None`` for multiclass
+        sample_weights: sample weights for each data point
+
+    Returns:
+        average precision score; multiclass returns a per-class list
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0, 1, 2, 3])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> average_precision(pred, target, pos_label=1)
+        Array(1., dtype=float32)
+    """
+    preds, target, num_classes, pos_label = _average_precision_update(preds, target, num_classes, pos_label)
+    return _average_precision_compute(preds, target, num_classes, pos_label, sample_weights)

@@ -1,0 +1,214 @@
+"""Precision-recall curve (functional).
+
+Parity: ``torchmetrics/functional/classification/precision_recall_curve.py``.
+
+TPU design: ``_binary_clf_curve``'s sort + cumulative counts run as one
+jitted, fixed-shape XLA program (``_sorted_cumulants``); only the
+distinct-threshold deduplication — whose output length is data-dependent
+(reference ``precision_recall_curve.py:51``, an XLA dynamic-shape hazard per
+SURVEY §7) — runs eagerly at epoch-end ``compute()``, where it executes once
+per epoch and is off the hot path. ``jnp.argsort`` is stable, so tie handling
+needs no workaround.
+"""
+from functools import partial
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.utilities import rank_zero_warn
+
+
+@partial(jax.jit, static_argnames=("weighted",))
+def _sorted_cumulants(preds, target, pos_label, sample_weights=None, weighted: bool = False):
+    """Descending-score sort and cumulative true/false-positive counts.
+
+    One fixed-shape XLA program: argsort (stable), gather, two cumsums and the
+    adjacent-distinct mask are fused by XLA; everything downstream of the
+    data-dependent dedup stays outside.
+    """
+    order = jnp.argsort(-preds)  # descending; stable, so ties keep input order
+    preds_s = preds[order]
+    target_s = (target[order] == pos_label).astype(jnp.float32)
+    weight = sample_weights[order] if weighted else jnp.ones((), jnp.float32)
+    tps = jnp.cumsum(target_s * weight)
+    fps = jnp.cumsum((1.0 - target_s) * weight)
+    distinct = preds_s[1:] != preds_s[:-1]
+    return preds_s, tps, fps, distinct
+
+
+def _binary_clf_curve(
+    preds: jax.Array,
+    target: jax.Array,
+    sample_weights: Optional[Sequence] = None,
+    pos_label: int = 1,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Cumulative fps/tps at each distinct score threshold, descending.
+
+    Behavioral parity with reference ``precision_recall_curve.py:23-63``
+    (itself modeled on sklearn's ``_binary_clf_curve``).
+    """
+    weighted = sample_weights is not None
+    if weighted and not isinstance(sample_weights, (jax.Array, jnp.ndarray)):
+        sample_weights = jnp.asarray(sample_weights, dtype=jnp.float32)
+
+    # remove class dimension if necessary
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+
+    preds_s, tps_all, fps_all, distinct = _sorted_cumulants(
+        preds, target, pos_label, sample_weights, weighted=weighted
+    )
+
+    # preds typically has many tied values; keep the last index of each tie
+    # group plus the end of the curve (data-dependent length -> eager/host)
+    distinct_value_indices = np.nonzero(np.asarray(distinct))[0]
+    threshold_idxs = jnp.asarray(
+        np.concatenate([distinct_value_indices, [preds.shape[0] - 1]]).astype(np.int32)
+    )
+
+    tps = tps_all[threshold_idxs]
+    if weighted:
+        # cumsum keeps fps monotone under floating-point accumulation
+        fps = fps_all[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+
+    return fps, tps, preds_s[threshold_idxs]
+
+
+def _precision_recall_curve_update(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, int, int]:
+    """Canonicalize curve inputs to ``(N,)`` binary or ``(N, C)`` column form.
+
+    Parity: reference ``precision_recall_curve.py:66-111``.
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if not (preds.ndim == target.ndim or preds.ndim == target.ndim + 1):
+        raise ValueError("preds and target must have same number of dimensions, or one additional dimension for preds")
+
+    if preds.ndim == target.ndim:
+        if pos_label is None:
+            rank_zero_warn("`pos_label` automatically set 1.")
+            pos_label = 1
+        if num_classes is not None and num_classes != 1:
+            # multilabel problem
+            if num_classes != preds.shape[1]:
+                raise ValueError(
+                    f"Argument `num_classes` was set to {num_classes} in"
+                    f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                    " number of classes from predictions"
+                )
+            preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+            target = jnp.swapaxes(target, 0, 1).reshape(num_classes, -1).T
+        else:
+            # binary problem
+            preds = preds.reshape(-1)
+            target = target.reshape(-1)
+            num_classes = 1
+
+    # multi class problem
+    if preds.ndim == target.ndim + 1:
+        if pos_label is not None:
+            rank_zero_warn(
+                "Argument `pos_label` should be `None` when running"
+                f" multiclass precision recall curve. Got {pos_label}"
+            )
+        if num_classes != preds.shape[1]:
+            raise ValueError(
+                f"Argument `num_classes` was set to {num_classes} in"
+                f" metric `precision_recall_curve` but detected {preds.shape[1]}"
+                " number of classes from predictions"
+            )
+        preds = jnp.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+        target = target.reshape(-1)
+
+    return preds, target, num_classes, pos_label
+
+
+def _precision_recall_curve_compute(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[jax.Array, jax.Array, jax.Array], Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]]:
+    """Parity: reference ``precision_recall_curve.py:114-160``."""
+    if num_classes == 1:
+        fps, tps, thresholds = _binary_clf_curve(
+            preds=preds, target=target, sample_weights=sample_weights, pos_label=pos_label
+        )
+
+        precision = tps / (tps + fps)
+        recall = tps / tps[-1]
+
+        # stop when full recall attained, reverse so recall is decreasing
+        last_ind = int(np.nonzero(np.asarray(tps == tps[-1]))[0][0])
+        sl = slice(0, last_ind + 1)
+
+        precision = jnp.concatenate([precision[sl][::-1], jnp.ones(1, precision.dtype)])
+        recall = jnp.concatenate([recall[sl][::-1], jnp.zeros(1, recall.dtype)])
+        thresholds = thresholds[sl][::-1]
+
+        return precision, recall, thresholds
+
+    # Recursively call per class
+    precision, recall, thresholds = [], [], []
+    for c in range(num_classes):
+        preds_c = preds[:, c]
+        res = precision_recall_curve(
+            preds=preds_c,
+            target=target,
+            num_classes=1,
+            pos_label=c,
+            sample_weights=sample_weights,
+        )
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds.append(res[2])
+
+    return precision, recall, thresholds
+
+
+def precision_recall_curve(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[jax.Array, jax.Array, jax.Array], Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]]:
+    """Computes precision-recall pairs for different thresholds.
+
+    Args:
+        preds: predictions from model (probabilities)
+        target: ground truth labels
+        num_classes: number of classes (binary problems may omit it)
+        pos_label: the positive class; defaults to 1 for binary input and
+            must stay ``None`` for multiclass (each class takes a turn)
+        sample_weights: sample weights for each data point
+
+    Returns:
+        ``(precision, recall, thresholds)``; element ``i`` of precision/recall
+        is the score for predictions with ``score >= thresholds[i]``, with a
+        final ``(1, 0)`` point appended. Multiclass returns per-class lists.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0, 1, 2, 3])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> precision, recall, thresholds = precision_recall_curve(pred, target, pos_label=1)
+        >>> precision
+        Array([0.6666667, 0.5      , 0.       , 1.       ], dtype=float32)
+        >>> recall
+        Array([1. , 0.5, 0. , 0. ], dtype=float32)
+        >>> thresholds
+        Array([1, 2, 3], dtype=int32)
+    """
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    return _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)

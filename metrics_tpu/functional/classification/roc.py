@@ -1,0 +1,118 @@
+"""Receiver operating characteristic (functional).
+
+Parity: ``torchmetrics/functional/classification/roc.py``. The sorted
+cumulative counts come from the shared jitted kernel in
+``precision_recall_curve.py``; curve assembly (data-dependent lengths) runs
+eagerly at epoch-end.
+"""
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_clf_curve,
+    _precision_recall_curve_update,
+)
+
+
+def _roc_update(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, int, int]:
+    """Parity: reference ``roc.py:25-32`` (delegates to the curve canonicalizer)."""
+    preds, target, num_classes, pos_label = _precision_recall_curve_update(preds, target, num_classes, pos_label)
+    return preds, target, num_classes, pos_label
+
+
+def _roc_compute(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: int,
+    pos_label: int,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[jax.Array, jax.Array, jax.Array], Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]]:
+    """Parity: reference ``roc.py:35-85`` incl. the prepended ``(0, 0)`` point."""
+    if num_classes == 1 and preds.ndim == 1:  # binary
+        fps, tps, thresholds = _binary_clf_curve(
+            preds=preds, target=target, sample_weights=sample_weights, pos_label=pos_label
+        )
+        # extra threshold position so the curve starts at (0, 0)
+        tps = jnp.concatenate([jnp.zeros(1, tps.dtype), tps])
+        fps = jnp.concatenate([jnp.zeros(1, fps.dtype), fps])
+        thresholds = jnp.concatenate([thresholds[0:1] + 1, thresholds])
+
+        if float(fps[-1]) <= 0:
+            raise ValueError("No negative samples in targets, false positive value should be meaningless")
+        fpr = fps / fps[-1]
+
+        if float(tps[-1]) <= 0:
+            raise ValueError("No positive samples in targets, true positive value should be meaningless")
+        tpr = tps / tps[-1]
+
+        return fpr, tpr, thresholds
+
+    # Recursively call per class
+    fpr, tpr, thresholds = [], [], []
+    for c in range(num_classes):
+        if preds.shape == target.shape:
+            preds_c = preds[:, c]
+            target_c = target[:, c]
+            pos_label = 1
+        else:
+            preds_c = preds[:, c]
+            target_c = target
+            pos_label = c
+        res = roc(
+            preds=preds_c,
+            target=target_c,
+            num_classes=1,
+            pos_label=pos_label,
+            sample_weights=sample_weights,
+        )
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds.append(res[2])
+
+    return fpr, tpr, thresholds
+
+
+def roc(
+    preds: jax.Array,
+    target: jax.Array,
+    num_classes: Optional[int] = None,
+    pos_label: Optional[int] = None,
+    sample_weights: Optional[Sequence] = None,
+) -> Union[Tuple[jax.Array, jax.Array, jax.Array], Tuple[List[jax.Array], List[jax.Array], List[jax.Array]]]:
+    """Computes the Receiver Operating Characteristic (ROC).
+
+    Works with binary, multiclass and multilabel input.
+
+    Args:
+        preds: predictions from model (logits or probabilities)
+        target: ground truth values
+        num_classes: number of classes (binary problems may omit it)
+        pos_label: the positive class; defaults to 1 for binary input and
+            must stay ``None`` for multiclass
+        sample_weights: sample weights for each data point
+
+    Returns:
+        ``(fpr, tpr, thresholds)`` arrays; per-class lists for
+        multiclass/multilabel input.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([0, 1, 2, 3])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> fpr, tpr, thresholds = roc(pred, target, pos_label=1)
+        >>> fpr
+        Array([0., 0., 0., 0., 1.], dtype=float32)
+        >>> tpr
+        Array([0.        , 0.33333334, 0.6666667 , 1.        , 1.        ],      dtype=float32)
+        >>> thresholds
+        Array([4, 3, 2, 1, 0], dtype=int32)
+    """
+    preds, target, num_classes, pos_label = _roc_update(preds, target, num_classes, pos_label)
+    return _roc_compute(preds, target, num_classes, pos_label, sample_weights)

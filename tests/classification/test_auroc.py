@@ -1,0 +1,121 @@
+"""AUROC tests. Mirrors reference ``tests/classification/test_auroc.py``
+(the ``_TORCH_LOWER_1_6`` skips dissolve: ``searchsorted`` is always
+available on XLA; ``average='micro'`` is skipped for any multiclass-shaped
+input since neither implementation defines it there)."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score as sk_roc_auc_score
+
+from metrics_tpu.classification.auroc import AUROC
+from metrics_tpu.functional import auroc
+from tests.classification.inputs import _input_binary_prob
+from tests.classification.inputs import _input_multiclass_prob as _input_mcls_prob
+from tests.classification.inputs import _input_multidim_multiclass_prob as _input_mdmc_prob
+from tests.classification.inputs import _input_multilabel_multidim_prob as _input_mlmd_prob
+from tests.classification.inputs import _input_multilabel_prob as _input_mlb_prob
+from tests.helpers import seed_all
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+seed_all(42)
+
+
+def _sk_auroc_binary_prob(preds, target, num_classes, average="macro", max_fpr=None, multi_class="ovr"):
+    sk_preds = preds.reshape(-1)
+    sk_target = target.reshape(-1)
+    return sk_roc_auc_score(y_true=sk_target, y_score=sk_preds, average=average, max_fpr=max_fpr)
+
+
+def _sk_auroc_multiclass_prob(preds, target, num_classes, average="macro", max_fpr=None, multi_class="ovr"):
+    sk_preds = preds.reshape(-1, num_classes)
+    sk_target = target.reshape(-1)
+    return sk_roc_auc_score(
+        y_true=sk_target, y_score=sk_preds, average=average, max_fpr=max_fpr, multi_class=multi_class
+    )
+
+
+def _sk_auroc_multidim_multiclass_prob(preds, target, num_classes, average="macro", max_fpr=None, multi_class="ovr"):
+    sk_preds = np.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+    sk_target = target.reshape(-1)
+    return sk_roc_auc_score(
+        y_true=sk_target, y_score=sk_preds, average=average, max_fpr=max_fpr, multi_class=multi_class
+    )
+
+
+def _sk_auroc_multilabel_prob(preds, target, num_classes, average="macro", max_fpr=None, multi_class="ovr"):
+    sk_preds = preds.reshape(-1, num_classes)
+    sk_target = target.reshape(-1, num_classes)
+    return sk_roc_auc_score(y_true=sk_target, y_score=sk_preds, average=average, max_fpr=max_fpr)
+
+
+def _sk_auroc_multilabel_multidim_prob(preds, target, num_classes, average="macro", max_fpr=None, multi_class="ovr"):
+    sk_preds = np.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+    sk_target = np.swapaxes(target, 0, 1).reshape(num_classes, -1).T
+    return sk_roc_auc_score(y_true=sk_target, y_score=sk_preds, average=average, max_fpr=max_fpr)
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_auroc_binary_prob, 1),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, _sk_auroc_multiclass_prob, NUM_CLASSES),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, _sk_auroc_multidim_multiclass_prob, NUM_CLASSES),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, _sk_auroc_multilabel_prob, NUM_CLASSES),
+        (_input_mlmd_prob.preds, _input_mlmd_prob.target, _sk_auroc_multilabel_multidim_prob, NUM_CLASSES),
+    ],
+)
+@pytest.mark.parametrize("average", ["macro", "weighted", "micro"])
+@pytest.mark.parametrize("max_fpr", [None, 0.8, 0.5])
+class TestAUROC(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_auroc(self, preds, target, sk_metric, num_classes, average, max_fpr, ddp, dist_sync_on_step):
+        # max_fpr different from None is not supported in multi class
+        if max_fpr is not None and num_classes != 1:
+            pytest.skip("max_fpr parameter not support for multi class or multi label")
+
+        # average='micro' only supported for multilabel
+        if average == "micro" and preds.ndim == target.ndim + 1:
+            pytest.skip("micro argument only support for multilabel input")
+
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=AUROC,
+            sk_metric=partial(sk_metric, num_classes=num_classes, average=average, max_fpr=max_fpr),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes, "average": average, "max_fpr": max_fpr},
+        )
+
+    def test_auroc_functional(self, preds, target, sk_metric, num_classes, average, max_fpr):
+        if max_fpr is not None and num_classes != 1:
+            pytest.skip("max_fpr parameter not support for multi class or multi label")
+        if average == "micro" and preds.ndim == target.ndim + 1:
+            pytest.skip("micro argument only support for multilabel input")
+
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=auroc,
+            sk_metric=partial(sk_metric, num_classes=num_classes, average=average, max_fpr=max_fpr),
+            metric_args={"num_classes": num_classes, "average": average, "max_fpr": max_fpr},
+        )
+
+
+def test_error_on_different_mode():
+    """An error is raised if the user passes data of different modes
+    (binary, multi-label, multi-class) between updates."""
+    np.random.seed(42)
+    metric = AUROC()
+    # pass in multi-class data
+    probs = np.random.rand(10, 5)
+    probs = probs / probs.sum(1, keepdims=True)
+    metric.update(jnp.asarray(probs), jnp.asarray(np.random.randint(0, 5, (10,))))
+    with pytest.raises(ValueError, match=r"The mode of data.* should be constant.*"):
+        # pass in multi-label data
+        metric.update(jnp.asarray(np.random.rand(10, 5)), jnp.asarray(np.random.randint(0, 2, (10, 5))))

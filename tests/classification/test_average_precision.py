@@ -1,0 +1,90 @@
+"""AveragePrecision tests. Mirrors reference
+``tests/classification/test_average_precision.py``."""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_average_precision_score
+
+from metrics_tpu.classification.average_precision import AveragePrecision
+from metrics_tpu.functional import average_precision
+from tests.classification.inputs import _input_binary_prob
+from tests.classification.inputs import _input_multiclass_prob as _input_mcls_prob
+from tests.classification.inputs import _input_multidim_multiclass_prob as _input_mdmc_prob
+from tests.helpers import seed_all
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+seed_all(42)
+
+
+def _sk_average_precision_score(y_true, probas_pred, num_classes=1):
+    if num_classes == 1:
+        return sk_average_precision_score(y_true, probas_pred)
+
+    res = []
+    for i in range(num_classes):
+        y_true_temp = np.zeros_like(y_true)
+        y_true_temp[y_true == i] = 1
+        res.append(sk_average_precision_score(y_true_temp, probas_pred[:, i]))
+    return res
+
+
+def _sk_avg_prec_binary_prob(preds, target, num_classes=1):
+    return _sk_average_precision_score(target.reshape(-1), preds.reshape(-1), num_classes=num_classes)
+
+
+def _sk_avg_prec_multiclass_prob(preds, target, num_classes=1):
+    return _sk_average_precision_score(target.reshape(-1), preds.reshape(-1, num_classes), num_classes=num_classes)
+
+
+def _sk_avg_prec_multidim_multiclass_prob(preds, target, num_classes=1):
+    sk_preds = np.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+    return _sk_average_precision_score(target.reshape(-1), sk_preds, num_classes=num_classes)
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_avg_prec_binary_prob, 1),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, _sk_avg_prec_multiclass_prob, NUM_CLASSES),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, _sk_avg_prec_multidim_multiclass_prob, NUM_CLASSES),
+    ],
+)
+class TestAveragePrecision(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_average_precision(self, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=AveragePrecision,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes},
+        )
+
+    def test_average_precision_functional(self, preds, target, sk_metric, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=average_precision,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            metric_args={"num_classes": num_classes},
+        )
+
+
+@pytest.mark.parametrize(
+    ["scores", "target", "expected_score"],
+    [
+        # Constant-predictor AP equals the fraction of positives (single threshold)
+        pytest.param([1, 1, 1, 1], [0, 0, 0, 1], 0.25),
+        # With threshold 0.8: 1 TP, 2 TN and one FN
+        pytest.param([0.6, 0.7, 0.8, 9], [1, 0, 0, 1], 0.75),
+    ],
+)
+def test_average_precision(scores, target, expected_score):
+    assert float(average_precision(jnp.asarray(scores), jnp.asarray(target))) == expected_score

@@ -1,0 +1,107 @@
+"""PrecisionRecallCurve tests. Mirrors reference
+``tests/classification/test_precision_recall_curve.py``.
+
+Oracle note: sklearn >= 1.x keeps every full-recall point on the curve; the
+reference era truncates to the last full-recall point before appending the
+terminal ``(1, 0)``. ``_trim_full_recall`` re-applies that truncation.
+"""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import precision_recall_curve as sk_precision_recall_curve
+
+from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve
+from metrics_tpu.functional import precision_recall_curve
+from tests.classification.inputs import _input_binary_prob
+from tests.classification.inputs import _input_multiclass_prob as _input_mcls_prob
+from tests.classification.inputs import _input_multidim_multiclass_prob as _input_mdmc_prob
+from tests.helpers import seed_all
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+seed_all(42)
+
+
+def _trim_full_recall(precision, recall, thresholds):
+    """Truncate modern sklearn's duplicate leading full-recall points."""
+    d = 1
+    while d < len(recall) and recall[d] == recall[0]:
+        d += 1
+    return precision[d - 1:], recall[d - 1:], thresholds[d - 1:]
+
+
+def _sk_precision_recall_curve(y_true, probas_pred, num_classes=1):
+    if num_classes == 1:
+        return _trim_full_recall(*sk_precision_recall_curve(y_true, probas_pred))
+
+    precision, recall, thresholds = [], [], []
+    for i in range(num_classes):
+        y_true_temp = np.zeros_like(y_true)
+        y_true_temp[y_true == i] = 1
+        res = _trim_full_recall(*sk_precision_recall_curve(y_true_temp, probas_pred[:, i]))
+        precision.append(res[0])
+        recall.append(res[1])
+        thresholds.append(res[2])
+    return precision, recall, thresholds
+
+
+def _sk_prec_rc_binary_prob(preds, target, num_classes=1):
+    return _sk_precision_recall_curve(target.reshape(-1), preds.reshape(-1), num_classes=num_classes)
+
+
+def _sk_prec_rc_multiclass_prob(preds, target, num_classes=1):
+    return _sk_precision_recall_curve(target.reshape(-1), preds.reshape(-1, num_classes), num_classes=num_classes)
+
+
+def _sk_prec_rc_multidim_multiclass_prob(preds, target, num_classes=1):
+    sk_preds = np.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+    return _sk_precision_recall_curve(target.reshape(-1), sk_preds, num_classes=num_classes)
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_prec_rc_binary_prob, 1),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, _sk_prec_rc_multiclass_prob, NUM_CLASSES),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, _sk_prec_rc_multidim_multiclass_prob, NUM_CLASSES),
+    ],
+)
+class TestPrecisionRecallCurve(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_precision_recall_curve(self, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=PrecisionRecallCurve,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes},
+        )
+
+    def test_precision_recall_curve_functional(self, preds, target, sk_metric, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=precision_recall_curve,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            metric_args={"num_classes": num_classes},
+        )
+
+
+@pytest.mark.parametrize(
+    ["pred", "target", "expected_p", "expected_r", "expected_t"],
+    [pytest.param([1, 2, 3, 4], [1, 0, 0, 1], [0.5, 1 / 3, 0.5, 1.0, 1.0], [1, 0.5, 0.5, 0.5, 0.0], [1, 2, 3, 4])],
+)
+def test_pr_curve(pred, target, expected_p, expected_r, expected_t):
+    p, r, t = precision_recall_curve(jnp.asarray(pred), jnp.asarray(target))
+    assert p.shape == r.shape
+    assert p.shape[0] == t.shape[0] + 1
+
+    assert np.allclose(np.asarray(p), np.asarray(expected_p))
+    assert np.allclose(np.asarray(r), np.asarray(expected_r))
+    assert np.allclose(np.asarray(t), np.asarray(expected_t))

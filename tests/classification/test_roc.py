@@ -1,0 +1,127 @@
+"""ROC tests. Mirrors reference ``tests/classification/test_roc.py``.
+
+Oracle note: sklearn >= 1.2 returns ``inf`` as the first ROC threshold;
+the reference era (and this package, for parity) uses ``max_score + 1``,
+so the oracle rewrites that single entry.
+"""
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import roc_curve as sk_roc_curve
+
+from metrics_tpu.classification.roc import ROC
+from metrics_tpu.functional import roc
+from tests.classification.inputs import _input_binary_prob
+from tests.classification.inputs import _input_multiclass_prob as _input_mcls_prob
+from tests.classification.inputs import _input_multidim_multiclass_prob as _input_mdmc_prob
+from tests.classification.inputs import _input_multilabel_multidim_prob as _input_mlmd_prob
+from tests.classification.inputs import _input_multilabel_prob as _input_mlb_prob
+from tests.helpers import seed_all
+from tests.helpers.testers import NUM_CLASSES, MetricTester
+
+seed_all(42)
+
+
+def _sk_roc_curve_ref(y_true, probas_pred):
+    fpr, tpr, thresholds = sk_roc_curve(y_true, probas_pred, drop_intermediate=False)
+    thresholds = thresholds.copy()
+    thresholds[0] = thresholds[1] + 1  # reference-era convention: max score + 1
+    return fpr, tpr, thresholds
+
+
+def _sk_roc(y_true, probas_pred, num_classes: int = 1, multilabel: bool = False):
+    if num_classes == 1:
+        return _sk_roc_curve_ref(y_true, probas_pred)
+
+    fpr, tpr, thresholds = [], [], []
+    for i in range(num_classes):
+        if multilabel:
+            y_true_temp = y_true[:, i]
+        else:
+            y_true_temp = np.zeros_like(y_true)
+            y_true_temp[y_true == i] = 1
+        res = _sk_roc_curve_ref(y_true_temp, probas_pred[:, i])
+        fpr.append(res[0])
+        tpr.append(res[1])
+        thresholds.append(res[2])
+    return fpr, tpr, thresholds
+
+
+def _sk_roc_binary_prob(preds, target, num_classes=1):
+    return _sk_roc(target.reshape(-1), preds.reshape(-1), num_classes=num_classes)
+
+
+def _sk_roc_multiclass_prob(preds, target, num_classes=1):
+    return _sk_roc(target.reshape(-1), preds.reshape(-1, num_classes), num_classes=num_classes)
+
+
+def _sk_roc_multidim_multiclass_prob(preds, target, num_classes=1):
+    sk_preds = np.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+    return _sk_roc(target.reshape(-1), sk_preds, num_classes=num_classes)
+
+
+def _sk_roc_multilabel_prob(preds, target, num_classes=1):
+    return _sk_roc(target, preds, num_classes=num_classes, multilabel=True)
+
+
+def _sk_roc_multilabel_multidim_prob(preds, target, num_classes=1):
+    sk_preds = np.swapaxes(preds, 0, 1).reshape(num_classes, -1).T
+    sk_target = np.swapaxes(target, 0, 1).reshape(num_classes, -1).T
+    return _sk_roc(sk_target, sk_preds, num_classes=num_classes, multilabel=True)
+
+
+@pytest.mark.parametrize(
+    "preds, target, sk_metric, num_classes",
+    [
+        (_input_binary_prob.preds, _input_binary_prob.target, _sk_roc_binary_prob, 1),
+        (_input_mcls_prob.preds, _input_mcls_prob.target, _sk_roc_multiclass_prob, NUM_CLASSES),
+        (_input_mdmc_prob.preds, _input_mdmc_prob.target, _sk_roc_multidim_multiclass_prob, NUM_CLASSES),
+        (_input_mlb_prob.preds, _input_mlb_prob.target, _sk_roc_multilabel_prob, NUM_CLASSES),
+        (_input_mlmd_prob.preds, _input_mlmd_prob.target, _sk_roc_multilabel_multidim_prob, NUM_CLASSES),
+    ],
+)
+class TestROC(MetricTester):
+    atol = 1e-5
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_roc(self, preds, target, sk_metric, num_classes, ddp, dist_sync_on_step):
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=preds,
+            target=target,
+            metric_class=ROC,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_classes": num_classes},
+        )
+
+    def test_roc_functional(self, preds, target, sk_metric, num_classes):
+        self.run_functional_metric_test(
+            preds,
+            target,
+            metric_functional=roc,
+            sk_metric=partial(sk_metric, num_classes=num_classes),
+            metric_args={"num_classes": num_classes},
+        )
+
+
+@pytest.mark.parametrize(
+    ["pred", "target", "expected_tpr", "expected_fpr"],
+    [
+        pytest.param([0, 1], [0, 1], [0, 1, 1], [0, 0, 1]),
+        pytest.param([1, 0], [0, 1], [0, 0, 1], [0, 1, 1]),
+        pytest.param([1, 1], [1, 0], [0, 1], [0, 1]),
+        pytest.param([1, 0], [1, 0], [0, 1, 1], [0, 0, 1]),
+        pytest.param([0.5, 0.5], [0, 1], [0, 1], [0, 1]),
+    ],
+)
+def test_roc_curve(pred, target, expected_tpr, expected_fpr):
+    fpr, tpr, thresh = roc(jnp.asarray(pred), jnp.asarray(target))
+
+    assert fpr.shape == tpr.shape
+    assert fpr.shape[0] == thresh.shape[0]
+    assert np.allclose(np.asarray(fpr), np.asarray(expected_fpr))
+    assert np.allclose(np.asarray(tpr), np.asarray(expected_tpr))
