@@ -41,3 +41,10 @@ from metrics_tpu.regression import (  # noqa: F401, E402
     MeanSquaredLogError,
     R2Score,
 )
+from metrics_tpu.retrieval import (  # noqa: F401, E402
+    RetrievalMAP,
+    RetrievalMetric,
+    RetrievalMRR,
+    RetrievalPrecision,
+    RetrievalRecall,
+)

@@ -1,0 +1,4 @@
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision  # noqa: F401
+from metrics_tpu.functional.retrieval.precision import retrieval_precision  # noqa: F401
+from metrics_tpu.functional.retrieval.recall import retrieval_recall  # noqa: F401
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank  # noqa: F401

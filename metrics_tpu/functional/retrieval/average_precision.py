@@ -1,0 +1,42 @@
+"""Retrieval average precision (functional).
+
+Parity: ``torchmetrics/functional/retrieval/average_precision.py:20-51``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+
+@jax.jit
+def _ap_sorted(preds: jax.Array, target: jax.Array) -> jax.Array:
+    """AP over one query, fully vectorized (no boolean indexing).
+
+    The reference gathers the ranks of relevant documents and averages
+    ``(i+1)/rank_i``; the mask-weighted identity
+    ``sum(rel * cum_rel/rank) / n_rel`` computes the same value with static
+    shapes so it jits cleanly.
+    """
+    t_sorted = target[jnp.argsort(-preds, stable=True)].astype(jnp.float32)
+    rank = jnp.arange(1, target.shape[0] + 1, dtype=jnp.float32)
+    n_rel = jnp.sum(t_sorted)
+    ap = jnp.sum(t_sorted * jnp.cumsum(t_sorted) / rank) / jnp.maximum(n_rel, 1.0)
+    return jnp.where(n_rel == 0, 0.0, ap)
+
+
+def retrieval_average_precision(preds: jax.Array, target: jax.Array) -> jax.Array:
+    """Computes average precision for information retrieval over one query.
+
+    ``preds`` and ``target`` must be of the same shape; ``target`` is binary
+    (bool or 0/1 ints), ``preds`` float scores. Returns 0 if no ``target``
+    is positive.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_average_precision(preds, target)
+        Array(0.8333334, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    return _ap_sorted(preds.flatten(), target.flatten())

@@ -1,0 +1,45 @@
+"""Retrieval recall@k (functional).
+
+Parity: ``torchmetrics/functional/retrieval/recall.py:20-57``.
+"""
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _recall_sorted(preds: jax.Array, target: jax.Array, k: int) -> jax.Array:
+    t_sorted = target[jnp.argsort(-preds, stable=True)].astype(jnp.float32)
+    n_rel = jnp.sum(t_sorted)
+    relevant = jnp.sum(t_sorted[:k])
+    return jnp.where(n_rel == 0, 0.0, relevant / jnp.maximum(n_rel, 1.0))
+
+
+def retrieval_recall(preds: jax.Array, target: jax.Array, k: Optional[int] = None) -> jax.Array:
+    """Computes recall@k for information retrieval over one query.
+
+    Args:
+        preds: estimated relevance scores per document.
+        target: binary ground-truth relevance per document.
+        k: consider only the top k elements (default: all).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([True, False, True])
+        >>> retrieval_recall(preds, target, k=2)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+
+    if k is None:
+        k = preds.shape[-1]
+
+    if not (isinstance(k, int) and k > 0):
+        raise ValueError("`k` has to be a positive integer or None")
+
+    return _recall_sorted(preds.flatten(), target.flatten(), min(k, preds.size))

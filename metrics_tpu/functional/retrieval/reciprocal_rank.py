@@ -1,0 +1,33 @@
+"""Retrieval reciprocal rank (functional).
+
+Parity: ``torchmetrics/functional/retrieval/reciprocal_rank.py:20-53``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+
+@jax.jit
+def _rr_sorted(preds: jax.Array, target: jax.Array) -> jax.Array:
+    t_sorted = target[jnp.argsort(-preds, stable=True)].astype(jnp.float32)
+    rank = jnp.arange(1, target.shape[0] + 1, dtype=jnp.float32)
+    first = jnp.min(jnp.where(t_sorted > 0, rank, jnp.inf))
+    return jnp.where(jnp.isinf(first), 0.0, 1.0 / first)
+
+
+def retrieval_reciprocal_rank(preds: jax.Array, target: jax.Array) -> jax.Array:
+    """Computes reciprocal rank for information retrieval over one query.
+
+    Returns ``1/rank`` of the highest-scored relevant document, or 0 if no
+    ``target`` is positive.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> preds = jnp.array([0.2, 0.3, 0.5])
+        >>> target = jnp.array([False, True, False])
+        >>> retrieval_reciprocal_rank(preds, target)
+        Array(0.5, dtype=float32)
+    """
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    return _rr_sorted(preds.flatten(), target.flatten())

@@ -1,0 +1,2 @@
+"""TPU-friendly building-block ops shared across metric families."""
+from metrics_tpu.ops.segment import ranked_group_stats  # noqa: F401

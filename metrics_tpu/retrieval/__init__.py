@@ -1,0 +1,5 @@
+from metrics_tpu.retrieval.mean_average_precision import RetrievalMAP  # noqa: F401
+from metrics_tpu.retrieval.mean_reciprocal_rank import RetrievalMRR  # noqa: F401
+from metrics_tpu.retrieval.precision import RetrievalPrecision  # noqa: F401
+from metrics_tpu.retrieval.recall import RetrievalRecall  # noqa: F401
+from metrics_tpu.retrieval.retrieval_metric import IGNORE_IDX, RetrievalMetric  # noqa: F401

@@ -1,0 +1,40 @@
+"""Mean Average Precision for information retrieval.
+
+Parity: ``torchmetrics/retrieval/mean_average_precision.py:21-72``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+from metrics_tpu.ops.segment import RankedGroupStats
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+
+class RetrievalMAP(RetrievalMetric):
+    """Computes Mean Average Precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> rmap = RetrievalMAP()
+        >>> rmap(indexes, preds, target)
+        Array(0.7916667, dtype=float32)
+    """
+
+    def _score_groups(self, stats: RankedGroupStats) -> jax.Array:
+        return _map_segments(stats)
+
+    def _metric(self, preds: jax.Array, target: jax.Array) -> jax.Array:
+        return retrieval_average_precision(preds, target)
+
+
+@jax.jit
+def _map_segments(stats: RankedGroupStats) -> jax.Array:
+    """AP per group in one segment reduction: sum(rel·cum_rel/rank)/n_rel."""
+    num_groups = stats.pos_per_group.shape[0]
+    ap_sum = jax.ops.segment_sum(
+        stats.relevant * stats.cum_relevant / stats.rank, stats.group, num_segments=num_groups
+    )
+    return ap_sum / jnp.maximum(stats.pos_per_group, 1.0)

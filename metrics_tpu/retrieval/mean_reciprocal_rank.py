@@ -1,0 +1,40 @@
+"""Mean Reciprocal Rank for information retrieval.
+
+Parity: ``torchmetrics/retrieval/mean_reciprocal_rank.py:21-73``.
+"""
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
+from metrics_tpu.ops.segment import RankedGroupStats
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+
+
+class RetrievalMRR(RetrievalMetric):
+    """Computes Mean Reciprocal Rank over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> mrr = RetrievalMRR()
+        >>> mrr(indexes, preds, target)
+        Array(0.75, dtype=float32)
+    """
+
+    def _score_groups(self, stats: RankedGroupStats) -> jax.Array:
+        return _mrr_segments(stats)
+
+    def _metric(self, preds: jax.Array, target: jax.Array) -> jax.Array:
+        return retrieval_reciprocal_rank(preds, target)
+
+
+@jax.jit
+def _mrr_segments(stats: RankedGroupStats) -> jax.Array:
+    """1 / (rank of first relevant doc) per group via a segment-min."""
+    num_groups = stats.pos_per_group.shape[0]
+    first_rank = jax.ops.segment_min(
+        jnp.where(stats.relevant > 0, stats.rank, jnp.inf), stats.group, num_segments=num_groups
+    )
+    return jnp.where(jnp.isinf(first_rank), 0.0, 1.0 / jnp.maximum(first_rank, 1.0))

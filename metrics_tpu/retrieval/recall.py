@@ -1,0 +1,68 @@
+"""Recall@k for information retrieval.
+
+Parity: ``torchmetrics/retrieval/recall.py:21-99``.
+"""
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.retrieval.recall import retrieval_recall
+from metrics_tpu.ops.segment import RankedGroupStats
+from metrics_tpu.retrieval.retrieval_metric import IGNORE_IDX, RetrievalMetric
+
+
+class RetrievalRecall(RetrievalMetric):
+    """Computes mean Recall@k over queries.
+
+    Args:
+        k: consider only the top k elements for each query (default: all).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> indexes = jnp.array([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.array([False, False, True, False, True, False, True])
+        >>> r2 = RetrievalRecall(k=2)
+        >>> r2(indexes, preds, target)
+        Array(0.75, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        empty_target_action: str = "skip",
+        exclude: int = IGNORE_IDX,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+        k: Optional[int] = None,
+    ):
+        super().__init__(
+            empty_target_action=empty_target_action,
+            exclude=exclude,
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        if (k is not None) and not (isinstance(k, int) and k > 0):
+            raise ValueError("`k` has to be a positive integer or None")
+        self.k = k
+
+    def _score_groups(self, stats: RankedGroupStats) -> jax.Array:
+        return _recall_segments(stats, self.k)
+
+    def _metric(self, preds: jax.Array, target: jax.Array) -> jax.Array:
+        return retrieval_recall(preds, target, k=self.k)
+
+
+def _recall_segments(stats: RankedGroupStats, k: Optional[int]) -> jax.Array:
+    """Relevant-in-top-k / total-relevant per group."""
+    num_groups = stats.pos_per_group.shape[0]
+    sizes = jax.ops.segment_sum(jnp.ones_like(stats.relevant), stats.group, num_segments=num_groups)
+    k_per_group = sizes if k is None else jnp.minimum(float(k), sizes)
+    in_topk = stats.rank <= k_per_group[stats.group]
+    hits = jax.ops.segment_sum(stats.relevant * in_topk, stats.group, num_segments=num_groups)
+    return hits / jnp.maximum(stats.pos_per_group, 1.0)

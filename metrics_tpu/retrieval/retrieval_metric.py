@@ -1,0 +1,146 @@
+"""Base class for grouped-query (information retrieval) metrics.
+
+Parity: ``torchmetrics/retrieval/retrieval_metric.py:28-147`` — same states
+(``idx``/``preds``/``target`` cat-lists), same ``empty_target_action``
+semantics, same mean-over-queries contract.
+
+TPU-native design: ``compute()`` does NOT loop over queries. Query ids are
+densified host-side once (``np.unique``), then ranking + per-query scores for
+the whole epoch run as one XLA program (stable sort + segment reductions, see
+:mod:`metrics_tpu.ops.segment`). Subclasses provide the vectorized per-group
+scoring via :meth:`_score_groups`; the reference's per-query extension point
+:meth:`_metric` is kept as a fallback path for user subclasses.
+"""
+from abc import ABC
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.ops.segment import RankedGroupStats, ranked_group_stats
+from metrics_tpu.utilities.checks import _check_retrieval_inputs
+
+#: predictions with target equal to this value are excluded from scoring
+IGNORE_IDX = -100
+
+
+class RetrievalMetric(Metric, ABC):
+    """Works with binary target data; accepts float predictions.
+
+    ``forward``/``update`` accept same-shape ``indexes``, ``preds`` and
+    ``target`` (flattened on entry). ``indexes`` say which query each
+    prediction belongs to; ``compute()`` scores each query and returns the
+    mean over queries.
+
+    Args:
+        empty_target_action:
+            What to do with queries that have no positive target:
+            ``'skip'`` (default) drops them (0.0 if all are dropped),
+            ``'error'`` raises, ``'pos'`` scores them 1.0, ``'neg'`` 0.0.
+        exclude:
+            Do not take into account predictions where the target is equal to
+            this value. default `-100`
+        compute_on_step / dist_sync_on_step / process_group / dist_sync_fn:
+            see :class:`metrics_tpu.Metric`.
+    """
+
+    def __init__(
+        self,
+        empty_target_action: str = "skip",
+        exclude: int = IGNORE_IDX,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        empty_target_action_options = ("error", "skip", "pos", "neg")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(f"`empty_target_action` received a wrong value {empty_target_action}.")
+
+        self.empty_target_action = empty_target_action
+        self.exclude = exclude
+
+        self.add_state("idx", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, idx: jax.Array, preds: jax.Array, target: jax.Array) -> None:
+        """Check shape, check and convert dtypes, flatten and add to accumulators."""
+        idx, preds, target = _check_retrieval_inputs(idx, preds, target, ignore=IGNORE_IDX)
+        self.idx.append(idx.flatten())
+        self.preds.append(preds.flatten())
+        self.target.append(target.flatten())
+
+    def compute(self) -> jax.Array:
+        """Mean of the per-query scores (empty queries per ``empty_target_action``)."""
+        idx = jnp.concatenate(list(self.idx), axis=0)
+        preds = jnp.concatenate(list(self.preds), axis=0)
+        target = jnp.concatenate(list(self.target), axis=0)
+
+        # drop excluded predictions entirely (reference filters them inside
+        # each `_metric` call; filtering up-front is equivalent and keeps the
+        # segment math uniform)
+        valid = np.asarray(target != self.exclude)
+        idx_np = np.asarray(idx)[valid]
+        preds = preds[jnp.asarray(valid)]
+        target = target[jnp.asarray(valid)]
+
+        # densify query ids host-side; group count becomes a static shape
+        _, dense = np.unique(idx_np, return_inverse=True)
+        num_groups = int(dense.max()) + 1 if dense.size else 0
+        if num_groups == 0:
+            return jnp.asarray(0.0, dtype=jnp.float32)
+
+        stats = ranked_group_stats(jnp.asarray(dense.astype(np.int32)), preds, target, num_groups)
+        scores = self._score_groups(stats)
+
+        if self.empty_target_action == "error" and bool(jnp.any(stats.pos_per_group == 0)):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+
+        return _reduce_over_queries(scores, stats.pos_per_group, self.empty_target_action)
+
+    def _score_groups(self, stats: RankedGroupStats) -> jax.Array:
+        """Vectorized per-group scores ``(G,)``; fallback loops via ``_metric``.
+
+        Built-in subclasses override this with a single segment-reduction XLA
+        program. User subclasses that only implement the reference-style
+        per-query :meth:`_metric` get correct (slower) behavior from this
+        host-side loop.
+        """
+        scores = []
+        for g in range(int(stats.pos_per_group.shape[0])):
+            mask = np.asarray(stats.group == g)
+            # recover scores consistent with ranking: relevance in rank order
+            rel = jnp.asarray(np.asarray(stats.relevant)[mask])
+            fake_preds = -jnp.arange(rel.shape[0], dtype=jnp.float32)  # already rank-ordered
+            scores.append(self._metric(fake_preds, rel.astype(jnp.int32)))
+        return jnp.stack(scores) if scores else jnp.zeros((0,), dtype=jnp.float32)
+
+    def _metric(self, preds: jax.Array, target: jax.Array) -> jax.Array:
+        """Score a single query (reference extension point)."""
+        raise NotImplementedError
+
+
+@partial(jax.jit, static_argnames=("action",))
+def _reduce_over_queries(scores: jax.Array, pos_per_group: jax.Array, action: str = "skip") -> jax.Array:
+    """Apply ``empty_target_action`` and average over queries."""
+    empty = pos_per_group == 0
+    if action == "pos":
+        scores = jnp.where(empty, 1.0, scores)
+    elif action == "neg":
+        scores = jnp.where(empty, 0.0, scores)
+    else:  # skip (error was raised eagerly before)
+        n_kept = jnp.sum(~empty)
+        total = jnp.sum(jnp.where(empty, 0.0, scores))
+        return jnp.where(n_kept == 0, 0.0, total / jnp.maximum(n_kept, 1))
+    return jnp.mean(scores)

@@ -1,0 +1,122 @@
+"""Shared harness for retrieval metric tests.
+
+Mirrors the reference's ``tests/retrieval/helpers.py``: per-query numpy/sklearn
+oracles averaged per ``empty_target_action``, shuffled flat inputs to force
+the metric to regroup, and exact error-message checks.
+"""
+from typing import Callable, List
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.helpers import seed_all
+
+seed_all(1337)
+
+
+def _compute_sklearn_metric(
+    metric: Callable, target: List[np.ndarray], preds: List[np.ndarray], behaviour: str, **kwargs
+) -> np.ndarray:
+    """Compute the oracle with one iteration per query's predictions."""
+    sk_results = []
+
+    for b, a in zip(target, preds):
+        if b.sum() == 0:
+            if behaviour == "skip":
+                pass
+            elif behaviour == "pos":
+                sk_results.append(1.0)
+            else:
+                sk_results.append(0.0)
+        else:
+            sk_results.append(metric(b, a, **kwargs))
+
+    if len(sk_results) > 0:
+        return np.mean(sk_results)
+    return np.array(0.0)
+
+
+def _test_retrieval_against_sklearn(
+    sklearn_metric: Callable,
+    jax_metric,
+    size: int,
+    n_documents: int,
+    empty_target_action: str,
+    **kwargs,
+) -> None:
+    """Compare a retrieval metric to the per-query oracle on shuffled inputs."""
+    metric = jax_metric(empty_target_action=empty_target_action, **kwargs)
+    shape = (size,)
+
+    indexes = []
+    preds = []
+    target = []
+
+    for i in range(n_documents):
+        indexes.append(np.ones(shape, dtype=np.int64) * i)
+        preds.append(np.random.randn(*shape))
+        target.append(np.random.randn(*shape) > 0)
+
+    sk_result = _compute_sklearn_metric(sklearn_metric, target, preds, empty_target_action, **kwargs)
+
+    indexes_all = np.concatenate(indexes)
+    preds_all = np.concatenate(preds).astype(np.float32)
+    target_all = np.concatenate(target).astype(np.int64)
+
+    # assume data are not ordered: shuffle to require regrouping
+    perm = np.random.permutation(indexes_all.size)
+    result = metric(jnp.asarray(indexes_all[perm]), jnp.asarray(preds_all[perm]), jnp.asarray(target_all[perm]))
+
+    assert np.allclose(np.asarray(result, dtype=np.float64), sk_result, atol=1e-6), (
+        f"Test failed comparing metric {sklearn_metric} with {jax_metric}: {sk_result} vs {result}."
+    )
+
+
+def _test_dtypes(jax_metric) -> None:
+    """Check inputs are validated with the reference's exact error messages."""
+    length = 10
+
+    indexes = jnp.asarray(np.zeros(length, dtype=np.int64))
+    preds = jnp.asarray(np.random.rand(length).astype(np.float32))
+    target = jnp.asarray(np.zeros(length, dtype=np.bool_))
+
+    metric = jax_metric(empty_target_action="error")
+    with pytest.raises(ValueError, match="`compute` method was provided with a query with no positive target."):
+        metric(indexes, preds, target)
+
+    casual_argument = "casual_argument"
+    with pytest.raises(ValueError, match=f"`empty_target_action` received a wrong value {casual_argument}."):
+        jax_metric(empty_target_action=casual_argument)
+
+    indexes = jnp.asarray(np.zeros(length, dtype=np.int64))
+    preds = jnp.asarray(np.zeros(length, dtype=np.float32))
+    target = jnp.asarray(np.zeros(length, dtype=np.int64))
+
+    metric = jax_metric(empty_target_action="error")
+
+    with pytest.raises(ValueError, match="`indexes` must be a tensor of long integers"):
+        metric(indexes.astype(jnp.bool_), preds, target)
+    with pytest.raises(ValueError, match="`preds` must be a tensor of floats"):
+        metric(indexes, preds.astype(jnp.bool_), target)
+    with pytest.raises(ValueError, match="`target` must be a tensor of booleans or integers"):
+        metric(indexes, preds, target.astype(jnp.float32))
+
+
+def _test_input_shapes(jax_metric) -> None:
+    """Check shape mismatches are rejected."""
+    metric = jax_metric(empty_target_action="error")
+
+    elements_1, elements_2 = np.random.choice(np.arange(1, 20), size=2, replace=False)
+    indexes = jnp.asarray(np.zeros(int(elements_1), dtype=np.int64))
+    preds = jnp.asarray(np.zeros(int(elements_2), dtype=np.float32))
+    target = jnp.asarray(np.zeros(int(elements_2), dtype=np.int64))
+
+    with pytest.raises(ValueError, match="`indexes`, `preds` and `target` must be of the same shape"):
+        metric(indexes, preds, target)
+
+
+def _test_input_args(jax_metric, message: str, **kwargs) -> None:
+    """Check invalid constructor args are rejected with the right message."""
+    with pytest.raises(ValueError, match=message):
+        jax_metric(**kwargs)

@@ -1,0 +1,20 @@
+import pytest
+from sklearn.metrics import average_precision_score as sk_average_precision
+
+from metrics_tpu.retrieval import RetrievalMAP
+from tests.retrieval.helpers import _test_dtypes, _test_input_shapes, _test_retrieval_against_sklearn
+
+
+@pytest.mark.parametrize("size", [1, 4, 10])
+@pytest.mark.parametrize("n_documents", [1, 5])
+@pytest.mark.parametrize("empty_target_action", ["skip", "pos", "neg"])
+def test_results(size, n_documents, empty_target_action):
+    _test_retrieval_against_sklearn(sk_average_precision, RetrievalMAP, size, n_documents, empty_target_action)
+
+
+def test_dtypes():
+    _test_dtypes(RetrievalMAP)
+
+
+def test_input_shapes() -> None:
+    _test_input_shapes(RetrievalMAP)

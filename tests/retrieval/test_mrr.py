@@ -1,0 +1,54 @@
+import numpy as np
+import pytest
+from sklearn.metrics import label_ranking_average_precision_score
+
+from metrics_tpu.retrieval import RetrievalMRR
+from tests.retrieval.helpers import _test_dtypes, _test_input_shapes, _test_retrieval_against_sklearn
+
+
+def _reciprocal_rank(target: np.ndarray, preds: np.ndarray):
+    """Implementation of reciprocal rank via sklearn's LRAP on the
+    first-relevant-only target (matches the reference oracle)."""
+    assert target.shape == preds.shape
+    assert len(target.shape) == 1
+
+    target = target[np.argsort(preds, axis=-1)][::-1]
+    first_relevant_position = np.nonzero(target)[0]
+
+    if len(first_relevant_position) == 0:
+        return 0.0
+    return 1.0 / (first_relevant_position[0] + 1)
+
+
+def test_against_sklearn_lrap():
+    """MRR equals sklearn's label_ranking_average_precision when each query
+    has exactly one relevant document."""
+    rng = np.random.RandomState(7)
+    n_queries, n_docs = 16, 8
+    preds = rng.rand(n_queries, n_docs).astype(np.float32)
+    target = np.zeros((n_queries, n_docs), dtype=np.int64)
+    target[np.arange(n_queries), rng.randint(n_docs, size=n_queries)] = 1
+
+    import jax.numpy as jnp
+
+    indexes = np.repeat(np.arange(n_queries), n_docs)
+    metric = RetrievalMRR()
+    result = metric(jnp.asarray(indexes), jnp.asarray(preds.ravel()), jnp.asarray(target.ravel()))
+
+    expected = label_ranking_average_precision_score(target, preds)
+    assert np.allclose(np.asarray(result), expected, atol=1e-6)
+
+
+@pytest.mark.parametrize("size", [1, 4, 10])
+@pytest.mark.parametrize("n_documents", [1, 5])
+@pytest.mark.parametrize("empty_target_action", ["skip", "pos", "neg"])
+def test_results(size, n_documents, empty_target_action):
+    _test_retrieval_against_sklearn(_reciprocal_rank, RetrievalMRR, size, n_documents, empty_target_action)
+
+
+def test_dtypes():
+    _test_dtypes(RetrievalMRR)
+
+
+def test_input_shapes() -> None:
+    _test_input_shapes(RetrievalMRR)

@@ -1,0 +1,46 @@
+import numpy as np
+import pytest
+
+from metrics_tpu.retrieval import RetrievalPrecision
+from tests.retrieval.helpers import (
+    _test_dtypes,
+    _test_input_args,
+    _test_input_shapes,
+    _test_retrieval_against_sklearn,
+)
+
+
+def _precision_at_k(target: np.ndarray, preds: np.ndarray, k: int = None):
+    """Per-query precision@k oracle (relevant-in-top-k over requested k)."""
+    assert target.shape == preds.shape
+    assert len(target.shape) == 1
+
+    if k is None:
+        k = len(preds)
+
+    if target.sum() > 0:
+        order_indexes = np.argsort(preds, axis=0)[::-1]
+        relevant = np.sum(target[order_indexes][:k])
+        return relevant * 1.0 / k
+    return np.nan
+
+
+@pytest.mark.parametrize("size", [1, 4, 10])
+@pytest.mark.parametrize("n_documents", [1, 5])
+@pytest.mark.parametrize("empty_target_action", ["skip", "pos", "neg"])
+@pytest.mark.parametrize("k", [None, 1, 4, 10])
+def test_results(size, n_documents, empty_target_action, k):
+    _test_retrieval_against_sklearn(_precision_at_k, RetrievalPrecision, size, n_documents, empty_target_action, k=k)
+
+
+def test_dtypes():
+    _test_dtypes(RetrievalPrecision)
+
+
+def test_input_shapes() -> None:
+    _test_input_shapes(RetrievalPrecision)
+
+
+@pytest.mark.parametrize("k", [-1, 1.0])
+def test_input_params(k) -> None:
+    _test_input_args(RetrievalPrecision, "`k` has to be a positive integer or None", k=k)
