@@ -25,6 +25,7 @@ from metrics_tpu.classification import (  # noqa: F401, E402
     ConfusionMatrix,
     FBeta,
     HammingDistance,
+    Hinge,
     IoU,
     MatthewsCorrcoef,
     Precision,
@@ -41,6 +42,8 @@ from metrics_tpu.regression import (  # noqa: F401, E402
     MeanSquaredLogError,
     R2Score,
 )
+from metrics_tpu.collections import MetricCollection  # noqa: F401, E402
+from metrics_tpu.wrappers import BootStrapper  # noqa: F401, E402
 from metrics_tpu.retrieval import (  # noqa: F401, E402
     RetrievalMAP,
     RetrievalMetric,

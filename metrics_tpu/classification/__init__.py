@@ -6,6 +6,7 @@ from metrics_tpu.classification.cohen_kappa import CohenKappa  # noqa: F401
 from metrics_tpu.classification.confusion_matrix import ConfusionMatrix  # noqa: F401
 from metrics_tpu.classification.f_beta import F1, FBeta  # noqa: F401
 from metrics_tpu.classification.hamming_distance import HammingDistance  # noqa: F401
+from metrics_tpu.classification.hinge import Hinge  # noqa: F401
 from metrics_tpu.classification.iou import IoU  # noqa: F401
 from metrics_tpu.classification.matthews_corrcoef import MatthewsCorrcoef  # noqa: F401
 from metrics_tpu.classification.precision_recall import Precision, Recall  # noqa: F401

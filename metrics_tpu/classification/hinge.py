@@ -1,0 +1,77 @@
+"""Hinge loss (module). Parity: ``torchmetrics/classification/hinge.py:21-123``."""
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.classification.hinge import MulticlassMode, _hinge_compute, _hinge_update
+from metrics_tpu.metric import Metric
+
+
+class Hinge(Metric):
+    r"""Computes the mean Hinge loss, typically used for SVMs.
+
+    See :func:`metrics_tpu.functional.hinge` for the formulas. Accumulates a
+    summed measure and a count; sync is a plain ``psum``.
+
+    Args:
+        squared: if True, compute the squared hinge loss.
+        multiclass_mode: None / ``'crammer-singer'`` (default) or
+            ``'one-vs-all'``.
+
+    Example (binary case):
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 1])
+        >>> preds = jnp.array([-2.2, 2.4, 0.1])
+        >>> hinge = Hinge()
+        >>> hinge(preds, target)
+        Array(0.29999998, dtype=float32)
+
+        >>> target = jnp.array([0, 1, 2])
+        >>> preds = jnp.array([[-1.0, 0.9, 0.2], [0.5, -1.1, 0.8], [2.2, -0.5, 0.3]])
+        >>> hinge = Hinge()
+        >>> hinge(preds, target)
+        Array(2.9000003, dtype=float32)
+
+        >>> hinge = Hinge(multiclass_mode="one-vs-all")
+        >>> hinge(preds, target)
+        Array([2.2333333, 1.5      , 1.2333333], dtype=float32)
+    """
+
+    def __init__(
+        self,
+        squared: bool = False,
+        multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+
+        self.add_state("measure", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+        if multiclass_mode not in (None, MulticlassMode.CRAMMER_SINGER, MulticlassMode.ONE_VS_ALL):
+            raise ValueError(
+                "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+                "(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL,"
+                f" got {multiclass_mode}."
+            )
+
+        self.squared = squared
+        self.multiclass_mode = multiclass_mode
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        measure, total = _hinge_update(preds, target, squared=self.squared, multiclass_mode=self.multiclass_mode)
+
+        self.measure = measure + self.measure
+        self.total = total + self.total
+
+    def compute(self) -> jax.Array:
+        return _hinge_compute(self.measure, self.total)

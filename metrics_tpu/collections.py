@@ -1,0 +1,152 @@
+"""MetricCollection: chain same-call-pattern metrics into one object.
+
+Parity: ``torchmetrics/collections.py:23-156``. The reference subclasses
+``nn.ModuleDict``; here a plain ordered mapping suffices since JAX metrics
+have no module machinery.
+"""
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from metrics_tpu.metric import Metric
+
+
+class MetricCollection:
+    """Chain metrics with the same call pattern into one single class.
+
+    Args:
+        metrics: One of the following
+
+            * list or tuple: uses the metric class names as output-dict keys;
+              two metrics of the same class cannot be chained this way.
+            * dict: uses the given keys, allowing multiple instances of the
+              same metric class with different parameters.
+
+        prefix: a string to append in front of the keys of the output dict
+
+    Example (input as list):
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import MetricCollection, Accuracy, Precision, Recall
+        >>> target = jnp.array([0, 2, 0, 2, 0, 1, 0, 2])
+        >>> preds = jnp.array([2, 1, 2, 0, 1, 2, 2, 2])
+        >>> metrics = MetricCollection([Accuracy(),
+        ...                             Precision(num_classes=3, average='macro'),
+        ...                             Recall(num_classes=3, average='macro')])
+        >>> {k: float(v) for k, v in metrics(preds, target).items()}  # doctest: +ELLIPSIS
+        {'Accuracy': 0.125, 'Precision': 0.06..., 'Recall': 0.11...}
+
+    Example (input as dict):
+        >>> metrics = MetricCollection({'micro_recall': Recall(num_classes=3, average='micro'),
+        ...                             'macro_recall': Recall(num_classes=3, average='macro')})
+        >>> sorted(metrics(preds, target))
+        ['macro_recall', 'micro_recall']
+    """
+
+    def __init__(
+        self,
+        metrics: Union[List[Metric], Tuple[Metric, ...], Dict[str, Metric]],
+        prefix: Optional[str] = None,
+    ):
+        self._metrics: "OrderedDict[str, Metric]" = OrderedDict()
+        if isinstance(metrics, dict):
+            for name, metric in metrics.items():
+                if not isinstance(metric, Metric):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of `metrics_tpu.Metric`"
+                    )
+                self[name] = metric
+        elif isinstance(metrics, (tuple, list)):
+            for metric in metrics:
+                if not isinstance(metric, Metric):
+                    raise ValueError(
+                        f"Input {metric} to `MetricCollection` is not a instance of `metrics_tpu.Metric`"
+                    )
+                name = metric.__class__.__name__
+                if name in self:
+                    raise ValueError(f"Encountered two metrics both named {name}")
+                self[name] = metric
+        else:
+            raise ValueError("Unknown input to MetricCollection.")
+
+        self.prefix = self._check_prefix_arg(prefix)
+
+    # --- mapping protocol (stands in for the reference's nn.ModuleDict) ---
+    def __getitem__(self, key: str) -> Metric:
+        return self._metrics[key]
+
+    def __setitem__(self, key: str, value: Metric) -> None:
+        self._metrics[key] = value
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._metrics
+
+    def __len__(self) -> int:
+        return len(self._metrics)
+
+    def __iter__(self):
+        return iter(self._metrics)
+
+    def keys(self):
+        return self._metrics.keys()
+
+    def values(self):
+        return self._metrics.values()
+
+    def items(self):
+        return self._metrics.items()
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Call forward for each metric; kwargs are filtered per metric signature."""
+        return {self._set_prefix(k): m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
+
+    __call__ = forward
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Call update for each metric; kwargs are filtered per metric signature."""
+        for _, m in self.items():
+            m.update(*args, **m._filter_kwargs(**kwargs))
+
+    def compute(self) -> Dict[str, Any]:
+        return {self._set_prefix(k): m.compute() for k, m in self.items()}
+
+    def reset(self) -> None:
+        """Call reset for each metric."""
+        for _, m in self.items():
+            m.reset()
+
+    def clone(self, prefix: Optional[str] = None) -> "MetricCollection":
+        """Make a copy of the metric collection, optionally with a new prefix."""
+        mc = deepcopy(self)
+        mc.prefix = self._check_prefix_arg(prefix)
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        """Change whether metric states are saved to ``state_dict``."""
+        for _, m in self.items():
+            m.persistent(mode)
+
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
+        destination = {} if destination is None else destination
+        for k, m in self.items():
+            m.state_dict(destination, prefix=f"{prefix}{k}.")
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        for k, m in self.items():
+            m.load_state_dict(state_dict, prefix=f"{prefix}{k}.")
+
+    def to_device(self, device) -> "MetricCollection":
+        for _, m in self.items():
+            m.to_device(device)
+        return self
+
+    def _set_prefix(self, k: str) -> str:
+        return k if self.prefix is None else self.prefix + k
+
+    @staticmethod
+    def _check_prefix_arg(prefix: Optional[str]) -> Optional[str]:
+        if prefix is not None:
+            if isinstance(prefix, str):
+                return prefix
+            raise ValueError("Expected input `prefix` to be a string")
+        return None

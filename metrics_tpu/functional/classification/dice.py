@@ -1,0 +1,106 @@
+"""Dice score (functional). Parity: ``torchmetrics/functional/classification/dice.py``.
+
+The reference loops over classes in Python, calling a per-class
+``_stat_scores``; here the per-class TP/FP/FN come from one confusion-style
+bincount so the whole score is a single XLA program.
+"""
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.data import to_categorical
+from metrics_tpu.utilities.distributed import reduce
+
+
+def _stat_scores(
+    preds: jax.Array,
+    target: jax.Array,
+    class_index: int,
+    argmax_dim: int = 1,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """TP/FP/TN/FN/support for one class (reference ``dice.py:23-60``).
+
+    Kept for API parity with the reference's legacy per-class helper; the
+    dice computation itself uses the vectorized ``_dice_score_jit`` below.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> x = jnp.array([1, 2, 3])
+        >>> y = jnp.array([0, 2, 3])
+        >>> tp, fp, tn, fn, sup = _stat_scores(x, y, class_index=1)
+        >>> tp, fp, tn, fn, sup
+        (Array(0, dtype=int32), Array(1, dtype=int32), Array(2, dtype=int32), Array(0, dtype=int32), Array(0, dtype=int32))
+    """
+    if preds.ndim == target.ndim + 1:
+        preds = to_categorical(preds, argmax_dim=argmax_dim)
+
+    tp = jnp.sum((preds == class_index) & (target == class_index)).astype(jnp.int32)
+    fp = jnp.sum((preds == class_index) & (target != class_index)).astype(jnp.int32)
+    tn = jnp.sum((preds != class_index) & (target != class_index)).astype(jnp.int32)
+    fn = jnp.sum((preds != class_index) & (target == class_index)).astype(jnp.int32)
+    sup = jnp.sum(target == class_index).astype(jnp.int32)
+
+    return tp, fp, tn, fn, sup
+
+
+@partial(jax.jit, static_argnames=("bg", "nan_score", "no_fg_score", "reduction"))
+def _dice_score_jit(
+    pred: jax.Array,
+    target: jax.Array,
+    bg: bool,
+    nan_score: float,
+    no_fg_score: float,
+    reduction: str,
+) -> jax.Array:
+    num_classes = pred.shape[1]
+    start = 1 - int(bool(bg))
+    classes = jnp.arange(start, num_classes)
+
+    # probabilities (one extra dim vs target) get argmaxed; labels pass through
+    cat = to_categorical(pred) if pred.ndim == target.ndim + 1 else pred
+    pred_onehot = cat.reshape(-1)[:, None] == classes  # (N*, C-bg)
+    target_onehot = target.reshape(-1)[:, None] == classes
+
+    tp = jnp.sum(pred_onehot & target_onehot, axis=0).astype(jnp.float32)
+    fp = jnp.sum(pred_onehot & ~target_onehot, axis=0).astype(jnp.float32)
+    fn = jnp.sum(~pred_onehot & target_onehot, axis=0).astype(jnp.float32)
+    support = jnp.sum(target_onehot, axis=0)
+
+    denom = 2 * tp + fp + fn
+    score = jnp.where(denom > 0, 2 * tp / jnp.maximum(denom, 1.0), nan_score)
+    scores = jnp.where(support > 0, score, no_fg_score).astype(jnp.float32)
+
+    return reduce(scores, reduction=reduction)
+
+
+def dice_score(
+    pred: jax.Array,
+    target: jax.Array,
+    bg: bool = False,
+    nan_score: float = 0.0,
+    no_fg_score: float = 0.0,
+    reduction: str = "elementwise_mean",
+) -> jax.Array:
+    """Compute dice score from prediction scores.
+
+    Args:
+        pred: estimated probabilities ``(N, C, ...)``.
+        target: ground-truth labels ``(N, ...)``.
+        bg: whether to also compute dice for the background.
+        nan_score: score to return if a NaN occurs (empty denominator).
+        no_fg_score: score to return if a class has no foreground pixel.
+        reduction: ``'elementwise_mean'`` | ``'sum'`` | ``'none'``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> pred = jnp.array([[0.85, 0.05, 0.05, 0.05],
+        ...                   [0.05, 0.85, 0.05, 0.05],
+        ...                   [0.05, 0.05, 0.85, 0.05],
+        ...                   [0.05, 0.05, 0.05, 0.85]])
+        >>> target = jnp.array([0, 1, 3, 2])
+        >>> dice_score(pred, target)
+        Array(0.33333334, dtype=float32)
+    """
+    return _dice_score_jit(pred, target, bool(bg), float(nan_score), float(no_fg_score), reduction)

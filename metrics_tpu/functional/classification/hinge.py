@@ -1,0 +1,138 @@
+"""Hinge loss (functional). Parity: ``torchmetrics/functional/classification/hinge.py``."""
+from functools import partial
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.enums import DataType, EnumStr
+
+
+class MulticlassMode(EnumStr):
+    """Enum to represent possible multiclass modes of hinge.
+
+    >>> "Crammer-Singer" in list(MulticlassMode)
+    True
+    """
+
+    CRAMMER_SINGER = "crammer-singer"
+    ONE_VS_ALL = "one-vs-all"
+
+
+def _check_shape_and_type_consistency_hinge(preds: jax.Array, target: jax.Array) -> DataType:
+    if target.ndim > 1:
+        raise ValueError(f"The `target` should be one dimensional, got `target` with shape={target.shape}.")
+
+    if preds.ndim == 1:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,",
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}.",
+            )
+        mode = DataType.BINARY
+    elif preds.ndim == 2:
+        if preds.shape[0] != target.shape[0]:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape in the first dimension,",
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}.",
+            )
+        mode = DataType.MULTICLASS
+    else:
+        raise ValueError(f"The `preds` should be one or two dimensional, got `preds` with shape={preds.shape}.")
+    return mode
+
+
+@partial(jax.jit, static_argnames=("mode", "squared", "one_vs_all"))
+def _hinge_measures(preds, target, mode, squared, one_vs_all):
+    """Summed hinge measures, fully vectorized (no boolean fancy indexing)."""
+    mode = DataType(mode)
+    if mode == DataType.MULTICLASS:
+        num_classes = max(2, preds.shape[1])
+        onehot = target[:, None] == jnp.arange(num_classes)
+
+        if one_vs_all:
+            # every class pitted against the rest: (N, C) signed margins
+            margin = jnp.where(onehot, preds, -preds)
+        else:
+            # Crammer-Singer: true-class score minus the best other score
+            p_true = jnp.sum(jnp.where(onehot, preds, 0.0), axis=1)
+            p_other = jnp.max(jnp.where(onehot, -jnp.inf, preds), axis=1)
+            margin = p_true - p_other
+    else:
+        margin = jnp.where(target > 0, preds, -preds)
+
+    measures = jnp.clip(1 - margin, min=0)
+    if squared:
+        measures = measures**2
+
+    return jnp.sum(measures, axis=0), jnp.asarray(target.shape[0], dtype=jnp.int32)
+
+
+def _hinge_update(
+    preds: jax.Array,
+    target: jax.Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    if preds.shape[0] == 1:
+        # keep the batch dim when squeezing a single-sample input
+        preds, target = preds.squeeze()[None, ...], target.squeeze()[None, ...]
+    else:
+        preds, target = preds.squeeze(), target.squeeze()
+
+    mode = _check_shape_and_type_consistency_hinge(preds, target)
+
+    if mode == DataType.MULTICLASS:
+        if multiclass_mode is None or multiclass_mode == MulticlassMode.CRAMMER_SINGER:
+            one_vs_all = False
+        elif multiclass_mode == MulticlassMode.ONE_VS_ALL:
+            one_vs_all = True
+        else:
+            raise ValueError(
+                "The `multiclass_mode` should be either None / 'crammer-singer' / MulticlassMode.CRAMMER_SINGER"
+                "(default) or 'one-vs-all' / MulticlassMode.ONE_VS_ALL,"
+                f" got {multiclass_mode}."
+            )
+    else:
+        one_vs_all = False
+
+    return _hinge_measures(preds, target, mode.value, squared, one_vs_all)
+
+
+def _hinge_compute(measure: jax.Array, total: jax.Array) -> jax.Array:
+    return measure / total
+
+
+def hinge(
+    preds: jax.Array,
+    target: jax.Array,
+    squared: bool = False,
+    multiclass_mode: Optional[Union[str, MulticlassMode]] = None,
+) -> jax.Array:
+    r"""Computes the mean Hinge loss, typically used for SVMs.
+
+    Binary: ``max(0, 1 - y*ŷ)`` with ``y ∈ {-1, 1}``. Multiclass default is
+    the Crammer-Singer loss ``max(0, 1 - ŷ_y + max_{i≠y} ŷ_i)``;
+    ``multiclass_mode='one-vs-all'`` instead returns a vector of C
+    one-vs-rest losses. ``squared=True`` squares the per-sample measures.
+
+    Only accepts preds shape (N) (binary) or (N, C) (multi-class) and target
+    shape (N).
+
+    Example (binary case):
+        >>> import jax.numpy as jnp
+        >>> target = jnp.array([0, 1, 1])
+        >>> preds = jnp.array([-2.2, 2.4, 0.1])
+        >>> hinge(preds, target)
+        Array(0.29999998, dtype=float32)
+
+        >>> target = jnp.array([0, 1, 2])
+        >>> preds = jnp.array([[-1.0, 0.9, 0.2], [0.5, -1.1, 0.8], [2.2, -0.5, 0.3]])
+        >>> hinge(preds, target)
+        Array(2.9000003, dtype=float32)
+
+        >>> hinge(preds, target, multiclass_mode="one-vs-all")
+        Array([2.2333333, 1.5      , 1.2333333], dtype=float32)
+    """
+    measure, total = _hinge_update(preds, target, squared=squared, multiclass_mode=multiclass_mode)
+    return _hinge_compute(measure, total)

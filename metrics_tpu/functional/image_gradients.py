@@ -1,0 +1,45 @@
+"""Image gradients by finite differences.
+
+Parity: ``torchmetrics/functional/image_gradients.py:107-170``.
+"""
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _image_gradients_validate(img) -> None:
+    """Validates whether img is a 4D jax array."""
+    if not isinstance(img, (jax.Array, jnp.ndarray)):
+        raise TypeError(f"The `img` expects a value of <jax.Array> type but got {type(img)}")
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+
+
+@jax.jit
+def _compute_image_gradients(img: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """1-step forward differences, zero-padded at the far edge."""
+    dy = jnp.pad(img[..., 1:, :] - img[..., :-1, :], ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(img[..., :, 1:] - img[..., :, :-1], ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx
+
+
+def image_gradients(img: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Computes image gradients (dy, dx) of an ``(N, C, H, W)`` image batch.
+
+    The gradient of ``I(x+1, y) - I(x, y)`` is stored at location ``(x, y)``
+    (1-step finite difference, matching the TF convention).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> image = jnp.arange(0, 1*1*5*5, dtype=jnp.float32).reshape((1, 1, 5, 5))
+        >>> dy, dx = image_gradients(image)
+        >>> dy[0, 0, :, :]
+        Array([[5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [5., 5., 5., 5., 5.],
+               [0., 0., 0., 0., 0.]], dtype=float32)
+    """
+    _image_gradients_validate(img)
+    return _compute_image_gradients(img)

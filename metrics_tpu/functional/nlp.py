@@ -1,0 +1,87 @@
+"""BLEU score. Parity: ``torchmetrics/functional/nlp.py:26-112``.
+
+Operates on tokenized string sequences (host-side Python — n-gram counting
+over strings is not tensor work); only the final arithmetic is an array.
+"""
+from collections import Counter
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def _count_ngram(ngram_input_list: List[str], n_gram: int) -> Counter:
+    """Count every 1..n-gram occurrence in a token list."""
+    ngram_counter: Counter = Counter()
+
+    for i in range(1, n_gram + 1):
+        for j in range(len(ngram_input_list) - i + 1):
+            ngram_key = tuple(ngram_input_list[j:(i + j)])
+            ngram_counter[ngram_key] += 1
+
+    return ngram_counter
+
+
+def bleu_score(
+    translate_corpus: Sequence[Sequence[str]],
+    reference_corpus: Sequence[Sequence[Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+) -> jax.Array:
+    """Calculate BLEU score of machine-translated text with one or more references.
+
+    Args:
+        translate_corpus: An iterable of machine translated corpus
+        reference_corpus: An iterable of iterables of reference corpus
+        n_gram: Gram value ranged from 1 to 4 (Default 4)
+        smooth: Whether or not to apply smoothing - Lin et al. 2004
+
+    Example:
+        >>> translate_corpus = ['the cat is on the mat'.split()]
+        >>> reference_corpus = [['there is a cat on the mat'.split(), 'a cat is on the mat'.split()]]
+        >>> bleu_score(translate_corpus, reference_corpus)
+        Array(0.75983566, dtype=float32)
+    """
+    assert len(translate_corpus) == len(reference_corpus)
+    numerator = [0.0] * n_gram
+    denominator = [0.0] * n_gram
+    c = 0.0
+    r = 0.0
+
+    for translation, references in zip(translate_corpus, reference_corpus):
+        c += len(translation)
+        # closest reference length (ties go to the first/shorter)
+        ref_len_list = [len(ref) for ref in references]
+        ref_len_diff = [abs(len(translation) - x) for x in ref_len_list]
+        r += ref_len_list[ref_len_diff.index(min(ref_len_diff))]
+        translation_counter = _count_ngram(list(translation), n_gram)
+        reference_counter: Counter = Counter()
+
+        for ref in references:
+            reference_counter |= _count_ngram(list(ref), n_gram)
+
+        # clipped counts: per n-gram, no more credit than the best reference
+        ngram_counter_clip = translation_counter & reference_counter
+
+        for counter_clip in ngram_counter_clip:
+            numerator[len(counter_clip) - 1] += ngram_counter_clip[counter_clip]
+
+        for counter in translation_counter:
+            denominator[len(counter) - 1] += translation_counter[counter]
+
+    if min(numerator) == 0.0:
+        return jnp.asarray(0.0, dtype=jnp.float32)
+
+    num = jnp.asarray(numerator, dtype=jnp.float32)
+    denom = jnp.asarray(denominator, dtype=jnp.float32)
+    if smooth:
+        # Lin & Och (2004) add-1 smoothing; unigram precision stays unsmoothed
+        # (matching nltk's SmoothingFunction.method2)
+        ones = jnp.asarray([0.0] + [1.0] * (n_gram - 1), dtype=jnp.float32)
+        precision_scores = (num + ones) / (denom + ones)
+    else:
+        precision_scores = num / denom
+
+    geometric_mean = jnp.exp(jnp.sum(jnp.log(precision_scores) / n_gram))
+    brevity_penalty = jnp.asarray(1.0) if c > r else jnp.exp(1 - jnp.asarray(r / c))
+    return (brevity_penalty * geometric_mean).astype(jnp.float32)

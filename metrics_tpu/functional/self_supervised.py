@@ -1,0 +1,52 @@
+"""Embedding similarity matrix.
+
+Parity: ``torchmetrics/functional/self_supervised.py:20-57``. The pairwise
+matmul is a single MXU-friendly ``(B, D) @ (D, B)`` contraction.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("similarity", "reduction", "zero_diagonal"))
+def embedding_similarity(
+    batch: jax.Array,
+    similarity: str = "cosine",
+    reduction: str = "none",
+    zero_diagonal: bool = True,
+) -> jax.Array:
+    """Computes pairwise representation similarity of a ``(batch, dim)`` array.
+
+    Args:
+        batch: (batch, dim)
+        similarity: 'dot' or 'cosine'
+        reduction: 'none', 'sum', 'mean' (all along dim -1)
+        zero_diagonal: if True, the diagonal is set to zero
+
+    Return:
+        A ``(batch, batch)`` similarity matrix, or ``(batch,)`` when reduced.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> embeddings = jnp.array([[1., 2., 3., 4.], [1., 2., 3., 4.], [4., 5., 6., 7.]])
+        >>> embedding_similarity(embeddings)
+        Array([[0.        , 1.        , 0.97588956],
+               [1.        , 0.        , 0.97588956],
+               [0.97588956, 0.97588956, 0.        ]], dtype=float32)
+    """
+    if similarity == "cosine":
+        norm = jnp.linalg.norm(batch, ord=2, axis=1)
+        batch = batch / norm[:, None]
+
+    sqr_mtx = batch @ batch.T
+
+    if zero_diagonal:
+        sqr_mtx = sqr_mtx * (1 - jnp.eye(batch.shape[0], dtype=batch.dtype))
+
+    if reduction == "mean":
+        sqr_mtx = jnp.mean(sqr_mtx, axis=-1)
+    if reduction == "sum":
+        sqr_mtx = jnp.sum(sqr_mtx, axis=-1)
+
+    return sqr_mtx

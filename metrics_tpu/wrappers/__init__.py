@@ -1,0 +1,1 @@
+from metrics_tpu.wrappers.bootstrapping import BootStrapper  # noqa: F401

@@ -1,0 +1,162 @@
+"""BootStrapper: confidence intervals by resampled metric replicas.
+
+Parity: ``torchmetrics/wrappers/bootstrapping.py:25-170``. The reference
+keeps ``num_bootstraps`` deepcopied modules and resamples inputs per copy;
+the same design is kept here (metric state is cheap pytrees), with the
+resampling indices drawn host-side so every replica's update stays a
+static-shape XLA program: ``'poisson'`` draws per-sample counts n~Poisson(1)
+and repeats indices (approximating the true bootstrap for large N),
+``'multinomial'`` draws N samples with replacement (fixed-size, the
+TPU-friendliest choice).
+"""
+from copy import deepcopy
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import apply_to_collection
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str = "poisson") -> jax.Array:
+    """Index array resampling ``size`` elements along dim 0 with replacement."""
+    if sampling_strategy == "poisson":
+        n = np.random.poisson(1.0, size=size)
+        idx = np.repeat(np.arange(size), n)
+        if idx.size == 0:
+            # an all-zero draw (probability e^-N) would give the wrapped
+            # metric a zero-length batch; fall back to a single resample
+            idx = np.random.randint(0, size, size=1)
+        return jnp.asarray(idx.astype(np.int32))
+    if sampling_strategy == "multinomial":
+        return jnp.asarray(np.random.randint(0, size, size=size).astype(np.int32))
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(Metric):
+    r"""Turn a metric into a bootstrapped metric for confidence intervals.
+
+    Keeps ``num_bootstraps`` copies of the base metric; every ``update`` /
+    ``forward`` resamples the input tensors (with replacement) along dim 0
+    once per copy.
+
+    Args:
+        base_metric: base metric instance to wrap.
+        num_bootstraps: number of resampled copies.
+        mean: if True, ``compute`` returns the mean of the bootstraps.
+        std: if True, ``compute`` returns the standard deviation.
+        quantile: if given, returns this quantile of the bootstraps.
+        raw: if True, return all bootstrapped values.
+        sampling_strategy: ``'poisson'`` or ``'multinomial'`` (see module docs).
+
+    Example:
+        >>> import numpy as np
+        >>> import jax.numpy as jnp
+        >>> from metrics_tpu import Accuracy
+        >>> from metrics_tpu.wrappers import BootStrapper
+        >>> np.random.seed(123)
+        >>> bootstrap = BootStrapper(Accuracy(), num_bootstraps=20)
+        >>> bootstrap.update(jnp.asarray(np.random.randint(5, size=20)),
+        ...                  jnp.asarray(np.random.randint(5, size=20)))
+        >>> sorted(bootstrap.compute())
+        ['mean', 'std']
+    """
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, jax.Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ) -> None:
+        super().__init__(compute_on_step, dist_sync_on_step, process_group, dist_sync_fn)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of metrics_tpu.Metric but received {base_metric}"
+            )
+
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but recieved {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+
+    def forward(self, *args: Any, **kwargs: Any):
+        """Batch-value forward with the snapshot taken over the CHILD metrics.
+
+        ``Metric.forward`` snapshots only states registered on self, which is
+        empty here (state lives in the replicas), so the base implementation
+        would wipe accumulated bootstrap state; snapshot/restore the children
+        instead.
+        """
+        self.update(*args, **kwargs)
+        self._forward_cache = None
+
+        if self.compute_on_step:
+            caches = [{k: getattr(m, k) for k in m._defaults} for m in self.metrics]
+            for m in self.metrics:
+                m.reset()
+            self.update(*args, **kwargs)
+            self._computed = None
+            self._forward_cache = self.compute()
+            for m, cache in zip(self.metrics, caches):
+                for k, v in cache.items():
+                    setattr(m, k, v)
+                m._computed = None
+            self._computed = None
+            return self._forward_cache
+
+    __call__ = forward
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update all replicas, each on its own resampling of the inputs."""
+        arrays = [a for a in args if isinstance(a, (jax.Array, jnp.ndarray))]
+        arrays += [v for v in kwargs.values() if isinstance(v, (jax.Array, jnp.ndarray))]
+        if not arrays:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        size = len(arrays[0])
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, sampling_strategy=self.sampling_strategy)
+            new_args = apply_to_collection(args, (jax.Array, jnp.ndarray), lambda x: jnp.take(x, sample_idx, axis=0))
+            new_kwargs = apply_to_collection(
+                kwargs, (jax.Array, jnp.ndarray), lambda x: jnp.take(x, sample_idx, axis=0)
+            )
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, jax.Array]:
+        """Bootstrapped metric values: dict of ``mean``/``std``/``quantile``/``raw``."""
+        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        output_dict = {}
+        if self.mean:
+            output_dict["mean"] = jnp.mean(computed_vals, axis=0)
+        if self.std:
+            # ddof=1 matches torch.std's default (sample standard deviation)
+            output_dict["std"] = jnp.std(computed_vals, axis=0, ddof=1)
+        if self.quantile is not None:
+            output_dict["quantile"] = jnp.quantile(computed_vals, self.quantile)
+        if self.raw:
+            output_dict["raw"] = computed_vals
+        return output_dict
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()

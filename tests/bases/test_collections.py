@@ -1,0 +1,123 @@
+import pickle
+
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.collections import MetricCollection
+from tests.helpers import seed_all
+from tests.helpers.testers import DummyMetricDiff, DummyMetricSum
+
+seed_all(42)
+
+
+def test_metric_collection():
+    m1 = DummyMetricSum()
+    m2 = DummyMetricDiff()
+
+    metric_collection = MetricCollection([m1, m2])
+
+    # correct dict structure
+    assert len(metric_collection) == 2
+    assert metric_collection["DummyMetricSum"] == m1
+    assert metric_collection["DummyMetricDiff"] == m2
+
+    # correct initialization
+    for name, metric in metric_collection.items():
+        assert metric.x == 0, f"Metric {name} not initialized correctly"
+
+    # every metric gets updated
+    metric_collection.update(5)
+    for name, metric in metric_collection.items():
+        assert jnp.abs(metric.x) == 5, f"Metric {name} not updated correctly"
+
+    # compute on each metric
+    metric_collection.update(-5)
+    metric_vals = metric_collection.compute()
+    assert len(metric_vals) == 2
+    for name, metric_val in metric_vals.items():
+        assert metric_val == 0, f"Metric {name}.compute not called correctly"
+
+    # everything is reset
+    metric_collection.reset()
+    for name, metric in metric_collection.items():
+        assert metric.x == 0, f"Metric {name} not reset correctly"
+
+    # picklable
+    metric_pickled = pickle.dumps(metric_collection)
+    metric_loaded = pickle.loads(metric_pickled)
+    assert isinstance(metric_loaded, MetricCollection)
+
+
+def test_metric_collection_wrong_input():
+    """Check that errors are raised on wrong input."""
+    m1 = DummyMetricSum()
+
+    # not all inputs are metrics (list)
+    with pytest.raises(ValueError):
+        _ = MetricCollection([m1, 5])
+
+    # not all inputs are metrics (dict)
+    with pytest.raises(ValueError):
+        _ = MetricCollection({"metric1": m1, "metric2": 5})
+
+    # same metric passed in multiple times
+    with pytest.raises(ValueError, match="Encountered two metrics both named *."):
+        _ = MetricCollection([m1, m1])
+
+    # not a list or dict passed in
+    with pytest.raises(ValueError, match="Unknown input to MetricCollection."):
+        _ = MetricCollection(m1)
+
+
+def test_metric_collection_args_kwargs():
+    """Check that args and kwargs get routed correctly in update and forward."""
+    m1 = DummyMetricSum()
+    m2 = DummyMetricDiff()
+
+    metric_collection = MetricCollection([m1, m2])
+
+    # args get passed to all metrics
+    metric_collection.update(5)
+    assert metric_collection["DummyMetricSum"].x == 5
+    assert metric_collection["DummyMetricDiff"].x == -5
+    metric_collection.reset()
+    _ = metric_collection(5)
+    assert metric_collection["DummyMetricSum"].x == 5
+    assert metric_collection["DummyMetricDiff"].x == -5
+    metric_collection.reset()
+
+    # kwargs get only passed to the metrics whose signature matches
+    metric_collection.update(x=10, y=20)
+    assert metric_collection["DummyMetricSum"].x == 10
+    assert metric_collection["DummyMetricDiff"].x == -20
+    metric_collection.reset()
+    _ = metric_collection(x=10, y=20)
+    assert metric_collection["DummyMetricSum"].x == 10
+    assert metric_collection["DummyMetricDiff"].x == -20
+
+
+def test_metric_collection_prefix():
+    """Check prefix is applied to output keys and clone can change it."""
+    m1 = DummyMetricSum()
+    metric_collection = MetricCollection([m1], prefix="new_prefix_")
+
+    out = metric_collection(5)
+    assert "new_prefix_DummyMetricSum" in out
+
+    # clone with new prefix
+    new_collection = metric_collection.clone(prefix="another_")
+    out = new_collection(5)
+    assert "another_DummyMetricSum" in out
+
+    with pytest.raises(ValueError, match="Expected input `prefix` to be a string"):
+        MetricCollection([DummyMetricSum()], prefix=5)
+
+
+def test_metric_collection_same_order():
+    """Updates hit replicas in the collection in a deterministic order."""
+    m1 = DummyMetricSum()
+    m2 = DummyMetricDiff()
+    col1 = MetricCollection({"a": m1, "b": m2})
+    col1.update(5)
+    res = col1.compute()
+    assert res["a"] == 5 and res["b"] == -5

@@ -1,0 +1,107 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import dice_score
+from metrics_tpu.functional.classification.precision_recall_curve import _binary_clf_curve
+from metrics_tpu.utilities.data import get_num_classes, to_categorical, to_onehot
+from tests.helpers import seed_all
+
+
+def test_onehot():
+    test_array = jnp.array([[0, 1, 2, 3, 4], [5, 6, 7, 8, 9]])
+    expected = np.stack(
+        [
+            np.concatenate([np.eye(5, dtype=int), np.zeros((5, 5), dtype=int)]),
+            np.concatenate([np.zeros((5, 5), dtype=int), np.eye(5, dtype=int)]),
+        ]
+    )
+
+    assert test_array.shape == (2, 5)
+    assert expected.shape == (2, 10, 5)
+
+    onehot_classes = to_onehot(test_array, num_classes=10)
+    onehot_no_classes = to_onehot(test_array)
+
+    assert np.allclose(np.asarray(onehot_classes), np.asarray(onehot_no_classes))
+    assert onehot_classes.shape == expected.shape
+    assert onehot_no_classes.shape == expected.shape
+    assert np.allclose(expected, np.asarray(onehot_no_classes))
+    assert np.allclose(expected, np.asarray(onehot_classes))
+
+
+def test_to_categorical():
+    test_array = jnp.asarray(
+        np.stack(
+            [
+                np.concatenate([np.eye(5, dtype=int), np.zeros((5, 5), dtype=int)]),
+                np.concatenate([np.zeros((5, 5), dtype=int), np.eye(5, dtype=int)]),
+            ]
+        ).astype(np.float32)
+    )
+
+    expected = np.array([[0, 1, 2, 3, 4], [5, 6, 7, 8, 9]])
+    assert expected.shape == (2, 5)
+    assert test_array.shape == (2, 10, 5)
+
+    result = to_categorical(test_array)
+
+    assert result.shape == expected.shape
+    assert np.allclose(np.asarray(result), expected)
+
+
+@pytest.mark.parametrize(
+    ["preds_shape", "target_high", "target_shape", "num_classes", "expected_num_classes"],
+    [
+        ((32, 10, 28, 28), 10, (32, 28, 28), 10, 10),
+        ((32, 10, 28, 28), 10, (32, 28, 28), None, 10),
+        ((32, 28, 28), 10, (32, 28, 28), None, 10),
+    ],
+)
+def test_get_num_classes(preds_shape, target_high, target_shape, num_classes, expected_num_classes):
+    seed_all(0)
+    preds = jnp.asarray(np.random.rand(*preds_shape).astype(np.float32))
+    target = jnp.asarray(np.random.randint(target_high, size=target_shape))
+    # ensure the max class is actually present so inference matches the oracle
+    target = target.at[(0,) * target.ndim].set(target_high - 1)
+    assert get_num_classes(preds, target, num_classes) == expected_num_classes
+
+
+@pytest.mark.parametrize(
+    ["sample_weight", "pos_label"],
+    [
+        pytest.param(1, 1.0),
+        pytest.param(None, 1.0),
+    ],
+)
+def test_binary_clf_curve(sample_weight, pos_label):
+    seed_all(0)
+    pred_np = np.random.randint(low=51, high=99, size=(100,)).astype(np.float32)
+    pred = jnp.asarray(pred_np) / 100
+    target = jnp.asarray(np.array([0, 1] * 50, dtype=np.int32))
+    exp_shape = np.unique(pred_np).size  # one point per distinct threshold
+    if sample_weight is not None:
+        sample_weight = jnp.ones_like(pred) * sample_weight
+
+    fps, tps, thresh = _binary_clf_curve(preds=pred, target=target, sample_weights=sample_weight, pos_label=pos_label)
+
+    assert isinstance(tps, (jnp.ndarray,))
+    assert isinstance(fps, (jnp.ndarray,))
+    assert isinstance(thresh, (jnp.ndarray,))
+    assert tps.shape == (exp_shape,)
+    assert fps.shape == (exp_shape,)
+    assert thresh.shape == (exp_shape,)
+
+
+@pytest.mark.parametrize(
+    ["pred", "target", "expected"],
+    [
+        pytest.param([[0, 0], [1, 1]], [[0, 0], [1, 1]], 1.0),
+        pytest.param([[1, 1], [0, 0]], [[0, 0], [1, 1]], 0.0),
+        pytest.param([[1, 1], [1, 1]], [[1, 1], [0, 0]], 2 / 3),
+        pytest.param([[1, 1], [0, 0]], [[1, 1], [0, 0]], 1.0),
+    ],
+)
+def test_dice_score(pred, target, expected):
+    score = dice_score(jnp.asarray(pred, dtype=jnp.float32), jnp.asarray(target))
+    assert np.allclose(float(score), expected)

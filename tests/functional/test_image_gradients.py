@@ -1,0 +1,70 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.functional import image_gradients
+
+
+def test_invalid_input_type():
+    """Non-array input raises a TypeError."""
+    img = [[1, 2, 4], [3, 4, 6]]
+    with pytest.raises(TypeError):
+        image_gradients(img)
+
+
+def test_invalid_input_ndims():
+    """Non-4D input raises a RuntimeError."""
+    img = jnp.reshape(jnp.arange(0, 5 * 5, dtype=jnp.float32), (5, 5))
+    with pytest.raises(RuntimeError):
+        image_gradients(img)
+
+
+def test_multi_batch_image_gradients():
+    """Gradients of a known ramp image are exact for every batch element."""
+    batch_size, channels, height, width = 5, 1, 5, 5
+    single_channel_img = jnp.arange(0, height * width, dtype=jnp.float32).reshape(1, 1, height, width)
+    image = jnp.tile(single_channel_img, (batch_size, channels, 1, 1))
+
+    true_dy = np.array(
+        [
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [0.0, 0.0, 0.0, 0.0, 0.0],
+        ]
+    )
+
+    dy, dx = image_gradients(image)
+    for i in range(batch_size):
+        assert np.allclose(np.asarray(dy[i, 0, :, :]), true_dy)
+    assert dy.shape == (batch_size, 1, height, width)
+    assert dx.shape == (batch_size, 1, height, width)
+
+
+def test_image_gradients():
+    """Gradients of a known 5x5 ramp match the finite-difference convention."""
+    image = jnp.arange(0, 5 * 5, dtype=jnp.float32).reshape(1, 1, 5, 5)
+
+    true_dy = np.array(
+        [
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [5.0, 5.0, 5.0, 5.0, 5.0],
+            [0.0, 0.0, 0.0, 0.0, 0.0],
+        ]
+    )
+    true_dx = np.array(
+        [
+            [1.0, 1.0, 1.0, 1.0, 0.0],
+            [1.0, 1.0, 1.0, 1.0, 0.0],
+            [1.0, 1.0, 1.0, 1.0, 0.0],
+            [1.0, 1.0, 1.0, 1.0, 0.0],
+            [1.0, 1.0, 1.0, 1.0, 0.0],
+        ]
+    )
+
+    dy, dx = image_gradients(image)
+    assert np.allclose(np.asarray(dy[0, 0]), true_dy)
+    assert np.allclose(np.asarray(dx[0, 0]), true_dx)

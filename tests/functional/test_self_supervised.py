@@ -1,0 +1,35 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import pairwise
+
+from metrics_tpu.functional import embedding_similarity
+
+
+@pytest.mark.parametrize("similarity", ["cosine", "dot"])
+@pytest.mark.parametrize("reduction", ["none", "mean", "sum"])
+def test_against_sklearn(similarity, reduction):
+    """Compare embedding similarity against the sklearn pairwise oracles."""
+    np.random.seed(12)
+    batch = np.random.rand(10, 5).astype(np.float32)
+
+    result = embedding_similarity(jnp.asarray(batch), similarity=similarity, reduction=reduction, zero_diagonal=False)
+
+    if similarity == "cosine":
+        sk_result = pairwise.cosine_similarity(batch)
+    else:
+        sk_result = pairwise.linear_kernel(batch)
+
+    if reduction == "mean":
+        sk_result = sk_result.mean(axis=-1)
+    elif reduction == "sum":
+        sk_result = sk_result.sum(axis=-1)
+
+    assert np.allclose(np.asarray(result), sk_result, atol=1e-5)
+
+
+def test_zero_diagonal():
+    np.random.seed(12)
+    batch = np.random.rand(6, 4).astype(np.float32)
+    result = embedding_similarity(jnp.asarray(batch), zero_diagonal=True)
+    assert np.allclose(np.diag(np.asarray(result)), 0.0)

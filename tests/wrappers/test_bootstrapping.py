@@ -1,0 +1,108 @@
+import operator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import precision_score, recall_score
+
+from metrics_tpu.classification import Precision, Recall
+from metrics_tpu.utilities.data import apply_to_collection
+from metrics_tpu.wrappers.bootstrapping import BootStrapper, _bootstrap_sampler
+from tests.helpers import seed_all
+
+seed_all(42)
+
+_preds = np.random.randint(10, size=(10, 32))
+_target = np.random.randint(10, size=(10, 32))
+
+
+class _TestBootStrapper(BootStrapper):
+    """Subclass exposing the exact permutations the wrapper creates."""
+
+    def update(self, *args) -> None:
+        self.out = []
+        for idx in range(self.num_bootstraps):
+            size = len(args[0])
+            sample_idx = _bootstrap_sampler(size, sampling_strategy=self.sampling_strategy)
+            new_args = apply_to_collection(
+                args, (jax.Array, jnp.ndarray), lambda x: jnp.take(x, sample_idx, axis=0)
+            )
+            self.metrics[idx].update(*new_args)
+            self.out.append(new_args)
+
+
+def _sample_checker(old_samples, new_samples, op, threshold: int):
+    found_one = False
+    for os in old_samples:
+        cond = op(os, new_samples)
+        if np.asarray(cond).sum() > threshold:
+            found_one = True
+            break
+    return found_one
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+def test_bootstrap_sampler(sampling_strategy):
+    """Make sure that the bootstrap sampler works as intended."""
+    old_samples = np.random.randn(10, 2)
+
+    # new samples must consist only of old samples
+    idx = _bootstrap_sampler(10, sampling_strategy=sampling_strategy)
+    new_samples = old_samples[np.asarray(idx)]
+    for ns in new_samples:
+        assert any(np.allclose(ns, os) for os in old_samples)
+
+    found_one = _sample_checker(old_samples, new_samples, operator.eq, 2)
+    assert found_one, "resampling did not work because no samples were sampled twice"
+
+    found_zero = _sample_checker(old_samples, new_samples, operator.ne, 0)
+    assert found_zero, "resampling did not work because all samples were at least sampled once"
+
+
+@pytest.mark.parametrize("sampling_strategy", ["poisson", "multinomial"])
+@pytest.mark.parametrize(
+    "metric_cls, metric_kwargs, sk_metric",
+    [
+        (Precision, dict(average="micro"), precision_score),
+        (Recall, dict(average="micro"), recall_score),
+    ],
+)
+def test_bootstrap(sampling_strategy, metric_cls, metric_kwargs, sk_metric):
+    """Bootstraps see the expected resamples and compute() aggregates them."""
+    _kwargs = {
+        "base_metric": metric_cls(**metric_kwargs),
+        "mean": True,
+        "std": True,
+        "raw": True,
+        "quantile": 0.95,
+        "sampling_strategy": sampling_strategy,
+    }
+    bootstrapper = _TestBootStrapper(**_kwargs)
+
+    collected_preds = [[] for _ in range(10)]
+    collected_target = [[] for _ in range(10)]
+    for p, t in zip(_preds, _target):
+        bootstrapper.update(jnp.asarray(p), jnp.asarray(t))
+
+        for i, o in enumerate(bootstrapper.out):
+            collected_preds[i].append(np.asarray(o[0]))
+            collected_target[i].append(np.asarray(o[1]))
+
+    collected_preds = [np.concatenate(cp) for cp in collected_preds]
+    collected_target = [np.concatenate(ct) for ct in collected_target]
+
+    sk_scores = [sk_metric(ct, cp, average="micro") for ct, cp in zip(collected_target, collected_preds)]
+
+    output = bootstrapper.compute()
+    assert np.allclose(np.asarray(output["quantile"]), np.quantile(sk_scores, 0.95), atol=1e-6)
+    assert np.allclose(np.asarray(output["mean"]), np.mean(sk_scores), atol=1e-6)
+    assert np.allclose(np.asarray(output["std"]), np.std(sk_scores, ddof=1), atol=1e-6)
+    assert np.allclose(np.asarray(output["raw"]), sk_scores, atol=1e-6)
+
+
+def test_bootstrap_invalid_args():
+    with pytest.raises(ValueError, match="Expected base metric to be an instance"):
+        BootStrapper(5)
+    with pytest.raises(ValueError, match="Expected argument ``sampling_strategy``"):
+        BootStrapper(Precision(), sampling_strategy="banana")
