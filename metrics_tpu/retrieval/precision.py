@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.functional.retrieval.precision import retrieval_precision
-from metrics_tpu.ops.segment import RankedGroupStats
+from metrics_tpu.ops.segment import RankedGroupStats, hits_in_topk
 from metrics_tpu.retrieval.retrieval_metric import IGNORE_IDX, RetrievalMetric
 
 
@@ -60,11 +60,6 @@ class RetrievalPrecision(RetrievalMetric):
 
 def _precision_segments(stats: RankedGroupStats, k: Optional[int]) -> jax.Array:
     """Relevant-in-top-k / k per group; k=None means each group's own size."""
-    num_groups = stats.pos_per_group.shape[0]
-    sizes = jax.ops.segment_sum(jnp.ones_like(stats.relevant), stats.group, num_segments=num_groups)
-    k_per_group = sizes if k is None else jnp.minimum(float(k), sizes)
-    in_topk = stats.rank <= k_per_group[stats.group]
-    hits = jax.ops.segment_sum(stats.relevant * in_topk, stats.group, num_segments=num_groups)
+    hits, sizes = hits_in_topk(stats, k)
     # divide by the requested k (not the clamped one) to match the functional
-    denom = k_per_group if k is None else float(k)
-    return hits / denom
+    return hits / (sizes if k is None else float(k))

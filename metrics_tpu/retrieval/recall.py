@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.functional.retrieval.recall import retrieval_recall
-from metrics_tpu.ops.segment import RankedGroupStats
+from metrics_tpu.ops.segment import RankedGroupStats, hits_in_topk
 from metrics_tpu.retrieval.retrieval_metric import IGNORE_IDX, RetrievalMetric
 
 
@@ -60,9 +60,5 @@ class RetrievalRecall(RetrievalMetric):
 
 def _recall_segments(stats: RankedGroupStats, k: Optional[int]) -> jax.Array:
     """Relevant-in-top-k / total-relevant per group."""
-    num_groups = stats.pos_per_group.shape[0]
-    sizes = jax.ops.segment_sum(jnp.ones_like(stats.relevant), stats.group, num_segments=num_groups)
-    k_per_group = sizes if k is None else jnp.minimum(float(k), sizes)
-    in_topk = stats.rank <= k_per_group[stats.group]
-    hits = jax.ops.segment_sum(stats.relevant * in_topk, stats.group, num_segments=num_groups)
+    hits, _ = hits_in_topk(stats, k)
     return hits / jnp.maximum(stats.pos_per_group, 1.0)

@@ -76,7 +76,7 @@ class RetrievalMetric(Metric, ABC):
 
     def update(self, idx: jax.Array, preds: jax.Array, target: jax.Array) -> None:
         """Check shape, check and convert dtypes, flatten and add to accumulators."""
-        idx, preds, target = _check_retrieval_inputs(idx, preds, target, ignore=IGNORE_IDX)
+        idx, preds, target = _check_retrieval_inputs(idx, preds, target, ignore=self.exclude)
         self.idx.append(idx.flatten())
         self.preds.append(preds.flatten())
         self.target.append(target.flatten())

@@ -511,17 +511,28 @@ def _check_retrieval_inputs(
     target,
     ignore: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Validate retrieval (indexes, preds, target); parity with ``checks.py:531-565``."""
-    indexes = jnp.asarray(indexes)
-    if ignore is not None:
-        target = jnp.asarray(target)
-        target = target[target != ignore]  # ignore check on values that are ignored
-    preds, target = _check_retrieval_functional_inputs(preds, target)
+    """Validate retrieval (indexes, preds, target); parity with ``checks.py:531-565``.
 
+    Unlike the reference (which filters ``target`` in place and thereby breaks
+    the shape check whenever an ignored value is actually present), the
+    ``ignore`` value is masked only for the binary value-range check — shapes
+    and data pass through intact, so documented ``exclude`` handling in the
+    retrieval metrics works.
+    """
+    indexes = jnp.asarray(indexes)
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
     if indexes.shape != target.shape:
         raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
 
     if not jnp.issubdtype(indexes.dtype, jnp.integer) or indexes.dtype == jnp.bool_:
         raise ValueError("`indexes` must be a tensor of long integers")
 
-    return indexes.astype(jnp.int32), preds, target
+    # run dtype/value validation with ignored entries masked to a valid 0
+    check_target = target if ignore is None else jnp.where(target == ignore, 0, target)
+    preds, _ = _check_retrieval_functional_inputs(preds, check_target)
+
+    return indexes.astype(jnp.int32), preds, target.astype(jnp.int32)

@@ -16,5 +16,20 @@ def test_dtypes():
     _test_dtypes(RetrievalMAP)
 
 
+def test_exclude_filters_ignored_targets():
+    """Predictions whose target equals `exclude` are dropped from scoring."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    indexes = jnp.array([0, 0, 0, 0])
+    preds = jnp.array([0.9, 0.7, 0.5, 0.3])
+    target = jnp.array([1, -100, 0, 1])
+
+    # same data without the excluded row
+    expected = RetrievalMAP()(jnp.array([0, 0, 0]), jnp.array([0.9, 0.5, 0.3]), jnp.array([1, 0, 1]))
+    result = RetrievalMAP()(indexes, preds, target)
+    assert np.allclose(np.asarray(result), np.asarray(expected))
+
+
 def test_input_shapes() -> None:
     _test_input_shapes(RetrievalMAP)
