@@ -38,7 +38,6 @@ def binary_auroc(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax
     tps = jnp.cumsum(rel_s)
     fps = jnp.cumsum(1.0 - rel_s)
 
-    n = preds.shape[0]
     is_first = jnp.concatenate([jnp.ones((1,), bool), neg_sorted[1:] != neg_sorted[:-1]])
     is_last = jnp.concatenate([neg_sorted[1:] != neg_sorted[:-1], jnp.ones((1,), bool)])
 
@@ -53,4 +52,6 @@ def binary_auroc(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax
 
     n_pos = tps[-1]
     n_neg = fps[-1]
-    return area / jnp.maximum(n_pos * n_neg, 1.0)
+    # degenerate targets (single class) have no defined AUROC: surface NaN
+    # under jit; the eager functional path raises before reaching here
+    return jnp.where(n_pos * n_neg == 0, jnp.nan, area / jnp.maximum(n_pos * n_neg, 1.0))

@@ -1,0 +1,311 @@
+"""Exhaustive tests of ``_input_format_classification``.
+
+Mirror of reference ``tests/classification/test_inputs.py`` (326 LoC): case
+detection, canonical transforms per input case, ``is_multiclass`` overrides,
+threshold edge behavior, and error paths (value, shape, num_classes, top_k).
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.data import select_topk, to_onehot
+from metrics_tpu.utilities.enums import DataType
+from tests.classification.inputs import Input
+from tests.classification.inputs import _input_binary as _bin
+from tests.classification.inputs import _input_binary_prob as _bin_prob
+from tests.classification.inputs import _input_multiclass as _mc
+from tests.classification.inputs import _input_multiclass_prob as _mc_prob
+from tests.classification.inputs import _input_multidim_multiclass as _mdmc
+from tests.classification.inputs import _input_multidim_multiclass_prob as _mdmc_prob
+from tests.classification.inputs import _input_multilabel as _ml
+from tests.classification.inputs import _input_multilabel_multidim as _mlmd
+from tests.classification.inputs import _input_multilabel_multidim_prob as _mlmd_prob
+from tests.classification.inputs import _input_multilabel_prob as _ml_prob
+from tests.helpers import seed_all
+from tests.helpers.testers import BATCH_SIZE, EXTRA_DIM, NUM_BATCHES, NUM_CLASSES, THRESHOLD
+
+seed_all(42)
+
+
+def _rand(*shape):
+    return np.random.rand(*shape).astype(np.float32)
+
+
+def _randint(high, shape, low=0):
+    return np.random.randint(low, high, size=shape)
+
+
+_ml_prob_half = Input(_ml_prob.preds.astype(np.float16), _ml_prob.target)
+
+_mc_prob_2cls_preds = _rand(NUM_BATCHES, BATCH_SIZE, 2)
+_mc_prob_2cls_preds /= _mc_prob_2cls_preds.sum(axis=2, keepdims=True)
+_mc_prob_2cls = Input(_mc_prob_2cls_preds, _randint(2, (NUM_BATCHES, BATCH_SIZE)))
+
+_mdmc_prob_many_dims_preds = _rand(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES, EXTRA_DIM, EXTRA_DIM)
+_mdmc_prob_many_dims_preds /= _mdmc_prob_many_dims_preds.sum(axis=2, keepdims=True)
+_mdmc_prob_many_dims = Input(
+    _mdmc_prob_many_dims_preds,
+    _randint(2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM, EXTRA_DIM)),
+)
+
+_mdmc_prob_2cls_preds = _rand(NUM_BATCHES, BATCH_SIZE, 2, EXTRA_DIM)
+_mdmc_prob_2cls_preds /= _mdmc_prob_2cls_preds.sum(axis=2, keepdims=True)
+_mdmc_prob_2cls = Input(_mdmc_prob_2cls_preds, _randint(2, (NUM_BATCHES, BATCH_SIZE, EXTRA_DIM)))
+
+
+def _idn(x):
+    return x
+
+
+def _usq(x):
+    return x[..., None]
+
+
+def _thrs(x):
+    return x >= THRESHOLD
+
+
+def _rshp1(x):
+    return x.reshape(x.shape[0], -1)
+
+
+def _rshp2(x):
+    return x.reshape(x.shape[0], x.shape[1], -1)
+
+
+def _onehot(x):
+    return to_onehot(x, NUM_CLASSES)
+
+
+def _onehot2(x):
+    return to_onehot(x, 2)
+
+
+def _top1(x):
+    return select_topk(x, 1)
+
+
+def _top2(x):
+    return select_topk(x, 2)
+
+
+def _ml_preds_tr(x):
+    return _rshp1(_thrs(x))
+
+
+def _onehot_rshp1(x):
+    return _onehot(_rshp1(x))
+
+
+def _onehot2_rshp1(x):
+    return _onehot2(_rshp1(x))
+
+
+def _top1_rshp2(x):
+    return _top1(_rshp2(x))
+
+
+def _top2_rshp2(x):
+    return _top2(_rshp2(x))
+
+
+def _probs_to_mc_preds_tr(x):
+    return _onehot2(_thrs(x))
+
+
+def _mlmd_prob_to_mc_preds_tr(x):
+    return _onehot2(_rshp1(_thrs(x)))
+
+
+@pytest.mark.parametrize(
+    "inputs, num_classes, is_multiclass, top_k, exp_mode, post_preds, post_target",
+    [
+        #############################
+        # Test usual expected cases
+        (_bin, None, False, None, "multi-class", _usq, _usq),
+        (_bin, 1, False, None, "multi-class", _usq, _usq),
+        (_bin_prob, None, None, None, "binary", lambda x: _usq(_thrs(x)), _usq),
+        (_ml_prob, None, None, None, "multi-label", _thrs, _idn),
+        (_ml, None, False, None, "multi-dim multi-class", _idn, _idn),
+        (_ml_prob, None, None, 2, "multi-label", _top2, _rshp1),
+        (_mlmd, None, False, None, "multi-dim multi-class", _rshp1, _rshp1),
+        (_mc, NUM_CLASSES, None, None, "multi-class", _onehot, _onehot),
+        (_mc_prob, None, None, None, "multi-class", _top1, _onehot),
+        (_mc_prob, None, None, 2, "multi-class", _top2, _onehot),
+        (_mdmc, NUM_CLASSES, None, None, "multi-dim multi-class", _onehot, _onehot),
+        (_mdmc_prob, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot),
+        (_mdmc_prob, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot),
+        (_mdmc_prob_many_dims, None, None, None, "multi-dim multi-class", _top1_rshp2, _onehot_rshp1),
+        (_mdmc_prob_many_dims, None, None, 2, "multi-dim multi-class", _top2_rshp2, _onehot_rshp1),
+        ###########################
+        # Test some special cases
+        # Half precision is promoted to full precision
+        (_ml_prob_half, None, None, None, "multi-label", lambda x: _ml_preds_tr(x.astype(np.float32)), _rshp1),
+        # Binary as multiclass
+        (_bin, None, None, None, "multi-class", _onehot2, _onehot2),
+        # Binary probs as multiclass
+        (_bin_prob, None, True, None, "binary", _probs_to_mc_preds_tr, _onehot2),
+        # Multilabel as multiclass
+        (_ml, None, True, None, "multi-dim multi-class", _onehot2, _onehot2),
+        # Multilabel probs as multiclass
+        (_ml_prob, None, True, None, "multi-label", _probs_to_mc_preds_tr, _onehot2),
+        # Multidim multilabel as multiclass
+        (_mlmd, None, True, None, "multi-dim multi-class", _onehot2_rshp1, _onehot2_rshp1),
+        # Multidim multilabel probs as multiclass
+        (_mlmd_prob, None, True, None, "multi-label", _mlmd_prob_to_mc_preds_tr, _onehot2_rshp1),
+        # Multiclass prob with 2 classes as binary
+        (_mc_prob_2cls, None, False, None, "multi-class", lambda x: _top1(x)[:, [1]], _usq),
+        # Multi-dim multi-class with 2 classes as multi-label
+        (_mdmc_prob_2cls, None, False, None, "multi-dim multi-class", lambda x: _top1(x)[:, 1], _idn),
+    ],
+)
+def test_usual_cases(inputs, num_classes, is_multiclass, top_k, exp_mode, post_preds, post_target):
+    def _to_int(x):
+        return np.asarray(x).astype(np.int32)
+
+    for batch_slice in [slice(None), slice(0, 1)]:  # full batch and batch_size=1
+        preds_in = jnp.asarray(inputs.preds[0][batch_slice])
+        target_in = jnp.asarray(inputs.target[0][batch_slice])
+
+        preds_out, target_out, mode = _input_format_classification(
+            preds=preds_in,
+            target=target_in,
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            is_multiclass=is_multiclass,
+            top_k=top_k,
+        )
+
+        assert mode == exp_mode
+        np.testing.assert_array_equal(_to_int(preds_out), _to_int(post_preds(jnp.asarray(inputs.preds[0][batch_slice]))))
+        np.testing.assert_array_equal(
+            _to_int(target_out), _to_int(post_target(jnp.asarray(inputs.target[0][batch_slice])))
+        )
+
+
+def test_threshold():
+    """The threshold boundary is inclusive: preds >= threshold are positive."""
+    target = jnp.asarray([1, 1, 1], dtype=jnp.int32)
+    preds_probs = jnp.asarray([0.5 - 1e-5, 0.5, 0.5 + 1e-5])
+
+    preds_probs_out, _, _ = _input_format_classification(preds_probs, target, threshold=0.5)
+
+    np.testing.assert_array_equal(np.array([0, 1, 1]), np.asarray(preds_probs_out).squeeze().astype(int))
+
+
+########################################################################
+# Test incorrect inputs
+########################################################################
+
+
+@pytest.mark.parametrize("threshold", [-0.5, 0.0, 1.0, 1.5])
+def test_incorrect_threshold(threshold):
+    preds, target = jnp.asarray(_rand(7)), jnp.asarray(_randint(2, (7,)))
+    with pytest.raises(ValueError):
+        _input_format_classification(preds, target, threshold=threshold)
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, is_multiclass",
+    [
+        # Target not integer
+        (_randint(2, (7,)), _randint(2, (7,)).astype(np.float32), None, None),
+        # Target negative
+        (_randint(2, (7,)), -_randint(2, (7,)) - 1, None, None),
+        # Preds negative integers
+        (-_randint(2, (7,)) - 1, _randint(2, (7,)), None, None),
+        # Negative probabilities
+        (-_rand(7), _randint(2, (7,)), None, None),
+        # is_multiclass=False and target > 1
+        (_rand(7), _randint(4, (7,), low=2), None, False),
+        # is_multiclass=False and preds integers with > 1
+        (_randint(4, (7,), low=2), _randint(2, (7,)), None, False),
+        # Wrong batch size
+        (_randint(2, (8,)), _randint(2, (7,)), None, None),
+        # Completely wrong shape
+        (_randint(2, (7,)), _randint(2, (7, 4)), None, None),
+        # Same #dims, different shape
+        (_randint(2, (7, 3)), _randint(2, (7, 4)), None, None),
+        # Same shape and preds floats, target not binary
+        (_rand(7, 3), _randint(4, (7, 3), low=2), None, None),
+        # #dims in preds = 1 + #dims in target, C shape not second or last
+        (_rand(7, 3, 4, 3), _randint(4, (7, 3, 3)), None, None),
+        # #dims in preds = 1 + #dims in target, preds not float
+        (_randint(2, (7, 3, 3, 4)), _randint(4, (7, 3, 3)), None, None),
+        # is_multiclass=False, with C dimension > 2
+        (_mc_prob.preds[0], _randint(2, (BATCH_SIZE,)), None, False),
+        # Probs of multiclass preds do not sum up to 1
+        (_rand(7, 3, 5), _randint(2, (7, 5)), None, None),
+        # Max target larger or equal to C dimension
+        (_mc_prob.preds[0], _randint(100, (BATCH_SIZE,), low=NUM_CLASSES + 1), None, None),
+        # C dimension not equal to num_classes
+        (_mc_prob.preds[0], _mc_prob.target[0], NUM_CLASSES + 1, None),
+        # Max target larger than num_classes (with #dim preds = 1 + #dims target)
+        (_mc_prob.preds[0], _randint(100, (BATCH_SIZE, NUM_CLASSES), low=NUM_CLASSES + 1), 4, None),
+        # Max target larger than num_classes (with #dim preds = #dims target)
+        (_randint(4, (7, 3)), _randint(7, (7, 3), low=5), 4, None),
+        # Max preds larger than num_classes (with #dim preds = #dims target)
+        (_randint(7, (7, 3), low=5), _randint(4, (7, 3)), 4, None),
+        # Num_classes=1, but is_multiclass not false
+        (_randint(2, (7,)), _randint(2, (7,)), 1, None),
+        # is_multiclass=False, but implied class dimension != num_classes
+        (_randint(2, (7, 3, 3)), _randint(2, (7, 3, 3)), 4, False),
+        # Multilabel input with implied class dimension != num_classes
+        (_rand(7, 3, 3), _randint(2, (7, 3, 3)), 4, False),
+        # Multilabel input with is_multiclass=True, but num_classes != 2 (or None)
+        (_rand(7, 3), _randint(2, (7, 3)), 4, True),
+        # Binary input, num_classes > 2
+        (_rand(7), _randint(2, (7,)), 4, None),
+        # Binary input, num_classes == 2 and is_multiclass not True
+        (_rand(7), _randint(2, (7,)), 2, None),
+        (_rand(7), _randint(2, (7,)), 2, False),
+        # Binary input, num_classes == 1 and is_multiclass=True
+        (_rand(7), _randint(2, (7,)), 1, True),
+    ],
+)
+def test_incorrect_inputs(preds, target, num_classes, is_multiclass):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=jnp.asarray(preds),
+            target=jnp.asarray(target),
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            is_multiclass=is_multiclass,
+        )
+
+
+@pytest.mark.parametrize(
+    "preds, target, num_classes, is_multiclass, top_k",
+    [
+        # Topk set with non (md)mc or ml prob data
+        (_bin.preds[0], _bin.target[0], None, None, 2),
+        (_bin_prob.preds[0], _bin_prob.target[0], None, None, 2),
+        (_mc.preds[0], _mc.target[0], None, None, 2),
+        (_ml.preds[0], _ml.target[0], None, None, 2),
+        (_mlmd.preds[0], _mlmd.target[0], None, None, 2),
+        (_mdmc.preds[0], _mdmc.target[0], None, None, 2),
+        # top_k = 0
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, None, 0),
+        # top_k = float
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, None, 0.123),
+        # top_k =2 with 2 classes, is_multiclass=False
+        (_mc_prob_2cls.preds[0], _mc_prob_2cls.target[0], None, False, 2),
+        # top_k = number of classes (C dimension)
+        (_mc_prob.preds[0], _mc_prob.target[0], None, None, NUM_CLASSES),
+        # is_multiclass = True for ml prob inputs, top_k set
+        (_ml_prob.preds[0], _ml_prob.target[0], None, True, 2),
+        # top_k = num_classes for ml prob inputs
+        (_ml_prob.preds[0], _ml_prob.target[0], None, True, NUM_CLASSES),
+    ],
+)
+def test_incorrect_inputs_topk(preds, target, num_classes, is_multiclass, top_k):
+    with pytest.raises(ValueError):
+        _input_format_classification(
+            preds=jnp.asarray(preds),
+            target=jnp.asarray(target),
+            threshold=THRESHOLD,
+            num_classes=num_classes,
+            is_multiclass=is_multiclass,
+            top_k=top_k,
+        )

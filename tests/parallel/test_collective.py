@@ -104,3 +104,36 @@ def test_masked_cat_sync():
 def test_sync_array_invalid_reduction():
     with pytest.raises(ValueError):
         sync_array(jnp.ones(()), "bogus", "data")
+
+
+def test_distributed_auroc_equals_single_device():
+    """Sharded cat-state AUROC (per-device buffers + all_gather + exact kernel)
+    equals the single-device value — the SURVEY §5.7 sharded-buffer design."""
+    from metrics_tpu.ops.auroc_kernel import binary_auroc
+
+    mesh = _mesh()
+    n_per_dev = 16
+    rng = np.random.RandomState(3)
+    preds = jnp.asarray(rng.rand(8 * n_per_dev).astype(np.float32))
+    target = jnp.asarray(rng.randint(2, size=8 * n_per_dev).astype(np.int32))
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P("data"), P("data")),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def distributed_auroc(p, t):
+        # each device holds only its shard ("sharded cat-state"); sync is one
+        # tiled all_gather, then the exact kernel runs on the gathered stream
+        count = jnp.asarray(p.shape[0], jnp.int32)
+        gathered_p, _, mask = masked_cat_sync(p, count, "data")
+        gathered_t, _, _ = masked_cat_sync(t, count, "data")
+        # all slots valid here (full buffers); mask is all-True
+        del mask
+        return binary_auroc(gathered_p, gathered_t)
+
+    got = float(jax.jit(distributed_auroc)(preds, target))
+    want = float(binary_auroc(preds, target))
+    assert np.allclose(got, want, atol=1e-6)

@@ -1,0 +1,113 @@
+"""Utility-layer tests (reference ``tests/test_utilities.py`` +
+``tests/functional/test_reduction.py``, extended for the JAX utilities)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.utilities import rank_zero_debug, rank_zero_info, rank_zero_warn
+from metrics_tpu.utilities.data import (
+    _flatten,
+    _stable_1d_sort,
+    apply_to_collection,
+    dim_zero_cat,
+    dim_zero_mean,
+    dim_zero_sum,
+    get_group_indexes,
+    select_topk,
+    to_onehot,
+)
+from metrics_tpu.utilities.distributed import class_reduce, reduce
+
+
+def test_prints():
+    rank_zero_debug("DEBUG")
+    rank_zero_info("INFO")
+    rank_zero_warn("WARN")
+
+
+def test_reduce():
+    start_array = jnp.asarray(np.random.rand(50, 40, 30).astype(np.float32))
+
+    assert np.allclose(reduce(start_array, "elementwise_mean"), jnp.mean(start_array))
+    assert np.allclose(reduce(start_array, "sum"), jnp.sum(start_array))
+    assert np.allclose(reduce(start_array, "none"), start_array)
+
+    with pytest.raises(ValueError):
+        reduce(start_array, "error_reduction")
+
+
+def test_class_reduce():
+    num = jnp.asarray(np.random.randint(1, 10, 100).astype(np.float32))
+    denom = jnp.asarray(np.random.randint(10, 20, 100).astype(np.float32))
+    weights = jnp.asarray(np.random.randint(1, 100, 100).astype(np.float32))
+
+    assert np.allclose(class_reduce(num, denom, weights, "micro"), jnp.sum(num) / jnp.sum(denom))
+    assert np.allclose(class_reduce(num, denom, weights, "macro"), jnp.mean(num / denom))
+    assert np.allclose(
+        class_reduce(num, denom, weights, "weighted"), jnp.sum(num / denom * (weights / jnp.sum(weights)))
+    )
+    assert np.allclose(class_reduce(num, denom, weights, "none"), num / denom)
+
+
+def test_dim_zero_reducers():
+    x = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+    assert np.allclose(dim_zero_sum(x), [4.0, 6.0])
+    assert np.allclose(dim_zero_mean(x), [2.0, 3.0])
+    assert np.allclose(dim_zero_cat([jnp.asarray([1.0]), jnp.asarray([2.0])]), [1.0, 2.0])
+    # scalars are promoted to 1d before concatenation
+    assert np.allclose(dim_zero_cat(jnp.asarray(5.0)), [5.0])
+
+
+def test_flatten():
+    assert _flatten([[1, 2], [3], [4, 5, 6]]) == [1, 2, 3, 4, 5, 6]
+
+
+def test_to_onehot_out_of_range():
+    """Labels outside [0, num_classes) produce all-zero rows, not errors."""
+    out = to_onehot(jnp.asarray([0, 3]), num_classes=2)
+    assert np.allclose(np.asarray(out), [[1, 0], [0, 0]])
+
+
+def test_select_topk_dim():
+    x = jnp.asarray([[1.0, 2.0, 3.0], [3.0, 2.0, 1.0]])
+    np.testing.assert_array_equal(np.asarray(select_topk(x, 1)), [[0, 0, 1], [1, 0, 0]])
+    np.testing.assert_array_equal(np.asarray(select_topk(x, 2)), [[0, 1, 1], [1, 1, 0]])
+
+
+def test_stable_1d_sort():
+    x = jnp.asarray([4, 1, 3, 2])
+    values, idx = _stable_1d_sort(x)
+    np.testing.assert_array_equal(np.asarray(values), [1, 2, 3, 4])
+    np.testing.assert_array_equal(np.asarray(idx), [1, 3, 2, 0])
+
+    # nb truncation contract
+    values, idx = _stable_1d_sort(jnp.arange(10)[::-1], nb=3)
+    np.testing.assert_array_equal(np.asarray(values), [0, 1, 2])
+
+    with pytest.raises(ValueError):
+        _stable_1d_sort(jnp.zeros((2, 2)))
+
+
+def test_apply_to_collection():
+    # dict / namedtuple / list recursion with dtype filtering
+    from collections import namedtuple
+
+    NT = namedtuple("NT", ["a", "b"])
+    data = {"x": jnp.asarray([1.0, 2.0]), "y": [jnp.asarray([3.0])], "z": NT(jnp.asarray([4.0]), "keep")}
+    out = apply_to_collection(data, (jnp.ndarray,), lambda t: t * 2)
+    assert np.allclose(out["x"], [2.0, 4.0])
+    assert np.allclose(out["y"][0], [6.0])
+    assert np.allclose(out["z"].a, [8.0])
+    assert out["z"].b == "keep"
+
+
+def test_get_group_indexes():
+    indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+    groups = get_group_indexes(indexes)
+    np.testing.assert_array_equal(np.asarray(groups[0]), [0, 1, 2])
+    np.testing.assert_array_equal(np.asarray(groups[1]), [3, 4, 5, 6])
+
+    # order of first appearance, not sorted value order
+    groups = get_group_indexes(jnp.asarray([5, 5, 2, 2]))
+    np.testing.assert_array_equal(np.asarray(groups[0]), [0, 1])
+    np.testing.assert_array_equal(np.asarray(groups[1]), [2, 3])
