@@ -21,6 +21,7 @@ from metrics_tpu.classification import (  # noqa: F401, E402
     ROC,
     Accuracy,
     AveragePrecision,
+    BinnedAUROC,
     CohenKappa,
     ConfusionMatrix,
     FBeta,

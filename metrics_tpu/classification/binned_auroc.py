@@ -1,0 +1,67 @@
+"""BinnedAUROC: streaming AUROC with O(num_bins) state.
+
+TPU-native extension beyond the reference (SURVEY §5.7): where ``AUROC``
+stores every prediction (``classification/auroc.py:141-142`` in the
+reference, list state with all-gather sync), ``BinnedAUROC`` accumulates two
+fixed-size score histograms. State is O(num_bins) regardless of dataset
+size, sync is a plain ``"sum"`` reduction (one psum over the mesh), and the
+value converges to the exact AUROC as ``num_bins`` grows (error is bounded by
+the score quantization, ~1/num_bins).
+"""
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.ops.histogram import histogram_auroc, score_histograms
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+
+
+class BinnedAUROC(Metric):
+    """Streaming binary AUROC over score histograms.
+
+    Accepts probability scores in ``[0, 1]`` and binary targets. Unlike
+    :class:`~metrics_tpu.AUROC`, memory and sync cost do not grow with the
+    dataset.
+
+    Args:
+        num_bins: score quantization resolution (state size and accuracy).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> m = BinnedAUROC(num_bins=4)
+        >>> m.update(jnp.array([0.1, 0.4, 0.35, 0.8]), jnp.array([0, 0, 1, 1]))
+        >>> m.compute()
+        Array(0.875, dtype=float32)
+    """
+
+    def __init__(
+        self,
+        num_bins: int = 512,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if not isinstance(num_bins, int) or num_bins < 2:
+            raise ValueError(f"`num_bins` must be an integer >= 2, got {num_bins}")
+        self.num_bins = num_bins
+
+        self.add_state("hist_pos", default=jnp.zeros((num_bins,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("hist_neg", default=jnp.zeros((num_bins,), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        preds, target = _check_retrieval_functional_inputs(preds, target)
+        hist_pos, hist_neg = score_histograms(preds.flatten(), target.flatten(), self.num_bins)
+        self.hist_pos = self.hist_pos + hist_pos
+        self.hist_neg = self.hist_neg + hist_neg
+
+    def compute(self) -> jax.Array:
+        return histogram_auroc(self.hist_pos, self.hist_neg)

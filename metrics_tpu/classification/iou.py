@@ -16,7 +16,7 @@ class IoU(ConfusionMatrix):
         >>> preds = jnp.array([0, 1, 0, 0])
         >>> iou = IoU(num_classes=2)
         >>> iou(preds, target)
-        Array(0.58333343, dtype=float32)
+        Array(0.5833334, dtype=float32)
     """
 
     def __init__(

@@ -20,7 +20,7 @@ class MatthewsCorrcoef(Metric):
         >>> preds = jnp.array([0, 1, 0, 0])
         >>> matthews_corrcoef = MatthewsCorrcoef(num_classes=2)
         >>> matthews_corrcoef(preds, target)
-        Array(0.5773503, dtype=float32)
+        Array(0.57735026, dtype=float32)
     """
 
     def __init__(

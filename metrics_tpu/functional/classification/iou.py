@@ -47,7 +47,7 @@ def iou(
         >>> target = jnp.array([1, 1, 0, 0])
         >>> preds = jnp.array([0, 1, 0, 0])
         >>> iou(preds, target)
-        Array(0.58333343, dtype=float32)
+        Array(0.5833334, dtype=float32)
     """
     num_classes = get_num_classes(preds=preds, target=target, num_classes=num_classes)
     confmat = _confusion_matrix_update(preds, target, num_classes, threshold)

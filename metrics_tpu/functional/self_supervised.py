@@ -30,10 +30,10 @@ def embedding_similarity(
     Example:
         >>> import jax.numpy as jnp
         >>> embeddings = jnp.array([[1., 2., 3., 4.], [1., 2., 3., 4.], [4., 5., 6., 7.]])
-        >>> embedding_similarity(embeddings)
-        Array([[0.        , 1.        , 0.97588956],
-               [1.        , 0.        , 0.97588956],
-               [0.97588956, 0.97588956, 0.        ]], dtype=float32)
+        >>> jnp.round(embedding_similarity(embeddings), 4)
+        Array([[0.    , 1.    , 0.9759],
+               [1.    , 0.    , 0.9759],
+               [0.9759, 0.9759, 0.    ]], dtype=float32)
     """
     if similarity == "cosine":
         norm = jnp.linalg.norm(batch, ord=2, axis=1)

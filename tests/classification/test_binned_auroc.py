@@ -1,0 +1,80 @@
+"""BinnedAUROC: streaming histogram AUROC (TPU-native extension, SURVEY §5.7)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score
+
+from metrics_tpu import BinnedAUROC
+from metrics_tpu.ops.histogram import histogram_auroc, score_histograms
+from tests.helpers import seed_all
+from tests.helpers.testers import NUM_BATCHES, BATCH_SIZE, MetricTester
+
+seed_all(13)
+
+NUM_BINS = 64
+
+# scores pre-quantized to the bin grid: the binned value is then EXACT
+_quantized_preds = (
+    np.floor(np.random.rand(NUM_BATCHES, BATCH_SIZE) * NUM_BINS) / NUM_BINS + 0.5 / NUM_BINS
+).astype(np.float32)
+_target = np.random.randint(2, size=(NUM_BATCHES, BATCH_SIZE))
+
+
+def _sk_auroc(preds, target):
+    return roc_auc_score(target.reshape(-1), preds.reshape(-1))
+
+
+class TestBinnedAUROC(MetricTester):
+    atol = 1e-6
+
+    @pytest.mark.parametrize("ddp", [True, False])
+    @pytest.mark.parametrize("dist_sync_on_step", [True, False])
+    def test_binned_auroc_class(self, ddp, dist_sync_on_step):
+        """Histogram states sync with plain 'sum' reduction under DDP."""
+        self.run_class_metric_test(
+            ddp=ddp,
+            preds=_quantized_preds,
+            target=_target,
+            metric_class=BinnedAUROC,
+            sk_metric=_sk_auroc,
+            dist_sync_on_step=dist_sync_on_step,
+            metric_args={"num_bins": NUM_BINS},
+        )
+
+
+def test_convergence_to_exact():
+    """With fine bins the histogram AUROC approaches the exact value."""
+    rng = np.random.RandomState(0)
+    preds = rng.rand(20000).astype(np.float32)
+    target = (rng.rand(20000) < preds).astype(np.int64)  # informative scores
+
+    exact = roc_auc_score(target, preds)
+    for num_bins, tol in [(64, 2e-2), (512, 5e-3), (4096, 1e-3)]:
+        m = BinnedAUROC(num_bins=num_bins)
+        m.update(jnp.asarray(preds), jnp.asarray(target))
+        assert abs(float(m.compute()) - exact) < tol, (num_bins, float(m.compute()), exact)
+
+
+def test_streaming_equals_single_shot():
+    """Batch-wise accumulation equals one-shot histogram computation."""
+    rng = np.random.RandomState(4)
+    preds = rng.rand(256).astype(np.float32)
+    target = rng.randint(2, size=256)
+
+    m = BinnedAUROC(num_bins=128)
+    for i in range(0, 256, 32):
+        m.update(jnp.asarray(preds[i:i + 32]), jnp.asarray(target[i:i + 32]))
+
+    hist_pos, hist_neg = score_histograms(jnp.asarray(preds), jnp.asarray(target), 128)
+    assert np.allclose(float(m.compute()), float(histogram_auroc(hist_pos, hist_neg)))
+
+
+def test_degenerate_is_nan():
+    m = BinnedAUROC(num_bins=16)
+    m.update(jnp.asarray([0.2, 0.8]), jnp.asarray([1, 1]))
+    assert np.isnan(float(m.compute()))
+
+
+def test_invalid_num_bins():
+    with pytest.raises(ValueError, match="`num_bins` must be an integer >= 2"):
+        BinnedAUROC(num_bins=1)
