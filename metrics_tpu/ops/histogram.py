@@ -49,8 +49,10 @@ def histogram_roc(hist_pos: jax.Array, hist_neg: jax.Array):
     fps = jnp.concatenate([jnp.zeros((1,), jnp.float32), jnp.cumsum(hist_neg[::-1])])
     tpr = tps / jnp.maximum(tps[-1], 1.0)
     fpr = fps / jnp.maximum(fps[-1], 1.0)
-    # lower bin edges, descending, with an unreachable top threshold first
-    thresholds = jnp.arange(num_bins + 1, dtype=jnp.float32)[::-1] / num_bins
+    # lower bin edges, descending; the origin's threshold is +inf (sklearn's
+    # convention) because scores of exactly 1.0 land in the top bin
+    edges = jnp.arange(num_bins, dtype=jnp.float32)[::-1] / num_bins
+    thresholds = jnp.concatenate([jnp.asarray([jnp.inf], jnp.float32), edges])
     return fpr, tpr, thresholds
 
 

@@ -1,0 +1,81 @@
+"""Checkpoint/resume of metric state (SURVEY §5.4).
+
+The reference persists metric states through ``nn.Module.state_dict``
+(``metric.py:306-318``); here state is a pytree of arrays, checkpointable
+with orbax (the TPU-native checkpoint library) or plain npz.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, BinnedAUROC, MetricCollection
+
+
+def _fill(metric):
+    rng = np.random.RandomState(0)
+    logits = rng.rand(32, 5).astype(np.float32)
+    probs = logits / logits.sum(1, keepdims=True)
+    target = rng.randint(5, size=32)
+    metric.update(jnp.asarray(probs), jnp.asarray(target))
+    return metric
+
+
+def test_state_dict_roundtrip_mid_accumulation():
+    m = _fill(Accuracy())
+    m.persistent(True)
+    saved = m.state_dict()
+
+    m2 = Accuracy()
+    m2.load_state_dict(saved)
+    assert float(m.compute()) == float(m2.compute())
+
+
+def test_orbax_checkpoint_roundtrip(tmp_path):
+    """Metric state saves/restores through orbax like any model pytree."""
+    ocp = pytest.importorskip("orbax.checkpoint")
+
+    m = _fill(Accuracy())
+    m.persistent(True)
+    state = m.state_dict()
+
+    ckptr = ocp.PyTreeCheckpointer()
+    path = tmp_path / "metric_state"
+    ckptr.save(path, state)
+    restored = ckptr.restore(path)
+
+    m2 = Accuracy()
+    m2.load_state_dict({k: jnp.asarray(v) for k, v in restored.items()})
+    assert float(m.compute()) == float(m2.compute())
+
+
+def test_npz_checkpoint_roundtrip(tmp_path):
+    """Plain-npz fallback: every state is a flat named array."""
+    m = BinnedAUROC(num_bins=32)
+    rng = np.random.RandomState(0)
+    m.update(jnp.asarray(rng.rand(64).astype(np.float32)), jnp.asarray(rng.randint(2, size=64)))
+    m.persistent(True)
+    state = m.state_dict()
+
+    path = tmp_path / "state.npz"
+    np.savez(path, **{k: np.asarray(v) for k, v in state.items()})
+    loaded = dict(np.load(path))
+
+    m2 = BinnedAUROC(num_bins=32)
+    m2.load_state_dict(loaded)
+    assert float(m.compute()) == float(m2.compute())
+
+
+def test_collection_state_dict_roundtrip():
+    col = MetricCollection([Accuracy(), BinnedAUROC(num_bins=16)])
+    rng = np.random.RandomState(1)
+    preds = jnp.asarray(rng.rand(64).astype(np.float32))
+    target = jnp.asarray(rng.randint(2, size=64))
+    col.update(preds, target)
+    col.persistent(True)
+    saved = col.state_dict()
+
+    col2 = MetricCollection([Accuracy(), BinnedAUROC(num_bins=16)])
+    col2.load_state_dict(saved)
+    a, b = col.compute(), col2.compute()
+    for k in a:
+        assert float(a[k]) == float(b[k])

@@ -70,6 +70,9 @@ class TestExplainedVariance(MetricTester):
             metric_args=dict(multioutput=multioutput),
         )
 
+    def test_explained_variance_half_cpu(self, multioutput, preds, target, sk_metric):
+        self.run_precision_test_cpu(preds, target, ExplainedVariance, explained_variance)
+
 
 def test_error_on_different_shape():
     metric = ExplainedVariance()

@@ -112,6 +112,11 @@ class TestPSNR(MetricTester):
             metric_args=_args,
         )
 
+    def test_psnr_half_cpu(self, preds, target, data_range, reduction, dim, base, sk_metric):
+        if dim is not None:
+            pytest.skip("list-state PSNR path tested at full precision")
+        self.run_precision_test_cpu(preds, target, PSNR, psnr)
+
 
 @pytest.mark.parametrize("reduction", ["none", "sum"])
 def test_reduction_for_dim_none(reduction):

@@ -81,6 +81,9 @@ class TestR2Score(MetricTester):
             metric_args=dict(adjusted=adjusted, multioutput=multioutput),
         )
 
+    def test_r2_half_cpu(self, adjusted, multioutput, preds, target, sk_metric, num_outputs):
+        self.run_precision_test_cpu(preds, target, partial(R2Score, num_outputs=num_outputs), r2score)
+
 
 def test_error_on_different_shape():
     metric = R2Score()
