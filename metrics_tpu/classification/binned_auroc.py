@@ -15,7 +15,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.ops.histogram import histogram_auroc, score_histograms
-from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs, _min_max_jit
 from metrics_tpu.utilities.data import _is_concrete
 
 
@@ -60,11 +60,13 @@ class BinnedAUROC(Metric):
 
     def update(self, preds: jax.Array, target: jax.Array) -> None:
         preds, target = _check_retrieval_functional_inputs(preds, target)
-        if _is_concrete(preds) and (bool(jnp.min(preds) < 0) or bool(jnp.max(preds) > 1)):
-            # logits would be silently clipped into the edge bins
-            raise ValueError(
-                "The `preds` should be probabilities in [0, 1], but values were detected outside of that range."
-            )
+        if _is_concrete(preds):
+            pmin, pmax = _min_max_jit(preds)
+            if float(pmin) < 0 or float(pmax) > 1:
+                # logits would be silently clipped into the edge bins
+                raise ValueError(
+                    "The `preds` should be probabilities, but values were detected outside of [0,1] range."
+                )
         hist_pos, hist_neg = score_histograms(preds.flatten(), target.flatten(), self.num_bins)
         self.hist_pos = self.hist_pos + hist_pos
         self.hist_neg = self.hist_neg + hist_neg

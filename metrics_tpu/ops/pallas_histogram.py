@@ -1,0 +1,94 @@
+"""Pallas TPU kernel for the score-histogram update (reference, not default).
+
+The binned-AUROC update is a weighted histogram of quantized scores — a
+scatter-add in its naive form, which serializes badly on TPU (measured 353ms
+for 1M scores x 512 bins). This kernel computes it as per-block one-hot
+contractions accumulated in a grid-persistent output block.
+
+Measured verdict (1M x 512, v5e): XLA's fused compare-reduce formulation
+(``metrics_tpu.ops.histogram.score_histograms``) runs ~16ms; this kernel
+~159ms — mosaic can't shape-cast across lanes, forcing per-sublane
+(1, 128) @ (128, bins) dots whose M=1 tiles waste the 128x128 MXU. The XLA
+path therefore stays the default; this kernel is kept as a correct,
+interpreter-testable example of the pattern (and a baseline for future
+mosaic layouts that admit wider contractions). Profile before hand-writing:
+the compiler won this one.
+"""
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_BLOCK_ROWS = 8  # (8, 128) f32 tile
+_BLOCK = _BLOCK_ROWS * 128
+
+
+def _hist_kernel(bins_ref, wpos_ref, wneg_ref, hist_ref):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        hist_ref[:] = jnp.zeros_like(hist_ref)
+
+    num_bins = hist_ref.shape[1]
+    bins = bins_ref[:]  # (ROWS, 128)
+    iota = jax.lax.broadcasted_iota(jnp.int32, (128, num_bins), 1)
+
+    # per-sublane one-hot contraction: no cross-lane reshape (mosaic can't
+    # shape-cast (8, 128) -> (1024,)); 8 small MXU dots per block instead
+    acc_p = jnp.zeros((1, num_bins), jnp.float32)
+    acc_n = jnp.zeros((1, num_bins), jnp.float32)
+    for r in range(_BLOCK_ROWS):
+        onehot = (bins[r, :][:, None] == iota).astype(jnp.float32)  # (128, num_bins)
+        acc_p += jnp.dot(wpos_ref[r : r + 1, :], onehot, preferred_element_type=jnp.float32)
+        acc_n += jnp.dot(wneg_ref[r : r + 1, :], onehot, preferred_element_type=jnp.float32)
+
+    hist_ref[0:1, :] += acc_p
+    hist_ref[1:2, :] += acc_n
+
+
+@partial(jax.jit, static_argnames=("num_bins", "interpret"))
+def score_histograms_pallas(
+    preds: jax.Array,
+    target: jax.Array,
+    num_bins: int = 512,
+    mask: jax.Array = None,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Pallas-accelerated ``(hist_pos, hist_neg)`` of 1-d scores.
+
+    Same contract as :func:`metrics_tpu.ops.histogram.score_histograms`;
+    ``num_bins`` must be a multiple of 128 (lane width).
+    """
+    if num_bins % 128 != 0:
+        raise ValueError(f"`num_bins` must be a multiple of 128 for the pallas kernel, got {num_bins}")
+
+    n = preds.shape[0]
+    bins = jnp.clip((preds * num_bins).astype(jnp.int32), 0, num_bins - 1)
+    rel = (target == 1).astype(jnp.float32)
+    valid = jnp.ones_like(rel) if mask is None else mask.astype(jnp.float32)
+    w_pos = rel * valid
+    w_neg = (1.0 - rel) * valid
+
+    # pad to a whole number of (8, 128) blocks; padded slots carry zero weight
+    n_pad = (-n) % _BLOCK
+    bins = jnp.pad(bins, (0, n_pad)).reshape(-1, 128)
+    w_pos = jnp.pad(w_pos, (0, n_pad)).reshape(-1, 128)
+    w_neg = jnp.pad(w_neg, (0, n_pad)).reshape(-1, 128)
+    grid = bins.shape[0] // _BLOCK_ROWS
+
+    hist = pl.pallas_call(
+        _hist_kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, 128), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((2, num_bins), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((2, num_bins), jnp.float32),
+        interpret=interpret,
+    )(bins, w_pos, w_neg)
+
+    return hist[0], hist[1]

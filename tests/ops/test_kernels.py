@@ -1,0 +1,75 @@
+"""TPU kernel ops: exact AUROC kernel, histogram ops, pallas histogram."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import roc_auc_score
+
+from metrics_tpu.ops.auroc_kernel import binary_auroc
+from metrics_tpu.ops.histogram import histogram_auroc, histogram_roc, score_histograms
+from metrics_tpu.ops.pallas_histogram import score_histograms_pallas
+
+
+@pytest.mark.parametrize("quant", [None, 10, 2])
+def test_binary_auroc_matches_sklearn(quant):
+    rng = np.random.RandomState(1)
+    p = rng.rand(2000).astype(np.float32)
+    if quant:
+        p = np.round(p * quant) / quant
+    t = rng.randint(2, size=2000)
+    ours = float(binary_auroc(jnp.asarray(p), jnp.asarray(t)))
+    assert abs(ours - roc_auc_score(t, p)) < 1e-5
+
+
+def test_binary_auroc_pos_label_zero():
+    rng = np.random.RandomState(2)
+    p = rng.rand(500).astype(np.float32)
+    t = rng.randint(2, size=500)
+    ours = float(binary_auroc(jnp.asarray(p), jnp.asarray(t), pos_label=0))
+    assert abs(ours - roc_auc_score(1 - t, p)) < 1e-5
+
+
+def test_binary_auroc_degenerate_nan():
+    assert np.isnan(float(binary_auroc(jnp.asarray([0.1, 0.9]), jnp.asarray([1, 1]))))
+
+
+def test_histogram_auroc_exact_on_quantized():
+    """With scores on the bin grid, the histogram AUROC is exact."""
+    rng = np.random.RandomState(3)
+    num_bins = 32
+    p = (np.floor(rng.rand(4000) * num_bins) / num_bins + 0.5 / num_bins).astype(np.float32)
+    t = rng.randint(2, size=4000)
+    hp, hn = score_histograms(jnp.asarray(p), jnp.asarray(t), num_bins)
+    assert abs(float(histogram_auroc(hp, hn)) - roc_auc_score(t, p)) < 1e-6
+
+
+def test_histogram_roc_thresholds():
+    """Origin threshold is +inf; each point matches `preds >= threshold`."""
+    hp, hn = score_histograms(jnp.asarray([0.8, 0.3]), jnp.asarray([1, 0]), 4)
+    fpr, tpr, th = histogram_roc(hp, hn)
+    assert np.isinf(float(th[0])) and float(tpr[0]) == 0.0 and float(fpr[0]) == 0.0
+    # at threshold 0.75 only the 0.8 positive is included
+    k = int(np.argwhere(np.isclose(np.asarray(th), 0.75))[0, 0])
+    assert float(tpr[k]) == 1.0 and float(fpr[k]) == 0.0
+
+
+def test_score_histograms_mask():
+    p = jnp.asarray([0.1, 0.6, 0.9])
+    t = jnp.asarray([1, 0, 1])
+    hp, hn = score_histograms(p, t, 4, mask=jnp.asarray([True, True, False]))
+    assert float(hp.sum()) == 1.0 and float(hn.sum()) == 1.0
+
+
+def test_pallas_histogram_matches_xla():
+    """Interpreter-mode pallas kernel agrees with the XLA formulation."""
+    rng = np.random.RandomState(5)
+    p = jnp.asarray(rng.rand(3000).astype(np.float32))
+    t = jnp.asarray(rng.randint(2, size=3000).astype(np.int32))
+    hp1, hn1 = score_histograms_pallas(p, t, 256, interpret=True)
+    hp2, hn2 = score_histograms(p, t, 256)
+    assert np.allclose(np.asarray(hp1), np.asarray(hp2))
+    assert np.allclose(np.asarray(hn1), np.asarray(hn2))
+
+
+def test_pallas_histogram_bad_bins():
+    with pytest.raises(ValueError, match="multiple of 128"):
+        score_histograms_pallas(jnp.zeros(8), jnp.zeros(8, jnp.int32), 100, interpret=True)
