@@ -97,6 +97,30 @@ def _auroc_compute(
             ]
             fpr = [o[0] for o in output]
             tpr = [o[1] for o in output]
+    elif (
+        mode in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
+        and sample_weights is None
+        and max_fpr is None
+        and preds.ndim == 2
+        and target.ndim == 1
+        and num_classes == preds.shape[1]
+    ):
+        # fully on-device fast path: C batched sorts in one XLA program
+        # (ops/auroc_kernel.py) instead of a per-class host loop
+        from metrics_tpu.ops.auroc_kernel import multiclass_auroc_ovr
+
+        auc_scores = list(multiclass_auroc_ovr(preds, target))
+        if average == AverageMethod.NONE:
+            return auc_scores
+        if average == AverageMethod.MACRO:
+            return jnp.mean(jnp.stack(auc_scores))
+        if average == AverageMethod.WEIGHTED:
+            support = jnp.bincount(target.reshape(-1).astype(jnp.int32), length=num_classes)
+            return jnp.sum(jnp.stack(auc_scores) * support / support.sum())
+        allowed_average = (AverageMethod.NONE.value, AverageMethod.MACRO.value, AverageMethod.WEIGHTED.value)
+        raise ValueError(
+            f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
+        )
     else:
         fpr, tpr, _ = roc(preds, target, num_classes, pos_label, sample_weights)
 

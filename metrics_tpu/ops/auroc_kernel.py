@@ -55,3 +55,17 @@ def binary_auroc(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax
     # degenerate targets (single class) have no defined AUROC: surface NaN
     # under jit; the eager functional path raises before reaching here
     return jnp.where(n_pos * n_neg == 0, jnp.nan, area / jnp.maximum(n_pos * n_neg, 1.0))
+
+
+@jax.jit
+def multiclass_auroc_ovr(preds: jax.Array, target: jax.Array) -> jax.Array:
+    """Per-class one-vs-rest AUROC of ``(N, C)`` scores vs ``(N,)`` labels.
+
+    One XLA program — C batched sorts via vmap — replacing the reference's
+    per-class Python loop over ``roc`` (``functional/.../auroc.py:79-86``).
+    Classes absent from ``target`` (or covering all of it) yield NaN, like
+    the reference's 0/0 rate normalization.
+    """
+    num_classes = preds.shape[1]
+    onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
+    return jax.vmap(binary_auroc, in_axes=(1, 1))(preds, onehot)
