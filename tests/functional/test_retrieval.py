@@ -1,0 +1,95 @@
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score as sk_average_precision
+
+from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
+from metrics_tpu.functional.retrieval.precision import retrieval_precision
+from metrics_tpu.functional.retrieval.recall import retrieval_recall
+from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
+from tests.helpers import seed_all
+from tests.retrieval.test_mrr import _reciprocal_rank as reciprocal_rank
+from tests.retrieval.test_precision import _precision_at_k as precision_at_k
+from tests.retrieval.test_recall import _recall_at_k as recall_at_k
+
+seed_all(1337)
+
+
+@pytest.mark.parametrize(
+    ["sklearn_metric", "jax_metric"],
+    [
+        [sk_average_precision, retrieval_average_precision],
+        [reciprocal_rank, retrieval_reciprocal_rank],
+    ],
+)
+@pytest.mark.parametrize("size", [1, 4, 10])
+def test_metrics_output_values(sklearn_metric, jax_metric, size):
+    """Compare single-query functionals to the per-query oracles."""
+    for i in range(6):
+        preds = np.random.randn(size).astype(np.float32)
+        target = np.random.randn(size) > 0
+
+        # sometimes test with integer targets
+        if (i % 2) == 0:
+            target = target.astype(int)
+
+        sk = float(sklearn_metric(target, preds))
+        tm = float(jax_metric(jnp.asarray(preds), jnp.asarray(target)))
+
+        # ours return 0 when no label is True while sklearn returns NaN
+        if math.isnan(sk):
+            assert tm == 0
+        else:
+            assert np.allclose(sk, tm, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    ["sklearn_metric", "jax_metric"],
+    [
+        [precision_at_k, retrieval_precision],
+        [recall_at_k, retrieval_recall],
+    ],
+)
+@pytest.mark.parametrize("size", [1, 4, 10])
+@pytest.mark.parametrize("k", [None, 1, 4, 10])
+def test_metrics_output_values_with_k(sklearn_metric, jax_metric, size, k):
+    """Compare @k functionals to the per-query oracles."""
+    for i in range(6):
+        preds = np.random.randn(size).astype(np.float32)
+        target = np.random.randn(size) > 0
+
+        if (i % 2) == 0:
+            target = target.astype(int)
+
+        sk = float(sklearn_metric(target, preds, k))
+        tm = float(jax_metric(jnp.asarray(preds), jnp.asarray(target), k))
+
+        if math.isnan(sk):
+            assert tm == 0
+        else:
+            assert np.allclose(sk, tm, atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    "jax_metric", [retrieval_average_precision, retrieval_reciprocal_rank, retrieval_precision, retrieval_recall]
+)
+def test_input_dtypes(jax_metric) -> None:
+    length = 10
+
+    # preds must be float
+    with pytest.raises(ValueError, match="`preds` must be a tensor of floats"):
+        jax_metric(jnp.zeros(length, dtype=jnp.int32), jnp.zeros(length, dtype=jnp.int32))
+
+    # target must be bool/int
+    with pytest.raises(ValueError, match="`target` must be a tensor of booleans or integers"):
+        jax_metric(jnp.zeros(length, dtype=jnp.float32), jnp.zeros(length, dtype=jnp.float32))
+
+    # shapes must match
+    with pytest.raises(ValueError, match="`preds` and `target` must be of the same shape"):
+        jax_metric(jnp.zeros(length + 1, dtype=jnp.float32), jnp.zeros(length, dtype=jnp.int32))
+
+    # non-empty
+    with pytest.raises(ValueError, match="`preds` and `target` must be non-empty"):
+        jax_metric(jnp.zeros(0, dtype=jnp.float32), jnp.zeros(0, dtype=jnp.int32))
