@@ -22,6 +22,8 @@ from metrics_tpu.classification import (  # noqa: F401, E402
     Accuracy,
     AveragePrecision,
     BinnedAUROC,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
     CohenKappa,
     ConfusionMatrix,
     FBeta,

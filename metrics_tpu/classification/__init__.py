@@ -2,7 +2,11 @@ from metrics_tpu.classification.accuracy import Accuracy  # noqa: F401
 from metrics_tpu.classification.auc import AUC  # noqa: F401
 from metrics_tpu.classification.auroc import AUROC  # noqa: F401
 from metrics_tpu.classification.average_precision import AveragePrecision  # noqa: F401
-from metrics_tpu.classification.binned_auroc import BinnedAUROC  # noqa: F401
+from metrics_tpu.classification.binned import (  # noqa: F401
+    BinnedAUROC,
+    BinnedAveragePrecision,
+    BinnedPrecisionRecallCurve,
+)
 from metrics_tpu.classification.cohen_kappa import CohenKappa  # noqa: F401
 from metrics_tpu.classification.confusion_matrix import ConfusionMatrix  # noqa: F401
 from metrics_tpu.classification.f_beta import F1, FBeta  # noqa: F401

@@ -1,0 +1,119 @@
+"""Binned (streaming) curve metrics over score histograms.
+
+TPU-native extensions beyond the reference (SURVEY §5.7): where the exact
+curve metrics store every prediction (reference ``classification/auroc.py:
+141-142`` etc., list states with all-gather sync), these accumulate two
+fixed-size score histograms. State is O(num_bins) regardless of dataset
+size, sync is a plain ``"sum"`` reduction (one psum over the mesh), and the
+values converge to the exact ones as ``num_bins`` grows (error bounded by
+the score quantization, ~1/num_bins).
+"""
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.metric import Metric
+from metrics_tpu.ops.histogram import (
+    histogram_auroc,
+    histogram_average_precision,
+    histogram_pr_curve,
+    score_histograms,
+)
+from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs, _min_max_jit
+from metrics_tpu.utilities.data import _is_concrete
+
+
+class _BinnedScoreMetric(Metric):
+    """Shared runtime for histogram-state metrics: binary targets, score
+    probabilities in [0, 1], two ``(num_bins,)`` sum-reduced histograms."""
+
+    def __init__(
+        self,
+        num_bins: int = 512,
+        compute_on_step: bool = True,
+        dist_sync_on_step: bool = False,
+        process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
+    ):
+        super().__init__(
+            compute_on_step=compute_on_step,
+            dist_sync_on_step=dist_sync_on_step,
+            process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
+        )
+        if not isinstance(num_bins, int) or num_bins < 2:
+            raise ValueError(f"`num_bins` must be an integer >= 2, got {num_bins}")
+        self.num_bins = num_bins
+
+        self.add_state("hist_pos", default=jnp.zeros((num_bins,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("hist_neg", default=jnp.zeros((num_bins,), jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, preds: jax.Array, target: jax.Array) -> None:
+        preds, target = _check_retrieval_functional_inputs(preds, target)
+        if _is_concrete(preds):
+            pmin, pmax = _min_max_jit(preds)
+            if float(pmin) < 0 or float(pmax) > 1:
+                # logits would be silently clipped into the edge bins
+                raise ValueError(
+                    "The `preds` should be probabilities, but values were detected outside of [0,1] range."
+                )
+        hist_pos, hist_neg = score_histograms(preds.flatten(), target.flatten(), self.num_bins)
+        self.hist_pos = self.hist_pos + hist_pos
+        self.hist_neg = self.hist_neg + hist_neg
+
+
+class BinnedAUROC(_BinnedScoreMetric):
+    """Streaming binary AUROC over score histograms.
+
+    Unlike :class:`~metrics_tpu.AUROC`, memory and sync cost do not grow
+    with the dataset.
+
+    Args:
+        num_bins: score quantization resolution (state size and accuracy).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> m = BinnedAUROC(num_bins=4)
+        >>> m.update(jnp.array([0.1, 0.4, 0.35, 0.8]), jnp.array([0, 0, 1, 1]))
+        >>> m.compute()
+        Array(0.875, dtype=float32)
+    """
+
+    def compute(self) -> jax.Array:
+        return histogram_auroc(self.hist_pos, self.hist_neg)
+
+
+class BinnedPrecisionRecallCurve(_BinnedScoreMetric):
+    """Streaming binary precision-recall curve over score histograms.
+
+    Returns ``(precision, recall, thresholds)`` arrays of length
+    ``num_bins + 1``; point k classifies ``preds >= thresholds[k]`` positive
+    (``thresholds[0] = +inf``, the empty-positive point).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> m = BinnedPrecisionRecallCurve(num_bins=4)
+        >>> m.update(jnp.array([0.1, 0.4, 0.35, 0.8]), jnp.array([0, 0, 1, 1]))
+        >>> precision, recall, thresholds = m.compute()
+        >>> recall
+        Array([0. , 0.5, 0.5, 1. , 1. ], dtype=float32)
+    """
+
+    def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        return histogram_pr_curve(self.hist_pos, self.hist_neg)
+
+
+class BinnedAveragePrecision(_BinnedScoreMetric):
+    """Streaming binary average precision over score histograms.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> m = BinnedAveragePrecision(num_bins=4)
+        >>> m.update(jnp.array([0.1, 0.4, 0.35, 0.8]), jnp.array([0, 0, 1, 1]))
+        >>> m.compute()
+        Array(0.8333334, dtype=float32)
+    """
+
+    def compute(self) -> jax.Array:
+        return histogram_average_precision(self.hist_pos, self.hist_neg)

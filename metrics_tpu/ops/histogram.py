@@ -26,9 +26,9 @@ def score_histograms(
     (optional, bool) drops entries — used with fixed-capacity sharded buffers
     whose tail slots are unfilled.
 
-    On TPU the histogram is a compare-and-reduce (a fused one-hot
-    contraction the MXU/VPU eat directly — measured 22x faster than
-    scatter-add at 1M scores x 512 bins); scatter-add lowers fine on CPU.
+    On TPU the histogram is a chunked one-hot contraction (~9ms steady-state
+    at 1M scores x 512 bins on v5e, vs ~350ms for scatter-add, which
+    serializes); scatter-add lowers fine on CPU.
     """
     bins = jnp.clip((preds * num_bins).astype(jnp.int32), 0, num_bins - 1)
     rel = (target == 1).astype(jnp.float32)
@@ -65,25 +65,35 @@ def score_histograms(
     return hist_pos, hist_neg
 
 
-@jax.jit
-def histogram_roc(hist_pos: jax.Array, hist_neg: jax.Array):
-    """(fpr, tpr, thresholds) from score histograms, descending thresholds.
+def _cum_counts_and_thresholds(hist_pos: jax.Array, hist_neg: jax.Array):
+    """Descending-threshold cumulative (tps, fps, thresholds), origin first.
 
-    Point k counts scores landing in the top k+1 bins, i.e. classifying
+    Point k counts scores landing in the top k bins, i.e. classifying
     positive at ``preds >= thresholds[k]`` where the threshold is the LOWER
-    edge of the lowest included bin. The (0, 0) origin (nothing classified
-    positive, threshold above the top bin) is included, so the curve is
-    directly integrable.
+    edge of the lowest included bin; the origin's threshold is +inf
+    (sklearn's convention) because scores of exactly 1.0 land in the top bin.
+    Shared by the ROC and PR curve constructions so their conventions can't
+    drift apart.
     """
     num_bins = hist_pos.shape[0]
     tps = jnp.concatenate([jnp.zeros((1,), jnp.float32), jnp.cumsum(hist_pos[::-1])])
     fps = jnp.concatenate([jnp.zeros((1,), jnp.float32), jnp.cumsum(hist_neg[::-1])])
-    tpr = tps / jnp.maximum(tps[-1], 1.0)
-    fpr = fps / jnp.maximum(fps[-1], 1.0)
-    # lower bin edges, descending; the origin's threshold is +inf (sklearn's
-    # convention) because scores of exactly 1.0 land in the top bin
     edges = jnp.arange(num_bins, dtype=jnp.float32)[::-1] / num_bins
     thresholds = jnp.concatenate([jnp.asarray([jnp.inf], jnp.float32), edges])
+    return tps, fps, thresholds
+
+
+@jax.jit
+def histogram_roc(hist_pos: jax.Array, hist_neg: jax.Array):
+    """(fpr, tpr, thresholds) from score histograms, descending thresholds.
+
+    The (0, 0) origin (nothing classified positive) is included, so the
+    curve is directly integrable; see :func:`_cum_counts_and_thresholds`
+    for the threshold convention.
+    """
+    tps, fps, thresholds = _cum_counts_and_thresholds(hist_pos, hist_neg)
+    tpr = tps / jnp.maximum(tps[-1], 1.0)
+    fpr = fps / jnp.maximum(fps[-1], 1.0)
     return fpr, tpr, thresholds
 
 
@@ -99,3 +109,25 @@ def histogram_auroc(hist_pos: jax.Array, hist_neg: jax.Array) -> jax.Array:
     n_neg = jnp.sum(hist_neg)
     auc = jnp.trapezoid(tpr, fpr)
     return jnp.where(n_pos * n_neg == 0, jnp.nan, auc)
+
+
+@jax.jit
+def histogram_pr_curve(hist_pos: jax.Array, hist_neg: jax.Array):
+    """(precision, recall, thresholds) from score histograms.
+
+    Same threshold convention as :func:`histogram_roc`: point k classifies
+    ``preds >= thresholds[k]`` positive, with ``thresholds[0] = +inf`` (the
+    empty-positive point, precision defined as 1 there by convention).
+    """
+    tps, fps, thresholds = _cum_counts_and_thresholds(hist_pos, hist_neg)
+    precision = jnp.where(tps + fps > 0, tps / jnp.maximum(tps + fps, 1.0), 1.0)
+    recall = tps / jnp.maximum(tps[-1], 1.0)
+    return precision, recall, thresholds
+
+
+@jax.jit
+def histogram_average_precision(hist_pos: jax.Array, hist_neg: jax.Array) -> jax.Array:
+    """Average precision ``sum((recall_k - recall_{k-1}) * precision_k)``."""
+    precision, recall, _ = histogram_pr_curve(hist_pos, hist_neg)
+    ap = jnp.sum(jnp.diff(recall) * precision[1:])
+    return jnp.where(jnp.sum(hist_pos) == 0, jnp.nan, ap)

@@ -78,3 +78,69 @@ def test_degenerate_is_nan():
 def test_invalid_num_bins():
     with pytest.raises(ValueError, match="`num_bins` must be an integer >= 2"):
         BinnedAUROC(num_bins=1)
+
+
+def test_binned_pr_curve_pointwise():
+    """Each curve point equals the brute-force `preds >= threshold` rates."""
+    from metrics_tpu import BinnedPrecisionRecallCurve
+
+    rng = np.random.RandomState(6)
+    num_bins = 16
+    preds = rng.rand(500).astype(np.float32)
+    target = rng.randint(2, size=500)
+
+    m = BinnedPrecisionRecallCurve(num_bins=num_bins)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    precision, recall, thresholds = m.compute()
+
+    # quantize scores exactly as the histogram does before thresholding
+    q = np.clip((preds * num_bins).astype(int), 0, num_bins - 1) / num_bins
+    for k in range(len(np.asarray(thresholds))):
+        th = float(thresholds[k])
+        sel = np.zeros_like(target, dtype=bool) if np.isinf(th) else q >= th
+        tp = int((target[sel] == 1).sum())
+        expected_prec = 1.0 if sel.sum() == 0 else tp / sel.sum()
+        expected_rec = tp / max(int((target == 1).sum()), 1)
+        assert np.allclose(float(precision[k]), expected_prec, atol=1e-6), k
+        assert np.allclose(float(recall[k]), expected_rec, atol=1e-6), k
+
+
+def test_binned_average_precision_vs_sklearn():
+    """On bin-grid scores the binned AP equals sklearn's average_precision."""
+    from sklearn.metrics import average_precision_score
+
+    from metrics_tpu import BinnedAveragePrecision
+
+    rng = np.random.RandomState(7)
+    num_bins = 64
+    preds = (np.floor(rng.rand(4000) * num_bins) / num_bins + 0.5 / num_bins).astype(np.float32)
+    target = rng.randint(2, size=4000)
+
+    m = BinnedAveragePrecision(num_bins=num_bins)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    assert abs(float(m.compute()) - average_precision_score(target, preds)) < 1e-6
+
+
+def test_binned_pr_curve_ddp_sync():
+    """Histogram states of the PR curve sum correctly across virtual ranks."""
+    from metrics_tpu import BinnedPrecisionRecallCurve
+    from tests.helpers.testers import run_virtual_ddp
+
+    rng = np.random.RandomState(8)
+    preds = rng.rand(4, 64).astype(np.float32)
+    target = rng.randint(2, size=(4, 64))
+
+    single = BinnedPrecisionRecallCurve(num_bins=32)
+    for i in range(4):
+        single.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+    expected = single.compute()
+
+    def worker(rank, world):
+        m = BinnedPrecisionRecallCurve(num_bins=32)
+        for i in range(rank, 4, world):
+            m.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        result = m.compute()
+        for got, want in zip(result, expected):
+            assert np.allclose(np.asarray(got), np.asarray(want), atol=1e-6)
+
+    run_virtual_ddp(2, worker)
