@@ -43,6 +43,25 @@ def _auroc_update(preds: jax.Array, target: jax.Array):
     return preds, target, mode
 
 
+def _reduce_auroc(auc_scores, average, support_fn):
+    """Apply NONE/MACRO/WEIGHTED averaging to per-class AUC scores.
+
+    ``support_fn`` lazily computes the per-class support for WEIGHTED.
+    """
+    if average == AverageMethod.NONE:
+        return auc_scores
+    if average == AverageMethod.MACRO:
+        return jnp.mean(jnp.stack(auc_scores))
+    if average == AverageMethod.WEIGHTED:
+        support = support_fn()
+        return jnp.sum(jnp.stack(auc_scores) * support / support.sum())
+
+    allowed_average = (AverageMethod.NONE.value, AverageMethod.MACRO.value, AverageMethod.WEIGHTED.value)
+    raise ValueError(
+        f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
+    )
+
+
 def _auroc_compute(
     preds: jax.Array,
     target: jax.Array,
@@ -89,6 +108,22 @@ def _auroc_compute(
     if mode == DataType.MULTILABEL:
         if average == AverageMethod.MICRO:
             fpr, tpr, _ = roc(preds.reshape(-1), target.reshape(-1), 1, pos_label, sample_weights)
+        elif sample_weights is None and preds.ndim == 2 and target.ndim == 2:
+            # fully on-device fast path: per-label batched sorts in one XLA
+            # program (ops/auroc_kernel.py) instead of a per-label host loop
+            from metrics_tpu.ops.auroc_kernel import binary_auroc
+            from metrics_tpu.utilities.data import _is_concrete
+
+            if _is_concrete(target):
+                # keep the curve path's loud failure on degenerate label columns
+                pos_per_col = jnp.sum(target, axis=0)
+                if bool(jnp.any(pos_per_col == target.shape[0])):
+                    raise ValueError("No negative samples in targets, false positive value should be meaningless")
+                if bool(jnp.any(pos_per_col == 0)):
+                    raise ValueError("No positive samples in targets, true positive value should be meaningless")
+
+            auc_scores = list(jax.vmap(binary_auroc, in_axes=(1, 1))(preds, target))
+            return _reduce_auroc(auc_scores, average, lambda: jnp.sum(target, axis=0))
         else:
             # for multilabel we iteratively evaluate roc in a binary fashion
             output = [
@@ -110,16 +145,8 @@ def _auroc_compute(
         from metrics_tpu.ops.auroc_kernel import multiclass_auroc_ovr
 
         auc_scores = list(multiclass_auroc_ovr(preds, target))
-        if average == AverageMethod.NONE:
-            return auc_scores
-        if average == AverageMethod.MACRO:
-            return jnp.mean(jnp.stack(auc_scores))
-        if average == AverageMethod.WEIGHTED:
-            support = jnp.bincount(target.reshape(-1).astype(jnp.int32), length=num_classes)
-            return jnp.sum(jnp.stack(auc_scores) * support / support.sum())
-        allowed_average = (AverageMethod.NONE.value, AverageMethod.MACRO.value, AverageMethod.WEIGHTED.value)
-        raise ValueError(
-            f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
+        return _reduce_auroc(
+            auc_scores, average, lambda: jnp.bincount(target.reshape(-1).astype(jnp.int32), length=num_classes)
         )
     else:
         fpr, tpr, _ = roc(preds, target, num_classes, pos_label, sample_weights)
@@ -132,22 +159,12 @@ def _auroc_compute(
             # calculate auc scores per class
             auc_scores = [_auc_compute(x, y) for x, y in zip(fpr, tpr)]
 
-            # calculate average
-            if average == AverageMethod.NONE:
-                return auc_scores
-            if average == AverageMethod.MACRO:
-                return jnp.mean(jnp.stack(auc_scores))
-            if average == AverageMethod.WEIGHTED:
+            def support_fn():
                 if mode == DataType.MULTILABEL:
-                    support = jnp.sum(target, axis=0)
-                else:
-                    support = jnp.bincount(target.reshape(-1).astype(jnp.int32), length=num_classes)
-                return jnp.sum(jnp.stack(auc_scores) * support / support.sum())
+                    return jnp.sum(target, axis=0)
+                return jnp.bincount(target.reshape(-1).astype(jnp.int32), length=num_classes)
 
-            allowed_average = (AverageMethod.NONE.value, AverageMethod.MACRO.value, AverageMethod.WEIGHTED.value)
-            raise ValueError(
-                f"Argument `average` expected to be one of the following: {allowed_average} but got {average}"
-            )
+            return _reduce_auroc(auc_scores, average, support_fn)
 
         return _auc_compute(fpr, tpr)
 

@@ -35,6 +35,19 @@ def _average_precision_compute(
     last precision entry from the curve is guaranteed to be 1. Unlike the
     reference (which leaves ``sample_weights`` as a todo), the weights are
     forwarded to the curve computation."""
+    if sample_weights is None:
+        # fully on-device fast path: one co-sort + O(N) scans per class, no
+        # host round-trip through the curve dedup (ops/auroc_kernel.py)
+        from metrics_tpu.ops.auroc_kernel import binary_average_precision
+
+        if num_classes == 1:
+            return binary_average_precision(preds.reshape(-1), target.reshape(-1), pos_label=pos_label)
+        if target.ndim == 1:
+            # multiclass label-encoded targets; multilabel (N, C) targets
+            # fall through to the curve path and its shape validation
+            onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
+            return list(jax.vmap(binary_average_precision, in_axes=(1, 1))(preds, onehot))
+
     precision, recall, _ = _precision_recall_curve_compute(preds, target, num_classes, pos_label, sample_weights)
     if num_classes == 1:
         return -jnp.sum((recall[1:] - recall[:-1]) * precision[:-1])

@@ -1,11 +1,11 @@
-"""Exact binary AUROC as one static-shape XLA program.
+"""Exact binary AUROC / average precision as static-shape XLA programs.
 
 The parity curve path (``functional/classification/precision_recall_curve``)
 dedups tied thresholds host-side because the deduped length is data-dependent
 (reference ``precision_recall_curve.py:51``). For the streaming/TPU hot path
-that host round-trip is the bottleneck, and it isn't needed: the trapezoid
+that host round-trip is the bottleneck, and it isn't needed: the integral
 over deduped points equals a per-element sum where only each tie group's last
-element contributes a trapezoid from the previous group's cumulative counts —
+element contributes a segment from the previous group's cumulative counts —
 and those "previous group" counts can be forward-filled with a ``cummax``
 (cumulative counts are non-decreasing), so the whole computation is one sort
 plus O(N) scans. No gather, no searchsorted, no host round-trip.
@@ -17,6 +17,31 @@ scans are memory-bound element-wise passes.
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+def _sorted_tie_groups(preds: jax.Array, rel: jax.Array):
+    """Co-sort by descending score; return cumulative counts + tie masks.
+
+    Returns ``(tps, fps, is_last, tps_prev, fps_prev)`` where ``*_prev`` are
+    the cumulative counts *before* each element's tie group, forward-filled
+    to the whole group: valid at group firsts, -inf elsewhere; ``cummax``
+    fills forward because cumulative counts are non-decreasing. This
+    forward-fill is the load-bearing trick — keep it in this one place.
+    """
+    # descending sort with co-sorted relevance: no argsort+gather round-trip
+    neg_sorted, rel_s = lax.sort((-preds, rel), num_keys=1, is_stable=True)
+
+    tps = jnp.cumsum(rel_s)
+    fps = jnp.cumsum(1.0 - rel_s)
+
+    boundary = neg_sorted[1:] != neg_sorted[:-1]
+    is_first = jnp.concatenate([jnp.ones((1,), bool), boundary])
+    is_last = jnp.concatenate([boundary, jnp.ones((1,), bool)])
+
+    tps_prev = lax.cummax(jnp.where(is_first, tps - rel_s, -jnp.inf))
+    fps_prev = lax.cummax(jnp.where(is_first, fps - (1.0 - rel_s), -jnp.inf))
+
+    return tps, fps, is_last, tps_prev, fps_prev
 
 
 @jax.jit
@@ -32,20 +57,7 @@ def binary_auroc(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax
         Array(0.75, dtype=float32)
     """
     rel = (target == pos_label).astype(jnp.float32)
-    # descending sort with co-sorted relevance: no argsort+gather round-trip
-    neg_sorted, rel_s = lax.sort((-preds, rel), num_keys=1, is_stable=True)
-
-    tps = jnp.cumsum(rel_s)
-    fps = jnp.cumsum(1.0 - rel_s)
-
-    is_first = jnp.concatenate([jnp.ones((1,), bool), neg_sorted[1:] != neg_sorted[:-1]])
-    is_last = jnp.concatenate([neg_sorted[1:] != neg_sorted[:-1], jnp.ones((1,), bool)])
-
-    # cumulative counts *before* each tie group, forward-filled to the whole
-    # group: valid at group firsts, -inf elsewhere; cummax fills forward
-    # because tps/fps are non-decreasing
-    tps_prev = lax.cummax(jnp.where(is_first, tps - rel_s, -jnp.inf))
-    fps_prev = lax.cummax(jnp.where(is_first, fps - (1.0 - rel_s), -jnp.inf))
+    tps, fps, is_last, tps_prev, fps_prev = _sorted_tie_groups(preds, rel)
 
     # trapezoid contribution of each tie group, attributed to its last element
     area = jnp.sum(jnp.where(is_last, 0.5 * (tps + tps_prev) * (fps - fps_prev), 0.0))
@@ -69,3 +81,27 @@ def multiclass_auroc_ovr(preds: jax.Array, target: jax.Array) -> jax.Array:
     num_classes = preds.shape[1]
     onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
     return jax.vmap(binary_auroc, in_axes=(1, 1))(preds, onehot)
+
+
+@jax.jit
+def binary_average_precision(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax.Array:
+    """Exact average precision of 1-d scores vs binary targets, jittable.
+
+    Tie-correct: AP = sum over distinct thresholds of
+    ``(R_k - R_{k-1}) * P_k``, computed with the same co-sort +
+    cummax-forward-fill pattern as :func:`binary_auroc` — no host dedup.
+    Targets with no positive sample yield NaN (0/0 recall), matching the
+    parity curve path.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> binary_average_precision(jnp.array([0.1, 0.4, 0.35, 0.8]), jnp.array([0, 0, 1, 1]))
+        Array(0.8333334, dtype=float32)
+    """
+    rel = (target == pos_label).astype(jnp.float32)
+    tps, fps, is_last, tps_prev, _ = _sorted_tie_groups(preds, rel)
+
+    n_pos = tps[-1]
+    precision = tps / jnp.maximum(tps + fps, 1.0)
+    ap = jnp.sum(jnp.where(is_last, (tps - tps_prev) * precision, 0.0)) / jnp.maximum(n_pos, 1.0)
+    return jnp.where(n_pos == 0, jnp.nan, ap)
