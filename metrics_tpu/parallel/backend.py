@@ -60,8 +60,10 @@ class MultiHostBackend(SyncBackend):
 
     def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
         from jax.experimental import multihost_utils
+        import jax.numpy as jnp
 
-        stacked = multihost_utils.process_allgather(x)  # (num_processes, ...)
+        stacked = jnp.asarray(multihost_utils.process_allgather(x))  # (num_processes, ...)
+        # one device put; slices are jax Arrays, as _sync_dist's reduce expects
         return [stacked[i] for i in range(stacked.shape[0])]
 
 

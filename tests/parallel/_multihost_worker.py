@@ -1,0 +1,65 @@
+"""Worker script for the real multi-process `jax.distributed` test.
+
+Launched by ``test_multihost.py`` as N separate Python processes; each
+process is one "host" with its own metric replica, synced through
+:class:`MultiHostBackend` at ``compute()`` — the TPU-pod analog of the
+reference's 2-process Gloo pool (``tests/helpers/testers.py:24-47``).
+"""
+import sys
+
+
+def main(coordinator: str, num_processes: int, process_id: int) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from metrics_tpu import Accuracy
+    from metrics_tpu.parallel.backend import MultiHostBackend, set_sync_backend
+
+    set_sync_backend(MultiHostBackend())
+
+    # interleaved batch sharding, like the reference's _class_test
+    rng = np.random.RandomState(0)
+    n_batches, batch = 4, 32
+    logits = rng.rand(n_batches, batch, 5).astype(np.float32)
+    probs = logits / logits.sum(axis=2, keepdims=True)
+    targets = rng.randint(5, size=(n_batches, batch))
+
+    metric = Accuracy()
+    for i in range(process_id, n_batches, num_processes):
+        metric.update(jnp.asarray(probs[i]), jnp.asarray(targets[i]))
+
+    result = float(metric.compute())
+
+    expected = float(np.mean(probs.reshape(-1, 5).argmax(1) == targets.reshape(-1)))
+    assert abs(result - expected) < 1e-6, (result, expected)
+
+    # cat-state (list) metric: per-rank preds/targets all-gather + concat
+    from sklearn.metrics import roc_auc_score
+
+    from metrics_tpu import AUROC
+
+    bin_preds = rng.rand(n_batches, batch).astype(np.float32)
+    bin_targets = rng.randint(2, size=(n_batches, batch))
+
+    auroc = AUROC()
+    for i in range(process_id, n_batches, num_processes):
+        auroc.update(jnp.asarray(bin_preds[i]), jnp.asarray(bin_targets[i]))
+    auroc_result = float(auroc.compute())
+
+    auroc_expected = roc_auc_score(bin_targets.reshape(-1), bin_preds.reshape(-1))
+    assert abs(auroc_result - auroc_expected) < 1e-6, (auroc_result, auroc_expected)
+
+    print(f"rank {process_id}: OK {result}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))
