@@ -8,6 +8,8 @@ from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, List, Optional, Tuple, Union
 
+import jax.numpy as jnp
+
 from metrics_tpu.metric import Metric
 
 
@@ -139,6 +141,18 @@ class MetricCollection:
         for _, m in self.items():
             m.to_device(device)
         return self
+
+    def astype(self, dtype) -> "MetricCollection":
+        """Apply a precision policy to every metric (see :meth:`Metric.astype`)."""
+        for _, m in self.items():
+            m.astype(dtype)
+        return self
+
+    def bfloat16(self) -> "MetricCollection":
+        return self.astype(jnp.bfloat16)
+
+    def float(self) -> "MetricCollection":
+        return self.astype(jnp.float32)
 
     def _set_prefix(self, k: str) -> str:
         return k if self.prefix is None else self.prefix + k

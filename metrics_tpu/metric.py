@@ -147,15 +147,14 @@ class Metric(ABC):
             self._to_sync = self.dist_sync_on_step
 
             # save accumulated state, compute on this batch alone
-            cache = {attr: getattr(self, attr) for attr in self._defaults}
+            cache = self._snapshot_state()
 
             self.reset()
             self.update(*args, **kwargs)
             self._forward_cache = self.compute()
 
             # restore accumulated state
-            for attr, val in cache.items():
-                setattr(self, attr, val)
+            self._restore_state(cache)
             self._to_sync = True
             self._computed = None
 
@@ -207,18 +206,28 @@ class Metric(ABC):
             cache = {}
             if self._to_sync and dist_sync_fn is not None:
                 # cache prior to syncing so accumulation continues un-synced
-                cache = {attr: getattr(self, attr) for attr in self._defaults}
+                cache = self._snapshot_state()
                 self._sync_dist(dist_sync_fn)
                 synced = True
 
             self._computed = compute(*args, **kwargs)
             if synced:
-                for attr, val in cache.items():
-                    setattr(self, attr, val)
+                self._restore_state(cache)
 
             return self._computed
 
         return wrapped_func
+
+    def _snapshot_state(self) -> Dict[str, Any]:
+        """Snapshot everything ``reset()`` touches, so forward's
+        snapshot/reset/restore cycle is lossless. Subclasses with host-side
+        bookkeeping beyond the registered states must extend both this and
+        :meth:`_restore_state`."""
+        return {attr: getattr(self, attr) for attr in self._defaults}
+
+    def _restore_state(self, cache: Dict[str, Any]) -> None:
+        for attr, val in cache.items():
+            setattr(self, attr, val)
 
     @abstractmethod
     def update(self) -> None:
@@ -266,6 +275,46 @@ class Metric(ABC):
                 )
         return self
 
+    def astype(self, dtype) -> "Metric":
+        """Cast floating-point array states to ``dtype`` (precision policy).
+
+        Analog of the reference's ``_apply``-based ``.half()/.float()``
+        (``torchmetrics/metric.py:280-297``) for bf16 eval loops::
+
+            metric.astype(jnp.bfloat16)
+
+        Only floating states are cast — integer counter states (``tp``,
+        ``total``, confusion matrices, ...) keep their dtype, matching
+        ``nn.Module.half`` semantics. List states are cast elementwise.
+        Unlike the reference, the registered defaults are cast too, so
+        ``reset()`` preserves the precision policy. Inputs passed to
+        ``update`` afterwards follow the usual jnp promotion rules.
+        """
+        dtype = jnp.dtype(dtype)
+
+        def _cast(v):
+            if isinstance(v, (Array, jnp.ndarray)) and jnp.issubdtype(v.dtype, jnp.floating):
+                return v.astype(dtype)
+            return v
+
+        for key in self._defaults:
+            val = getattr(self, key)
+            setattr(self, key, [_cast(v) for v in val] if isinstance(val, list) else _cast(val))
+            default = self._defaults[key]
+            self._defaults[key] = (
+                [_cast(v) for v in default] if isinstance(default, list) else _cast(default)
+            )
+        self._computed = None
+        return self
+
+    def bfloat16(self) -> "Metric":
+        """Shorthand for ``astype(jnp.bfloat16)`` (reference ``.half()`` analog)."""
+        return self.astype(jnp.bfloat16)
+
+    def float(self) -> "Metric":
+        """Shorthand for ``astype(jnp.float32)`` (reference ``.float()`` analog)."""
+        return self.astype(jnp.float32)
+
     def persistent(self, mode: bool = False) -> None:
         """Post-init toggle: should states be saved in ``state_dict``?"""
         for key in self._persistent:
@@ -281,6 +330,7 @@ class Metric(ABC):
 
     def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
         """Restore states saved by :meth:`state_dict`."""
+        loaded = False
         for key in self._defaults:
             name = prefix + key
             if name in state_dict:
@@ -289,6 +339,10 @@ class Metric(ABC):
                     setattr(self, key, [jnp.asarray(v) for v in val])
                 else:
                     setattr(self, key, jnp.asarray(val))
+                loaded = True
+        if loaded:
+            # a cached pre-load result no longer describes the state
+            self._computed = None
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         """Filter kwargs to those accepted by this metric's ``update`` signature."""
@@ -471,6 +525,40 @@ class CompositionalMetric(Metric):
             self.metric_a.persistent(mode=mode)
         if isinstance(self.metric_b, Metric):
             self.metric_b.persistent(mode=mode)
+
+    # A composition registers no state of its own (`_defaults` is empty), so
+    # checkpointing / device / dtype handling must recurse into the operand
+    # metrics — the analog of ``nn.Module``'s child-module recursion the
+    # reference gets for free (``torchmetrics/metric.py:306-318``).
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
+        destination = {} if destination is None else destination
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.state_dict(destination, prefix + "metric_a.")
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.state_dict(destination, prefix + "metric_b.")
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.load_state_dict(state_dict, prefix + "metric_a.")
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.load_state_dict(state_dict, prefix + "metric_b.")
+        self._computed = None
+
+    def to_device(self, device) -> "CompositionalMetric":
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.to_device(device)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.to_device(device)
+        return self
+
+    def astype(self, dtype) -> "CompositionalMetric":
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.astype(dtype)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.astype(dtype)
+        self._computed = None
+        return self
 
     def __repr__(self) -> str:
         _op_name = getattr(self.op, "__name__", repr(self.op))

@@ -65,6 +65,91 @@ def test_npz_checkpoint_roundtrip(tmp_path):
     assert float(m.compute()) == float(m2.compute())
 
 
+def test_load_state_dict_invalidates_cached_compute():
+    """Regression: a cached compute() result must not survive a state load."""
+    rng = np.random.RandomState(2)
+    logits = rng.rand(32, 5).astype(np.float32)
+    preds = jnp.asarray(logits / logits.sum(1, keepdims=True))
+    target = jnp.asarray(rng.randint(5, size=32))
+
+    donor = Accuracy()
+    donor.update(preds, target)
+    donor.persistent(True)
+    saved = donor.state_dict()
+    want = float(donor.compute())
+
+    m = Accuracy()
+    m.update(preds, (jnp.argmax(preds, axis=1) + 1) % 5)  # all-wrong stream
+    stale = float(m.compute())
+    m.load_state_dict(saved)
+    assert float(m.compute()) == want != stale
+
+
+def test_compositional_state_dict_roundtrip():
+    """Composition checkpoints must recurse into operand metrics
+    (reference analog: nn.Module child recursion, ``metric.py:306-318``)."""
+    m1, m2 = _fill(Accuracy()), _fill(Accuracy())
+    comp = m1 + m2
+    comp.persistent(True)
+    saved = comp.state_dict()
+    assert saved, "composition state_dict must include child states"
+
+    comp2 = Accuracy() + Accuracy()
+    comp2.load_state_dict(saved)
+    assert float(comp.compute()) == float(comp2.compute())
+
+
+def test_nested_compositional_state_dict_roundtrip():
+    comp = (_fill(Accuracy()) + _fill(Accuracy())) * 2.0
+    comp.persistent(True)
+    saved = comp.state_dict()
+
+    comp2 = (Accuracy() + Accuracy()) * 2.0
+    comp2.load_state_dict(saved)
+    assert float(comp.compute()) == float(comp2.compute())
+
+
+def test_astype_bf16_state_roundtrip():
+    """Precision policy: float states cast to bf16, int counters untouched,
+    reset() keeps the policy, checkpoints roundtrip in bf16."""
+    m = BinnedAUROC(num_bins=32)
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(256).astype(np.float32))
+    target = jnp.asarray(rng.randint(2, size=256))
+    m.update(preds, target)
+    ref = float(m.compute())
+
+    m.astype(jnp.bfloat16)
+    for key in m._defaults:
+        val = getattr(m, key)
+        if jnp.issubdtype(val.dtype, jnp.floating):
+            assert val.dtype == jnp.bfloat16
+    m._computed = None
+    bf16_val = float(m.compute())
+    assert abs(bf16_val - ref) < 1e-2
+
+    m.persistent(True)
+    saved = m.state_dict()
+    m2 = BinnedAUROC(num_bins=32).astype(jnp.bfloat16)
+    m2.load_state_dict(saved)
+    assert float(m2.compute()) == bf16_val
+
+    m.reset()
+    for key in m._defaults:
+        val = getattr(m, key)
+        if jnp.issubdtype(val.dtype, jnp.floating):
+            assert val.dtype == jnp.bfloat16, "reset() must preserve the dtype policy"
+
+
+def test_astype_int_counters_unchanged():
+    m = _fill(Accuracy())
+    dtypes_before = {k: getattr(m, k).dtype for k in m._defaults}
+    m.astype(jnp.bfloat16)
+    for k, dt in dtypes_before.items():
+        if not jnp.issubdtype(dt, jnp.floating):
+            assert getattr(m, k).dtype == dt
+
+
 def test_collection_state_dict_roundtrip():
     col = MetricCollection([Accuracy(), BinnedAUROC(num_bins=16)])
     rng = np.random.RandomState(1)
