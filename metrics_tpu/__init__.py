@@ -34,6 +34,11 @@ from metrics_tpu.classification import (  # noqa: F401, E402
     Precision,
     PrecisionRecallCurve,
     Recall,
+    ShardedAUROC,
+    ShardedAveragePrecision,
+    ShardedCurveMetric,
+    ShardedPrecisionRecallCurve,
+    ShardedROC,
     StatScores,
 )
 from metrics_tpu.regression import (  # noqa: F401, E402

@@ -17,4 +17,11 @@ from metrics_tpu.classification.matthews_corrcoef import MatthewsCorrcoef  # noq
 from metrics_tpu.classification.precision_recall import Precision, Recall  # noqa: F401
 from metrics_tpu.classification.precision_recall_curve import PrecisionRecallCurve  # noqa: F401
 from metrics_tpu.classification.roc import ROC  # noqa: F401
+from metrics_tpu.classification.sharded import (  # noqa: F401
+    ShardedAUROC,
+    ShardedAveragePrecision,
+    ShardedCurveMetric,
+    ShardedPrecisionRecallCurve,
+    ShardedROC,
+)
 from metrics_tpu.classification.stat_scores import StatScores  # noqa: F401

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def _sorted_tie_groups(preds: jax.Array, rel: jax.Array):
+def _sorted_tie_groups(preds: jax.Array, rel: jax.Array, weight: jax.Array = None):
     """Co-sort by descending score; return cumulative counts + tie masks.
 
     Returns ``(tps, fps, is_last, tps_prev, fps_prev)`` where ``*_prev`` are
@@ -27,19 +27,32 @@ def _sorted_tie_groups(preds: jax.Array, rel: jax.Array):
     to the whole group: valid at group firsts, -inf elsewhere; ``cummax``
     fills forward because cumulative counts are non-decreasing. This
     forward-fill is the load-bearing trick — keep it in this one place.
-    """
-    # descending sort with co-sorted relevance: no argsort+gather round-trip
-    neg_sorted, rel_s = lax.sort((-preds, rel), num_keys=1, is_stable=True)
 
-    tps = jnp.cumsum(rel_s)
-    fps = jnp.cumsum(1.0 - rel_s)
+    ``weight`` (default all-ones) scales each element's contribution to the
+    counts. Zero-weight elements are counted nowhere, so they cannot affect
+    the result regardless of where their (arbitrary, even ±inf) score sorts
+    them: cumulative counts don't move through them, and a tie group of only
+    zero-weight elements has zero count deltas. This is how masked buffers
+    exclude unfilled slots without score sentinels.
+    """
+    if weight is None:
+        # descending sort with co-sorted relevance: no argsort+gather round-trip
+        neg_sorted, rel_s = lax.sort((-preds, rel), num_keys=1, is_stable=True)
+        w_s = jnp.ones_like(rel_s)
+    else:
+        neg_sorted, rel_s, w_s = lax.sort((-preds, rel, weight), num_keys=1, is_stable=True)
+
+    pos_w = rel_s * w_s
+    neg_w = (1.0 - rel_s) * w_s
+    tps = jnp.cumsum(pos_w)
+    fps = jnp.cumsum(neg_w)
 
     boundary = neg_sorted[1:] != neg_sorted[:-1]
     is_first = jnp.concatenate([jnp.ones((1,), bool), boundary])
     is_last = jnp.concatenate([boundary, jnp.ones((1,), bool)])
 
-    tps_prev = lax.cummax(jnp.where(is_first, tps - rel_s, -jnp.inf))
-    fps_prev = lax.cummax(jnp.where(is_first, fps - (1.0 - rel_s), -jnp.inf))
+    tps_prev = lax.cummax(jnp.where(is_first, tps - pos_w, -jnp.inf))
+    fps_prev = lax.cummax(jnp.where(is_first, fps - neg_w, -jnp.inf))
 
     return tps, fps, is_last, tps_prev, fps_prev
 
@@ -57,16 +70,9 @@ def binary_auroc(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax
         Array(0.75, dtype=float32)
     """
     rel = (target == pos_label).astype(jnp.float32)
-    tps, fps, is_last, tps_prev, fps_prev = _sorted_tie_groups(preds, rel)
-
-    # trapezoid contribution of each tie group, attributed to its last element
-    area = jnp.sum(jnp.where(is_last, 0.5 * (tps + tps_prev) * (fps - fps_prev), 0.0))
-
-    n_pos = tps[-1]
-    n_neg = fps[-1]
-    # degenerate targets (single class) have no defined AUROC: surface NaN
-    # under jit; the eager functional path raises before reaching here
-    return jnp.where(n_pos * n_neg == 0, jnp.nan, area / jnp.maximum(n_pos * n_neg, 1.0))
+    # degenerate targets (single class) surface NaN under jit (the eager
+    # functional path raises before reaching here)
+    return _auroc_from_groups(*_sorted_tie_groups(preds, rel))
 
 
 @jax.jit
@@ -81,6 +87,55 @@ def multiclass_auroc_ovr(preds: jax.Array, target: jax.Array) -> jax.Array:
     num_classes = preds.shape[1]
     onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
     return jax.vmap(binary_auroc, in_axes=(1, 1))(preds, onehot)
+
+
+def _auroc_from_groups(tps, fps, is_last, tps_prev, fps_prev) -> jax.Array:
+    """Tie-corrected trapezoid area over groups → normalized AUROC (NaN when
+    a class is absent). The ONE place the AUROC formula lives."""
+    area = jnp.sum(jnp.where(is_last, 0.5 * (tps + tps_prev) * (fps - fps_prev), 0.0))
+    n_pos = tps[-1]
+    n_neg = fps[-1]
+    return jnp.where(n_pos * n_neg == 0, jnp.nan, area / jnp.maximum(n_pos * n_neg, 1.0))
+
+
+def _ap_from_groups(tps, fps, is_last, tps_prev) -> jax.Array:
+    """Per-threshold ``ΔR·P`` sum over groups → average precision (NaN when
+    no positives). The ONE place the AP formula lives."""
+    n_pos = tps[-1]
+    precision = tps / jnp.maximum(tps + fps, 1.0)
+    ap = jnp.sum(jnp.where(is_last, (tps - tps_prev) * precision, 0.0)) / jnp.maximum(n_pos, 1.0)
+    return jnp.where(n_pos == 0, jnp.nan, ap)
+
+
+@jax.jit
+def masked_binary_auroc(preds: jax.Array, target: jax.Array, mask: jax.Array, pos_label: int = 1) -> jax.Array:
+    """Exact AUROC over the ``mask``-valid subset, static shape, jittable.
+
+    The distributed building block for sharded cat-state metrics
+    (:class:`metrics_tpu.classification.ShardedAUROC`): gathered
+    fixed-capacity buffers contain unfilled slots, which must not affect the
+    result. Invalid entries get weight 0 in the cumulative counts — no score
+    sentinel, so even valid ``±inf`` scores (raw logits) stay exact.
+    """
+    w = mask.astype(jnp.float32)
+    rel = (target == pos_label).astype(jnp.float32)
+    tps, fps, is_last, tps_prev, fps_prev = _sorted_tie_groups(preds, rel, w)
+    return _auroc_from_groups(tps, fps, is_last, tps_prev, fps_prev)
+
+
+@jax.jit
+def masked_binary_average_precision(
+    preds: jax.Array, target: jax.Array, mask: jax.Array, pos_label: int = 1
+) -> jax.Array:
+    """Exact average precision over the ``mask``-valid subset, jittable.
+
+    Invalid entries get weight 0 (see :func:`_sorted_tie_groups`): they move
+    no cumulative count, so precision and recall deltas never see them.
+    """
+    w = mask.astype(jnp.float32)
+    rel = (target == pos_label).astype(jnp.float32)
+    tps, fps, is_last, tps_prev, _ = _sorted_tie_groups(preds, rel, w)
+    return _ap_from_groups(tps, fps, is_last, tps_prev)
 
 
 @jax.jit
@@ -100,8 +155,4 @@ def binary_average_precision(preds: jax.Array, target: jax.Array, pos_label: int
     """
     rel = (target == pos_label).astype(jnp.float32)
     tps, fps, is_last, tps_prev, _ = _sorted_tie_groups(preds, rel)
-
-    n_pos = tps[-1]
-    precision = tps / jnp.maximum(tps + fps, 1.0)
-    ap = jnp.sum(jnp.where(is_last, (tps - tps_prev) * precision, 0.0)) / jnp.maximum(n_pos, 1.0)
-    return jnp.where(n_pos == 0, jnp.nan, ap)
+    return _ap_from_groups(tps, fps, is_last, tps_prev)

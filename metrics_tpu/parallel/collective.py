@@ -82,5 +82,8 @@ def masked_cat_sync(buffer: jax.Array, count: jax.Array, axis_name: str):
     world = counts.shape[0]
     pos_in_dev = jnp.arange(world * capacity) % capacity
     dev = jnp.arange(world * capacity) // capacity
-    mask = pos_in_dev < counts[dev]
+    # clamp: a count that ran past capacity must not validate slots that were
+    # never written (writers drop out-of-bounds updates; see ShardedCurveMetric,
+    # which raises loudly on overflow before it can happen)
+    mask = pos_in_dev < jnp.minimum(counts[dev], capacity)
     return gathered, counts, mask

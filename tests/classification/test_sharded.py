@@ -1,0 +1,318 @@
+"""Sharded exact curve metrics: bounded per-device state, sklearn-exact values.
+
+The library answer to the reference's replicated unbounded list states and
+their memory warning (``torchmetrics/classification/auroc.py:141-147``);
+VERDICT round-1 item 2. Runs on the 8 virtual CPU devices provisioned by
+``tests/conftest.py``.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import average_precision_score, roc_auc_score, roc_curve as sk_roc
+
+from metrics_tpu import (
+    AUROC,
+    ShardedAUROC,
+    ShardedAveragePrecision,
+    ShardedPrecisionRecallCurve,
+    ShardedROC,
+)
+
+WORLD = 8
+
+
+def _stream(n, seed=0, ties=False):
+    rng = np.random.RandomState(seed)
+    preds = rng.rand(n).astype(np.float32)
+    if ties:
+        preds = np.round(preds * 10) / 10  # force heavy tie groups
+    target = rng.randint(2, size=n).astype(np.int32)
+    return preds, target
+
+
+def test_sharded_auroc_matches_sklearn_exactly():
+    preds, target = _stream(4096)
+    m = ShardedAUROC(capacity_per_device=1024)
+    for chunk in range(4):
+        sl = slice(chunk * 1024, (chunk + 1) * 1024)
+        m.update(jnp.asarray(preds[sl]), jnp.asarray(target[sl]))
+    got = float(m.compute())
+    want = roc_auc_score(target, preds)
+    assert np.allclose(got, want, atol=1e-6)
+
+
+def test_sharded_auroc_with_ties_matches_sklearn():
+    preds, target = _stream(2048, seed=7, ties=True)
+    m = ShardedAUROC(capacity_per_device=256)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    assert np.allclose(float(m.compute()), roc_auc_score(target, preds), atol=1e-6)
+
+
+def test_sharded_auroc_partially_filled_buffers():
+    """The mask must exclude unfilled slots (zeros would otherwise pollute)."""
+    preds, target = _stream(64, seed=3)
+    m = ShardedAUROC(capacity_per_device=100)  # mostly empty
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    assert np.allclose(float(m.compute()), roc_auc_score(target, preds), atol=1e-6)
+
+
+def test_sharded_auroc_matches_replicated_class():
+    preds, target = _stream(512, seed=11)
+    sharded = ShardedAUROC(capacity_per_device=64)
+    replicated = AUROC(pos_label=1)
+    sharded.update(jnp.asarray(preds), jnp.asarray(target))
+    replicated.update(jnp.asarray(preds), jnp.asarray(target))
+    assert np.allclose(float(sharded.compute()), float(replicated.compute()), atol=1e-6)
+
+
+def test_state_is_sharded_one_over_world_per_device():
+    m = ShardedAUROC(capacity_per_device=128)
+    shardings = m.buf_preds.sharding
+    # each device must hold exactly capacity_per_device elements
+    shard_sizes = {s.data.size for s in m.buf_preds.addressable_shards}
+    assert shard_sizes == {128}
+    assert len(m.buf_preds.addressable_shards) == WORLD
+    assert not shardings.is_fully_replicated
+
+
+def test_overflow_raises_loudly():
+    m = ShardedAUROC(capacity_per_device=4)  # capacity 32 total
+    preds, target = _stream(32)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    with pytest.raises(ValueError, match="overflow"):
+        m.update(jnp.asarray(preds[:8]), jnp.asarray(target[:8]))
+    # state is still valid and exact after the refused update
+    assert np.allclose(float(m.compute()), roc_auc_score(target, preds), atol=1e-6)
+
+
+def test_count_past_capacity_never_corrupts():
+    """Even writing past capacity inside the program (bypassing the host
+    check) must not silently validate unwritten slots: writes drop and the
+    sync mask clamps."""
+    from metrics_tpu.classification.sharded import _programs
+
+    m = ShardedAUROC(capacity_per_device=4)
+    preds, target = _stream(32)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    # force a second full write, bypassing update()'s overflow guard
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sharding = NamedSharding(m.mesh, P(m.axis_name))
+    p2 = jax.device_put(jnp.asarray(preds), sharding)
+    t2 = jax.device_put(jnp.asarray(target), sharding)
+    jit_update, _ = _programs(m.mesh, m.axis_name)
+    m.buf_preds, m.buf_target, m.counts = jit_update(m.buf_preds, m.buf_target, m.counts, p2, t2)
+    m._computed = None
+    # counts now read 8/device with capacity 4: the mask must clamp, and the
+    # value must still be the exact AUROC of the first (kept) stream
+    assert np.allclose(float(m.compute()), roc_auc_score(target, preds), atol=1e-6)
+
+
+def test_batch_not_divisible_raises():
+    m = ShardedAUROC(capacity_per_device=8)
+    with pytest.raises(ValueError, match="divisible"):
+        m.update(jnp.zeros(9), jnp.zeros(9, jnp.int32))
+
+
+def test_reset_and_reuse():
+    preds, target = _stream(64, seed=5)
+    m = ShardedAUROC(capacity_per_device=16)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    m.reset()
+    assert m._n_seen == 0
+    preds2, target2 = _stream(64, seed=6)
+    m.update(jnp.asarray(preds2), jnp.asarray(target2))
+    assert np.allclose(float(m.compute()), roc_auc_score(target2, preds2), atol=1e-6)
+
+
+def test_sharded_average_precision_matches_sklearn():
+    preds, target = _stream(1024, seed=9)
+    m = ShardedAveragePrecision(capacity_per_device=256)
+    m.update(jnp.asarray(preds[:512]), jnp.asarray(target[:512]))
+    m.update(jnp.asarray(preds[512:]), jnp.asarray(target[512:]))
+    assert np.allclose(float(m.compute()), average_precision_score(target, preds), atol=1e-5)
+
+
+def test_sharded_average_precision_with_ties():
+    preds, target = _stream(512, seed=13, ties=True)
+    m = ShardedAveragePrecision(capacity_per_device=64)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    assert np.allclose(float(m.compute()), average_precision_score(target, preds), atol=1e-5)
+
+
+def test_sharded_roc_matches_sklearn():
+    preds, target = _stream(256, seed=2)
+    m = ShardedROC(capacity_per_device=64)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    fpr, tpr, thresholds = m.compute()
+    sk_fpr, sk_tpr, _ = sk_roc(target, preds, drop_intermediate=False)
+    assert np.allclose(np.asarray(fpr), sk_fpr, atol=1e-6)
+    assert np.allclose(np.asarray(tpr), sk_tpr, atol=1e-6)
+
+
+def test_sharded_prc_matches_replicated_class():
+    """Same curve as the replicated parity class (which is sklearn-tested);
+    conventions (threshold dedup, terminal point) must match exactly."""
+    from metrics_tpu import PrecisionRecallCurve
+
+    preds, target = _stream(256, seed=4)
+    m = ShardedPrecisionRecallCurve(capacity_per_device=64)
+    ref = PrecisionRecallCurve(pos_label=1)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    ref.update(jnp.asarray(preds), jnp.asarray(target))
+    precision, recall, thresholds = m.compute()
+    ref_p, ref_r, ref_t = ref.compute()
+    assert np.allclose(np.asarray(precision), np.asarray(ref_p), atol=1e-6)
+    assert np.allclose(np.asarray(recall), np.asarray(ref_r), atol=1e-6)
+    assert np.allclose(np.asarray(thresholds), np.asarray(ref_t), atol=1e-6)
+
+
+def test_checkpoint_roundtrip_restores_sharding_and_fill():
+    preds, target = _stream(128, seed=8)
+    m = ShardedAUROC(capacity_per_device=32)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    m.persistent(True)
+    saved = {k: np.asarray(v) for k, v in m.state_dict().items()}  # host npz-style
+
+    m2 = ShardedAUROC(capacity_per_device=32)
+    m2.load_state_dict(saved)
+    assert m2._n_seen == 128
+    assert {s.data.size for s in m2.buf_preds.addressable_shards} == {32}
+    assert np.allclose(float(m2.compute()), roc_auc_score(target, preds), atol=1e-6)
+    # and accumulation continues after restore
+    preds2, target2 = _stream(64, seed=14)
+    m2.update(jnp.asarray(preds2), jnp.asarray(target2))
+    all_p, all_t = np.concatenate([preds, preds2]), np.concatenate([target, target2])
+    m2._computed = None
+    assert np.allclose(float(m2.compute()), roc_auc_score(all_t, all_p), atol=1e-6)
+
+
+def test_forward_returns_batch_local_value():
+    preds, target = _stream(64, seed=15)
+    m = ShardedAUROC(capacity_per_device=32)
+    batch_val = m(jnp.asarray(preds), jnp.asarray(target))
+    assert np.allclose(float(batch_val), roc_auc_score(target, preds), atol=1e-6)
+    assert m._n_seen == 64
+
+
+def test_repeated_forward_accumulates_and_overflow_still_loud():
+    """Regression: forward()'s snapshot/reset/restore must preserve the
+    host-side fill level — a forgotten `_n_seen` would silently drop samples
+    instead of raising on overflow."""
+    preds, target = _stream(48, seed=16)
+    m = ShardedAUROC(capacity_per_device=4)  # capacity 32 total
+    m(jnp.asarray(preds[:16]), jnp.asarray(target[:16]))
+    m(jnp.asarray(preds[16:32]), jnp.asarray(target[16:32]))
+    assert m._n_seen == 32
+    with pytest.raises(ValueError, match="overflow"):
+        m(jnp.asarray(preds[32:]), jnp.asarray(target[32:]))
+    assert np.allclose(
+        float(m.compute()), roc_auc_score(target[:32], preds[:32]), atol=1e-6
+    )
+
+
+def test_load_state_dict_invalidates_compute_cache():
+    """Regression: compute() after loading a checkpoint must not serve the
+    stale pre-load cached value."""
+    preds, target = _stream(64, seed=17)
+    preds2, target2 = _stream(64, seed=18)
+    m = ShardedAUROC(capacity_per_device=32)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    m.persistent(True)
+    saved = m.state_dict()
+
+    other = ShardedAUROC(capacity_per_device=32)
+    other.update(jnp.asarray(preds2), jnp.asarray(target2))
+    stale = float(other.compute())  # populates the cache
+    other.load_state_dict(saved)
+    fresh = float(other.compute())
+    assert np.allclose(fresh, roc_auc_score(target, preds), atol=1e-6)
+    assert fresh != stale
+
+
+def test_pickle_roundtrip_mid_accumulation():
+    """Device handles never pickle; the metric serializes its mesh spec +
+    host states and rebuilds sharded on the unpickling host's devices."""
+    import pickle
+
+    preds, target = _stream(128, seed=21)
+    m = ShardedAUROC(capacity_per_device=32)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    m2 = pickle.loads(pickle.dumps(m))
+    assert {s.data.size for s in m2.buf_preds.addressable_shards} == {32}
+    assert np.allclose(float(m2.compute()), roc_auc_score(target, preds), atol=1e-6)
+    m2.update(jnp.asarray(preds), jnp.asarray(target))  # still updatable
+
+
+def test_clone_is_independent():
+    preds, target = _stream(64, seed=22)
+    m = ShardedAUROC(capacity_per_device=32)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    c = m.clone()
+    c.reset()
+    assert m._n_seen == 64 and c._n_seen == 0
+    assert np.allclose(float(m.compute()), roc_auc_score(target, preds), atol=1e-6)
+
+
+def test_masked_kernels_exact_with_inf_scores():
+    """Regression: valid ±inf scores (raw logits) must not collide with any
+    invalid-slot handling — masking is by weight, not score sentinel."""
+    from metrics_tpu.ops.auroc_kernel import (
+        binary_auroc,
+        binary_average_precision,
+        masked_binary_auroc,
+        masked_binary_average_precision,
+    )
+
+    preds = jnp.asarray([np.inf, 0.4, 0.3, 0.2, -np.inf, 7.7, 0.0, 0.0])
+    target = jnp.asarray([1, 0, 1, 0, 1, 0, 1, 1], jnp.int32)
+    mask = jnp.asarray([1, 1, 1, 1, 1, 0, 0, 0], bool)
+    vp, vt = preds[:5], target[:5]
+    assert np.allclose(
+        float(masked_binary_auroc(preds, target, mask)), float(binary_auroc(vp, vt)), atol=1e-6
+    )
+    assert np.allclose(
+        float(masked_binary_average_precision(preds, target, mask)),
+        float(binary_average_precision(vp, vt)),
+        atol=1e-6,
+    )
+
+
+def test_load_state_dict_rejects_mesh_mismatch():
+    """Regression: a checkpoint from a different mesh size must be refused,
+    not silently mis-masked."""
+    from jax.sharding import Mesh
+
+    preds, target = _stream(64, seed=19)
+    m8 = ShardedAUROC(capacity_per_device=16)
+    m8.update(jnp.asarray(preds), jnp.asarray(target))
+    m8.persistent(True)
+    saved = m8.state_dict()
+
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("data",))
+    m4 = ShardedAUROC(capacity_per_device=16, mesh=mesh4)
+    with pytest.raises(ValueError, match="mesh"):
+        m4.load_state_dict(saved)
+
+    m_cap = ShardedAUROC(capacity_per_device=8)
+    with pytest.raises(ValueError, match="capacity"):
+        m_cap.load_state_dict(saved)
+
+
+def test_collection_astype():
+    from metrics_tpu import Accuracy, BinnedAUROC, MetricCollection
+
+    col = MetricCollection([Accuracy(), BinnedAUROC(num_bins=16)])
+    col.bfloat16()
+    binned = col["BinnedAUROC"]
+    for key in binned._defaults:
+        val = getattr(binned, key)
+        if jnp.issubdtype(val.dtype, jnp.floating):
+            assert val.dtype == jnp.bfloat16
+
+
+def test_degenerate_single_class_is_nan():
+    m = ShardedAUROC(capacity_per_device=8)
+    m.update(jnp.asarray(np.linspace(0, 1, 16, dtype=np.float32)), jnp.zeros(16, jnp.int32))
+    assert np.isnan(float(m.compute()))
