@@ -26,6 +26,9 @@ def _bench_jax() -> float:
     import jax.numpy as jnp
 
     from metrics_tpu.ops.auroc_kernel import binary_auroc
+    from metrics_tpu.utilities.jit import enable_persistent_cache
+
+    enable_persistent_cache()
 
     rng = np.random.RandomState(0)
     preds = jnp.asarray(rng.rand(N).astype(np.float32))
@@ -109,6 +112,52 @@ def _bench_reference() -> float:
         sys.path.remove("/root/reference")
 
 
+def _bench_sync_cpu() -> float:
+    """Distributed sync+compute leg: 8-virtual-device CPU mesh, so the step
+    contains a real XLA collective (all_gather of the sharded AUROC state).
+
+    Reported separately from the TPU number — the TPU bench host has one
+    chip, so its timing is update+compute only. This leg makes
+    "metric-sync wall-clock" contain a sync. Runs in a subprocess because
+    the virtual device count must be set before jax initializes.
+    """
+    import os
+
+    from metrics_tpu.utilities.virtual_mesh import run_in_virtual_mesh
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = f"""
+import time
+import numpy as np, jax.numpy as jnp
+from metrics_tpu import ShardedAUROC
+
+N = {N}
+rng = np.random.RandomState(0)
+preds = rng.rand(N).astype(np.float32)
+target = rng.randint(2, size=N).astype(np.int32)
+
+m = ShardedAUROC(capacity_per_device=N // 8)
+m.update(jnp.asarray(preds), jnp.asarray(target))
+float(m.compute())  # warm compile
+times = []
+for _ in range(3):
+    m._computed = None
+    t0 = time.perf_counter()
+    v = float(m.compute())
+    times.append(time.perf_counter() - t0)
+from sklearn.metrics import roc_auc_score
+assert abs(v - roc_auc_score(target, preds)) < 1e-6, v
+print("SYNC_MS", min(times) * 1e3)
+"""
+    proc = run_in_virtual_mesh(code, 8, cwd=repo)
+    if proc.returncode != 0:
+        raise RuntimeError(f"sync leg failed: {proc.stderr[-1000:]}")
+    for line in proc.stdout.splitlines():
+        if line.startswith("SYNC_MS"):
+            return float(line.split()[1])
+    raise RuntimeError("sync leg produced no timing")
+
+
 def main() -> None:
     jax_time, jax_acc, jax_auroc = _bench_jax()
     try:
@@ -117,6 +166,12 @@ def main() -> None:
         # a broken comparison harness must not masquerade as parity
         print(f"WARNING: reference benchmark failed ({err!r}); vs_baseline is null", file=sys.stderr)
         ref_time = None
+
+    try:
+        sync_ms = round(_bench_sync_cpu(), 3)
+    except Exception as err:
+        print(f"WARNING: 8-device sync leg failed ({err!r})", file=sys.stderr)
+        sync_ms = None
 
     value_ms = jax_time * 1e3
     vs_baseline = round(ref_time / jax_time, 3) if ref_time else None
@@ -128,10 +183,14 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "metric-sync wall-clock/step (Accuracy+AUROC, 1M preds)",
+                "metric": "metric update+compute wall-clock/step (Accuracy+AUROC, 1M preds, single chip)",
                 "value": round(value_ms, 3),
                 "unit": "ms",
                 "vs_baseline": vs_baseline,
+                # honest labeling: the single-chip number contains no
+                # collective; this leg (8-virtual-device CPU mesh, sharded
+                # state + all_gather) does, and is reported separately
+                "sync_8dev_cpu_ms": sync_ms,
             }
         )
     )

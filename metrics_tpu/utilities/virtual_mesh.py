@@ -1,0 +1,60 @@
+"""Run Python code in a fresh process with an N-device virtual CPU mesh.
+
+One shared implementation of the environment bootstrap that the driver
+dryrun (``__graft_entry__``), the bench sync leg (``bench.py``), and the
+test suite (``tests/conftest.py``) all depend on. Two environment facts make
+it non-obvious and worth centralizing:
+
+* ``--xla_force_host_platform_device_count`` must be in ``XLA_FLAGS``
+  *before* the child imports jax;
+* this machine's site hook pins a remote TPU backend via ``jax.config`` at
+  interpreter start, overriding the ``JAX_PLATFORMS`` env var — so the child
+  must also call ``jax.config.update("jax_platforms", "cpu")`` before any
+  device use (the generated preamble does).
+"""
+import os
+import subprocess
+import sys
+from typing import Optional
+
+
+def virtual_cpu_env(n_devices: int, base: Optional[dict] = None) -> dict:
+    """Env dict forcing an ``n_devices`` virtual CPU platform in a child."""
+    env = dict(os.environ if base is None else base)
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def run_in_virtual_mesh(
+    code: str,
+    n_devices: int,
+    cwd: Optional[str] = None,
+    timeout: float = 600,
+    extra_env: Optional[dict] = None,
+) -> "subprocess.CompletedProcess":
+    """Execute ``code`` in a subprocess seeing ``n_devices`` virtual CPU
+    devices, with the repo root on ``sys.path``. Returns the completed
+    process (caller checks ``returncode``/``stdout``)."""
+    repo = cwd or os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env = virtual_cpu_env(n_devices)
+    if extra_env:
+        env.update(extra_env)
+    preamble = (
+        "import jax; jax.config.update('jax_platforms', 'cpu'); "
+        f"import sys; sys.path.insert(0, {repo!r})\n"
+    )
+    return subprocess.run(
+        [sys.executable, "-c", preamble + code],
+        env=env,
+        cwd=repo,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
