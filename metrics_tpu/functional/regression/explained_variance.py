@@ -11,12 +11,14 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import promote_accumulator
 
 
 def _explained_variance_update(
     preds: jax.Array, target: jax.Array
 ) -> Tuple[int, jax.Array, jax.Array, jax.Array, jax.Array]:
     _check_same_shape(preds, target)
+    preds, target = promote_accumulator(preds, target)
 
     n_obs = preds.shape[0]
     diff = target - preds

@@ -5,10 +5,12 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import promote_accumulator
 
 
 def _mean_absolute_error_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, int]:
     _check_same_shape(preds, target)
+    preds, target = promote_accumulator(preds, target)
     sum_abs_error = jnp.sum(jnp.abs(preds - target))
     n_obs = target.size
     return sum_abs_error, n_obs

@@ -11,10 +11,12 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import promote_accumulator
 
 
 def _mean_relative_error_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, int]:
     _check_same_shape(preds, target)
+    preds, target = promote_accumulator(preds, target)
     target_nz = jnp.where(target == 0, jnp.ones_like(target), target)
     sum_rltv_error = jnp.sum(jnp.abs((preds - target) / target_nz))
     n_obs = target.size

@@ -5,10 +5,12 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import promote_accumulator
 
 
 def _mean_squared_error_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, int]:
     _check_same_shape(preds, target)
+    preds, target = promote_accumulator(preds, target)
     diff = preds - target
     sum_squared_error = jnp.sum(diff * diff)
     n_obs = target.size

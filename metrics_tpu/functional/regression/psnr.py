@@ -4,6 +4,8 @@ from typing import Optional, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.utilities.data import promote_accumulator
+
 from metrics_tpu.utilities.distributed import reduce
 from metrics_tpu.utilities.prints import rank_zero_warn
 
@@ -25,6 +27,7 @@ def _psnr_update(
     target: jax.Array,
     dim: Optional[Union[int, Tuple[int, ...]]] = None,
 ) -> Tuple[jax.Array, jax.Array]:
+    preds, target = promote_accumulator(preds, target)
     if dim is None:
         sum_squared_error = jnp.sum((preds - target) ** 2)
         n_obs = jnp.asarray(target.size)

@@ -5,11 +5,13 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.checks import _check_same_shape
+from metrics_tpu.utilities.data import promote_accumulator
 from metrics_tpu.utilities.prints import rank_zero_warn
 
 
 def _r2score_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array, int]:
     _check_same_shape(preds, target)
+    preds, target = promote_accumulator(preds, target)
     if preds.ndim > 2:
         raise ValueError(
             "Expected both prediction and target to be 1D or 2D tensors,"

@@ -53,18 +53,31 @@ class _Probe(NamedTuple):
     prob_sum_ok: bool
 
 
-@partial(jax.jit, static_argnames=("p_shape", "t_shape", "check_prob_sum"))
-def _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum):
+@partial(jax.jit, static_argnames=("p_shape", "t_shape", "check_prob_sum", "sum_atol"))
+def _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum, sum_atol=1e-5):
     preds = preds.reshape(p_shape).astype(jnp.float32)
     target = target.reshape(t_shape)
     pmin, pmax = jnp.min(preds), jnp.max(preds)
     tmin, tmax = jnp.min(target), jnp.max(target)
     if check_prob_sum:
         s = jnp.sum(preds, axis=1)
-        prob_ok = jnp.all(jnp.isclose(s, jnp.ones_like(s)))
+        prob_ok = jnp.all(jnp.isclose(s, jnp.ones_like(s), atol=sum_atol))
     else:
         prob_ok = jnp.asarray(True)
     return pmin, pmax, tmin, tmax, prob_ok
+
+
+def _prob_sum_atol(preds: jax.Array, p_shape: Tuple[int, ...], check_prob_sum: bool) -> float:
+    """Tolerance for the probabilities-sum-to-1 check.
+
+    Half-precision probabilities were rounded on input: their sum is
+    legitimately 1 ± C·eps(dtype) (bf16 eps ≈ 7.8e-3). fp32 keeps the strict
+    default.
+    """
+    if not check_prob_sum:
+        return 1e-5
+    n_classes_dim = p_shape[1] if len(p_shape) > 1 else 1
+    return max(1e-5, n_classes_dim * float(jnp.finfo(preds.dtype).eps))
 
 
 def _check_same_shape(pred: jax.Array, target: jax.Array) -> None:
@@ -269,7 +282,10 @@ def _check_classification_inputs(
         check_prob_sum = (
             case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and preds_float
         )
-        raw = _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum)
+        raw = _value_probe_jit(
+            preds, target, p_shape, t_shape, check_prob_sum,
+            _prob_sum_atol(preds, p_shape, check_prob_sum),
+        )
         probe = _Probe(float(raw[0]), float(raw[1]), int(raw[2]), int(raw[3]), bool(raw[4]))
 
     if probe is not None:
@@ -384,7 +400,10 @@ def _input_format_classification(
         except ValueError:
             check_prob_sum = False
         if not _is_floating(target):
-            raw = _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum)
+            raw = _value_probe_jit(
+                preds, target, p_shape, t_shape, check_prob_sum,
+                _prob_sum_atol(preds, p_shape, check_prob_sum),
+            )
             probe = _Probe(float(raw[0]), float(raw[1]), int(raw[2]), int(raw[3]), bool(raw[4]))
 
     case = _check_classification_inputs(

@@ -25,6 +25,25 @@ from metrics_tpu.utilities.prints import rank_zero_warn
 METRIC_EPS = 1e-6
 
 
+def promote_accumulator(*arrays):
+    """Promote low-precision floating inputs to at least float32.
+
+    TPU mixed-precision discipline: inputs may arrive bf16 (MXU-friendly),
+    but sufficient statistics — sums of squares, products, log-space errors —
+    must accumulate at fp32 or cancellation destroys the result (bf16 keeps
+    ~3 significant decimal digits). Matches the reference's fp16→fp32
+    promotion on input canonicalization (``utilities/checks.py:400-403``),
+    extended to the regression moment updates.
+    """
+    out = tuple(
+        a.astype(jnp.promote_types(a.dtype, jnp.float32))
+        if jnp.issubdtype(a.dtype, jnp.floating)
+        else a
+        for a in arrays
+    )
+    return out[0] if len(out) == 1 else out
+
+
 def dim_zero_cat(x):
     """Concatenate a list of arrays along dim 0 (identity-ish for a lone array)."""
     x = x if isinstance(x, (list, tuple)) else [x]

@@ -1,0 +1,110 @@
+"""bfloat16 *value* checks across the classification pack.
+
+Each metric is evaluated on the same batch at fp32 (the oracle) and bf16,
+through both the module and functional paths, via
+``MetricTester.run_precision_test_cpu`` → ``_assert_half_support``
+(``tests/helpers/testers.py``). Strengthens the reference's existence-only
+half checks (``/root/reference/tests/helpers/testers.py:206-227``) to value
+assertions, per-metric tolerance.
+
+Tolerances: thresholded metrics legitimately differ when bf16 input rounding
+flips samples across ``threshold`` (bf16 eps near 0.5 is ~2e-3, so a few of
+the 32-sample batch can flip) — their tolerance admits a couple of flips
+while still catching real computation breakage. Rank-based metrics only
+reshuffle exact near-ties; moment/margin metrics must hit fp32 values within
+bf16 rounding (the update paths promote accumulators to fp32).
+"""
+from functools import partial
+
+import pytest
+
+from metrics_tpu import (
+    AUROC,
+    F1,
+    Accuracy,
+    AveragePrecision,
+    CohenKappa,
+    ConfusionMatrix,
+    FBeta,
+    HammingDistance,
+    Hinge,
+    IoU,
+    MatthewsCorrcoef,
+    Precision,
+    Recall,
+    StatScores,
+)
+from metrics_tpu.functional import (
+    accuracy,
+    auroc,
+    average_precision,
+    cohen_kappa,
+    confusion_matrix,
+    f1,
+    fbeta,
+    hamming_distance,
+    hinge,
+    iou,
+    matthews_corrcoef,
+    precision,
+    recall,
+    stat_scores,
+)
+from tests.classification.inputs import _input_binary_prob, _input_multiclass_prob
+from tests.helpers import seed_all
+from tests.helpers.testers import NUM_CLASSES, THRESHOLD, MetricTester
+
+seed_all(42)
+
+# a few samples of the 32 may flip across the 0.5 threshold under bf16
+# rounding; 3/32 ≈ 0.094
+_FLIP_ATOL = 0.1
+# rank-only metrics: bf16 rounding can merge near-ties, shifting the curve a little
+_RANK_ATOL = 0.02
+
+_BIN = (_input_binary_prob.preds, _input_binary_prob.target)
+_MC = (_input_multiclass_prob.preds, _input_multiclass_prob.target)
+
+CASES = [
+    ("accuracy-binary", Accuracy, accuracy, {"threshold": THRESHOLD}, _BIN, _FLIP_ATOL),
+    ("accuracy-multiclass", Accuracy, accuracy, {}, _MC, _FLIP_ATOL),
+    ("stat_scores-binary", StatScores, stat_scores, {"threshold": THRESHOLD}, _BIN, 3.0),
+    ("precision-binary", Precision, precision, {"threshold": THRESHOLD}, _BIN, _FLIP_ATOL),
+    ("precision-multiclass", Precision, precision,
+     {"num_classes": NUM_CLASSES, "average": "macro"}, _MC, _FLIP_ATOL),
+    ("recall-binary", Recall, recall, {"threshold": THRESHOLD}, _BIN, _FLIP_ATOL),
+    ("fbeta-binary", FBeta, fbeta, {"threshold": THRESHOLD, "beta": 2.0}, _BIN, _FLIP_ATOL),
+    ("f1-multiclass", F1, f1, {"num_classes": NUM_CLASSES, "average": "macro"}, _MC, _FLIP_ATOL),
+    ("hamming-binary", HammingDistance, hamming_distance, {"threshold": THRESHOLD}, _BIN, _FLIP_ATOL),
+    # counts: tolerance in absolute matrix entries (a flip moves one count)
+    ("confusion_matrix-multiclass", ConfusionMatrix, confusion_matrix,
+     {"num_classes": NUM_CLASSES}, _MC, 3.0),
+    ("cohen_kappa-multiclass", CohenKappa, cohen_kappa, {"num_classes": NUM_CLASSES}, _MC, _FLIP_ATOL),
+    ("matthews-multiclass", MatthewsCorrcoef, matthews_corrcoef,
+     {"num_classes": NUM_CLASSES}, _MC, _FLIP_ATOL),
+    ("iou-multiclass", IoU, iou, {"num_classes": NUM_CLASSES}, _MC, _FLIP_ATOL),
+    # margin loss: pure fp math, must match within bf16 rounding (rtol 2e-2)
+    ("hinge-multiclass", Hinge, hinge, {}, _MC, 1e-2),
+    # ranking metrics: exact math on scores, small tie-merge drift only
+    ("auroc-binary", AUROC, auroc, {"pos_label": 1}, _BIN, _RANK_ATOL),
+    ("average_precision-binary", AveragePrecision, average_precision,
+     {"pos_label": 1}, _BIN, _RANK_ATOL),
+]
+
+
+class TestHalfPrecisionValues(MetricTester):
+
+    @pytest.mark.parametrize(
+        "metric_class, metric_functional, metric_args, inputs, atol",
+        [pytest.param(*case[1:], id=case[0]) for case in CASES],
+    )
+    def test_half_matches_fp32(self, metric_class, metric_functional, metric_args, inputs, atol):
+        preds, target = inputs
+        self.run_precision_test_cpu(
+            preds,
+            target,
+            metric_class,
+            metric_functional,
+            metric_args=metric_args,
+            atol_half=atol,
+        )
