@@ -89,6 +89,82 @@ def test_metric_collection_eval_epoch():
     assert np.allclose(float(result["Accuracy"]), expected)
 
 
+def test_flax_optax_distributed_training_with_metrics():
+    """Full framework integration (the analog of the reference's Lightning
+    integration, ``integrations/test_metric_lightning.py:48-80``): a flax
+    model trained by optax with data-parallel batch sharding over an
+    8-device mesh, metrics riding the same sharded arrays — Accuracy via
+    MetricCollection, exact AUROC via mesh-sharded bounded state."""
+    import flax.linen as flnn
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from sklearn.metrics import accuracy_score, roc_auc_score
+
+    from metrics_tpu import MetricCollection, ShardedAUROC
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    shard = NamedSharding(mesh, P("dp"))
+    repl = NamedSharding(mesh, P())
+
+    class MLP(flnn.Module):
+        @flnn.compact
+        def __call__(self, x):
+            h = flnn.relu(flnn.Dense(16)(x))
+            return flnn.Dense(1)(h)[..., 0]
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(8)
+    X = rng.randn(512, 8).astype(np.float32)
+    y = (X @ w_true + 0.5 * rng.randn(512) > 0).astype(np.int32)
+
+    model = MLP()
+    params = jax.device_put(model.init(jax.random.PRNGKey(0), jnp.asarray(X[:2])), repl)
+    opt = optax.adam(1e-2)
+    opt_state = jax.device_put(opt.init(params), repl)
+
+    @jax.jit
+    def train_step(params, opt_state, x, yb):
+        # batch is dp-sharded, params replicated: XLA inserts the grad
+        # all-reduce (the role of DDP in the reference's Lightning loop)
+        def loss_fn(p):
+            logits = model.apply(p, x)
+            return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, yb))
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    @jax.jit
+    def predict(params, x):
+        return jax.nn.sigmoid(model.apply(params, x))
+
+    n_batches, bs = 8, 64
+    losses = []
+    for _epoch in range(3):
+        for i in range(n_batches):
+            xb = jax.device_put(jnp.asarray(X[i * bs:(i + 1) * bs]), shard)
+            yb = jax.device_put(jnp.asarray(y[i * bs:(i + 1) * bs], dtype=jnp.float32), shard)
+            params, opt_state, loss = train_step(params, opt_state, xb, yb)
+            losses.append(float(loss))
+    assert losses[-1] < losses[0], "training did not reduce the loss"
+
+    # eval epoch: metrics consume the sharded model outputs directly
+    metrics = MetricCollection([Accuracy()])
+    auroc = ShardedAUROC(capacity_per_device=128, mesh=mesh, axis_name="dp")
+    probs_all = []
+    for i in range(n_batches):
+        xb = jax.device_put(jnp.asarray(X[i * bs:(i + 1) * bs]), shard)
+        tb = jnp.asarray(y[i * bs:(i + 1) * bs])
+        probs = predict(params, xb)
+        metrics.update(probs, tb)
+        auroc.update(probs, tb)
+        probs_all.append(np.asarray(probs))
+    probs_all = np.concatenate(probs_all)
+
+    want_acc = accuracy_score(y, probs_all >= 0.5)
+    assert np.allclose(float(metrics.compute()["Accuracy"]), want_acc, atol=1e-6)
+    assert np.allclose(float(auroc.compute()), roc_auc_score(y, probs_all), atol=1e-6)
+
+
 def test_distributed_eval_epoch():
     """SPMD eval epoch: per-device updates + in-program psum sync equal the
     single-device result (8 virtual devices)."""

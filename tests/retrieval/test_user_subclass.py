@@ -1,0 +1,96 @@
+"""The reference-style user extension point: subclass + ``_metric`` only.
+
+A user subclass implementing just the per-query ``_metric`` (the reference
+contract, ``torchmetrics/retrieval/retrieval_metric.py:139-147``) must match
+the vectorized built-ins — this exercises the ``_score_groups`` host-loop
+fallback and its rank-order ``fake_preds`` reconstruction
+(``metrics_tpu/retrieval/retrieval_metric.py:112-127``).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu.retrieval import RetrievalMAP, RetrievalMRR, RetrievalPrecision
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from tests.helpers import seed_all
+
+seed_all(1337)
+
+
+class UserMAP(RetrievalMetric):
+    """Average precision from scratch, per query, reference-style."""
+
+    def _metric(self, preds: jax.Array, target: jax.Array) -> jax.Array:
+        order = jnp.argsort(-preds, stable=True)
+        rel = target[order].astype(jnp.float32)
+        positions = jnp.cumsum(rel)
+        ranks = jnp.arange(1, rel.shape[0] + 1, dtype=jnp.float32)
+        ap = jnp.sum(jnp.where(rel == 1, positions / ranks, 0.0)) / jnp.maximum(jnp.sum(rel), 1.0)
+        return ap
+
+
+class UserMRR(RetrievalMetric):
+    def _metric(self, preds: jax.Array, target: jax.Array) -> jax.Array:
+        order = jnp.argsort(-preds, stable=True)
+        rel = target[order]
+        first = jnp.argmax(rel)
+        return jnp.where(jnp.any(rel == 1), 1.0 / (first + 1.0), 0.0)
+
+
+class UserPrecisionAt2(RetrievalMetric):
+    def _metric(self, preds: jax.Array, target: jax.Array) -> jax.Array:
+        order = jnp.argsort(-preds, stable=True)
+        k = min(2, preds.shape[0])
+        return jnp.sum(target[order][:k]) / k
+
+
+def _random_batches(n_batches=4, n=64, n_queries=9, seed=0):
+    rng = np.random.RandomState(seed)
+    for _ in range(n_batches):
+        yield (
+            jnp.asarray(rng.randint(n_queries, size=n).astype(np.int64)),
+            jnp.asarray(rng.rand(n).astype(np.float32)),
+            jnp.asarray(rng.randint(2, size=n).astype(np.int64)),
+        )
+
+
+@pytest.mark.parametrize(
+    "user_cls, builtin_cls, builtin_kwargs",
+    [
+        (UserMAP, RetrievalMAP, {}),
+        (UserMRR, RetrievalMRR, {}),
+        (UserPrecisionAt2, RetrievalPrecision, {"k": 2}),
+    ],
+)
+@pytest.mark.parametrize("empty_target_action", ["skip", "pos", "neg"])
+def test_user_subclass_matches_builtin(user_cls, builtin_cls, builtin_kwargs, empty_target_action):
+    user = user_cls(empty_target_action=empty_target_action)
+    builtin = builtin_cls(empty_target_action=empty_target_action, **builtin_kwargs)
+    for idx, preds, target in _random_batches():
+        user.update(idx, preds, target)
+        builtin.update(idx, preds, target)
+    assert np.allclose(float(user.compute()), float(builtin.compute()), atol=1e-6)
+
+
+def test_user_subclass_with_ties_matches_builtin():
+    """fake_preds must preserve the stable tie order the ranking used."""
+    user, builtin = UserMAP(), RetrievalMAP()
+    rng = np.random.RandomState(3)
+    n = 128
+    idx = jnp.asarray(rng.randint(5, size=n).astype(np.int64))
+    preds = jnp.asarray((np.round(rng.rand(n) * 5) / 5).astype(np.float32))  # heavy ties
+    target = jnp.asarray(rng.randint(2, size=n).astype(np.int64))
+    user.update(idx, preds, target)
+    builtin.update(idx, preds, target)
+    assert np.allclose(float(user.compute()), float(builtin.compute()), atol=1e-6)
+
+
+def test_unimplemented_metric_raises():
+    class Incomplete(RetrievalMetric):
+        pass
+
+    m = Incomplete()
+    m.update(jnp.asarray([0, 0, 1, 1]), jnp.asarray([0.3, 0.2, 0.6, 0.1]), jnp.asarray([1, 0, 1, 1]))
+    with pytest.raises(NotImplementedError):
+        m.compute()
