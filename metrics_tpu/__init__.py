@@ -58,4 +58,9 @@ from metrics_tpu.retrieval import (  # noqa: F401, E402
     RetrievalMRR,
     RetrievalPrecision,
     RetrievalRecall,
+    ShardedRetrievalMAP,
+    ShardedRetrievalMetric,
+    ShardedRetrievalMRR,
+    ShardedRetrievalPrecision,
+    ShardedRetrievalRecall,
 )

@@ -23,78 +23,48 @@ data visibly — never silently corrupt the "exact" result.
 Multi-host: pass a mesh built over ``jax.devices()`` after
 ``jax.distributed.initialize`` — the same code path then rides DCN.
 """
-import functools
+
 from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.ops.auroc_kernel import masked_binary_auroc, masked_binary_average_precision
-from metrics_tpu.parallel.collective import masked_cat_sync
+from metrics_tpu.parallel.sharded_metric import (  # noqa: F401  (re-exported for tests/users)
+    ShardedStreamsMixin,
+    _default_mesh,
+    _programs,
+)
 
 
-def _default_mesh(axis_name: str) -> Mesh:
-    return Mesh(np.array(jax.devices()), (axis_name,))
+def _average_ovr(per_class: jax.Array, onehot: jax.Array, mask: jax.Array, average: Optional[str]) -> jax.Array:
+    """NONE/MACRO/WEIGHTED averaging of per-class one-vs-rest scores
+    (support counted over mask-valid entries).
 
-
-@functools.lru_cache(maxsize=None)
-def _programs(mesh: Mesh, axis: str):
-    """Jitted (update, gather) SPMD programs for one (mesh, axis).
-
-    Module-level and cached so every metric instance on the same mesh shares
-    one compilation, and instances stay picklable/deepcopyable (no jitted
-    closures in ``__dict__``).
+    Averaged modes fail LOUDLY when a class never occurred in the stream
+    (its OvR score is NaN and would silently poison the mean); the
+    per-class mode returns NaN for absent classes, documented.
     """
-
-    def _local_update(buf_p, buf_t, count, preds, target):
-        # per-device: append the local batch shard to the local buffer shard;
-        # out-of-bounds writes drop (the host raises on overflow before this
-        # can matter)
-        idx = count[0] + jnp.arange(preds.shape[0])
-        buf_p = buf_p.at[idx].set(preds, mode="drop")
-        buf_t = buf_t.at[idx].set(target, mode="drop")
-        return buf_p, buf_t, count + preds.shape[0]
-
-    jit_update = jax.jit(
-        jax.shard_map(
-            _local_update,
-            mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis)),
-            out_specs=(P(axis), P(axis), P(axis)),
+    if average in (None, "none"):
+        return per_class
+    support = jnp.sum(onehot * mask[:, None], axis=0)
+    absent = np.asarray(support) == 0
+    if absent.any():
+        raise ValueError(
+            f"classes {np.nonzero(absent)[0].tolist()} never occurred in the"
+            f" accumulated targets; their one-vs-rest score is undefined, so"
+            f" average={average!r} cannot be computed (use average=None for"
+            " per-class scores with NaN holes)"
         )
-    )
-
-    def _gather(buf_p, buf_t, count):
-        # one buffer collective, not one per state: bitcast the 32-bit target
-        # buffer to f32 and stack with preds, so preds+target ride a single
-        # tiled all_gather (plus one scalar counts gather inside
-        # masked_cat_sync)
-        if buf_t.dtype.itemsize == 4:
-            t_as_f32 = jax.lax.bitcast_convert_type(buf_t, jnp.float32)
-            stacked = jnp.stack([buf_p, t_as_f32], axis=1)  # (capacity, 2)
-            gathered, _, mask = masked_cat_sync(stacked, count[0], axis)
-            gathered_t = jax.lax.bitcast_convert_type(gathered[:, 1], buf_t.dtype)
-            return gathered[:, 0], gathered_t, mask
-        gathered_p, _, mask = masked_cat_sync(buf_p, count[0], axis)
-        gathered_t, _, _ = masked_cat_sync(buf_t, count[0], axis)
-        return gathered_p, gathered_t, mask
-
-    jit_gather = jax.jit(
-        jax.shard_map(
-            _gather,
-            mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis)),
-            out_specs=(P(), P(), P()),
-            check_vma=False,
-        )
-    )
-    return jit_update, jit_gather
+    if average == "macro":
+        return jnp.mean(per_class)
+    return jnp.sum(per_class * support / jnp.maximum(support.sum(), 1))
 
 
-class ShardedCurveMetric(Metric):
+class ShardedCurveMetric(ShardedStreamsMixin, Metric):
     """Base: fixed-capacity mesh-sharded (preds, target) stream state.
 
     Args:
@@ -104,6 +74,8 @@ class ShardedCurveMetric(Metric):
             devices).
         axis_name: mesh axis the state and batches are sharded over.
         target_dtype: dtype of the stored targets.
+        preds_suffix: trailing shape of one prediction — ``()`` for binary
+            scores, ``(C,)`` for per-class score rows.
     """
 
     def __init__(
@@ -113,140 +85,41 @@ class ShardedCurveMetric(Metric):
         axis_name: str = "data",
         compute_on_step: bool = True,
         target_dtype=jnp.int32,
+        preds_suffix: Tuple[int, ...] = (),
         **kwargs: Any,
     ):
         super().__init__(compute_on_step=compute_on_step, **kwargs)
-        if capacity_per_device < 1:
-            raise ValueError(f"`capacity_per_device` must be positive, got {capacity_per_device}")
-        self.mesh = mesh if mesh is not None else _default_mesh(axis_name)
-        if axis_name not in self.mesh.axis_names:
-            raise ValueError(f"axis {axis_name!r} not in mesh axes {self.mesh.axis_names}")
-        self.axis_name = axis_name
-        self.capacity_per_device = capacity_per_device
-        self.world = self.mesh.shape[axis_name]
-        self.capacity = capacity_per_device * self.world
-        self._n_seen = 0
-
-        sharding = NamedSharding(self.mesh, P(axis_name))
-        zeros_p = jax.device_put(jnp.zeros((self.capacity,), jnp.float32), sharding)
-        zeros_t = jax.device_put(jnp.zeros((self.capacity,), target_dtype), sharding)
-        counts = jax.device_put(jnp.zeros((self.world,), jnp.int32), sharding)
-        self.add_state("buf_preds", default=zeros_p, dist_reduce_fx=None)
-        self.add_state("buf_target", default=zeros_t, dist_reduce_fx=None)
-        self.add_state("counts", default=counts, dist_reduce_fx=None)
+        self.preds_suffix = tuple(preds_suffix)
+        self._init_streams(
+            {"buf_preds": (jnp.float32, self.preds_suffix), "buf_target": (target_dtype, ())},
+            capacity_per_device,
+            mesh,
+            axis_name,
+        )
 
     def _sync_dist(self, dist_sync_fn=None) -> None:
         # sync happens inside compute() as an in-program XLA collective
         pass
 
     def update(self, preds: jax.Array, target: jax.Array) -> None:
-        """Append a batch. ``preds``/``target`` are 1-d, length divisible by
-        the mesh-axis size (the usual SPMD batch contract)."""
+        """Append a batch of ``(n, *preds_suffix)`` scores / ``(n,)`` targets,
+        ``n`` divisible by the mesh-axis size (the usual SPMD batch
+        contract)."""
         preds = jnp.asarray(preds)
         target = jnp.asarray(target)
-        if preds.ndim != 1 or preds.shape != target.shape:
+        if target.ndim != 1 or preds.shape != (target.shape[0], *self.preds_suffix):
+            shape_desc = "(n" + "".join(f", {d}" for d in self.preds_suffix) + ")"
             raise ValueError(
-                f"expected matching 1-d preds/target, got {preds.shape} and {target.shape}"
+                f"expected preds of shape {shape_desc} and 1-d target,"
+                f" got {preds.shape} and {target.shape}"
             )
-        n = preds.shape[0]
-        if n % self.world != 0:
-            raise ValueError(
-                f"batch size {n} not divisible by mesh axis size {self.world};"
-                " pad the final batch or use a divisible eval batch"
-            )
-        if self._n_seen + n > self.capacity:
-            raise ValueError(
-                f"sharded curve state overflow: {self._n_seen} + {n} samples exceed"
-                f" capacity {self.capacity} ({self.capacity_per_device}/device ×"
-                f" {self.world} devices). Construct with a larger"
-                " `capacity_per_device` for this evaluation size."
-            )
-        sharding = NamedSharding(self.mesh, P(self.axis_name))
-        preds = jax.device_put(preds.astype(jnp.float32), sharding)
-        target = jax.device_put(target, sharding)
-        jit_update, _ = _programs(self.mesh, self.axis_name)
-        self.buf_preds, self.buf_target, self.counts = jit_update(
-            self.buf_preds, self.buf_target, self.counts, preds, target
-        )
-        self._n_seen += n
-
-    def reset(self) -> None:
-        super().reset()
-        self._n_seen = 0
-
-    def _snapshot_state(self):
-        # forward()'s snapshot/reset/restore cycle must carry the host-side
-        # fill level too, or the overflow guard would forget prior batches
-        cache = super()._snapshot_state()
-        cache["_n_seen"] = self._n_seen
-        return cache
-
-    def __getstate__(self) -> dict:
-        # Mesh holds Device handles, which never pickle; serialize its spec
-        # and the states as host arrays, and rebuild on the unpickling host's
-        # devices (device identity cannot cross processes anyway — same
-        # semantics as the reference metrics materializing on load).
-        state = dict(super().__getstate__())
-        state["mesh"] = None
-        state["_mesh_axes"] = tuple(self.mesh.axis_names)
-        state["_mesh_shape"] = tuple(self.mesh.devices.shape)
-        for key in ("buf_preds", "buf_target", "counts"):
-            state[key] = np.asarray(state[key])
-        state["_defaults"] = {k: np.asarray(v) for k, v in self._defaults.items()}
-        return state
-
-    def __setstate__(self, state: dict) -> None:
-        axes = state.pop("_mesh_axes")
-        shape = state.pop("_mesh_shape")
-        super().__setstate__(state)
-        n = int(np.prod(shape))
-        devs = jax.devices()
-        if len(devs) < n:
-            raise RuntimeError(
-                f"unpickling a sharded metric built over {n} devices on a host"
-                f" with only {len(devs)}"
-            )
-        self.mesh = Mesh(np.array(devs[:n]).reshape(shape), axes)
-        sharding = NamedSharding(self.mesh, P(self.axis_name))
-        for key in ("buf_preds", "buf_target", "counts"):
-            setattr(self, key, jax.device_put(jnp.asarray(getattr(self, key)), sharding))
-        self._defaults = {
-            k: jax.device_put(jnp.asarray(v), sharding) for k, v in self._defaults.items()
-        }
-
-    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
-        # a checkpoint from a different mesh size cannot be resharded blindly:
-        # counts are per-device and the mask logic depends on world/capacity
-        if prefix + "counts" in state_dict:
-            saved_world = np.asarray(state_dict[prefix + "counts"]).shape[0]
-            if saved_world != self.world:
-                raise ValueError(
-                    f"checkpoint was saved on a {saved_world}-device mesh axis but"
-                    f" this metric shards over {self.world} devices; rebuild the"
-                    " metric on a matching mesh (or re-accumulate)"
-                )
-        if prefix + "buf_preds" in state_dict:
-            saved_cap = np.asarray(state_dict[prefix + "buf_preds"]).shape[0]
-            if saved_cap != self.capacity:
-                raise ValueError(
-                    f"checkpoint capacity {saved_cap} != this metric's capacity"
-                    f" {self.capacity} ({self.capacity_per_device}/device)"
-                )
-        super().load_state_dict(state_dict, prefix)
-        # restore the mesh sharding (checkpoint restore yields single-device
-        # arrays) and the host-side fill level
-        sharding = NamedSharding(self.mesh, P(self.axis_name))
-        for key in ("buf_preds", "buf_target", "counts"):
-            if prefix + key in state_dict:
-                setattr(self, key, jax.device_put(getattr(self, key), sharding))
-        if prefix + "counts" in state_dict:
-            self._n_seen = int(np.asarray(self.counts).sum())
+        self._append_streams(preds.astype(jnp.float32), target)
 
     def _gathered(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
-        """One all-gather: full ``(capacity,)`` streams + validity mask,
+        """One all-gather: full ``(capacity, ...)`` streams + validity mask,
         replicated on every device."""
-        _, jit_gather = _programs(self.mesh, self.axis_name)
-        return jit_gather(self.buf_preds, self.buf_target, self.counts)
+        (preds, target), mask = self._gather_streams()
+        return preds, target, mask
 
     def _valid_host(self) -> Tuple[np.ndarray, np.ndarray]:
         """Materialize the valid samples on host, in device-rank order."""
@@ -255,14 +128,55 @@ class ShardedCurveMetric(Metric):
         return np.asarray(preds)[mask], np.asarray(target)[mask]
 
 
-class ShardedAUROC(ShardedCurveMetric):
-    """Exact binary AUROC with mesh-sharded bounded state.
+class _ShardedOVRMetric(ShardedCurveMetric):
+    """Shared init/compute for scalar one-vs-rest curve metrics: binary by
+    default, ``num_classes=C`` for ``(N, C)`` score rows with integer labels
+    run as one vmapped masked-kernel program, averaged by ``_average_ovr``.
+    Subclasses set ``_masked_kernel``."""
 
-    Drop-in replacement for :class:`~metrics_tpu.AUROC` on large binary
-    prediction streams: the same exact (sklearn ``roc_auc_score``) value, but
-    state is ``capacity_per_device`` floats per device instead of a
-    replicated copy of every prediction, and compute never leaves the device
-    (one ``all_gather`` + the co-sort kernel).
+    _masked_kernel = None
+
+    def __init__(
+        self,
+        capacity_per_device: int,
+        pos_label: int = 1,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "macro",
+        **kwargs: Any,
+    ):
+        allowed = (None, "none", "macro", "weighted")
+        if average not in allowed:
+            raise ValueError(f"Argument `average` expected to be one of {allowed}, got {average}")
+        suffix = () if num_classes in (None, 1) else (num_classes,)
+        super().__init__(capacity_per_device, preds_suffix=suffix, **kwargs)
+        self.pos_label = pos_label
+        self.num_classes = num_classes
+        self.average = average
+
+    def compute(self) -> jax.Array:
+        preds, target, mask = self._gathered()
+        if not self.preds_suffix:
+            return self._masked_kernel(preds, target, mask, self.pos_label)
+        # one-vs-rest: C batched co-sorts in a single XLA program (replaces
+        # the reference's per-class Python loop, functional/auroc.py:79-86)
+        num_classes = self.preds_suffix[0]
+        onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
+        per_class = jax.vmap(self._masked_kernel, in_axes=(1, 1, None))(preds, onehot, mask)
+        return _average_ovr(per_class, onehot, mask, self.average)
+
+
+class ShardedAUROC(_ShardedOVRMetric):
+    """Exact AUROC with mesh-sharded bounded state.
+
+    Drop-in replacement for :class:`~metrics_tpu.AUROC` on large prediction
+    streams: the same exact (sklearn ``roc_auc_score``) value, but state is
+    ``capacity_per_device`` rows per device instead of a replicated copy of
+    every prediction, and compute never leaves the device (one ``all_gather``
+    + the co-sort kernel; one-vs-rest classes run as one vmapped program).
+
+    Binary scores by default; pass ``num_classes=C`` for ``(N, C)`` score
+    rows with integer labels, averaged per ``average``
+    (``"macro"``/``"weighted"``/``None``).
 
     Example:
         >>> import jax.numpy as jnp
@@ -273,17 +187,14 @@ class ShardedAUROC(ShardedCurveMetric):
         0.8125
     """
 
-    def __init__(self, capacity_per_device: int, pos_label: int = 1, **kwargs: Any):
-        super().__init__(capacity_per_device, **kwargs)
-        self.pos_label = pos_label
-
-    def compute(self) -> jax.Array:
-        preds, target, mask = self._gathered()
-        return masked_binary_auroc(preds, target, mask, self.pos_label)
+    _masked_kernel = staticmethod(masked_binary_auroc)
 
 
-class ShardedAveragePrecision(ShardedCurveMetric):
-    """Exact binary average precision with mesh-sharded bounded state.
+class ShardedAveragePrecision(_ShardedOVRMetric):
+    """Exact average precision with mesh-sharded bounded state.
+
+    Binary by default; ``num_classes=C`` for one-vs-rest with averaging,
+    like :class:`ShardedAUROC`.
 
     Example:
         >>> import jax.numpy as jnp
@@ -294,13 +205,7 @@ class ShardedAveragePrecision(ShardedCurveMetric):
         0.8542
     """
 
-    def __init__(self, capacity_per_device: int, pos_label: int = 1, **kwargs: Any):
-        super().__init__(capacity_per_device, **kwargs)
-        self.pos_label = pos_label
-
-    def compute(self) -> jax.Array:
-        preds, target, mask = self._gathered()
-        return masked_binary_average_precision(preds, target, mask, self.pos_label)
+    _masked_kernel = staticmethod(masked_binary_average_precision)
 
 
 class ShardedROC(ShardedCurveMetric):

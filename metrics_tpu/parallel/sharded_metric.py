@@ -1,0 +1,236 @@
+"""Mesh-sharded fixed-capacity append streams as metric state.
+
+The shared machinery behind the bounded-state redesign of the reference's
+unbounded ``dist_reduce_fx=None`` list states (SURVEY §5.7): N parallel
+append-buffers laid out as ``NamedSharding`` over one mesh axis, a per-device
+fill count, loud host-side overflow, and a single-collective gather. Consumed
+by the curve metrics (:mod:`metrics_tpu.classification.sharded`, 2 streams)
+and the retrieval metrics (:mod:`metrics_tpu.retrieval.sharded`, 3 streams).
+
+``ShardedStreamsMixin`` is designed to precede :class:`metrics_tpu.Metric`
+(or a Metric subclass) in the MRO: it implements the pickling, checkpoint,
+reset and forward-snapshot hooks in terms of the stream states.
+"""
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from metrics_tpu.parallel.collective import masked_cat_sync
+
+
+def _default_mesh(axis_name: str) -> Mesh:
+    return Mesh(np.array(jax.devices()), (axis_name,))
+
+
+@functools.lru_cache(maxsize=None)
+def _programs(mesh: Mesh, axis: str, n_streams: int = 2):
+    """Jitted (update, gather) SPMD programs for ``n_streams`` parallel
+    append-buffers sharing one fill count on one (mesh, axis).
+
+    Module-level and cached so every metric instance on the same mesh shares
+    one compilation, and instances stay picklable/deepcopyable (no jitted
+    closures in ``__dict__``).
+    """
+
+    def _local_update(bufs, count, batches):
+        # per-device: append the local batch shards to the local buffer
+        # shards; out-of-bounds writes drop (the host raises on overflow
+        # before this can matter)
+        idx = count[0] + jnp.arange(batches[0].shape[0])
+        bufs = tuple(b.at[idx].set(x, mode="drop") for b, x in zip(bufs, batches))
+        return bufs, count + batches[0].shape[0]
+
+    spec_streams = (P(axis),) * n_streams
+    jit_update = jax.jit(
+        jax.shard_map(
+            _local_update,
+            mesh=mesh,
+            in_specs=(spec_streams, P(axis), spec_streams),
+            out_specs=(spec_streams, P(axis)),
+        )
+    )
+
+    def _gather(bufs, count):
+        # one buffer collective, not one per stream: bitcast 32-bit streams
+        # to f32 and stack, so all streams ride a single tiled all_gather
+        # (plus one scalar counts gather inside masked_cat_sync)
+        if all(b.ndim == 1 and b.dtype.itemsize == 4 for b in bufs):
+            as_f32 = [
+                b if b.dtype == jnp.float32 else jax.lax.bitcast_convert_type(b, jnp.float32)
+                for b in bufs
+            ]
+            stacked = jnp.stack(as_f32, axis=1)  # (capacity, n_streams)
+            gathered, _, mask = masked_cat_sync(stacked, count[0], axis)
+            outs = tuple(
+                gathered[:, i]
+                if b.dtype == jnp.float32
+                else jax.lax.bitcast_convert_type(gathered[:, i], b.dtype)
+                for i, b in enumerate(bufs)
+            )
+            return outs, mask
+        # multi-column streams (or exotic dtypes): one gather per stream
+        outs = []
+        for b in bufs:
+            g, _, mask = masked_cat_sync(b, count[0], axis)
+            outs.append(g)
+        return tuple(outs), mask
+
+    jit_gather = jax.jit(
+        jax.shard_map(
+            _gather,
+            mesh=mesh,
+            in_specs=(spec_streams, P(axis)),
+            out_specs=((P(),) * n_streams, P()),
+            check_vma=False,
+        )
+    )
+    return jit_update, jit_gather
+
+
+class ShardedStreamsMixin:
+    """State layout + lifecycle for metrics with sharded append-stream state.
+
+    Subclass must call :meth:`_init_streams` in ``__init__`` (after the
+    ``Metric`` base init), then use :meth:`_append_streams` in ``update`` and
+    :meth:`_gather_streams` in ``compute``.
+    """
+
+    def _init_streams(
+        self,
+        stream_specs: Dict[str, Tuple],
+        capacity_per_device: int,
+        mesh: Optional[Mesh],
+        axis_name: str,
+    ) -> None:
+        """``stream_specs``: ordered ``{state_name: (dtype, trailing_shape)}``."""
+        if capacity_per_device < 1:
+            raise ValueError(f"`capacity_per_device` must be positive, got {capacity_per_device}")
+        self.mesh = mesh if mesh is not None else _default_mesh(axis_name)
+        if axis_name not in self.mesh.axis_names:
+            raise ValueError(f"axis {axis_name!r} not in mesh axes {self.mesh.axis_names}")
+        self.axis_name = axis_name
+        self.capacity_per_device = capacity_per_device
+        self.world = self.mesh.shape[axis_name]
+        self.capacity = capacity_per_device * self.world
+        self._stream_names = tuple(stream_specs)
+        self._n_seen = 0
+
+        sharding = NamedSharding(self.mesh, P(axis_name))
+        for name, (dtype, suffix) in stream_specs.items():
+            zeros = jax.device_put(jnp.zeros((self.capacity, *suffix), dtype), sharding)
+            self.add_state(name, default=zeros, dist_reduce_fx=None)
+        counts = jax.device_put(jnp.zeros((self.world,), jnp.int32), sharding)
+        self.add_state("counts", default=counts, dist_reduce_fx=None)
+
+    def _append_streams(self, *arrays: jax.Array) -> None:
+        """Append one batch (first dim = n) to every stream, in spec order.
+
+        Raises loudly when the batch is not evenly shardable or would
+        overflow the fixed capacity."""
+        n = arrays[0].shape[0]
+        if n % self.world != 0:
+            raise ValueError(
+                f"batch size {n} not divisible by mesh axis size {self.world};"
+                " pad the final batch or use a divisible eval batch"
+            )
+        if self._n_seen + n > self.capacity:
+            raise ValueError(
+                f"sharded stream state overflow: {self._n_seen} + {n} samples exceed"
+                f" capacity {self.capacity} ({self.capacity_per_device}/device ×"
+                f" {self.world} devices). Construct with a larger"
+                " `capacity_per_device` for this evaluation size."
+            )
+        sharding = NamedSharding(self.mesh, P(self.axis_name))
+        batches = tuple(jax.device_put(a, sharding) for a in arrays)
+        jit_update, _ = _programs(self.mesh, self.axis_name, len(self._stream_names))
+        bufs = tuple(getattr(self, name) for name in self._stream_names)
+        new_bufs, self.counts = jit_update(bufs, self.counts, batches)
+        for name, buf in zip(self._stream_names, new_bufs):
+            setattr(self, name, buf)
+        self._n_seen += n
+
+    def _gather_streams(self) -> Tuple[Tuple[jax.Array, ...], jax.Array]:
+        """One all-gather: full ``(capacity, ...)`` streams + validity mask,
+        replicated on every device."""
+        _, jit_gather = _programs(self.mesh, self.axis_name, len(self._stream_names))
+        bufs = tuple(getattr(self, name) for name in self._stream_names)
+        return jit_gather(bufs, self.counts)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def reset(self) -> None:
+        super().reset()
+        self._n_seen = 0
+
+    def _snapshot_state(self):
+        # forward()'s snapshot/reset/restore cycle must carry the host-side
+        # fill level too, or the overflow guard would forget prior batches
+        cache = super()._snapshot_state()
+        cache["_n_seen"] = self._n_seen
+        return cache
+
+    def __getstate__(self) -> dict:
+        # Mesh holds Device handles, which never pickle; serialize its spec
+        # and the states as host arrays, and rebuild on the unpickling host's
+        # devices (device identity cannot cross processes anyway — same
+        # semantics as the reference metrics materializing on load).
+        state = dict(super().__getstate__())
+        state["mesh"] = None
+        state["_mesh_axes"] = tuple(self.mesh.axis_names)
+        state["_mesh_shape"] = tuple(self.mesh.devices.shape)
+        for key in (*self._stream_names, "counts"):
+            state[key] = np.asarray(state[key])
+        state["_defaults"] = {k: np.asarray(v) for k, v in self._defaults.items()}
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        axes = state.pop("_mesh_axes")
+        shape = state.pop("_mesh_shape")
+        super().__setstate__(state)
+        n = int(np.prod(shape))
+        devs = jax.devices()
+        if len(devs) < n:
+            raise RuntimeError(
+                f"unpickling a sharded metric built over {n} devices on a host"
+                f" with only {len(devs)}"
+            )
+        self.mesh = Mesh(np.array(devs[:n]).reshape(shape), axes)
+        sharding = NamedSharding(self.mesh, P(self.axis_name))
+        for key in (*self._stream_names, "counts"):
+            setattr(self, key, jax.device_put(jnp.asarray(getattr(self, key)), sharding))
+        self._defaults = {
+            k: jax.device_put(jnp.asarray(v), sharding) for k, v in self._defaults.items()
+        }
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        # a checkpoint from a different mesh size cannot be resharded blindly:
+        # counts are per-device and the mask logic depends on world/capacity
+        if prefix + "counts" in state_dict:
+            saved_world = np.asarray(state_dict[prefix + "counts"]).shape[0]
+            if saved_world != self.world:
+                raise ValueError(
+                    f"checkpoint was saved on a {saved_world}-device mesh axis but"
+                    f" this metric shards over {self.world} devices; rebuild the"
+                    " metric on a matching mesh (or re-accumulate)"
+                )
+        first = self._stream_names[0]
+        if prefix + first in state_dict:
+            saved_cap = np.asarray(state_dict[prefix + first]).shape[0]
+            if saved_cap != self.capacity:
+                raise ValueError(
+                    f"checkpoint capacity {saved_cap} != this metric's capacity"
+                    f" {self.capacity} ({self.capacity_per_device}/device)"
+                )
+        super().load_state_dict(state_dict, prefix)
+        # restore the mesh sharding (checkpoint restore yields single-device
+        # arrays) and the host-side fill level
+        sharding = NamedSharding(self.mesh, P(self.axis_name))
+        for key in (*self._stream_names, "counts"):
+            if prefix + key in state_dict:
+                setattr(self, key, jax.device_put(getattr(self, key), sharding))
+        if prefix + "counts" in state_dict:
+            self._n_seen = int(np.asarray(self.counts).sum())

@@ -3,3 +3,10 @@ from metrics_tpu.retrieval.mean_reciprocal_rank import RetrievalMRR  # noqa: F40
 from metrics_tpu.retrieval.precision import RetrievalPrecision  # noqa: F401
 from metrics_tpu.retrieval.recall import RetrievalRecall  # noqa: F401
 from metrics_tpu.retrieval.retrieval_metric import IGNORE_IDX, RetrievalMetric  # noqa: F401
+from metrics_tpu.retrieval.sharded import (  # noqa: F401
+    ShardedRetrievalMAP,
+    ShardedRetrievalMetric,
+    ShardedRetrievalMRR,
+    ShardedRetrievalPrecision,
+    ShardedRetrievalRecall,
+)

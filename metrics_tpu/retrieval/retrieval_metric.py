@@ -86,11 +86,25 @@ class RetrievalMetric(Metric, ABC):
         idx = jnp.concatenate(list(self.idx), axis=0)
         preds = jnp.concatenate(list(self.preds), axis=0)
         target = jnp.concatenate(list(self.target), axis=0)
+        return self._compute_from_arrays(idx, preds, target)
 
+    def _compute_from_arrays(
+        self,
+        idx: jax.Array,
+        preds: jax.Array,
+        target: jax.Array,
+        valid_mask: Optional[np.ndarray] = None,
+    ) -> jax.Array:
+        """Scoring core on concatenated epoch arrays (shared by the list-state
+        path above and the sharded bounded-state path,
+        :mod:`metrics_tpu.retrieval.sharded`, which folds its buffer-slot
+        validity into ``valid_mask`` so filtering happens once)."""
         # drop excluded predictions entirely (reference filters them inside
         # each `_metric` call; filtering up-front is equivalent and keeps the
         # segment math uniform)
         valid = np.asarray(target != self.exclude)
+        if valid_mask is not None:
+            valid = valid & valid_mask
         idx_np = np.asarray(idx)[valid]
         preds = preds[jnp.asarray(valid)]
         target = target[jnp.asarray(valid)]

@@ -1,0 +1,103 @@
+"""Retrieval metrics with mesh-sharded bounded accumulation (SURVEY §5.7).
+
+The reference's retrieval metrics accumulate every ``(index, pred, target)``
+triple in replicated lists (``torchmetrics/retrieval/retrieval_metric.py:92-94``)
+— the second unbounded-state family besides the curve metrics. Here the
+three streams live as fixed-capacity buffers sharded over one mesh axis
+(1/world per device, loud overflow), riding a single bitcast-stacked
+``all_gather`` at ``compute()``; scoring then reuses the vectorized
+sort+segment path of :class:`~metrics_tpu.retrieval.RetrievalMetric`
+(query-id densification is host-side by design there).
+"""
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from metrics_tpu.parallel.sharded_metric import ShardedStreamsMixin
+from metrics_tpu.retrieval.mean_average_precision import RetrievalMAP
+from metrics_tpu.retrieval.mean_reciprocal_rank import RetrievalMRR
+from metrics_tpu.retrieval.precision import RetrievalPrecision
+from metrics_tpu.retrieval.recall import RetrievalRecall
+from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from metrics_tpu.utilities.checks import _check_retrieval_inputs
+
+
+class ShardedRetrievalMetric(ShardedStreamsMixin, RetrievalMetric):
+    """Bounded, mesh-sharded accumulation for grouped-query metrics.
+
+    Same update/compute contract as :class:`RetrievalMetric`, but the
+    ``idx``/``preds``/``target`` streams are ``capacity_per_device`` entries
+    per device instead of replicated unbounded lists. Combine with a scoring
+    subclass (``ShardedRetrievalMAP`` etc.), or subclass and implement the
+    reference-style per-query ``_metric``.
+    """
+
+    def __init__(
+        self,
+        capacity_per_device: int,
+        mesh: Optional[Mesh] = None,
+        axis_name: str = "data",
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        # replace the unbounded list states registered by RetrievalMetric
+        # with the sharded bounded streams
+        for name in ("idx", "preds", "target"):
+            del self._defaults[name]
+            del self._persistent[name]
+            del self._reductions[name]
+            delattr(self, name)
+        self._init_streams(
+            {
+                "buf_idx": (jnp.int32, ()),
+                "buf_preds": (jnp.float32, ()),
+                "buf_target": (jnp.int32, ()),
+            },
+            capacity_per_device,
+            mesh,
+            axis_name,
+        )
+
+    def _sync_dist(self, dist_sync_fn=None) -> None:
+        # sync happens inside compute() as an in-program XLA collective
+        pass
+
+    def update(self, idx: jax.Array, preds: jax.Array, target: jax.Array) -> None:
+        """Check and append a batch of flattened (idx, preds, target)."""
+        idx, preds, target = _check_retrieval_inputs(idx, preds, target, ignore=self.exclude)
+        self._append_streams(idx.flatten(), preds.flatten(), target.flatten())
+
+    def compute(self) -> jax.Array:
+        (idx, preds, target), mask = self._gather_streams()
+        # buffer-slot validity folds into _compute_from_arrays' single
+        # host-side filter pass (query-id densification is host-side anyway)
+        return self._compute_from_arrays(idx, preds, target, valid_mask=np.asarray(mask))
+
+
+class ShardedRetrievalMAP(ShardedRetrievalMetric, RetrievalMAP):
+    """Mean average precision over queries, sharded bounded accumulation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> m = ShardedRetrievalMAP(capacity_per_device=2)
+        >>> m.update(jnp.array([0, 0, 0, 0, 1, 1, 1, 1]),
+        ...          jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.2, 0.5, 0.1]),
+        ...          jnp.array([False, False, True, False, False, True, False, True]))
+        >>> round(float(m.compute()), 4)
+        0.7083
+    """
+
+
+class ShardedRetrievalMRR(ShardedRetrievalMetric, RetrievalMRR):
+    """Mean reciprocal rank over queries, sharded bounded accumulation."""
+
+
+class ShardedRetrievalPrecision(ShardedRetrievalMetric, RetrievalPrecision):
+    """Precision@k over queries, sharded bounded accumulation."""
+
+
+class ShardedRetrievalRecall(ShardedRetrievalMetric, RetrievalRecall):
+    """Recall@k over queries, sharded bounded accumulation."""

@@ -102,7 +102,7 @@ def test_count_past_capacity_never_corrupts():
     p2 = jax.device_put(jnp.asarray(preds), sharding)
     t2 = jax.device_put(jnp.asarray(target), sharding)
     jit_update, _ = _programs(m.mesh, m.axis_name)
-    m.buf_preds, m.buf_target, m.counts = jit_update(m.buf_preds, m.buf_target, m.counts, p2, t2)
+    (m.buf_preds, m.buf_target), m.counts = jit_update((m.buf_preds, m.buf_target), m.counts, (p2, t2))
     m._computed = None
     # counts now read 8/device with capacity 4: the mask must clamp, and the
     # value must still be the exact AUROC of the first (kept) stream
@@ -229,6 +229,66 @@ def test_load_state_dict_invalidates_compute_cache():
     fresh = float(other.compute())
     assert np.allclose(fresh, roc_auc_score(target, preds), atol=1e-6)
     assert fresh != stale
+
+
+def test_sharded_auroc_multiclass_matches_sklearn():
+    rng = np.random.RandomState(31)
+    logits = rng.rand(512, 5).astype(np.float32)
+    probs = logits / logits.sum(1, keepdims=True)
+    target = rng.randint(5, size=512).astype(np.int32)
+
+    for average in ("macro", "weighted"):
+        m = ShardedAUROC(capacity_per_device=64, num_classes=5, average=average)
+        m.update(jnp.asarray(probs[:256]), jnp.asarray(target[:256]))
+        m.update(jnp.asarray(probs[256:]), jnp.asarray(target[256:]))
+        want = roc_auc_score(target, probs, multi_class="ovr", average=average)
+        assert np.allclose(float(m.compute()), want, atol=1e-6), average
+
+
+def test_sharded_auroc_multiclass_per_class_and_partial_fill():
+    rng = np.random.RandomState(33)
+    logits = rng.rand(64, 3).astype(np.float32)
+    probs = logits / logits.sum(1, keepdims=True)
+    target = rng.randint(3, size=64).astype(np.int32)
+
+    m = ShardedAUROC(capacity_per_device=32, num_classes=3, average=None)  # mostly empty
+    m.update(jnp.asarray(probs), jnp.asarray(target))
+    per_class = np.asarray(m.compute())
+    assert per_class.shape == (3,)
+    for c in range(3):
+        assert np.allclose(per_class[c], roc_auc_score((target == c).astype(int), probs[:, c]), atol=1e-6)
+    # row-sharded (capacity, C) state: capacity_per_device rows per device
+    assert {s.data.shape for s in m.buf_preds.addressable_shards} == {(32, 3)}
+
+
+def test_multiclass_absent_class_raises_loudly():
+    """An averaged OvR score over a stream missing a class must raise, not
+    silently return NaN."""
+    preds = jnp.asarray(np.eye(4, dtype=np.float32)[np.zeros(16, int)])  # all prob on class 0
+    target = jnp.zeros(16, jnp.int32)  # classes 1..3 never occur
+    for average in ("macro", "weighted"):
+        m = ShardedAUROC(capacity_per_device=4, num_classes=4, average=average)
+        m.update(preds, target)
+        with pytest.raises(ValueError, match="never occurred"):
+            m.compute()
+    # per-class mode keeps NaN holes
+    m = ShardedAUROC(capacity_per_device=4, num_classes=4, average=None)
+    m.update(preds, target)
+    assert np.isnan(np.asarray(m.compute())).all()  # class 0 covers everything: all OvR degenerate
+
+
+def test_sharded_ap_multiclass_matches_sklearn():
+    rng = np.random.RandomState(41)
+    logits = rng.rand(256, 4).astype(np.float32)
+    probs = logits / logits.sum(1, keepdims=True)
+    target = rng.randint(4, size=256).astype(np.int32)
+
+    m = ShardedAveragePrecision(capacity_per_device=32, num_classes=4, average="macro")
+    m.update(jnp.asarray(probs), jnp.asarray(target))
+    want = np.mean([
+        average_precision_score((target == c).astype(int), probs[:, c]) for c in range(4)
+    ])
+    assert np.allclose(float(m.compute()), want, atol=1e-5)
 
 
 def test_pickle_roundtrip_mid_accumulation():
