@@ -24,12 +24,13 @@ Multi-host: pass a mesh built over ``jax.devices()`` after
 ``jax.distributed.initialize`` — the same code path then rides DCN.
 """
 
+import functools
 from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.ops.auroc_kernel import masked_binary_auroc, masked_binary_average_precision
@@ -37,12 +38,13 @@ from metrics_tpu.parallel.sharded_metric import (  # noqa: F401  (re-exported fo
     ShardedStreamsMixin,
     _default_mesh,
     _programs,
+    replica0,
 )
 
 
-def _average_ovr(per_class: jax.Array, onehot: jax.Array, mask: jax.Array, average: Optional[str]) -> jax.Array:
+def _average_ovr(per_class: jax.Array, support: jax.Array, average: Optional[str]) -> jax.Array:
     """NONE/MACRO/WEIGHTED averaging of per-class one-vs-rest scores
-    (support counted over mask-valid entries).
+    (``support`` = mask-valid occurrences per class).
 
     Averaged modes fail LOUDLY when a class never occurred in the stream
     (its OvR score is NaN and would silently poison the mean); the
@@ -50,7 +52,6 @@ def _average_ovr(per_class: jax.Array, onehot: jax.Array, mask: jax.Array, avera
     """
     if average in (None, "none"):
         return per_class
-    support = jnp.sum(onehot * mask[:, None], axis=0)
     absent = np.asarray(support) == 0
     if absent.any():
         raise ValueError(
@@ -62,6 +63,45 @@ def _average_ovr(per_class: jax.Array, onehot: jax.Array, mask: jax.Array, avera
     if average == "macro":
         return jnp.mean(per_class)
     return jnp.sum(per_class * support / jnp.maximum(support.sum(), 1))
+
+
+@functools.lru_cache(maxsize=None)
+def _ovr_program(mesh: Mesh, axis: str, kernel):
+    """One-vs-rest scores with the **class axis sharded over the mesh**.
+
+    The gathered stream is replicated, so resharding its class axis is a
+    local slice; each device then co-sorts only its ``padded_classes/world``
+    classes — the per-class sorts are embarrassingly parallel, and this is
+    where the compute-side scalability comes from (the reference loops over
+    classes on every rank, ``functional/classification/auroc.py:79-86``).
+    Pad classes carry all-zero onehot columns: their kernel output is NaN
+    (no positives), sliced off by the caller.
+    """
+
+    def _local(preds, target, mask):
+        n_local = preds.shape[1]
+        first = jax.lax.axis_index(axis) * n_local
+        onehot = (target[:, None] == (first + jnp.arange(n_local))).astype(jnp.int32)
+        per_class = jax.vmap(kernel, in_axes=(1, 1, None))(preds, onehot, mask)
+        support = jnp.sum(onehot * mask[:, None].astype(jnp.int32), axis=0)
+        # gather the tiny (C,) results in-program so the outputs come out
+        # replicated — host-side slicing/averaging then works on any mesh,
+        # including multi-host where a P(axis)-sharded output would span
+        # non-addressable devices
+        return (
+            jax.lax.all_gather(per_class, axis, tiled=True),
+            jax.lax.all_gather(support, axis, tiled=True),
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(P(None, axis), P(), P()),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
 
 
 class ShardedCurveMetric(ShardedStreamsMixin, Metric):
@@ -113,6 +153,16 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
                 f"expected preds of shape {shape_desc} and 1-d target,"
                 f" got {preds.shape} and {target.shape}"
             )
+        if self.preds_suffix:
+            # eager value probe, same discipline as the replicated path
+            # (utilities/checks.py): an out-of-range label would silently
+            # count as all-negative in every one-vs-rest column
+            lo, hi = int(jnp.min(target)), int(jnp.max(target))
+            if lo < 0 or hi >= self.preds_suffix[0]:
+                raise ValueError(
+                    f"target labels must lie in [0, {self.preds_suffix[0]})"
+                    f" (the C dimension of preds); got range [{lo}, {hi}]"
+                )
         self._append_streams(preds.astype(jnp.float32), target)
 
     def _gathered(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -156,13 +206,23 @@ class _ShardedOVRMetric(ShardedCurveMetric):
     def compute(self) -> jax.Array:
         preds, target, mask = self._gathered()
         if not self.preds_suffix:
-            return self._masked_kernel(preds, target, mask, self.pos_label)
-        # one-vs-rest: C batched co-sorts in a single XLA program (replaces
-        # the reference's per-class Python loop, functional/auroc.py:79-86)
+            # the gathered stream is replicated; run the epilogue kernel on
+            # one local replica (identical wall-clock on a pod, 1/world the
+            # work on a shared-host mesh — see replica0)
+            return self._masked_kernel(replica0(preds), replica0(target), replica0(mask), self.pos_label)
+        # shard the one-vs-rest class axis over the mesh: each device
+        # co-sorts only ceil(C/world) classes (pad classes give NaN per-class
+        # scores from their all-zero onehot columns and are sliced off)
         num_classes = self.preds_suffix[0]
-        onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
-        per_class = jax.vmap(self._masked_kernel, in_axes=(1, 1, None))(preds, onehot, mask)
-        return _average_ovr(per_class, onehot, mask, self.average)
+        padded = -(-num_classes // self.world) * self.world
+        if padded != num_classes:
+            pad = jnp.zeros((preds.shape[0], padded - num_classes), preds.dtype)
+            preds = jnp.concatenate([preds, pad], axis=1)
+        preds = jax.device_put(preds, NamedSharding(self.mesh, P(None, self.axis_name)))
+        program = _ovr_program(self.mesh, self.axis_name, self._masked_kernel)
+        per_class, support = program(preds, target, mask)
+        per_class, support = replica0(per_class)[:num_classes], replica0(support)[:num_classes]
+        return _average_ovr(per_class, support, self.average)
 
 
 class ShardedAUROC(_ShardedOVRMetric):

@@ -91,6 +91,21 @@ def _programs(mesh: Mesh, axis: str, n_streams: int = 2):
     return jit_update, jit_gather
 
 
+def replica0(x: jax.Array) -> jax.Array:
+    """The local single-device copy of a fully-replicated array.
+
+    ``_gather_streams`` returns replicated outputs (every device holds the
+    full gathered stream). A jit launched on a replicated operand runs the
+    identical program on **every** device — free on a real pod (they run in
+    parallel) but pure serialized waste when mesh devices share one host
+    (the 8-virtual-device CPU test/bench mesh: 8× the sort work). Post-gather
+    epilogues are launched on this single local replica instead; on multi-host
+    meshes each process uses its own first local replica, so the value is
+    still computed everywhere it is needed.
+    """
+    return x.addressable_shards[0].data
+
+
 class ShardedStreamsMixin:
     """State layout + lifecycle for metrics with sharded append-stream state.
 

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from metrics_tpu.parallel.sharded_metric import ShardedStreamsMixin
+from metrics_tpu.parallel.sharded_metric import ShardedStreamsMixin, replica0
 from metrics_tpu.retrieval.mean_average_precision import RetrievalMAP
 from metrics_tpu.retrieval.mean_reciprocal_rank import RetrievalMRR
 from metrics_tpu.retrieval.precision import RetrievalPrecision
@@ -73,8 +73,12 @@ class ShardedRetrievalMetric(ShardedStreamsMixin, RetrievalMetric):
     def compute(self) -> jax.Array:
         (idx, preds, target), mask = self._gather_streams()
         # buffer-slot validity folds into _compute_from_arrays' single
-        # host-side filter pass (query-id densification is host-side anyway)
-        return self._compute_from_arrays(idx, preds, target, valid_mask=np.asarray(mask))
+        # host-side filter pass (query-id densification is host-side anyway);
+        # the gathered streams are replicated, so score on one local replica
+        # (identical wall-clock on a pod, 1/world the work on a shared host)
+        return self._compute_from_arrays(
+            replica0(idx), replica0(preds), replica0(target), valid_mask=np.asarray(replica0(mask))
+        )
 
 
 class ShardedRetrievalMAP(ShardedRetrievalMetric, RetrievalMAP):
