@@ -18,7 +18,13 @@ import time
 import numpy as np
 
 N = 1_000_000
-REPEATS = 5
+REPEATS = 50
+
+
+def _timed(f) -> float:
+    t0 = time.perf_counter()
+    f()
+    return time.perf_counter() - t0
 
 
 def _bench_jax() -> float:
@@ -48,25 +54,35 @@ def _bench_jax() -> float:
     acc, auroc = step(preds, target, jnp.zeros(()))
     acc_f, auroc_f = float(acc), float(auroc)
 
-    # measure host round-trip latency with a trivial program
+    # measure host round-trip latency with a trivial program (min = the
+    # optimistic estimate, which makes per_step conservative)
     tiny = jax.jit(lambda x: x + 1.0)
     float(tiny(jnp.zeros(())))
-    t0 = time.perf_counter()
-    for _ in range(3):
-        float(tiny(jnp.zeros(())))
-    rtt = (time.perf_counter() - t0) / 3
+    rtt = min(_timed(lambda: float(tiny(jnp.zeros(())))) for _ in range(5))
 
-    # chain REPEATS dependent steps, one readback at the end
-    carry = jnp.zeros(())
-    t0 = time.perf_counter()
-    for _ in range(REPEATS):
-        acc, auroc = step(preds, target, carry)
-        carry = auroc
-    float(carry)
-    total = time.perf_counter() - t0
+    # chain enough dependent steps that device compute dominates the tunnel
+    # RTT (at ~2ms/step and ~65ms RTT, 5 steps hide entirely inside one RTT
+    # — that clamped an earlier version of this bench to 0)
+    def chained(k):
+        carry = jnp.zeros(())
+        t0 = time.perf_counter()
+        for _ in range(k):
+            _, auroc = step(preds, target, carry)
+            carry = auroc
+        float(carry)
+        return time.perf_counter() - t0
 
-    per_step = max((total - rtt) / REPEATS, 1e-9)
-    return per_step, acc_f, auroc_f
+    chained(3)  # warm any per-shape dispatch paths
+    k = REPEATS
+    for _ in range(4):
+        totals = sorted(chained(k) for _ in range(3))
+        per_step = (totals[1] - rtt) / k
+        if per_step * k > 2 * rtt and per_step > 1e-5:
+            return per_step, acc_f, auroc_f
+        k *= 4  # compute still hiding under the RTT: lengthen the chain
+    raise RuntimeError(
+        f"could not resolve per-step time above the host RTT ({rtt * 1e3:.1f} ms)"
+    )
 
 
 def _bench_reference() -> float:
@@ -103,7 +119,7 @@ def _bench_reference() -> float:
 
         step()  # warm caches
         times = []
-        for _ in range(REPEATS):
+        for _ in range(5):
             t0 = time.perf_counter()
             acc, roc = step()
             times.append(time.perf_counter() - t0)

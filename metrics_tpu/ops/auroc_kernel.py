@@ -10,13 +10,34 @@ and those "previous group" counts can be forward-filled with a ``cummax``
 (cumulative counts are non-decreasing), so the whole computation is one sort
 plus O(N) scans. No gather, no searchsorted, no host round-trip.
 
-Cost profile on TPU (1M f32): the co-sort (``lax.sort`` with the relevance
-as a co-sorted operand instead of an argsort+gather) dominates at ~4ms; the
-scans are memory-bound element-wise passes.
+Cost profile on TPU (1M f32): the co-sort (``lax.sort`` of a monotone u32
+key with one packed payload operand, instead of an argsort+gather) dominates
+at ~2ms; the scans are memory-bound element-wise passes. Measured losers,
+for the record: argsort+gather and ``searchsorted`` formulations (~170ms),
+f32 keys (+7% TPU / +12% CPU), a third co-sorted operand (+20%).
 """
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+_SIGN = jnp.uint32(1 << 31)
+
+
+def _descending_key(preds: jax.Array) -> jax.Array:
+    """Total-order u32 sort key: ascending key == descending float score.
+
+    Integer compares are cheaper than float compares in XLA's sort network
+    (~7% on TPU, ~12% on CPU at 1M elements), and the map is the standard
+    bit-level monotone f32→u32 embedding. ``-0.0`` is canonicalized to
+    ``+0.0`` first so equal scores share one key (one tie group); NaN
+    scores are pinned to sort last, matching float-sort semantics (they're
+    garbage scores either way — the eager validation paths reject them
+    before this kernel).
+    """
+    p = preds.astype(jnp.float32) + 0.0  # -0.0 + 0.0 == +0.0
+    b = lax.bitcast_convert_type(p, jnp.uint32)
+    u = jnp.where(b >= _SIGN, ~b, b | _SIGN)  # ascending u == ascending float
+    return jnp.where(jnp.isnan(p), jnp.uint32(0xFFFFFFFF), ~u)
 
 
 def _sorted_tie_groups(preds: jax.Array, rel: jax.Array, weight: jax.Array = None):
@@ -36,9 +57,10 @@ def _sorted_tie_groups(preds: jax.Array, rel: jax.Array, weight: jax.Array = Non
     zero count deltas. This is how masked buffers exclude unfilled slots
     without score sentinels.
     """
+    key = _descending_key(preds)
     if weight is None:
-        # descending sort with co-sorted relevance: no argsort+gather round-trip
-        neg_sorted, rel_s = lax.sort((-preds, rel), num_keys=1, is_stable=True)
+        # one co-sorted relevance payload: no argsort+gather round-trip
+        key_s, rel_s = lax.sort((key, rel), num_keys=1, is_stable=True)
         pos_w = rel_s
         neg_w = 1.0 - rel_s
     else:
@@ -46,13 +68,13 @@ def _sorted_tie_groups(preds: jax.Array, rel: jax.Array, weight: jax.Array = Non
         # one fewer co-sorted array is ~20% off the sort, and the key is
         # unchanged so tie grouping is identical
         packed = rel + 2.0 * weight
-        neg_sorted, packed_s = lax.sort((-preds, packed), num_keys=1, is_stable=True)
-        pos_w = (packed_s == 3.0).astype(preds.dtype)  # rel=1, w=1
-        neg_w = (packed_s == 2.0).astype(preds.dtype)  # rel=0, w=1
+        key_s, packed_s = lax.sort((key, packed), num_keys=1, is_stable=True)
+        pos_w = (packed_s == 3.0).astype(jnp.float32)  # rel=1, w=1
+        neg_w = (packed_s == 2.0).astype(jnp.float32)  # rel=0, w=1
     tps = jnp.cumsum(pos_w)
     fps = jnp.cumsum(neg_w)
 
-    boundary = neg_sorted[1:] != neg_sorted[:-1]
+    boundary = key_s[1:] != key_s[:-1]
     is_first = jnp.concatenate([jnp.ones((1,), bool), boundary])
     is_last = jnp.concatenate([boundary, jnp.ones((1,), bool)])
 

@@ -32,6 +32,28 @@ def test_binary_auroc_degenerate_nan():
     assert np.isnan(float(binary_auroc(jnp.asarray([0.1, 0.9]), jnp.asarray([1, 1]))))
 
 
+def test_binary_auroc_signed_zero_is_one_tie_group():
+    """Regression for the u32 sort key: -0.0 and +0.0 are equal scores and
+    must land in the same tie group (raw bitcast would split them)."""
+    p = np.asarray([-0.0, 0.0, -0.0, 0.0, 0.5, -0.5], np.float32)
+    t = np.asarray([1, 0, 0, 1, 1, 0])
+    ours = float(binary_auroc(jnp.asarray(p), jnp.asarray(t)))
+    assert abs(ours - roc_auc_score(t, p)) < 1e-6
+
+
+def test_binary_auroc_negative_and_inf_scores():
+    """The u32 key embedding must order negatives and ±inf exactly like
+    float comparison (raw logits are valid scores)."""
+    rng = np.random.RandomState(5)
+    p = (rng.randn(512) * 10).astype(np.float32)
+    p[:2] = [np.inf, -np.inf]
+    t = rng.randint(2, size=512)
+    ours = float(binary_auroc(jnp.asarray(p), jnp.asarray(t)))
+    # sklearn rejects inf; rank-equivalent finite stand-ins give the oracle
+    finite = np.where(np.isposinf(p), 1e30, np.where(np.isneginf(p), -1e30, p))
+    assert abs(ours - roc_auc_score(t, finite)) < 1e-5
+
+
 def test_histogram_auroc_exact_on_quantized():
     """With scores on the bin grid, the histogram AUROC is exact."""
     rng = np.random.RandomState(3)
