@@ -275,6 +275,15 @@ class ShardedROC(ShardedCurveMetric):
     thresholds), so — exactly like the reference's compute — the final
     materialization is a host step on the gathered valid stream; only the
     accumulation memory is sharded.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> m = ShardedROC(capacity_per_device=1)
+        >>> m.update(jnp.array([0.1, 0.4, 0.35, 0.8, 0.6, 0.2, 0.9, 0.7]),
+        ...          jnp.array([0, 0, 1, 1, 1, 0, 1, 0]))
+        >>> fpr, tpr, thresholds = m.compute()
+        >>> fpr.shape == tpr.shape
+        True
     """
 
     def __init__(self, capacity_per_device: int, pos_label: int = 1, **kwargs: Any):
@@ -289,7 +298,17 @@ class ShardedROC(ShardedCurveMetric):
 
 
 class ShardedPrecisionRecallCurve(ShardedCurveMetric):
-    """Exact binary precision-recall curve with mesh-sharded bounded state."""
+    """Exact binary precision-recall curve with mesh-sharded bounded state.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> m = ShardedPrecisionRecallCurve(capacity_per_device=1)
+        >>> m.update(jnp.array([0.1, 0.4, 0.35, 0.8, 0.6, 0.2, 0.9, 0.7]),
+        ...          jnp.array([0, 0, 1, 1, 1, 0, 1, 0]))
+        >>> precision, recall, thresholds = m.compute()
+        >>> bool(jnp.all(recall[:-1] >= recall[1:]))  # recall is non-increasing
+        True
+    """
 
     def __init__(self, capacity_per_device: int, pos_label: int = 1, **kwargs: Any):
         super().__init__(capacity_per_device, **kwargs)

@@ -96,12 +96,42 @@ class ShardedRetrievalMAP(ShardedRetrievalMetric, RetrievalMAP):
 
 
 class ShardedRetrievalMRR(ShardedRetrievalMetric, RetrievalMRR):
-    """Mean reciprocal rank over queries, sharded bounded accumulation."""
+    """Mean reciprocal rank over queries, sharded bounded accumulation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> m = ShardedRetrievalMRR(capacity_per_device=1)
+        >>> m.update(jnp.array([0, 0, 0, 0, 1, 1, 1, 1]),
+        ...          jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.2, 0.5, 0.1]),
+        ...          jnp.array([False, False, True, False, False, True, False, True]))
+        >>> round(float(m.compute()), 4)
+        0.6667
+    """
 
 
 class ShardedRetrievalPrecision(ShardedRetrievalMetric, RetrievalPrecision):
-    """Precision@k over queries, sharded bounded accumulation."""
+    """Precision@k over queries, sharded bounded accumulation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> m = ShardedRetrievalPrecision(capacity_per_device=1, k=2)
+        >>> m.update(jnp.array([0, 0, 0, 0, 1, 1, 1, 1]),
+        ...          jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.2, 0.5, 0.1]),
+        ...          jnp.array([False, False, True, False, False, True, False, True]))
+        >>> round(float(m.compute()), 4)
+        0.25
+    """
 
 
 class ShardedRetrievalRecall(ShardedRetrievalMetric, RetrievalRecall):
-    """Recall@k over queries, sharded bounded accumulation."""
+    """Recall@k over queries, sharded bounded accumulation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> m = ShardedRetrievalRecall(capacity_per_device=1, k=2)
+        >>> m.update(jnp.array([0, 0, 0, 0, 1, 1, 1, 1]),
+        ...          jnp.array([0.2, 0.3, 0.5, 0.1, 0.3, 0.2, 0.5, 0.1]),
+        ...          jnp.array([False, False, True, False, False, True, False, True]))
+        >>> round(float(m.compute()), 4)
+        0.5
+    """
