@@ -417,6 +417,35 @@ def test_post_gather_epilogue_runs_on_single_replica():
     assert np.allclose(float(out), roc_auc_score(target, preds), atol=1e-6)
 
 
+def test_sharded_metric_inside_metric_collection():
+    """Sharded metrics are ordinary Metrics: they ride MetricCollection's
+    fan-out (kwargs routing, clone, compute dict) next to counter metrics."""
+    from metrics_tpu import Accuracy, MetricCollection
+
+    preds, target = _stream(128, seed=27)
+    col = MetricCollection([Accuracy(threshold=0.5), ShardedAUROC(capacity_per_device=32)])
+    for sl in (slice(0, 64), slice(64, 128)):
+        col(jnp.asarray(preds[sl]), jnp.asarray(target[sl]))
+    out = col.compute()
+    assert np.allclose(float(out["ShardedAUROC"]), roc_auc_score(target, preds), atol=1e-6)
+    assert np.allclose(float(out["Accuracy"]), np.mean((preds >= 0.5) == target), atol=1e-6)
+
+
+def test_sharded_ap_multiclass_weighted_matches_manual():
+    rng = np.random.RandomState(43)
+    probs = rng.rand(256, 4).astype(np.float32)
+    target = rng.randint(4, size=256).astype(np.int32)
+
+    m = ShardedAveragePrecision(capacity_per_device=32, num_classes=4, average="weighted")
+    m.update(jnp.asarray(probs), jnp.asarray(target))
+    per_class = np.asarray(
+        [average_precision_score((target == c).astype(int), probs[:, c]) for c in range(4)]
+    )
+    support = np.bincount(target, minlength=4)
+    want = float(np.sum(per_class * support / support.sum()))
+    assert np.allclose(float(m.compute()), want, atol=1e-5)
+
+
 def test_degenerate_single_class_is_nan():
     m = ShardedAUROC(capacity_per_device=8)
     m.update(jnp.asarray(np.linspace(0, 1, 16, dtype=np.float32)), jnp.zeros(16, jnp.int32))
