@@ -21,7 +21,10 @@ scatter writes are additionally dropped (``mode="drop"``) and
 data visibly — never silently corrupt the "exact" result.
 
 Multi-host: pass a mesh built over ``jax.devices()`` after
-``jax.distributed.initialize`` — the same code path then rides DCN.
+``jax.distributed.initialize`` — the same code path then rides DCN, with
+every process calling ``update`` in lockstep with its process-local slice
+of each global batch (validated with two real processes in
+``tests/parallel/test_multihost.py``).
 """
 
 import functools
@@ -30,7 +33,7 @@ from typing import Any, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.ops.auroc_kernel import masked_binary_auroc, masked_binary_average_precision
@@ -79,15 +82,18 @@ def _ovr_program(mesh: Mesh, axis: str, kernel):
     """
 
     def _local(preds, target, mask):
-        n_local = preds.shape[1]
+        # class-block slicing happens in-program (preds arrive replicated):
+        # no host-side resharding, so the same program runs on multi-host
+        # meshes where device_put to non-addressable devices would fail
+        world = jax.lax.axis_size(axis)
+        n_local = preds.shape[1] // world
         first = jax.lax.axis_index(axis) * n_local
+        local = jax.lax.dynamic_slice_in_dim(preds, first, n_local, axis=1)
         onehot = (target[:, None] == (first + jnp.arange(n_local))).astype(jnp.int32)
-        per_class = jax.vmap(kernel, in_axes=(1, 1, None))(preds, onehot, mask)
+        per_class = jax.vmap(kernel, in_axes=(1, 1, None))(local, onehot, mask)
         support = jnp.sum(onehot * mask[:, None].astype(jnp.int32), axis=0)
-        # gather the tiny (C,) results in-program so the outputs come out
-        # replicated — host-side slicing/averaging then works on any mesh,
-        # including multi-host where a P(axis)-sharded output would span
-        # non-addressable devices
+        # gather the tiny (C,) results so the outputs come out replicated —
+        # host-side slicing/averaging then works on any mesh
         return (
             jax.lax.all_gather(per_class, axis, tiled=True),
             jax.lax.all_gather(support, axis, tiled=True),
@@ -97,7 +103,7 @@ def _ovr_program(mesh: Mesh, axis: str, kernel):
         jax.shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(None, axis), P(), P()),
+            in_specs=(P(), P(), P()),
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -145,8 +151,13 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
         """Append a batch of ``(n, *preds_suffix)`` scores / ``(n,)`` targets,
         ``n`` divisible by the mesh-axis size (the usual SPMD batch
         contract)."""
-        preds = jnp.asarray(preds)
-        target = jnp.asarray(target)
+        # keep host inputs on host — _append_streams casts to the stream
+        # dtypes and stages exactly once (multi-process staging needs host
+        # arrays anyway)
+        if not hasattr(preds, "shape"):
+            preds = np.asarray(preds)
+        if not hasattr(target, "shape"):
+            target = np.asarray(target)
         if target.ndim != 1 or preds.shape != (target.shape[0], *self.preds_suffix):
             shape_desc = "(n" + "".join(f", {d}" for d in self.preds_suffix) + ")"
             raise ValueError(
@@ -157,13 +168,16 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
             # eager value probe, same discipline as the replicated path
             # (utilities/checks.py): an out-of-range label would silently
             # count as all-negative in every one-vs-rest column
-            lo, hi = int(jnp.min(target)), int(jnp.max(target))
+            if isinstance(target, np.ndarray):
+                lo, hi = int(target.min()), int(target.max())
+            else:
+                lo, hi = int(jnp.min(target)), int(jnp.max(target))
             if lo < 0 or hi >= self.preds_suffix[0]:
                 raise ValueError(
                     f"target labels must lie in [0, {self.preds_suffix[0]})"
                     f" (the C dimension of preds); got range [{lo}, {hi}]"
                 )
-        self._append_streams(preds.astype(jnp.float32), target)
+        self._append_streams(preds, target)
 
     def _gathered(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """One all-gather: full ``(capacity, ...)`` streams + validity mask,
@@ -218,7 +232,6 @@ class _ShardedOVRMetric(ShardedCurveMetric):
         if padded != num_classes:
             pad = jnp.zeros((preds.shape[0], padded - num_classes), preds.dtype)
             preds = jnp.concatenate([preds, pad], axis=1)
-        preds = jax.device_put(preds, NamedSharding(self.mesh, P(None, self.axis_name)))
         program = _ovr_program(self.mesh, self.axis_name, self._masked_kernel)
         per_class, support = program(preds, target, mask)
         per_class, support = replica0(per_class)[:num_classes], replica0(support)[:num_classes]

@@ -91,6 +91,21 @@ def _programs(mesh: Mesh, axis: str, n_streams: int = 2):
     return jit_update, jit_gather
 
 
+def _put_sharded(x, sharding: NamedSharding) -> jax.Array:
+    """Stage a host (or host-fetchable) array onto a mesh sharding.
+
+    ``jax.device_put`` suffices on single-process meshes; on meshes with
+    non-addressable devices (multi-host), each process supplies its local
+    shards from the globally-identical host array via
+    ``make_array_from_callback``.
+    """
+    mesh = sharding.mesh
+    if mesh.devices.size == len(mesh.local_devices):
+        return jax.device_put(jnp.asarray(x), sharding)
+    host = np.asarray(x)
+    return jax.make_array_from_callback(host.shape, sharding, lambda idx: host[idx])
+
+
 def replica0(x: jax.Array) -> jax.Array:
     """The local single-device copy of a fully-replicated array.
 
@@ -133,23 +148,38 @@ class ShardedStreamsMixin:
         self.capacity = capacity_per_device * self.world
         self._stream_names = tuple(stream_specs)
         self._n_seen = 0
+        # multi-controller (one process per host): every process sees the
+        # global mesh but only its local devices; state creation and batch
+        # staging must go through SPMD-safe paths
+        self.n_processes = self.mesh.devices.size // len(self.mesh.local_devices)
 
         sharding = NamedSharding(self.mesh, P(axis_name))
         for name, (dtype, suffix) in stream_specs.items():
-            zeros = jax.device_put(jnp.zeros((self.capacity, *suffix), dtype), sharding)
+            # jit-with-out-shardings creates each process's local shards
+            # in-program — works on meshes with non-addressable devices,
+            # where a host-side device_put cannot
+            zeros = jax.jit(
+                functools.partial(jnp.zeros, (self.capacity, *suffix), dtype),
+                out_shardings=sharding,
+            )()
             self.add_state(name, default=zeros, dist_reduce_fx=None)
-        counts = jax.device_put(jnp.zeros((self.world,), jnp.int32), sharding)
+        counts = jax.jit(
+            functools.partial(jnp.zeros, (self.world,), jnp.int32), out_shardings=sharding
+        )()
         self.add_state("counts", default=counts, dist_reduce_fx=None)
 
     def _append_streams(self, *arrays: jax.Array) -> None:
         """Append one batch (first dim = n) to every stream, in spec order.
 
+        Multi-controller contract (one process per host): every process
+        calls in lockstep with its equal-size process-local slice of the
+        global batch; the global batch is their rank-order concatenation.
         Raises loudly when the batch is not evenly shardable or would
         overflow the fixed capacity."""
-        n = arrays[0].shape[0]
+        n = arrays[0].shape[0] * self.n_processes  # global batch size
         if n % self.world != 0:
             raise ValueError(
-                f"batch size {n} not divisible by mesh axis size {self.world};"
+                f"global batch size {n} not divisible by mesh axis size {self.world};"
                 " pad the final batch or use a divisible eval batch"
             )
         if self._n_seen + n > self.capacity:
@@ -159,8 +189,21 @@ class ShardedStreamsMixin:
                 f" {self.world} devices). Construct with a larger"
                 " `capacity_per_device` for this evaluation size."
             )
+        # normalize to the registered stream dtypes here (works for numpy and
+        # jax inputs alike), so callers need not commit batches to device
+        # just to cast them
+        arrays = tuple(
+            a if a.dtype == self._defaults[name].dtype else a.astype(self._defaults[name].dtype)
+            for name, a in zip(self._stream_names, arrays)
+        )
         sharding = NamedSharding(self.mesh, P(self.axis_name))
-        batches = tuple(jax.device_put(a, sharding) for a in arrays)
+        if self.n_processes == 1:
+            batches = tuple(jax.device_put(a, sharding) for a in arrays)
+        else:
+            # each process contributes its local slice of the global batch
+            batches = tuple(
+                jax.make_array_from_process_local_data(sharding, np.asarray(a)) for a in arrays
+            )
         jit_update, _ = _programs(self.mesh, self.axis_name, len(self._stream_names))
         bufs = tuple(getattr(self, name) for name in self._stream_names)
         new_bufs, self.counts = jit_update(bufs, self.counts, batches)
@@ -214,12 +257,13 @@ class ShardedStreamsMixin:
                 f" with only {len(devs)}"
             )
         self.mesh = Mesh(np.array(devs[:n]).reshape(shape), axes)
+        # the pickled value described the source process topology; this
+        # host's may differ (e.g. pod -> single-process analysis host)
+        self.n_processes = self.mesh.devices.size // len(self.mesh.local_devices)
         sharding = NamedSharding(self.mesh, P(self.axis_name))
         for key in (*self._stream_names, "counts"):
-            setattr(self, key, jax.device_put(jnp.asarray(getattr(self, key)), sharding))
-        self._defaults = {
-            k: jax.device_put(jnp.asarray(v), sharding) for k, v in self._defaults.items()
-        }
+            setattr(self, key, _put_sharded(getattr(self, key), sharding))
+        self._defaults = {k: _put_sharded(v, sharding) for k, v in self._defaults.items()}
 
     def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
         # a checkpoint from a different mesh size cannot be resharded blindly:
@@ -242,10 +286,12 @@ class ShardedStreamsMixin:
                 )
         super().load_state_dict(state_dict, prefix)
         # restore the mesh sharding (checkpoint restore yields single-device
-        # arrays) and the host-side fill level
+        # arrays) and the host-side fill level; _put_sharded keeps this
+        # working on multi-host meshes, where every process loads the same
+        # global checkpoint and contributes its local shards
         sharding = NamedSharding(self.mesh, P(self.axis_name))
         for key in (*self._stream_names, "counts"):
             if prefix + key in state_dict:
-                setattr(self, key, jax.device_put(getattr(self, key), sharding))
+                setattr(self, key, _put_sharded(getattr(self, key), sharding))
         if prefix + "counts" in state_dict:
             self._n_seen = int(np.asarray(self.counts).sum())

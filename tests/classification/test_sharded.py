@@ -313,7 +313,9 @@ def test_pickle_roundtrip_mid_accumulation():
     preds, target = _stream(128, seed=21)
     m = ShardedAUROC(capacity_per_device=32)
     m.update(jnp.asarray(preds), jnp.asarray(target))
+    m.n_processes = 999  # simulate a pickle from a differently-topologized host
     m2 = pickle.loads(pickle.dumps(m))
+    assert m2.n_processes == 1  # recomputed from the rebuilt mesh, not trusted
     assert {s.data.size for s in m2.buf_preds.addressable_shards} == {32}
     assert np.allclose(float(m2.compute()), roc_auc_score(target, preds), atol=1e-6)
     m2.update(jnp.asarray(preds), jnp.asarray(target))  # still updatable

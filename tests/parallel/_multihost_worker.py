@@ -58,6 +58,40 @@ def main(coordinator: str, num_processes: int, process_id: int) -> None:
     auroc_expected = roc_auc_score(bin_targets.reshape(-1), bin_preds.reshape(-1))
     assert abs(auroc_result - auroc_expected) < 1e-6, (auroc_result, auroc_expected)
 
+    # sharded bounded-state metric over a GLOBAL mesh spanning both
+    # processes (the DCN path): every process calls update in lockstep with
+    # its process-local slice of each global batch; state lives 1/world per
+    # device; compute's all_gather crosses the process boundary
+    from jax.sharding import Mesh
+
+    from metrics_tpu import ShardedAUROC
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))  # 2 devices, 1 per process
+    assert len(mesh.local_devices) < mesh.devices.size, "mesh must span processes"
+    sharded = ShardedAUROC(capacity_per_device=n_batches * batch, mesh=mesh)
+    for i in range(n_batches):
+        # global batch i is split in half: this process contributes its half
+        half = batch // num_processes
+        lo = process_id * half
+        sharded.update(
+            jnp.asarray(bin_preds[i, lo:lo + half]), jnp.asarray(bin_targets[i, lo:lo + half])
+        )
+    sharded_result = float(sharded.compute())
+    sharded_expected = roc_auc_score(bin_targets.reshape(-1), bin_preds.reshape(-1))
+    assert abs(sharded_result - sharded_expected) < 1e-6, (sharded_result, sharded_expected)
+
+    # multiclass one-vs-rest with the class axis sharded across processes
+    ovr = ShardedAUROC(capacity_per_device=n_batches * batch, mesh=mesh, num_classes=5, average="macro")
+    for i in range(n_batches):
+        half = batch // num_processes
+        lo = process_id * half
+        ovr.update(jnp.asarray(probs[i, lo:lo + half]), jnp.asarray(targets[i, lo:lo + half]))
+    ovr_result = float(ovr.compute())
+    ovr_expected = roc_auc_score(
+        targets.reshape(-1), probs.reshape(-1, 5), multi_class="ovr", average="macro"
+    )
+    assert abs(ovr_result - ovr_expected) < 1e-5, (ovr_result, ovr_expected)
+
     print(f"rank {process_id}: OK {result}")
 
 

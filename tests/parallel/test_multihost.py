@@ -44,7 +44,7 @@ def test_two_process_metric_sync():
     try:
         outputs = []
         for p in procs:
-            out, _ = p.communicate(timeout=75)
+            out, _ = p.communicate(timeout=150)
             outputs.append(out)
     finally:
         for p in procs:
