@@ -294,4 +294,7 @@ class ShardedStreamsMixin:
             if prefix + key in state_dict:
                 setattr(self, key, _put_sharded(getattr(self, key), sharding))
         if prefix + "counts" in state_dict:
-            self._n_seen = int(np.asarray(self.counts).sum())
+            # read the fill level from the host checkpoint, not the restored
+            # device array — on a multi-host mesh the latter spans
+            # non-addressable devices and cannot be fetched
+            self._n_seen = int(np.asarray(state_dict[prefix + "counts"]).sum())

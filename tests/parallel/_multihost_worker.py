@@ -92,6 +92,24 @@ def main(coordinator: str, num_processes: int, process_id: int) -> None:
     )
     assert abs(ovr_result - ovr_expected) < 1e-5, (ovr_result, ovr_expected)
 
+    # checkpoint restore onto the cross-process mesh: every process loads
+    # the same global host checkpoint; _put_sharded supplies local shards
+    n_total = n_batches * batch
+    world = mesh.devices.size
+    ckpt_preds = bin_preds.reshape(world, n_total // world)  # rank-order shards
+    ckpt_target = bin_targets.reshape(world, n_total // world)
+    checkpoint = {
+        "buf_preds": ckpt_preds.reshape(-1).astype(np.float32),
+        "buf_target": ckpt_target.reshape(-1).astype(np.int32),
+        "counts": np.full((world,), n_total // world, np.int32),
+    }
+    restored = ShardedAUROC(capacity_per_device=n_total // world, mesh=mesh)
+    restored.persistent(True)
+    restored.load_state_dict(checkpoint)
+    assert restored._n_seen == n_total
+    restored_result = float(restored.compute())
+    assert abs(restored_result - auroc_expected) < 1e-6, (restored_result, auroc_expected)
+
     print(f"rank {process_id}: OK {result}")
 
 
