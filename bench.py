@@ -80,9 +80,29 @@ def _bench_jax() -> float:
         if per_step * k > 2 * rtt and per_step > 1e-5:
             return per_step, acc_f, auroc_f
         k *= 4  # compute still hiding under the RTT: lengthen the chain
-    raise RuntimeError(
-        f"could not resolve per-step time above the host RTT ({rtt * 1e3:.1f} ms)"
-    )
+
+    # fallback: the whole repeat loop on-device in one program (excludes
+    # per-step dispatch, so it slightly underestimates; still honest about
+    # device compute and robust to tunnel pathologies)
+    from jax import lax
+
+    @jax.jit
+    def many(preds, target):
+        def body(_, carry):
+            a, r = step(preds, target, carry)
+            return r + a * 0.0
+
+        return lax.fori_loop(0, REPEATS, body, jnp.zeros(()))
+
+    float(many(preds, target))
+    total = min(_timed(lambda: float(many(preds, target))) for _ in range(3))
+    per_step = (total - rtt) / REPEATS
+    if per_step <= 1e-5:
+        raise RuntimeError(
+            f"could not resolve per-step time above the host RTT ({rtt * 1e3:.1f} ms)"
+        )
+    print("WARNING: chained-dispatch timing unresolvable; on-device fori_loop fallback", file=sys.stderr)
+    return per_step, acc_f, auroc_f
 
 
 def _bench_reference() -> float:
