@@ -25,12 +25,19 @@ from metrics_tpu.utilities.data import _is_concrete
 
 
 class _BinnedScoreMetric(Metric):
-    """Shared runtime for histogram-state metrics: binary targets, score
-    probabilities in [0, 1], two ``(num_bins,)`` sum-reduced histograms."""
+    """Shared runtime for histogram-state metrics.
+
+    Binary (default): binary targets, score probabilities in [0, 1], two
+    ``(num_bins,)`` sum-reduced histograms. With ``num_classes=C``: ``(N, C)``
+    score rows with integer labels, per-class one-vs-rest ``(C, num_bins)``
+    histograms — still psum-able, still O(state) independent of dataset size.
+    """
 
     def __init__(
         self,
         num_bins: int = 512,
+        num_classes: Optional[int] = None,
+        average: Optional[str] = "macro",
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
@@ -44,13 +51,51 @@ class _BinnedScoreMetric(Metric):
         )
         if not isinstance(num_bins, int) or num_bins < 2:
             raise ValueError(f"`num_bins` must be an integer >= 2, got {num_bins}")
+        allowed = (None, "none", "macro", "weighted")
+        if average not in allowed:
+            raise ValueError(f"Argument `average` expected to be one of {allowed}, got {average}")
         self.num_bins = num_bins
+        self.num_classes = num_classes
+        self.average = average
 
-        self.add_state("hist_pos", default=jnp.zeros((num_bins,), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("hist_neg", default=jnp.zeros((num_bins,), jnp.float32), dist_reduce_fx="sum")
+        shape = (num_bins,) if num_classes in (None, 1) else (num_classes, num_bins)
+        self.add_state("hist_pos", default=jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("hist_neg", default=jnp.zeros(shape, jnp.float32), dist_reduce_fx="sum")
+
+    @property
+    def _is_multiclass(self) -> bool:
+        return self.hist_pos.ndim == 2
 
     def update(self, preds: jax.Array, target: jax.Array) -> None:
-        preds, target = _check_retrieval_functional_inputs(preds, target)
+        if self._is_multiclass:
+            preds = jnp.asarray(preds)
+            target = jnp.asarray(target)
+            num_classes = self.hist_pos.shape[0]
+            if target.ndim != 1 or preds.shape != (target.shape[0], num_classes):
+                raise ValueError(
+                    f"expected preds of shape (n, {num_classes}) and 1-d target,"
+                    f" got {preds.shape} and {target.shape}"
+                )
+            lo, hi = int(jnp.min(target)), int(jnp.max(target))
+            if lo < 0 or hi >= num_classes:
+                raise ValueError(
+                    f"target labels must lie in [0, {num_classes})"
+                    f" (the C dimension of preds); got range [{lo}, {hi}]"
+                )
+            self._check_prob_range(preds)
+            onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
+            hist_pos, hist_neg = jax.vmap(
+                lambda p, t: score_histograms(p, t, self.num_bins), in_axes=(1, 1)
+            )(preds, onehot)
+        else:
+            preds, target = _check_retrieval_functional_inputs(preds, target)
+            self._check_prob_range(preds)
+            hist_pos, hist_neg = score_histograms(preds.flatten(), target.flatten(), self.num_bins)
+        self.hist_pos = self.hist_pos + hist_pos
+        self.hist_neg = self.hist_neg + hist_neg
+
+    @staticmethod
+    def _check_prob_range(preds: jax.Array) -> None:
         if _is_concrete(preds):
             pmin, pmax = _min_max_jit(preds)
             if float(pmin) < 0 or float(pmax) > 1:
@@ -58,9 +103,15 @@ class _BinnedScoreMetric(Metric):
                 raise ValueError(
                     "The `preds` should be probabilities, but values were detected outside of [0,1] range."
                 )
-        hist_pos, hist_neg = score_histograms(preds.flatten(), target.flatten(), self.num_bins)
-        self.hist_pos = self.hist_pos + hist_pos
-        self.hist_neg = self.hist_neg + hist_neg
+
+    def _ovr_scores(self, kernel: Callable) -> jax.Array:
+        """Per-class one-vs-rest scores from the histogram rows, averaged
+        per ``self.average`` (loud failure on absent classes)."""
+        from metrics_tpu.classification.sharded import _average_ovr
+
+        per_class = jax.vmap(kernel)(self.hist_pos, self.hist_neg)
+        support = jnp.sum(self.hist_pos, axis=1)
+        return _average_ovr(per_class, support, self.average)
 
 
 class BinnedAUROC(_BinnedScoreMetric):
@@ -71,6 +122,8 @@ class BinnedAUROC(_BinnedScoreMetric):
 
     Args:
         num_bins: score quantization resolution (state size and accuracy).
+        num_classes: one-vs-rest over ``(N, C)`` score rows when set.
+        average: ``"macro"`` | ``"weighted"`` | ``None`` (multiclass only).
 
     Example:
         >>> import jax.numpy as jnp
@@ -81,6 +134,8 @@ class BinnedAUROC(_BinnedScoreMetric):
     """
 
     def compute(self) -> jax.Array:
+        if self._is_multiclass:
+            return self._ovr_scores(histogram_auroc)
         return histogram_auroc(self.hist_pos, self.hist_neg)
 
 
@@ -101,6 +156,11 @@ class BinnedPrecisionRecallCurve(_BinnedScoreMetric):
     """
 
     def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        if self._is_multiclass:
+            # per-class curves: (C, num_bins + 1) precision/recall rows;
+            # thresholds are shared across classes
+            precision, recall, thresholds = jax.vmap(histogram_pr_curve)(self.hist_pos, self.hist_neg)
+            return precision, recall, thresholds[0]
         return histogram_pr_curve(self.hist_pos, self.hist_neg)
 
 
@@ -116,4 +176,6 @@ class BinnedAveragePrecision(_BinnedScoreMetric):
     """
 
     def compute(self) -> jax.Array:
+        if self._is_multiclass:
+            return self._ovr_scores(histogram_average_precision)
         return histogram_average_precision(self.hist_pos, self.hist_neg)

@@ -144,3 +144,102 @@ def test_binned_pr_curve_ddp_sync():
             assert np.allclose(np.asarray(got), np.asarray(want), atol=1e-6)
 
     run_virtual_ddp(2, worker)
+
+
+def test_binned_auroc_multiclass_ovr_exact_on_quantized():
+    """With scores quantized to bin lower edges, binned OvR AUROC equals
+    sklearn's exact per-class value."""
+    from sklearn.metrics import roc_auc_score
+
+    from metrics_tpu import BinnedAUROC
+
+    num_bins = 64
+    rng = np.random.RandomState(11)
+    probs = (np.floor(rng.rand(1024, 4) * num_bins) / num_bins).astype(np.float32)
+    target = rng.randint(4, size=1024).astype(np.int32)
+
+    m = BinnedAUROC(num_bins=num_bins, num_classes=4, average=None)
+    m.update(jnp.asarray(probs[:512]), jnp.asarray(target[:512]))
+    m.update(jnp.asarray(probs[512:]), jnp.asarray(target[512:]))
+    per_class = np.asarray(m.compute())
+    assert per_class.shape == (4,)
+    for c in range(4):
+        want = roc_auc_score((target == c).astype(int), probs[:, c])
+        assert np.allclose(per_class[c], want, atol=1e-6), c
+
+    macro = BinnedAUROC(num_bins=num_bins, num_classes=4, average="macro")
+    macro.update(jnp.asarray(probs), jnp.asarray(target))
+    assert np.allclose(float(macro.compute()), per_class.mean(), atol=1e-6)
+
+    weighted = BinnedAUROC(num_bins=num_bins, num_classes=4, average="weighted")
+    weighted.update(jnp.asarray(probs), jnp.asarray(target))
+    support = np.bincount(target, minlength=4)
+    assert np.allclose(
+        float(weighted.compute()), float(np.sum(per_class * support / support.sum())), atol=1e-6
+    )
+
+
+def test_binned_ap_multiclass_and_pr_curve_shapes():
+    from sklearn.metrics import average_precision_score
+
+    from metrics_tpu import BinnedAveragePrecision, BinnedPrecisionRecallCurve
+
+    num_bins = 64
+    rng = np.random.RandomState(13)
+    probs = (np.floor(rng.rand(512, 3) * num_bins) / num_bins).astype(np.float32)
+    target = rng.randint(3, size=512).astype(np.int32)
+
+    m = BinnedAveragePrecision(num_bins=num_bins, num_classes=3, average=None)
+    m.update(jnp.asarray(probs), jnp.asarray(target))
+    per_class = np.asarray(m.compute())
+    for c in range(3):
+        want = average_precision_score((target == c).astype(int), probs[:, c])
+        assert np.allclose(per_class[c], want, atol=1e-6), c
+
+    curve = BinnedPrecisionRecallCurve(num_bins=num_bins, num_classes=3)
+    curve.update(jnp.asarray(probs), jnp.asarray(target))
+    precision, recall, thresholds = curve.compute()
+    assert precision.shape == (3, num_bins + 1)
+    assert recall.shape == (3, num_bins + 1)
+    assert thresholds.shape == (num_bins + 1,)
+
+
+def test_binned_multiclass_validation():
+    import pytest
+
+    from metrics_tpu import BinnedAUROC
+
+    m = BinnedAUROC(num_bins=8, num_classes=3)
+    probs = jnp.asarray(np.full((4, 3), 1 / 3, np.float32))
+    with pytest.raises(ValueError, match="target labels"):
+        m.update(probs, jnp.asarray([0, 1, 2, 5]))
+    with pytest.raises(ValueError, match="shape"):
+        m.update(probs, jnp.asarray([[0, 1], [1, 0]]))
+    # absent class fails loudly under averaging
+    m.update(probs, jnp.asarray([0, 0, 1, 1]))
+    with pytest.raises(ValueError, match="never occurred"):
+        m.compute()
+
+
+def test_binned_multiclass_ddp_sync():
+    """(C, num_bins) histogram states psum across virtual ranks."""
+    from metrics_tpu import BinnedAUROC
+    from tests.helpers.testers import run_virtual_ddp
+
+    num_bins = 32
+    rng = np.random.RandomState(17)
+    probs = (np.floor(rng.rand(4, 64, 3) * num_bins) / num_bins).astype(np.float32)
+    target = rng.randint(3, size=(4, 64))
+
+    single = BinnedAUROC(num_bins=num_bins, num_classes=3, average="macro")
+    for i in range(4):
+        single.update(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+    expected = float(single.compute())
+
+    def worker(rank, world):
+        m = BinnedAUROC(num_bins=num_bins, num_classes=3, average="macro")
+        for i in range(rank, 4, world):
+            m.update(jnp.asarray(probs[i]), jnp.asarray(target[i]))
+        assert np.allclose(float(m.compute()), expected, atol=1e-6)
+
+    run_virtual_ddp(2, worker)
