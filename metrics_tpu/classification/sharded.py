@@ -297,17 +297,29 @@ class ShardedROC(ShardedCurveMetric):
         >>> fpr, tpr, thresholds = m.compute()
         >>> fpr.shape == tpr.shape
         True
+
+    ``num_classes=C`` accepts ``(N, C)`` score rows with integer labels and
+    returns per-class curve lists, like the replicated :class:`ROC`.
     """
 
-    def __init__(self, capacity_per_device: int, pos_label: int = 1, **kwargs: Any):
-        super().__init__(capacity_per_device, **kwargs)
+    def __init__(
+        self, capacity_per_device: int, pos_label: int = 1, num_classes: Optional[int] = None, **kwargs: Any
+    ):
+        suffix = () if num_classes in (None, 1) else (num_classes,)
+        super().__init__(capacity_per_device, preds_suffix=suffix, **kwargs)
         self.pos_label = pos_label
+        self.num_classes = num_classes
 
     def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
         from metrics_tpu.functional.classification.roc import _roc_compute
 
         preds, target = self._valid_host()
-        return _roc_compute(jnp.asarray(preds), jnp.asarray(target), num_classes=1, pos_label=self.pos_label)
+        return _roc_compute(
+            jnp.asarray(preds),
+            jnp.asarray(target),
+            num_classes=self.num_classes or 1,
+            pos_label=self.pos_label,
+        )
 
 
 class ShardedPrecisionRecallCurve(ShardedCurveMetric):
@@ -321,11 +333,19 @@ class ShardedPrecisionRecallCurve(ShardedCurveMetric):
         >>> precision, recall, thresholds = m.compute()
         >>> bool(jnp.all(recall[:-1] >= recall[1:]))  # recall is non-increasing
         True
+
+    ``num_classes=C`` accepts ``(N, C)`` score rows with integer labels and
+    returns per-class curve lists, like the replicated
+    :class:`PrecisionRecallCurve`.
     """
 
-    def __init__(self, capacity_per_device: int, pos_label: int = 1, **kwargs: Any):
-        super().__init__(capacity_per_device, **kwargs)
+    def __init__(
+        self, capacity_per_device: int, pos_label: int = 1, num_classes: Optional[int] = None, **kwargs: Any
+    ):
+        suffix = () if num_classes in (None, 1) else (num_classes,)
+        super().__init__(capacity_per_device, preds_suffix=suffix, **kwargs)
         self.pos_label = pos_label
+        self.num_classes = num_classes
 
     def compute(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
         from metrics_tpu.functional.classification.precision_recall_curve import (
@@ -334,5 +354,8 @@ class ShardedPrecisionRecallCurve(ShardedCurveMetric):
 
         preds, target = self._valid_host()
         return _precision_recall_curve_compute(
-            jnp.asarray(preds), jnp.asarray(target), num_classes=1, pos_label=self.pos_label
+            jnp.asarray(preds),
+            jnp.asarray(target),
+            num_classes=self.num_classes or 1,
+            pos_label=self.pos_label,
         )

@@ -182,6 +182,31 @@ def test_sharded_prc_matches_replicated_class():
     assert np.allclose(np.asarray(thresholds), np.asarray(ref_t), atol=1e-6)
 
 
+def test_sharded_roc_and_prc_multiclass_match_replicated():
+    from metrics_tpu import ROC, PrecisionRecallCurve
+
+    rng = np.random.RandomState(61)
+    probs = rng.rand(256, 3).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    target = rng.randint(3, size=256).astype(np.int32)
+
+    sharded_roc = ShardedROC(capacity_per_device=32, num_classes=3)
+    repl_roc = ROC(num_classes=3)
+    sharded_roc.update(jnp.asarray(probs), jnp.asarray(target))
+    repl_roc.update(jnp.asarray(probs), jnp.asarray(target))
+    for got, want in zip(sharded_roc.compute(), repl_roc.compute()):
+        for g, w in zip(got, want):  # per-class lists
+            assert np.allclose(np.asarray(g), np.asarray(w), atol=1e-6)
+
+    sharded_prc = ShardedPrecisionRecallCurve(capacity_per_device=32, num_classes=3)
+    repl_prc = PrecisionRecallCurve(num_classes=3)
+    sharded_prc.update(jnp.asarray(probs), jnp.asarray(target))
+    repl_prc.update(jnp.asarray(probs), jnp.asarray(target))
+    for got, want in zip(sharded_prc.compute(), repl_prc.compute()):
+        for g, w in zip(got, want):
+            assert np.allclose(np.asarray(g), np.asarray(w), atol=1e-6)
+
+
 def test_checkpoint_roundtrip_restores_sharding_and_fill():
     preds, target = _stream(128, seed=8)
     m = ShardedAUROC(capacity_per_device=32)
