@@ -473,6 +473,18 @@ def test_sharded_ap_multiclass_weighted_matches_manual():
     assert np.allclose(float(m.compute()), want, atol=1e-5)
 
 
+def test_bf16_preds_buffer_quantizes_scores():
+    """preds_dtype=bfloat16 halves buffer memory/bandwidth; the value is the
+    exact AUROC of the bf16-quantized scores."""
+    preds, target = _stream(512, seed=29)
+    m = ShardedAUROC(capacity_per_device=64, preds_dtype=jnp.bfloat16)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    assert m.buf_preds.dtype == jnp.bfloat16
+    quantized = np.asarray(jnp.asarray(preds).astype(jnp.bfloat16).astype(jnp.float32))
+    want = roc_auc_score(target, quantized)
+    assert np.allclose(float(m.compute()), want, atol=1e-6)
+
+
 def test_degenerate_single_class_is_nan():
     m = ShardedAUROC(capacity_per_device=8)
     m.update(jnp.asarray(np.linspace(0, 1, 16, dtype=np.float32)), jnp.zeros(16, jnp.int32))
