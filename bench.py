@@ -216,6 +216,8 @@ def main() -> None:
         assert abs(jax_acc - ref_acc) < 1e-4, (jax_acc, ref_acc)
         assert abs(jax_auroc - ref_auroc) < 1e-3, (jax_auroc, ref_auroc)
 
+    import jax
+
     print(
         json.dumps(
             {
@@ -227,6 +229,7 @@ def main() -> None:
                 # collective; this leg (8-virtual-device CPU mesh, sharded
                 # state + all_gather) does, and is reported separately
                 "sync_8dev_cpu_ms": sync_ms,
+                "platform": jax.default_backend(),
             }
         )
     )
