@@ -473,6 +473,20 @@ def test_sharded_ap_multiclass_weighted_matches_manual():
     assert np.allclose(float(m.compute()), want, atol=1e-5)
 
 
+def test_astype_preserves_sharding_mid_accumulation():
+    """metric.bfloat16() after updates must keep the buffer sharded over the
+    mesh and yield the exact AUROC of the bf16-quantized scores."""
+    preds, target = _stream(64, seed=31)
+    m = ShardedAUROC(capacity_per_device=16)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    m.bfloat16()
+    assert m.buf_preds.dtype == jnp.bfloat16
+    assert not m.buf_preds.sharding.is_fully_replicated
+    assert len(m.buf_preds.addressable_shards) == WORLD
+    quantized = np.asarray(jnp.asarray(preds).astype(jnp.bfloat16).astype(jnp.float32))
+    assert np.allclose(float(m.compute()), roc_auc_score(target, quantized), atol=1e-6)
+
+
 def test_bf16_preds_buffer_quantizes_scores():
     """preds_dtype=bfloat16 halves buffer memory/bandwidth; the value is the
     exact AUROC of the bf16-quantized scores."""
