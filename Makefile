@@ -1,0 +1,24 @@
+.PHONY: test doctest bench dryrun clean
+
+test:
+	# full suite: sklearn/scipy oracles + package doctests + 8-virtual-device
+	# collective tests (tests/conftest.py provisions the mesh)
+	python -m pytest tests/ -q
+
+doctest:
+	# standalone doctest run (the default `make test` already includes these
+	# via tests/test_doctests.py)
+	python -m pytest --doctest-modules metrics_tpu -q
+
+bench:
+	# north-star benchmark; prints one JSON line (real TPU when available)
+	python bench.py
+
+dryrun:
+	# multi-chip sharded eval step on an 8-device mesh (self-provisions a
+	# virtual CPU mesh when fewer devices exist)
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+clean:
+	rm -rf .pytest_cache .jax_cache
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +
