@@ -308,8 +308,18 @@ class Metric(ABC):
         return self
 
     def bfloat16(self) -> "Metric":
-        """Shorthand for ``astype(jnp.bfloat16)`` (reference ``.half()`` analog)."""
+        """Shorthand for ``astype(jnp.bfloat16)`` (reference ``.half()`` analog;
+        bf16 is the TPU-native half precision)."""
         return self.astype(jnp.bfloat16)
+
+    def float16(self) -> "Metric":
+        """Shorthand for ``astype(jnp.float16)``."""
+        return self.astype(jnp.float16)
+
+    def half(self) -> "Metric":
+        """Reference-spelling alias (``metric.py:280-297`` ``.half()``);
+        maps to bfloat16, the TPU-native half precision."""
+        return self.bfloat16()
 
     def float(self) -> "Metric":
         """Shorthand for ``astype(jnp.float32)`` (reference ``.float()`` analog)."""

@@ -164,3 +164,19 @@ def test_collection_state_dict_roundtrip():
     a, b = col.compute(), col2.compute()
     for k in a:
         assert float(a[k]) == float(b[k])
+
+
+def test_half_and_float16_shortcuts():
+    """Reference-spelling `.half()` maps to bfloat16 (TPU-native half);
+    `.float16()` gives IEEE fp16 when explicitly wanted."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import MeanSquaredError
+
+    m = MeanSquaredError()
+    m.half()
+    assert m.sum_squared_error.dtype == jnp.bfloat16
+    m.float16()
+    assert m.sum_squared_error.dtype == jnp.float16
+    m.float()
+    assert m.sum_squared_error.dtype == jnp.float32
