@@ -151,6 +151,13 @@ class MetricCollection:
     def bfloat16(self) -> "MetricCollection":
         return self.astype(jnp.bfloat16)
 
+    def float16(self) -> "MetricCollection":
+        return self.astype(jnp.float16)
+
+    def half(self) -> "MetricCollection":
+        """Reference-spelling alias; maps to bfloat16 (TPU-native half)."""
+        return self.bfloat16()
+
     def float(self) -> "MetricCollection":
         return self.astype(jnp.float32)
 
