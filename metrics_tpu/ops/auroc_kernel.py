@@ -18,9 +18,13 @@ f32 keys (+7% TPU / +12% CPU), a third co-sorted operand (+20%).
 """
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
-_SIGN = jnp.uint32(1 << 31)
+# numpy scalar, NOT jnp: a module-level jnp constant would initialize the
+# device backend at import time (observed hanging the whole package import
+# when the remote-TPU tunnel was unreachable)
+_SIGN = np.uint32(1 << 31)
 
 
 def _descending_key(preds: jax.Array) -> jax.Array:

@@ -1,0 +1,26 @@
+"""Importing the package must not initialize any device backend.
+
+A module-level ``jnp`` constant once made ``import metrics_tpu`` dial the
+remote-TPU tunnel (and hang when it was unreachable). Import must stay
+device-free: backends initialize lazily at first array use.
+"""
+import subprocess
+import sys
+
+
+def test_package_import_initializes_no_backend():
+    code = (
+        "import metrics_tpu\n"
+        "from jax._src import xla_bridge\n"
+        "assert not xla_bridge._backends, list(xla_bridge._backends)\n"
+        "print('CLEAN')\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd="/root/repo",
+    )
+    assert proc.returncode == 0, proc.stderr[-1000:]
+    assert "CLEAN" in proc.stdout
