@@ -28,7 +28,16 @@ def _timed(f) -> float:
 
 
 def _bench_jax() -> float:
+    import os
+
     import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # the site hook pins the remote accelerator via jax.config; restore
+        # CPU while backends are uninitialized (fallback when the tunnel is
+        # unreachable — see main())
+        jax.config.update("jax_platforms", "cpu")
+
     import jax.numpy as jnp
 
     from metrics_tpu.ops.auroc_kernel import binary_auroc
@@ -73,12 +82,13 @@ def _bench_jax() -> float:
         return time.perf_counter() - t0
 
     chained(3)  # warm any per-shape dispatch paths
-    k = REPEATS
+    k = int(os.environ.get("BENCH_REPEATS", REPEATS))
+    platform = jax.default_backend()
     for _ in range(4):
         totals = sorted(chained(k) for _ in range(3))
         per_step = (totals[1] - rtt) / k
         if per_step * k > 2 * rtt and per_step > 1e-5:
-            return per_step, acc_f, auroc_f
+            return per_step, acc_f, auroc_f, platform
         k *= 4  # compute still hiding under the RTT: lengthen the chain
 
     # fallback: the whole repeat loop on-device in one program (excludes
@@ -102,7 +112,7 @@ def _bench_jax() -> float:
             f"could not resolve per-step time above the host RTT ({rtt * 1e3:.1f} ms)"
         )
     print("WARNING: chained-dispatch timing unresolvable; on-device fori_loop fallback", file=sys.stderr)
-    return per_step, acc_f, auroc_f
+    return per_step, acc_f, auroc_f, platform
 
 
 def _bench_reference() -> float:
@@ -194,8 +204,50 @@ print("SYNC_MS", min(times) * 1e3)
     raise RuntimeError("sync leg produced no timing")
 
 
+def _run_jax_leg_isolated() -> tuple:
+    """Run the accelerator leg in a subprocess with a hard timeout.
+
+    The remote-TPU tunnel can hang indefinitely (observed); an in-process
+    hang would lose the whole bench. On timeout/failure, fall back to a
+    CPU-forced subprocess so a (platform-labeled) number always exists.
+    """
+    import os
+    import subprocess
+
+    here = os.path.abspath(__file__)
+
+    def attempt(extra_env, timeout):
+        env = dict(os.environ, **extra_env)
+        proc = subprocess.run(
+            [sys.executable, here, "--leg-jax"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            env=env,
+            cwd=os.path.dirname(here),
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(proc.stderr[-800:])
+        for line in proc.stdout.splitlines():
+            if line.startswith("JAXLEG "):
+                _, per_step, acc, auroc, platform = line.split()
+                return float(per_step), float(acc), float(auroc), platform
+        raise RuntimeError(f"no JAXLEG line in output: {proc.stdout[-400:]}")
+
+    try:
+        return attempt({}, timeout=480)
+    except Exception as err:
+        print(f"WARNING: accelerator leg failed ({err!r}); falling back to CPU", file=sys.stderr)
+        return attempt({"BENCH_FORCE_CPU": "1", "BENCH_REPEATS": "3"}, timeout=480)
+
+
 def main() -> None:
-    jax_time, jax_acc, jax_auroc = _bench_jax()
+    if "--leg-jax" in sys.argv:
+        per_step, acc, auroc, platform = _bench_jax()
+        print(f"JAXLEG {per_step} {acc} {auroc} {platform}")
+        return
+
+    jax_time, jax_acc, jax_auroc, platform = _run_jax_leg_isolated()
     try:
         ref_time, ref_acc, ref_auroc = _bench_reference()
     except Exception as err:
@@ -216,8 +268,6 @@ def main() -> None:
         assert abs(jax_acc - ref_acc) < 1e-4, (jax_acc, ref_acc)
         assert abs(jax_auroc - ref_auroc) < 1e-3, (jax_auroc, ref_auroc)
 
-    import jax
-
     print(
         json.dumps(
             {
@@ -229,7 +279,7 @@ def main() -> None:
                 # collective; this leg (8-virtual-device CPU mesh, sharded
                 # state + all_gather) does, and is reported separately
                 "sync_8dev_cpu_ms": sync_ms,
-                "platform": jax.default_backend(),
+                "platform": platform,
             }
         )
     )
