@@ -1,0 +1,48 @@
+"""Regenerate docs/api.md from the live package (run from the repo root)."""
+import inspect
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import metrics_tpu
+import metrics_tpu.functional as F
+import metrics_tpu.parallel as P
+
+
+def _classes(module):
+    for name in sorted(dir(module)):
+        obj = getattr(module, name)
+        if inspect.isclass(obj) and not name.startswith("_"):
+            yield name, (inspect.getdoc(obj) or "").split("\n")[0]
+
+
+def _functions(module):
+    for name in sorted(dir(module)):
+        obj = getattr(module, name)
+        if inspect.isfunction(obj) and not name.startswith("_"):
+            yield name, (inspect.getdoc(obj) or "").split("\n")[0]
+
+
+def main() -> None:
+    lines = ["# API reference", "", "Generated from the live package (`python docs/_gen_api.py`).", ""]
+    lines += ["## Module metrics (`metrics_tpu`)", ""]
+    lines += [f"- **`{n}`** — {d}" for n, d in _classes(metrics_tpu)]
+    lines += ["", "## Functional metrics (`metrics_tpu.functional`)", ""]
+    lines += [f"- **`{n}`** — {d}" for n, d in _functions(F)]
+    lines += ["", "## Distributed primitives (`metrics_tpu.parallel`)", ""]
+    lines += [f"- **`{n}`** — {d}" for n, d in _classes(P)]
+    lines += [f"- **`{n}`** — {d}" for n, d in _functions(P)]
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "api.md")
+    with open(out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()
