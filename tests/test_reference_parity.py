@@ -309,3 +309,94 @@ def test_metric_collection_matches_reference(reference):
             _close(got[key], want[key])
     finally:
         sys.path.remove("/root/reference")
+
+
+def test_dice_and_auc_and_mre_match_reference(reference):
+    from metrics_tpu.functional import auc, dice_score, mean_relative_error
+
+    probs, target = _multiclass(n=128, seed=31)
+    _close(
+        dice_score(jnp.asarray(probs), jnp.asarray(target)),
+        reference.dice_score(_torch(probs), _torch(target)),
+    )
+
+    x = np.sort(np.random.RandomState(32).rand(64).astype(np.float32))
+    y = np.random.RandomState(33).rand(64).astype(np.float32)
+    _close(auc(jnp.asarray(x), jnp.asarray(y)), reference.auc(_torch(x), _torch(y)))
+
+    rng = np.random.RandomState(34)
+    p = rng.rand(128).astype(np.float32) + 0.5
+    t = rng.rand(128).astype(np.float32) + 0.5
+    _close(
+        mean_relative_error(jnp.asarray(p), jnp.asarray(t)),
+        reference.mean_relative_error(_torch(p), _torch(t)),
+    )
+
+
+def test_image_gradients_match_reference(reference):
+    from metrics_tpu.functional import image_gradients
+
+    rng = np.random.RandomState(35)
+    img = rng.rand(2, 3, 16, 16).astype(np.float32)
+    dy_ours, dx_ours = image_gradients(jnp.asarray(img))
+    dy_ref, dx_ref = reference.image_gradients(_torch(img))
+    _close(dy_ours, dy_ref)
+    _close(dx_ours, dx_ref)
+
+
+def test_accuracy_topk_threshold_match_reference(reference):
+    from metrics_tpu.functional import accuracy
+
+    probs, target = _multiclass(n=256, seed=36)
+    _close(
+        accuracy(jnp.asarray(probs), jnp.asarray(target), top_k=2),
+        reference.accuracy(_torch(probs), _torch(target), top_k=2),
+    )
+    preds_b, target_b = _binary(n=256, seed=37)
+    _close(
+        accuracy(jnp.asarray(preds_b), jnp.asarray(target_b), threshold=0.3),
+        reference.accuracy(_torch(preds_b), _torch(target_b), threshold=0.3),
+    )
+
+
+@pytest.mark.parametrize("reduce_", ["micro", "macro", "samples"])
+def test_stat_scores_reduce_modes_match_reference(reference, reduce_):
+    from metrics_tpu.functional import stat_scores
+
+    probs, target = _multiclass(n=128, seed=38)
+    ours = stat_scores(jnp.asarray(probs), jnp.asarray(target), reduce=reduce_, num_classes=5)
+    theirs = reference.stat_scores(_torch(probs), _torch(target), reduce=reduce_, num_classes=5)
+    _close(ours, theirs)
+
+
+def test_psnr_data_range_matches_reference(reference):
+    from metrics_tpu.functional import psnr
+
+    rng = np.random.RandomState(39)
+    p = (rng.rand(128) * 255).astype(np.float32)
+    t = (rng.rand(128) * 255).astype(np.float32)
+    _close(
+        psnr(jnp.asarray(p), jnp.asarray(t), data_range=255.0),
+        reference.psnr(_torch(p), _torch(t), data_range=255.0),
+        atol=1e-3,
+    )
+
+
+def test_multilabel_f1_matches_reference(reference):
+    from metrics_tpu.functional import f1
+
+    rng = np.random.RandomState(40)
+    probs = rng.rand(128, 4).astype(np.float32)
+    target = rng.randint(2, size=(128, 4))
+    ours = f1(jnp.asarray(probs), jnp.asarray(target), num_classes=4, average="macro", is_multiclass=False)
+    theirs = reference.f1(_torch(probs), _torch(target), num_classes=4, average="macro", is_multiclass=False)
+    _close(ours, theirs)
+
+
+def test_multiclass_auroc_matches_reference(reference):
+    from metrics_tpu.functional import auroc
+
+    probs, target = _multiclass(n=256, c=4, seed=41)
+    ours = auroc(jnp.asarray(probs), jnp.asarray(target), num_classes=4, average="macro")
+    theirs = reference.auroc(_torch(probs), _torch(target), num_classes=4, average="macro")
+    _close(ours, theirs)
