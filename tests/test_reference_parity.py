@@ -400,3 +400,56 @@ def test_multiclass_auroc_matches_reference(reference):
     ours = auroc(jnp.asarray(probs), jnp.asarray(target), num_classes=4, average="macro")
     theirs = reference.auroc(_torch(probs), _torch(target), num_classes=4, average="macro")
     _close(ours, theirs)
+
+
+def test_input_canonicalizer_matches_reference(reference):
+    """L3 parity: `_input_format_classification` produces the same canonical
+    (preds, target, case) as the reference across the input-case taxonomy,
+    including threshold / top_k / is_multiclass options."""
+    from metrics_tpu.utilities.checks import _input_format_classification
+
+    sys.path.insert(0, "/root/reference")
+    try:
+        from torchmetrics.utilities.checks import (
+            _input_format_classification as ref_canon,
+        )
+
+        rng = np.random.RandomState(50)
+        n, c, x = 40, 4, 3
+        cases = [
+            # (preds, target, kwargs)
+            (rng.randint(2, size=n), rng.randint(2, size=n), {}),  # binary labels
+            (rng.rand(n).astype(np.float32), rng.randint(2, size=n), {}),  # binary probs
+            (rng.rand(n).astype(np.float32), rng.randint(2, size=n), {"threshold": 0.3}),
+            (rng.rand(n, c).astype(np.float32), rng.randint(2, size=(n, c)), {}),  # multilabel probs
+            (rng.randint(c, size=n), rng.randint(c, size=n), {}),  # multiclass labels
+            (_softmax(rng.rand(n, c)), rng.randint(c, size=n), {}),  # multiclass probs
+            (_softmax(rng.rand(n, c)), rng.randint(c, size=n), {"top_k": 2}),
+            (rng.randint(c, size=(n, x)), rng.randint(c, size=(n, x)), {}),  # mdmc labels
+            (_softmax_axis1(rng.rand(n, c, x)), rng.randint(c, size=(n, x)), {}),  # mdmc probs
+            (rng.randint(2, size=n), rng.randint(2, size=n), {"is_multiclass": True}),
+        ]
+        import torch
+
+        for i, (preds, target, kwargs) in enumerate(cases):
+            ours_p, ours_t, ours_case = _input_format_classification(
+                jnp.asarray(preds), jnp.asarray(target), **kwargs
+            )
+            ref_p, ref_t, ref_case = ref_canon(
+                torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), **kwargs
+            )
+            assert str(ours_case) == str(ref_case), (i, ours_case, ref_case)
+            assert np.array_equal(np.asarray(ours_p), ref_p.numpy()), i
+            assert np.array_equal(np.asarray(ours_t), ref_t.numpy()), i
+    finally:
+        sys.path.remove("/root/reference")
+
+
+def _softmax(a):
+    e = np.exp(a)
+    return (e / e.sum(1, keepdims=True)).astype(np.float32)
+
+
+def _softmax_axis1(a):
+    e = np.exp(a)
+    return (e / e.sum(1, keepdims=True)).astype(np.float32)
