@@ -453,3 +453,43 @@ def _softmax(a):
 def _softmax_axis1(a):
     e = np.exp(a)
     return (e / e.sum(1, keepdims=True)).astype(np.float32)
+
+
+def test_error_messages_match_reference(reference):
+    """Invalid inputs raise the same error messages as the reference."""
+    from metrics_tpu.functional import accuracy, confusion_matrix
+    from metrics_tpu.utilities.checks import _input_format_classification
+
+    sys.path.insert(0, "/root/reference")
+    try:
+        import torch
+        from torchmetrics.utilities.checks import (
+            _input_format_classification as ref_canon,
+        )
+
+        rng = np.random.RandomState(51)
+        bad_cases = [
+            # preds floats out of [0,1]
+            (rng.randn(16).astype(np.float32) * 5, rng.randint(2, size=16), {}),
+            # shape mismatch
+            (rng.rand(16).astype(np.float32), rng.randint(2, size=8), {}),
+            # non-binary target values with float preds
+            (rng.rand(16).astype(np.float32), rng.randint(5, size=16), {}),
+            # bad threshold
+            (rng.rand(16).astype(np.float32), rng.randint(2, size=16), {"threshold": 1.5}),
+        ]
+        for i, (preds, target, kwargs) in enumerate(bad_cases):
+            try:
+                ref_canon(torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), **kwargs)
+                ref_err = None
+            except ValueError as err:
+                ref_err = str(err)
+            try:
+                _input_format_classification(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+                ours_err = None
+            except ValueError as err:
+                ours_err = str(err)
+            assert ref_err is not None, f"case {i}: reference accepted this input"
+            assert ours_err == ref_err, (i, ours_err, ref_err)
+    finally:
+        sys.path.remove("/root/reference")
