@@ -234,8 +234,9 @@ def _run_jax_leg_isolated() -> tuple:
                 return float(per_step), float(acc), float(auroc), platform
         raise RuntimeError(f"no JAXLEG line in output: {proc.stdout[-400:]}")
 
+    primary_timeout = float(os.environ.get("BENCH_JAX_TIMEOUT", 480))
     try:
-        return attempt({}, timeout=480)
+        return attempt({}, timeout=primary_timeout)
     except Exception as err:
         print(f"WARNING: accelerator leg failed ({err!r}); falling back to CPU", file=sys.stderr)
         return attempt({"BENCH_FORCE_CPU": "1", "BENCH_REPEATS": "3"}, timeout=480)
@@ -268,21 +269,34 @@ def main() -> None:
         assert abs(jax_acc - ref_acc) < 1e-4, (jax_acc, ref_acc)
         assert abs(jax_auroc - ref_auroc) < 1e-3, (jax_auroc, ref_auroc)
 
-    print(
-        json.dumps(
-            {
-                "metric": "metric update+compute wall-clock/step (Accuracy+AUROC, 1M preds, single chip)",
-                "value": round(value_ms, 3),
-                "unit": "ms",
-                "vs_baseline": vs_baseline,
-                # honest labeling: the single-chip number contains no
-                # collective; this leg (8-virtual-device CPU mesh, sharded
-                # state + all_gather) does, and is reported separately
-                "sync_8dev_cpu_ms": sync_ms,
-                "platform": platform,
-            }
-        )
-    )
+    result = {
+        "metric": "metric update+compute wall-clock/step (Accuracy+AUROC, 1M preds, single chip)",
+        "value": round(value_ms, 3),
+        "unit": "ms",
+        "vs_baseline": vs_baseline,
+        # honest labeling: the single-chip number contains no
+        # collective; this leg (8-virtual-device CPU mesh, sharded
+        # state + all_gather) does, and is reported separately
+        "sync_8dev_cpu_ms": sync_ms,
+        "platform": platform,
+    }
+
+    import os
+
+    last_good_path = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_last_good.json")
+    if platform != "cpu":
+        with open(last_good_path, "w") as f:
+            json.dump(dict(result, measured_at=time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())), f)
+    else:
+        # accelerator unreachable this run: cite the most recent successful
+        # accelerator measurement, clearly labeled as such
+        try:
+            with open(last_good_path) as f:
+                result["last_good_accelerator"] = json.load(f)
+        except Exception:
+            pass
+
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":
