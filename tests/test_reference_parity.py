@@ -493,3 +493,78 @@ def test_error_messages_match_reference(reference):
             assert ours_err == ref_err, (i, ours_err, ref_err)
     finally:
         sys.path.remove("/root/reference")
+
+
+def test_all_arithmetic_operators_match_reference(reference):
+    """All CompositionalMetric operators (forward, reflected, unary) produce
+    the reference's values on constant-valued metrics."""
+    import operator
+
+    import torch
+
+    import metrics_tpu
+
+    sys.path.insert(0, "/root/reference")
+    try:
+        import torchmetrics
+
+        def ours_const(v):
+            class _C(metrics_tpu.Metric):
+                def update(self):
+                    pass
+
+                def compute(self):
+                    return jnp.asarray(v, jnp.float32)
+
+            return _C()
+
+        def ref_const(v):
+            class _C(torchmetrics.Metric):
+                def update(self):
+                    pass
+
+                def compute(self):
+                    return torch.tensor(float(v))
+
+            return _C()
+
+        binary_ops = [
+            operator.add, operator.sub, operator.mul, operator.truediv,
+            operator.floordiv, operator.mod, operator.pow,
+            operator.eq, operator.ne, operator.lt, operator.le, operator.gt, operator.ge,
+        ]
+        for op in binary_ops:
+            got = op(ours_const(5.0), ours_const(2.0)).compute()
+            want = op(ref_const(5.0), ref_const(2.0)).compute()
+            assert np.allclose(np.asarray(got, dtype=np.float32), want.numpy().astype(np.float32)), op
+            # metric-with-constant and reflected forms
+            got_c = op(ours_const(5.0), 2.0).compute()
+            want_c = op(ref_const(5.0), 2.0).compute()
+            assert np.allclose(np.asarray(got_c, dtype=np.float32), want_c.numpy().astype(np.float32)), op
+
+        for op in (operator.abs, operator.neg, operator.pos):
+            got = op(ours_const(-3.0)).compute()
+            want = op(ref_const(-3.0)).compute()
+            assert np.allclose(np.asarray(got, dtype=np.float32), want.numpy().astype(np.float32)), op
+
+        # integer-only ops
+        for op in (operator.and_, operator.or_, operator.xor):
+            class _CI(metrics_tpu.Metric):
+                def update(self):
+                    pass
+
+                def compute(self):
+                    return jnp.asarray(6, jnp.int32)
+
+            class _RI(torchmetrics.Metric):
+                def update(self):
+                    pass
+
+                def compute(self):
+                    return torch.tensor(6)
+
+            got = op(_CI(), 3).compute()
+            want = op(_RI(), 3).compute()
+            assert int(np.asarray(got)) == int(want), op
+    finally:
+        sys.path.remove("/root/reference")
