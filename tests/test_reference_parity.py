@@ -568,3 +568,38 @@ def test_all_arithmetic_operators_match_reference(reference):
             assert int(np.asarray(got)) == int(want), op
     finally:
         sys.path.remove("/root/reference")
+
+
+def test_multiclass_roc_lists_match_reference(reference):
+    from metrics_tpu.functional import roc
+
+    probs, target = _multiclass(n=128, c=4, seed=60)
+    ours = roc(jnp.asarray(probs), jnp.asarray(target), num_classes=4)
+    theirs = reference.roc(_torch(probs), _torch(target), num_classes=4)
+    for ours_list, ref_list in zip(ours, theirs):  # fpr/tpr/threshold lists
+        assert len(ours_list) == len(ref_list) == 4
+        for g, w in zip(ours_list, ref_list):
+            _close(g, w)
+
+
+def test_multilabel_auroc_matches_reference(reference):
+    from metrics_tpu.functional import auroc
+
+    rng = np.random.RandomState(61)
+    probs = rng.rand(256, 3).astype(np.float32)
+    target = rng.randint(2, size=(256, 3))
+    ours = auroc(jnp.asarray(probs), jnp.asarray(target), num_classes=3, average="macro")
+    theirs = reference.auroc(_torch(probs), _torch(target), num_classes=3, average="macro")
+    _close(ours, theirs)
+
+
+def test_multiclass_hinge_variants_match_reference(reference):
+    from metrics_tpu.functional import hinge
+
+    rng = np.random.RandomState(62)
+    logits = rng.randn(128, 4).astype(np.float32)
+    target = rng.randint(4, size=128)
+    for kwargs in ({}, {"squared": True}, {"multiclass_mode": "one-vs-all"}):
+        ours = hinge(jnp.asarray(logits), jnp.asarray(target), **kwargs)
+        theirs = reference.hinge(_torch(logits), _torch(target), **kwargs)
+        _close(ours, theirs, atol=1e-4)
