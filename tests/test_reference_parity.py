@@ -603,3 +603,19 @@ def test_multiclass_hinge_variants_match_reference(reference):
         ours = hinge(jnp.asarray(logits), jnp.asarray(target), **kwargs)
         theirs = reference.hinge(_torch(logits), _torch(target), **kwargs)
         _close(ours, theirs, atol=1e-4)
+
+
+@pytest.mark.parametrize("mdmc_reduce", ["global", "samplewise"])
+@pytest.mark.parametrize("ignore_index", [None, 0])
+def test_stat_scores_mdmc_and_ignore_match_reference(reference, mdmc_reduce, ignore_index):
+    """Multidim-multiclass reductions and ignore_index: the densest
+    stat_scores configuration surface."""
+    from metrics_tpu.functional import stat_scores
+
+    rng = np.random.RandomState(63)
+    preds = rng.randint(4, size=(32, 6)).astype(np.int64)
+    target = rng.randint(4, size=(32, 6)).astype(np.int64)
+    kwargs = dict(reduce="macro", mdmc_reduce=mdmc_reduce, num_classes=4, ignore_index=ignore_index)
+    ours = stat_scores(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+    theirs = reference.stat_scores(_torch(preds), _torch(target), **kwargs)
+    _close(ours, theirs)
