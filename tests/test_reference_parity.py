@@ -619,3 +619,29 @@ def test_stat_scores_mdmc_and_ignore_match_reference(reference, mdmc_reduce, ign
     ours = stat_scores(jnp.asarray(preds), jnp.asarray(target), **kwargs)
     theirs = reference.stat_scores(_torch(preds), _torch(target), **kwargs)
     _close(ours, theirs)
+
+
+def test_cohen_kappa_weights_match_reference(reference):
+    from metrics_tpu.functional import cohen_kappa
+
+    probs, target = _multiclass(n=256, seed=64)
+    for weights in (None, "linear", "quadratic"):
+        ours = cohen_kappa(jnp.asarray(probs), jnp.asarray(target), num_classes=5, weights=weights)
+        theirs = reference.cohen_kappa(_torch(probs), _torch(target), num_classes=5, weights=weights)
+        _close(ours, theirs, atol=1e-5)
+
+
+def test_psnr_dim_and_reduction_match_reference(reference):
+    from metrics_tpu.functional import psnr
+
+    rng = np.random.RandomState(65)
+    p = rng.rand(4, 3, 8, 8).astype(np.float32)
+    t = rng.rand(4, 3, 8, 8).astype(np.float32)
+    for kwargs in (
+        {"data_range": 1.0, "dim": (1, 2, 3)},
+        {"data_range": 1.0, "dim": (1, 2, 3), "reduction": "sum"},
+        {"data_range": 1.0, "base": 2.0},
+    ):
+        ours = psnr(jnp.asarray(p), jnp.asarray(t), **kwargs)
+        theirs = reference.psnr(_torch(p), _torch(t), **kwargs)
+        _close(ours, theirs, atol=1e-3)
