@@ -11,6 +11,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import jax.numpy as jnp
 
 from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.checks import shared_canonicalization
 
 
 class MetricCollection:
@@ -98,15 +99,23 @@ class MetricCollection:
         return self._metrics.items()
 
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
-        """Call forward for each metric; kwargs are filtered per metric signature."""
-        return {self._set_prefix(k): m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
+        """Call forward for each metric; kwargs are filtered per metric signature.
+
+        Sibling metrics with identical canonicalization options share one
+        input canonicalization (see
+        :func:`~metrics_tpu.utilities.checks.shared_canonicalization`)."""
+        with shared_canonicalization():
+            return {self._set_prefix(k): m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
 
     __call__ = forward
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Call update for each metric; kwargs are filtered per metric signature."""
-        for _, m in self.items():
-            m.update(*args, **m._filter_kwargs(**kwargs))
+        """Call update for each metric; kwargs are filtered per metric
+        signature. Canonicalization is shared across siblings (see
+        :meth:`forward`)."""
+        with shared_canonicalization():
+            for _, m in self.items():
+                m.update(*args, **m._filter_kwargs(**kwargs))
 
     def compute(self) -> Dict[str, Any]:
         return {self._set_prefix(k): m.compute() for k, m in self.items()}

@@ -21,6 +21,8 @@ XLA-first architecture:
 raises a clear error when traced, where the caller must supply
 ``num_classes``.
 """
+import threading
+from contextlib import contextmanager
 from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
@@ -360,6 +362,35 @@ def _canonicalize_jit(preds, target, p_shape, t_shape, case, threshold, top_k, n
     return preds.astype(jnp.int32), target.astype(jnp.int32)
 
 
+_canon_memo = threading.local()
+_CANON_MEMO_MAX = 64
+
+
+@contextmanager
+def shared_canonicalization():
+    """Share canonicalization across identical calls within this context.
+
+    :class:`~metrics_tpu.MetricCollection` wraps its fan-out in this: sibling
+    metrics with the same canonicalization options (e.g. Precision / Recall /
+    F1) then canonicalize the batch once instead of once each — measured 55%
+    of a 4-metric collection update at 1M preds was redundant
+    canonicalization. Results are memoized by input array identity plus the
+    full option tuple; the memo pins the input arrays so ids stay valid, and
+    dies with the context. Nested contexts share the outermost memo.
+
+    Scope it to ONE step (one batch), as ``MetricCollection`` does — the memo
+    pins every distinct input it sees, so wrapping a whole epoch loop would
+    grow memory with batch count (a safety cap evicts beyond
+    ``_CANON_MEMO_MAX`` entries, trading sharing for boundedness).
+    """
+    prev = getattr(_canon_memo, "store", None)
+    _canon_memo.store = {} if prev is None else prev
+    try:
+        yield
+    finally:
+        _canon_memo.store = prev
+
+
 def _input_format_classification(
     preds,
     target,
@@ -380,6 +411,15 @@ def _input_format_classification(
         target: binary int array of the same shape
         case: the detected :class:`DataType`
     """
+    store = getattr(_canon_memo, "store", None)
+    memo_key = memo_orig = None
+    if store is not None:
+        memo_key = (id(preds), id(target), threshold, top_k, num_classes, is_multiclass, _num_classes_hint)
+        hit = store.get(memo_key)
+        if hit is not None:
+            return hit[2]
+        memo_orig = (preds, target)  # pin originals so their ids stay valid
+
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
 
@@ -446,6 +486,10 @@ def _input_format_classification(
         num_classes=nc,
         is_multiclass=is_multiclass,
     )
+    if store is not None:
+        if len(store) >= _CANON_MEMO_MAX:
+            store.clear()  # mis-scoped context (e.g. a whole epoch): stay bounded
+        store[memo_key] = (*memo_orig, (preds_c, target_c, case))
     return preds_c, target_c, case
 
 
