@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.parallel.backend import is_distributed_initialized
+from metrics_tpu.utilities.checks import shared_canonicalization
 from metrics_tpu.utilities.data import (
     _flatten,
     apply_to_collection,
@@ -139,26 +140,32 @@ class Metric(ABC):
 
     def forward(self, *args: Any, **kwargs: Any):
         """Update state with the batch; return the batch-local value if
-        ``compute_on_step`` (reference ``metric.py:147-174``)."""
-        self.update(*args, **kwargs)
-        self._forward_cache = None
+        ``compute_on_step`` (reference ``metric.py:147-174``).
 
-        if self.compute_on_step:
-            self._to_sync = self.dist_sync_on_step
-
-            # save accumulated state, compute on this batch alone
-            cache = self._snapshot_state()
-
-            self.reset()
+        The reference's forward canonicalizes the inputs twice (two
+        ``update`` calls per batch, its ``metric.py:153,165``); sharing the
+        canonicalization across the two calls halves that hot-path cost
+        while preserving the double-update contract."""
+        with shared_canonicalization():
             self.update(*args, **kwargs)
-            self._forward_cache = self.compute()
+            self._forward_cache = None
 
-            # restore accumulated state
-            self._restore_state(cache)
-            self._to_sync = True
-            self._computed = None
+            if self.compute_on_step:
+                self._to_sync = self.dist_sync_on_step
 
-            return self._forward_cache
+                # save accumulated state, compute on this batch alone
+                cache = self._snapshot_state()
+
+                self.reset()
+                self.update(*args, **kwargs)
+                self._forward_cache = self.compute()
+
+                # restore accumulated state
+                self._restore_state(cache)
+                self._to_sync = True
+                self._computed = None
+
+                return self._forward_cache
 
     __call__ = forward
 
