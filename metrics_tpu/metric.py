@@ -518,10 +518,12 @@ class CompositionalMetric(Metric):
         pass
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        if isinstance(self.metric_a, Metric):
-            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
-        if isinstance(self.metric_b, Metric):
-            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+        # both operands see the same batch: share input canonicalization
+        with shared_canonicalization():
+            if isinstance(self.metric_a, Metric):
+                self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+            if isinstance(self.metric_b, Metric):
+                self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
 
     def compute(self) -> Any:
         val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
