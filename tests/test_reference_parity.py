@@ -645,3 +645,54 @@ def test_psnr_dim_and_reduction_match_reference(reference):
         ours = psnr(jnp.asarray(p), jnp.asarray(t), **kwargs)
         theirs = reference.psnr(_torch(p), _torch(t), **kwargs)
         _close(ours, theirs, atol=1e-3)
+
+
+def test_embedding_similarity_modes_match_reference(reference):
+    from metrics_tpu.functional import embedding_similarity
+
+    rng = np.random.RandomState(70)
+    emb = rng.rand(24, 8).astype(np.float32)
+    for kwargs in (
+        {"similarity": "cosine"},
+        {"similarity": "dot"},
+        {"reduction": "mean"},
+        {"reduction": "sum"},
+        {"zero_diagonal": False},
+    ):
+        ours = embedding_similarity(jnp.asarray(emb), **kwargs)
+        theirs = reference.embedding_similarity(_torch(emb), **kwargs)
+        _close(ours, theirs, atol=1e-4)
+
+
+def test_regression_multioutput_modes_match_reference(reference):
+    from metrics_tpu.functional import explained_variance, r2score
+
+    rng = np.random.RandomState(71)
+    p = rng.rand(128, 3).astype(np.float32)
+    t = rng.rand(128, 3).astype(np.float32)
+    for mo in ("uniform_average", "raw_values", "variance_weighted"):
+        _close(
+            explained_variance(jnp.asarray(p), jnp.asarray(t), multioutput=mo),
+            reference.explained_variance(_torch(p), _torch(t), multioutput=mo),
+            atol=1e-4,
+        )
+    for kwargs in ({"multioutput": "raw_values"}, {"adjusted": 5}):
+        _close(
+            r2score(jnp.asarray(p), jnp.asarray(t), **kwargs),
+            reference.r2score(_torch(p), _torch(t), **kwargs),
+            atol=1e-4,
+        )
+
+
+def test_bleu_variants_match_reference(reference):
+    from metrics_tpu.functional import bleu_score
+
+    translate = ["the cat is on the mat".split(), "a dog ran in the park".split()]
+    ref_corpus = [
+        ["the cat is on the mat".split()],
+        ["a dog runs in the park".split(), "the dog ran in a park".split()],
+    ]
+    for kwargs in ({"n_gram": 2}, {"n_gram": 4, "smooth": True}):
+        ours = bleu_score(translate, ref_corpus, **kwargs)
+        theirs = reference.bleu_score(translate, ref_corpus, **kwargs)
+        _close(ours, theirs, atol=1e-5)
