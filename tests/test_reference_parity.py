@@ -696,3 +696,23 @@ def test_bleu_variants_match_reference(reference):
         ours = bleu_score(translate, ref_corpus, **kwargs)
         theirs = reference.bleu_score(translate, ref_corpus, **kwargs)
         _close(ours, theirs, atol=1e-5)
+
+
+def test_auroc_max_fpr_matches_reference(reference):
+    from metrics_tpu.functional import auroc
+
+    preds, target = _binary(seed=72)
+    for max_fpr in (0.25, 0.5, 0.9):
+        ours = auroc(jnp.asarray(preds), jnp.asarray(target), max_fpr=max_fpr)
+        theirs = reference.auroc(_torch(preds), _torch(target), max_fpr=max_fpr)
+        _close(ours, theirs, atol=1e-5)
+
+
+def test_dice_score_options_match_reference(reference):
+    from metrics_tpu.functional import dice_score
+
+    probs, target = _multiclass(n=128, seed=73)
+    for kwargs in ({"bg": True}, {"nan_score": 0.5}, {"no_fg_score": 1.0}):
+        ours = dice_score(jnp.asarray(probs), jnp.asarray(target), **kwargs)
+        theirs = reference.dice_score(_torch(probs), _torch(target), **kwargs)
+        _close(ours, theirs, atol=1e-5)
