@@ -716,3 +716,74 @@ def test_dice_score_options_match_reference(reference):
         ours = dice_score(jnp.asarray(probs), jnp.asarray(target), **kwargs)
         theirs = reference.dice_score(_torch(probs), _torch(target), **kwargs)
         _close(ours, theirs, atol=1e-5)
+
+
+def test_canonicalizer_fuzz_sweep_matches_reference(reference):
+    """Randomized sweep: 40 random (shape, dtype, options) configurations
+    through both canonicalizers; outputs and case labels must match
+    bit-for-bit whenever the reference accepts the input, and both must
+    reject the same inputs."""
+    import torch
+
+    from metrics_tpu.utilities.checks import _input_format_classification
+
+    sys.path.insert(0, "/root/reference")
+    try:
+        from torchmetrics.utilities.checks import (
+            _input_format_classification as ref_canon,
+        )
+
+        rng = np.random.RandomState(80)
+        n_match = n_reject = 0
+        for trial in range(40):
+            n = int(rng.randint(2, 33))
+            c = int(rng.randint(2, 6))
+            x = int(rng.randint(2, 5))
+            kind = rng.randint(6)
+            if kind == 0:
+                preds, target = rng.randint(2, size=n), rng.randint(2, size=n)
+            elif kind == 1:
+                preds, target = rng.rand(n).astype(np.float32), rng.randint(2, size=n)
+            elif kind == 2:
+                preds, target = rng.rand(n, c).astype(np.float32), rng.randint(2, size=(n, c))
+            elif kind == 3:
+                preds, target = rng.randint(c, size=n), rng.randint(c, size=n)
+            elif kind == 4:
+                e = np.exp(rng.rand(n, c))
+                preds, target = (e / e.sum(1, keepdims=True)).astype(np.float32), rng.randint(c, size=n)
+            else:
+                e = np.exp(rng.rand(n, c, x))
+                preds = (e / e.sum(1, keepdims=True)).astype(np.float32)
+                target = rng.randint(c, size=(n, x))
+            kwargs = {}
+            if rng.rand() < 0.3:
+                kwargs["threshold"] = float(rng.uniform(0.1, 0.9))
+            if kind == 4 and rng.rand() < 0.3:
+                kwargs["top_k"] = 2
+            if rng.rand() < 0.2:
+                kwargs["num_classes"] = c if kind in (2, 3, 4, 5) else None
+
+            try:
+                ref_out = ref_canon(
+                    torch.from_numpy(np.asarray(preds)), torch.from_numpy(np.asarray(target)), **kwargs
+                )
+                ref_err = None
+            except (ValueError, RuntimeError) as err:
+                ref_out, ref_err = None, str(err)
+            try:
+                ours_out = _input_format_classification(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+                ours_err = None
+            except (ValueError, RuntimeError) as err:
+                ours_out, ours_err = None, str(err)
+
+            assert (ref_err is None) == (ours_err is None), (trial, kind, kwargs, ours_err, ref_err)
+            if ref_err is None:
+                assert str(ours_out[2]) == str(ref_out[2]), (trial, kind)
+                assert np.array_equal(np.asarray(ours_out[0]), ref_out[0].numpy()), (trial, kind)
+                assert np.array_equal(np.asarray(ours_out[1]), ref_out[1].numpy()), (trial, kind)
+                n_match += 1
+            else:
+                n_reject += 1
+        assert n_match >= 20, (n_match, n_reject)  # the sweep must mostly exercise accepts
+    finally:
+        sys.path.remove("/root/reference")
