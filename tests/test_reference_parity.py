@@ -787,3 +787,33 @@ def test_canonicalizer_fuzz_sweep_matches_reference(reference):
         assert n_match >= 20, (n_match, n_reject)  # the sweep must mostly exercise accepts
     finally:
         sys.path.remove("/root/reference")
+
+
+def test_retrieval_module_classes_match_reference(reference):
+    """Stateful retrieval classes over interleaved batches, including
+    empty_target_action handling."""
+    import torch
+
+    from metrics_tpu import RetrievalMAP, RetrievalMRR
+
+    sys.path.insert(0, "/root/reference")
+    try:
+        from torchmetrics import RetrievalMAP as RefMAP, RetrievalMRR as RefMRR
+
+        rng = np.random.RandomState(81)
+        for action in ("skip", "pos", "neg"):
+            ours, theirs = RetrievalMAP(empty_target_action=action), RefMAP(empty_target_action=action)
+            ours2, theirs2 = RetrievalMRR(empty_target_action=action), RefMRR(empty_target_action=action)
+            for _ in range(3):
+                idx = rng.randint(6, size=64)
+                preds = rng.rand(64).astype(np.float32)
+                target = rng.randint(2, size=64)
+                target[idx == 0] = 0  # query 0 has no positives: exercises the action
+                ours.update(jnp.asarray(idx), jnp.asarray(preds), jnp.asarray(target))
+                theirs.update(torch.from_numpy(idx), torch.from_numpy(preds), torch.from_numpy(target))
+                ours2.update(jnp.asarray(idx), jnp.asarray(preds), jnp.asarray(target))
+                theirs2.update(torch.from_numpy(idx), torch.from_numpy(preds), torch.from_numpy(target))
+            _close(ours.compute(), theirs.compute())
+            _close(ours2.compute(), theirs2.compute())
+    finally:
+        sys.path.remove("/root/reference")
