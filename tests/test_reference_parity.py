@@ -817,3 +817,14 @@ def test_retrieval_module_classes_match_reference(reference):
             _close(ours2.compute(), theirs2.compute())
     finally:
         sys.path.remove("/root/reference")
+
+
+def test_multilabel_confusion_matrix_matches_reference(reference):
+    from metrics_tpu.functional import confusion_matrix
+
+    rng = np.random.RandomState(82)
+    probs = rng.rand(128, 4).astype(np.float32)
+    target = rng.randint(2, size=(128, 4))
+    ours = confusion_matrix(jnp.asarray(probs), jnp.asarray(target), num_classes=4, multilabel=True)
+    theirs = reference.confusion_matrix(_torch(probs), _torch(target), num_classes=4, multilabel=True)
+    _close(ours, theirs)
