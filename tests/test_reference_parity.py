@@ -828,3 +828,37 @@ def test_multilabel_confusion_matrix_matches_reference(reference):
     ours = confusion_matrix(jnp.asarray(probs), jnp.asarray(target), num_classes=4, multilabel=True)
     theirs = reference.confusion_matrix(_torch(probs), _torch(target), num_classes=4, multilabel=True)
     _close(ours, theirs)
+
+
+def test_tensor_utilities_match_reference(reference):
+    """to_onehot / select_topk / to_categorical — quasi-public utilities
+    re-exported by the reference."""
+    import torch
+
+    from metrics_tpu.utilities.data import select_topk, to_categorical, to_onehot
+
+    sys.path.insert(0, "/root/reference")
+    try:
+        from torchmetrics.utilities.data import (
+            select_topk as ref_topk,
+            to_categorical as ref_cat,
+            to_onehot as ref_onehot,
+        )
+
+        rng = np.random.RandomState(83)
+        labels = rng.randint(5, size=32)
+        assert np.array_equal(
+            np.asarray(to_onehot(jnp.asarray(labels), num_classes=5)),
+            ref_onehot(torch.from_numpy(labels), num_classes=5).numpy(),
+        )
+        probs = rng.rand(32, 5).astype(np.float32)
+        assert np.array_equal(
+            np.asarray(select_topk(jnp.asarray(probs), topk=2)),
+            ref_topk(torch.from_numpy(probs), topk=2).numpy(),
+        )
+        assert np.array_equal(
+            np.asarray(to_categorical(jnp.asarray(probs))),
+            ref_cat(torch.from_numpy(probs)).numpy(),
+        )
+    finally:
+        sys.path.remove("/root/reference")
