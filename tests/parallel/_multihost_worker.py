@@ -110,6 +110,31 @@ def main(coordinator: str, num_processes: int, process_id: int) -> None:
     restored_result = float(restored.compute())
     assert abs(restored_result - auroc_expected) < 1e-6, (restored_result, auroc_expected)
 
+    # three sharded streams (idx/preds/target) ride ONE bitcast-stacked
+    # all_gather across the process boundary — a distinct collective path
+    # from the 2-stream curve metrics
+    from metrics_tpu import RetrievalMAP, ShardedRetrievalMAP
+
+    q_idx = rng.randint(5, size=(n_batches, batch)).astype(np.int64)
+    q_scores = rng.rand(n_batches, batch).astype(np.float32)
+    q_rel = rng.randint(2, size=(n_batches, batch)).astype(np.int64)
+    smap = ShardedRetrievalMAP(capacity_per_device=n_batches * batch, mesh=mesh)
+    # local oracle: fed the FULL batches on every process, so it must NOT
+    # sync through the installed MultiHostBackend (that would double-count)
+    rmap = RetrievalMAP(dist_sync_fn=lambda x, group=None: [x])
+    for i in range(n_batches):
+        half = batch // num_processes
+        lo = process_id * half
+        smap.update(
+            jnp.asarray(q_idx[i, lo:lo + half]),
+            jnp.asarray(q_scores[i, lo:lo + half]),
+            jnp.asarray(q_rel[i, lo:lo + half]),
+        )
+        rmap.update(jnp.asarray(q_idx[i]), jnp.asarray(q_scores[i]), jnp.asarray(q_rel[i]))
+    smap_result = float(smap.compute())
+    rmap_result = float(rmap.compute())
+    assert abs(smap_result - rmap_result) < 1e-6, (smap_result, rmap_result)
+
     print(f"rank {process_id}: OK {result}")
 
 
