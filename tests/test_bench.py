@@ -1,0 +1,35 @@
+"""Smoke-test the bench's subprocess leg protocol (the round-end deliverable).
+
+The accelerator leg runs via ``bench.py --leg-jax`` in a subprocess; when the
+remote-accelerator tunnel is unreachable the CPU-forced fallback must still
+produce a parseable, plausible measurement.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(400)
+def test_bench_jax_leg_cpu_fallback_protocol():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_REPEATS="1")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--leg-jax"],
+        capture_output=True,
+        text=True,
+        timeout=360,
+        env=env,
+        cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    lines = [l for l in proc.stdout.splitlines() if l.startswith("JAXLEG ")]
+    assert len(lines) == 1, proc.stdout[-400:]
+    _, per_step, acc, auroc, platform = lines[0].split()
+    assert platform == "cpu"
+    assert float(per_step) > 0
+    # 1M uniform random preds vs random binary targets
+    assert 0.45 < float(acc) < 0.55
+    assert 0.49 < float(auroc) < 0.51
