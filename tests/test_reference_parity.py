@@ -862,3 +862,22 @@ def test_tensor_utilities_match_reference(reference):
         )
     finally:
         sys.path.remove("/root/reference")
+
+
+def test_collection_clone_prefix_matches_reference(reference):
+    from metrics_tpu import Accuracy, MetricCollection
+
+    sys.path.insert(0, "/root/reference")
+    try:
+        from torchmetrics import Accuracy as RefAccuracy, MetricCollection as RefCollection
+
+        probs, target = _multiclass(n=64, seed=90)
+        ours = MetricCollection([Accuracy()]).clone(prefix="val_")
+        theirs = RefCollection([RefAccuracy()]).clone(prefix="val_")
+        ours.update(jnp.asarray(probs), jnp.asarray(target))
+        theirs.update(_torch(probs), _torch(target))
+        got, want = ours.compute(), theirs.compute()
+        assert set(got) == set(want) == {"val_Accuracy"}
+        _close(got["val_Accuracy"], want["val_Accuracy"])
+    finally:
+        sys.path.remove("/root/reference")
