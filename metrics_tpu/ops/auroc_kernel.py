@@ -38,8 +38,13 @@ def _descending_key(preds: jax.Array) -> jax.Array:
     garbage scores either way — the eager validation paths reject them
     before this kernel).
     """
-    p = preds.astype(jnp.float32) + 0.0  # -0.0 + 0.0 == +0.0
+    p = preds.astype(jnp.float32)
     b = lax.bitcast_convert_type(p, jnp.uint32)
+    # -0.0 → +0.0 in BIT space (0x80000000 → 0). A float-space `p + 0.0`
+    # is constant-folded away by XLA under jit, leaving ±0.0 with distinct
+    # keys and splitting one tie group in two — eager and jitted kernels
+    # then disagree. The bit compare survives compilation.
+    b = jnp.where(b == _SIGN, jnp.uint32(0), b)
     u = jnp.where(b >= _SIGN, ~b, b | _SIGN)  # ascending u == ascending float
     return jnp.where(jnp.isnan(p), jnp.uint32(0xFFFFFFFF), ~u)
 

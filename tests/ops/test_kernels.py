@@ -34,11 +34,30 @@ def test_binary_auroc_degenerate_nan():
 
 def test_binary_auroc_signed_zero_is_one_tie_group():
     """Regression for the u32 sort key: -0.0 and +0.0 are equal scores and
-    must land in the same tie group (raw bitcast would split them)."""
-    p = np.asarray([-0.0, 0.0, -0.0, 0.0, 0.5, -0.5], np.float32)
-    t = np.asarray([1, 0, 0, 1, 1, 0])
+    must land in the same tie group (raw bitcast would split them).
+
+    The zero tie group is deliberately ASYMMETRIC — all positives carry -0.0
+    and all negatives +0.0 — so a key split changes the ROC chord and the
+    area. A symmetric arrangement passes even with split keys (the two
+    half-chords sum to the full chord), which is how a float-space `+ 0.0`
+    canonicalization that XLA folds away under jit once escaped this test:
+    eager keys merged the group, jitted keys split it, and only the jitted
+    kernel ships. `binary_auroc` is @jax.jit so this exercises the compiled
+    key path.
+    """
+    p = np.asarray([0.0, -0.0, 0.0, -0.0, 0.7, 0.2], np.float32)
+    t = np.asarray([0, 1, 0, 1, 1, 0])
     ours = float(binary_auroc(jnp.asarray(p), jnp.asarray(t)))
     assert abs(ours - roc_auc_score(t, p)) < 1e-6
+
+    # and a denser randomized mixed-sign-zero sweep, still under jit
+    rng = np.random.RandomState(7)
+    p2 = rng.rand(400).astype(np.float32)
+    p2[rng.rand(400) < 0.3] = 0.0
+    p2[rng.rand(400) < 0.15] = -0.0
+    t2 = rng.randint(2, size=400)
+    ours2 = float(binary_auroc(jnp.asarray(p2), jnp.asarray(t2)))
+    assert abs(ours2 - roc_auc_score(t2, p2)) < 1e-5
 
 
 def test_binary_auroc_negative_and_inf_scores():
