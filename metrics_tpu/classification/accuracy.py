@@ -23,6 +23,8 @@ class Accuracy(Metric):
         Array(0.5, dtype=float32)
     """
 
+    _fused_forward = True  # additive counter states: one-update forward
+
     def __init__(
         self,
         threshold: float = 0.5,

@@ -76,12 +76,13 @@ class _BinnedScoreMetric(Metric):
                     f"expected preds of shape (n, {num_classes}) and 1-d target,"
                     f" got {preds.shape} and {target.shape}"
                 )
-            lo, hi = int(jnp.min(target)), int(jnp.max(target))
-            if lo < 0 or hi >= num_classes:
-                raise ValueError(
-                    f"target labels must lie in [0, {num_classes})"
-                    f" (the C dimension of preds); got range [{lo}, {hi}]"
-                )
+            if _is_concrete(target):  # value probe: skip when traced (jit)
+                lo, hi = (int(v) for v in _min_max_jit(target))  # one fused dispatch
+                if lo < 0 or hi >= num_classes:
+                    raise ValueError(
+                        f"target labels must lie in [0, {num_classes})"
+                        f" (the C dimension of preds); got range [{lo}, {hi}]"
+                    )
             self._check_prob_range(preds)
             onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
             hist_pos, hist_neg = jax.vmap(
@@ -106,12 +107,19 @@ class _BinnedScoreMetric(Metric):
 
     def _ovr_scores(self, kernel: Callable) -> jax.Array:
         """Per-class one-vs-rest scores from the histogram rows, averaged
-        per ``self.average`` (loud failure on absent classes)."""
+        per ``self.average``.
+
+        Epoch-end ``compute()`` fails LOUDLY when a class never occurred in
+        the accumulated stream. The batch-local value ``forward`` returns is
+        different: a mini-batch legitimately misses classes, so there the
+        average runs over the classes the batch does contain (NaN only when
+        no class has a defined one-vs-rest score).
+        """
         from metrics_tpu.classification.sharded import _average_ovr
 
         per_class = jax.vmap(kernel)(self.hist_pos, self.hist_neg)
         support = jnp.sum(self.hist_pos, axis=1)
-        return _average_ovr(per_class, support, self.average)
+        return _average_ovr(per_class, support, self.average, batch_local=self._batch_local_compute)
 
 
 class BinnedAUROC(_BinnedScoreMetric):

@@ -20,6 +20,8 @@ class CohenKappa(Metric):
         Array(0.5, dtype=float32)
     """
 
+    _fused_forward = True  # additive counter states: one-update forward
+
     def __init__(
         self,
         num_classes: int,

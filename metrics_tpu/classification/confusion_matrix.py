@@ -25,6 +25,8 @@ class ConfusionMatrix(Metric):
                [1., 1.]], dtype=float32)
     """
 
+    _fused_forward = True  # additive counter states: one-update forward
+
     def __init__(
         self,
         num_classes: int,

@@ -38,6 +38,8 @@ class Hinge(Metric):
         Array([2.2333333, 1.5      , 1.2333333], dtype=float32)
     """
 
+    _fused_forward = True  # additive counter states: one-update forward
+
     def __init__(
         self,
         squared: bool = False,

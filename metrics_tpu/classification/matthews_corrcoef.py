@@ -23,6 +23,8 @@ class MatthewsCorrcoef(Metric):
         Array(0.57735026, dtype=float32)
     """
 
+    _fused_forward = True  # additive counter states: one-update forward
+
     def __init__(
         self,
         num_classes: int,

@@ -45,16 +45,29 @@ from metrics_tpu.parallel.sharded_metric import (  # noqa: F401  (re-exported fo
 )
 
 
-def _average_ovr(per_class: jax.Array, support: jax.Array, average: Optional[str]) -> jax.Array:
+def _average_ovr(
+    per_class: jax.Array, support: jax.Array, average: Optional[str], batch_local: bool = False
+) -> jax.Array:
     """NONE/MACRO/WEIGHTED averaging of per-class one-vs-rest scores
     (``support`` = mask-valid occurrences per class).
 
-    Averaged modes fail LOUDLY when a class never occurred in the stream
-    (its OvR score is NaN and would silently poison the mean); the
-    per-class mode returns NaN for absent classes, documented.
+    Epoch-end (``batch_local=False``) averaged modes fail LOUDLY when a
+    class never occurred in the stream (its OvR score is NaN and would
+    silently poison the mean); the per-class mode returns NaN for absent
+    classes, documented.
+
+    With ``batch_local=True`` (a ``forward`` step value): a mini-batch
+    legitimately misses classes, so the average runs over the classes whose
+    one-vs-rest score is defined — NaN only when none is.
     """
     if average in (None, "none"):
         return per_class
+    if batch_local:
+        valid = ~jnp.isnan(per_class)
+        weight = valid.astype(jnp.float32) if average == "macro" else jnp.where(valid, support, 0.0)
+        total = jnp.sum(weight)
+        score = jnp.sum(jnp.where(valid, per_class, 0.0) * weight) / jnp.maximum(total, 1.0)
+        return jnp.where(total > 0, score, jnp.nan)
     absent = np.asarray(support) == 0
     if absent.any():
         raise ValueError(
@@ -240,7 +253,7 @@ class _ShardedOVRMetric(ShardedCurveMetric):
         program = _ovr_program(self.mesh, self.axis_name, self._masked_kernel)
         per_class, support = program(preds, target, mask)
         per_class, support = replica0(per_class)[:num_classes], replica0(support)[:num_classes]
-        return _average_ovr(per_class, support, self.average)
+        return _average_ovr(per_class, support, self.average, batch_local=self._batch_local_compute)
 
 
 class ShardedAUROC(_ShardedOVRMetric):

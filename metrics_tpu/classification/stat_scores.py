@@ -31,6 +31,8 @@ class StatScores(Metric):
         Array([2, 2, 6, 2, 4], dtype=int32)
     """
 
+    _fused_forward = True  # additive counter states: one-update forward
+
     def __init__(
         self,
         threshold: float = 0.5,

@@ -4,8 +4,19 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.checks import (
+    _check_classification_inputs,
+    _detect_case,
+    _input_format_classification,
+    _is_floating,
+    _Probe,
+    _prob_sum_atol,
+    _probe_scalars,
+    _squeeze_shape,
+)
+from metrics_tpu.utilities.data import _is_concrete
 from metrics_tpu.utilities.enums import DataType
 
 
@@ -30,6 +41,124 @@ def _accuracy_count(preds, target, mode, subset_accuracy):
     return correct.astype(jnp.int32), jnp.asarray(total, dtype=jnp.int32)
 
 
+@partial(
+    jax.jit,
+    static_argnames=("p_shape", "t_shape", "case", "threshold", "top_k", "subset_accuracy", "sum_atol"),
+)
+def _accuracy_probe_count(preds, target, p_shape, t_shape, case, threshold, top_k, subset_accuracy, sum_atol):
+    """Single-pass probe + (correct, total) straight from RAW inputs.
+
+    The canonical path materializes two ``(N, C)`` one-hot int arrays
+    (``_canonicalize_jit``) only for ``_accuracy_count`` to reduce them
+    away again — at 1M×4 that is ~32MB of HBM/cache traffic for two scalars.
+    This kernel computes the same counts with compare/argmax/top-k ops on
+    the raw arrays, fused with the validation value probe, so the whole
+    update is ONE program and one pass over the data.
+    """
+    case = DataType(case)
+    preds = preds.reshape(p_shape)
+    target = target.reshape(t_shape)
+    if preds.dtype in (jnp.float16, jnp.bfloat16):
+        preds = preds.astype(jnp.float32)
+
+    check_prob_sum = case == DataType.MULTICLASS and jnp.issubdtype(preds.dtype, jnp.floating) and preds.ndim == 2
+    pmin, pmax, tmin, tmax, prob_ok = _probe_scalars(preds, target, check_prob_sum, sum_atol)
+
+    if case == DataType.BINARY:
+        hit = (preds >= threshold).astype(target.dtype) == target
+        correct, total = jnp.sum(hit), jnp.asarray(target.shape[0])
+    elif case == DataType.MULTICLASS and preds.ndim == target.ndim:
+        # 1-d label preds vs label target
+        correct, total = jnp.sum(preds == target), jnp.asarray(target.shape[0])
+    elif case == DataType.MULTICLASS:
+        # (N, C) probabilities vs (N,) labels: top-k membership without the
+        # one-hot expansion (ties resolve first-index, like select_topk)
+        k = top_k or 1
+        if k == 1:
+            hit = jnp.argmax(preds, axis=1) == target
+        else:
+            _, idx = lax.top_k(preds, k)
+            hit = jnp.any(idx == target[:, None], axis=1)
+        correct, total = jnp.sum(hit), jnp.asarray(target.shape[0])
+    else:  # MULTILABEL (float preds, equal shapes)
+        hit = (preds >= threshold).astype(target.dtype) == target
+        if subset_accuracy:
+            axes = tuple(range(1, hit.ndim))
+            correct, total = jnp.sum(jnp.all(hit, axis=axes)), jnp.asarray(target.shape[0])
+        else:
+            correct, total = jnp.sum(hit), jnp.asarray(target.size)
+
+    return pmin, pmax, tmin, tmax, prob_ok, correct.astype(jnp.int32), jnp.asarray(total, jnp.int32)
+
+
+def _accuracy_fast_update(
+    preds: jax.Array,
+    target: jax.Array,
+    threshold: float,
+    top_k: Optional[int],
+    subset_accuracy: bool,
+) -> Optional[Tuple[jax.Array, jax.Array]]:
+    """Fast path for the common eager cases; None = take the canonical path.
+
+    Validation parity is preserved: the fused kernel returns the same probe
+    scalars the canonical path reads, and they run through the identical
+    ``_check_classification_inputs`` pipeline (same errors, same order of
+    value checks) before the counts are accepted.
+    """
+    if not (_is_concrete(preds) and _is_concrete(target)):
+        return None  # traced: the canonical path handles jit semantics
+    if _is_floating(target):
+        return None  # let the canonical path raise its error
+    p_shape = _squeeze_shape(preds.shape)
+    t_shape = _squeeze_shape(target.shape)
+    preds_float = _is_floating(preds)
+
+    if (p_shape[0] if p_shape else 0) != (t_shape[0] if t_shape else 0):
+        # _detect_case tolerates this (an (N, C)/(M,) pair parses fine), but
+        # the kernel would crash on it — the canonical path raises the
+        # parity error before any compute, so defer to it
+        return None
+    try:
+        case, implied_classes = _detect_case(p_shape, t_shape, preds_float)
+    except ValueError:
+        return None  # canonical path raises the identical error
+    if case == DataType.MULTIDIM_MULTICLASS:
+        return None
+    if case == DataType.MULTICLASS and p_shape != t_shape and (len(p_shape) != 2 or implied_classes < 2):
+        return None
+    if top_k is not None and (not isinstance(top_k, int) or top_k <= 0 or top_k >= implied_classes):
+        # invalid top_k: the kernel's lax.top_k would leak its own error
+        # before _check_top_k runs; the canonical path raises the parity one
+        return None
+    if case == DataType.MULTILABEL and (top_k or not preds_float):
+        return None  # top_k raises below; int multilabel has onehot quirks
+
+    raw = _accuracy_probe_count(
+        preds,
+        target,
+        p_shape=p_shape,
+        t_shape=t_shape,
+        case=case.value,
+        threshold=float(threshold),
+        top_k=top_k,
+        subset_accuracy=subset_accuracy,
+        sum_atol=_prob_sum_atol(preds, p_shape, case == DataType.MULTICLASS and preds_float),
+    )
+    probe = _Probe(float(raw[0]), float(raw[1]), int(raw[2]), int(raw[3]), bool(raw[4]))
+    _check_classification_inputs(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=None,
+        is_multiclass=None,
+        top_k=top_k,
+        p_shape=p_shape,
+        t_shape=t_shape,
+        probe=probe,
+    )
+    return raw[5], raw[6]
+
+
 def _accuracy_update(
     preds: jax.Array,
     target: jax.Array,
@@ -39,8 +168,14 @@ def _accuracy_update(
 ) -> Tuple[jax.Array, jax.Array]:
     """Canonicalize inputs and count (correct, total) for the detected case.
 
-    Mirrors reference ``functional/classification/accuracy.py:23-55``.
+    Mirrors reference ``functional/classification/accuracy.py:23-55``; the
+    common eager cases take the fused single-pass kernel instead of the
+    one-hot canonicalization (identical counts and identical validation).
     """
+    fast = _accuracy_fast_update(jnp.asarray(preds), jnp.asarray(target), threshold, top_k, subset_accuracy)
+    if fast is not None:
+        return fast
+
     preds, target, mode = _input_format_classification(preds, target, threshold=threshold, top_k=top_k)
 
     if mode == DataType.MULTILABEL and top_k:

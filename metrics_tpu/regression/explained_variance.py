@@ -42,6 +42,8 @@ class ExplainedVariance(Metric):
         Array([0.96774197, 1.        ], dtype=float32)
     """
 
+    _fused_forward = True  # additive counter states: one-update forward
+
     def __init__(
         self,
         multioutput: str = "uniform_average",

@@ -23,6 +23,8 @@ class MeanSquaredError(Metric):
         Array(0.875, dtype=float32)
     """
 
+    _fused_forward = True  # additive counter states: one-update forward
+
     def __init__(
         self,
         compute_on_step: bool = True,

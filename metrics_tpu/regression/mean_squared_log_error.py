@@ -23,6 +23,8 @@ class MeanSquaredLogError(Metric):
         Array(0.03973011, dtype=float32)
     """
 
+    _fused_forward = True  # additive counter states: one-update forward
+
     def __init__(
         self,
         compute_on_step: bool = True,

@@ -40,6 +40,10 @@ class PSNR(Metric):
         Array(2.552725, dtype=float32)
     """
 
+    # sum counters, min/max trackers, and list states all merge by their
+    # registered reduction, so the one-update forward applies in every mode
+    _fused_forward = True
+
     def __init__(
         self,
         data_range: Optional[float] = None,

@@ -40,6 +40,8 @@ class R2Score(Metric):
         Array([0.96543777, 0.90816325], dtype=float32)
     """
 
+    _fused_forward = True  # additive counter states: one-update forward
+
     def __init__(
         self,
         num_outputs: int = 1,

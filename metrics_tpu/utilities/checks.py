@@ -55,10 +55,12 @@ class _Probe(NamedTuple):
     prob_sum_ok: bool
 
 
-@partial(jax.jit, static_argnames=("p_shape", "t_shape", "check_prob_sum", "sum_atol"))
-def _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum, sum_atol=1e-5):
-    preds = preds.reshape(p_shape).astype(jnp.float32)
-    target = target.reshape(t_shape)
+def _probe_scalars(preds, target, check_prob_sum, sum_atol):
+    """The probe body (un-jitted): min/max of both inputs + the
+    probabilities-sum-to-1 flag. The ONE definition of probe semantics —
+    called from :func:`_value_probe_jit` and fused into metric-specific
+    kernels (e.g. the accuracy probe+count kernel) so validation parity
+    cannot drift between them."""
     pmin, pmax = jnp.min(preds), jnp.max(preds)
     tmin, tmax = jnp.min(target), jnp.max(target)
     if check_prob_sum:
@@ -67,6 +69,13 @@ def _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum, sum_atol=1
     else:
         prob_ok = jnp.asarray(True)
     return pmin, pmax, tmin, tmax, prob_ok
+
+
+@partial(jax.jit, static_argnames=("p_shape", "t_shape", "check_prob_sum", "sum_atol"))
+def _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum, sum_atol=1e-5):
+    preds = preds.reshape(p_shape).astype(jnp.float32)
+    target = target.reshape(t_shape)
+    return _probe_scalars(preds, target, check_prob_sum, sum_atol)
 
 
 def _prob_sum_atol(preds: jax.Array, p_shape: Tuple[int, ...], check_prob_sum: bool) -> float:

@@ -232,3 +232,44 @@ def test_compositional_reset():
     compos.reset()
     assert compos.metric_a._num_updates == 0
     assert compos.metric_b._num_updates == 0
+
+
+def test_forward_preserves_operand_accumulation():
+    """Composition forward must not destroy operand accumulation: the
+    snapshot/reset/restore cycle recurses into operand metrics."""
+    import numpy as np
+    from sklearn.metrics import accuracy_score
+
+    from metrics_tpu import Accuracy
+
+    rng = np.random.RandomState(51)
+    probs = rng.rand(3, 64, 4).astype(np.float32)
+    probs /= probs.sum(axis=2, keepdims=True)
+    labels = rng.randint(4, size=(3, 64))
+
+    comp = Accuracy() + 0.0
+    for i in range(3):
+        step = comp(jnp.asarray(probs[i]), jnp.asarray(labels[i]))
+        assert abs(float(step) - accuracy_score(labels[i], probs[i].argmax(1))) < 1e-6
+    want = accuracy_score(labels.reshape(-1), probs.reshape(-1, 4).argmax(1))
+    assert abs(float(comp.compute()) - want) < 1e-6
+
+
+def test_epoch_compute_not_served_from_batch_local_cache():
+    """A value cached under batch-local (forward) semantics must not serve
+    the epoch-end compute: the tolerant batch-local OvR average must not
+    mask the epoch-end absent-class failure."""
+    import numpy as np
+    import pytest
+
+    from metrics_tpu import BinnedAUROC
+
+    rng = np.random.RandomState(53)
+    probs = (np.floor(rng.rand(64, 3) * 16) / 16).astype(np.float32)
+    target = rng.randint(2, size=64)  # class 2 never occurs
+
+    comp = BinnedAUROC(num_bins=16, num_classes=3, average="macro") + 0.0
+    step = comp(jnp.asarray(probs), jnp.asarray(target))
+    assert np.isfinite(float(step))  # tolerant batch-local value
+    with pytest.raises(ValueError, match="never occurred"):
+        comp.compute()  # epoch-end keeps the loud failure

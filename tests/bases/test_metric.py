@@ -231,3 +231,90 @@ def test_child_metric_state_dict():
     metric.persistent(True)
     sd = metric.state_dict(prefix="child.")
     assert "child.x" in sd
+
+
+def test_array_state_defaults_are_strongly_typed():
+    """Weakly-typed defaults (`jnp.asarray(0.0)`) must be strengthened at
+    registration: weak scalars in state arithmetic make result dtype metadata
+    depend on operand order through JAX's eager dispatch cache (observed as
+    suite-order-dependent `weak_type=True` reprs in doctests)."""
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, ExplainedVariance, Hinge, PSNR
+
+    class Weak(DummyMetric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("w", jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    for metric, names in [
+        (Weak(), ["w"]),
+        (Accuracy(), ["correct", "total"]),
+        (Hinge(), ["measure", "total"]),
+        (ExplainedVariance(), ["n_obs", "sum_error"]),
+        (PSNR(), ["sum_squared_error", "total", "min_target", "max_target"]),
+    ]:
+        for name in names:
+            state = getattr(metric, name)
+            assert not state.aval.weak_type, (type(metric).__name__, name)
+            assert not metric._defaults[name].aval.weak_type, (type(metric).__name__, name)
+
+
+def test_forward_batch_local_failure_restores_state_and_sync_flag():
+    """A raising batch-local compute() (classic, non-fused path) must leave
+    the accumulated state and the _to_sync flag intact."""
+    import numpy as np
+    import pytest
+
+    from metrics_tpu import RetrievalMAP
+
+    m = RetrievalMAP(empty_target_action="error")
+    good = (jnp.asarray([0, 0, 1, 1]), jnp.asarray([0.9, 0.2, 0.8, 0.3]), jnp.asarray([1, 0, 1, 0]))
+    m(*good)
+    with pytest.raises(ValueError, match="positive"):
+        # query 7 has no positive target -> the batch-local compute raises
+        m(jnp.asarray([7, 7]), jnp.asarray([0.5, 0.4]), jnp.asarray([0, 0]))
+    assert m._to_sync is True
+    assert m._batch_local_compute is False
+    # both updates' appends survive (update happened before the failure),
+    # exactly like a plain update() + failing compute() sequence
+    assert sum(int(np.asarray(x).size) for x in m.idx) == 6
+
+
+def test_fused_forward_failure_parity_with_classic_path():
+    """Fused forward mirrors the classic path's failure semantics: a batch
+    REJECTED by update() costs nothing, but once update() accepted it, the
+    batch stays in epoch state even when the batch-local compute() raises."""
+    import pytest
+
+    class Fussy(Metric):
+        _fused_forward = True
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("s", jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+        def update(self, x):
+            if int(jnp.size(x)) == 0:
+                raise ValueError("empty batch")
+            self.s = self.s + jnp.sum(x)
+
+        def compute(self):
+            if float(self.s) < 0:
+                raise ValueError("negative sum")
+            return self.s
+
+    m = Fussy()
+    assert float(m(jnp.ones(3))) == 3.0
+
+    # update rejects: accumulated state untouched, flags restored
+    with pytest.raises(ValueError, match="empty batch"):
+        m(jnp.zeros((0,)))
+    assert float(m.s) == 3.0 and m._to_sync is True
+
+    # update accepts, batch-local compute raises: the batch still lands in
+    # the epoch state (classic-path parity)
+    with pytest.raises(ValueError, match="negative sum"):
+        m(jnp.asarray(-5.0).reshape(1))
+    assert float(m.s) == -2.0
+    assert m._to_sync is True and m._batch_local_compute is False

@@ -168,3 +168,70 @@ def test_wrong_params(top_k, threshold):
 
     with pytest.raises(ValueError):
         accuracy(jnp.asarray(preds), jnp.asarray(target), threshold=threshold, top_k=top_k)
+
+
+def test_fast_update_matches_canonical_path(monkeypatch):
+    """The fused single-pass probe+count kernel must agree exactly with the
+    one-hot canonicalization path on every eligible input case — and fall
+    back (None) identically when disabled."""
+    import sys
+
+    import numpy as np
+
+    acc_mod = sys.modules["metrics_tpu.functional.classification.accuracy"]
+    rng = np.random.RandomState(41)
+
+    cases = []
+    # binary float
+    cases.append((rng.rand(257).astype(np.float32), rng.randint(2, size=257), {}))
+    # 1-d label preds vs labels
+    cases.append((rng.randint(5, size=257), rng.randint(5, size=257), {}))
+    # multiclass probs, top-1 and top-2
+    probs = rng.rand(257, 5).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    cases.append((probs, rng.randint(5, size=257), {}))
+    cases.append((probs, rng.randint(5, size=257), {"top_k": 2}))
+    # multilabel elementwise and subset
+    mlp = rng.rand(257, 4).astype(np.float32)
+    mlt = rng.randint(2, size=(257, 4))
+    cases.append((mlp, mlt, {}))
+    cases.append((mlp, mlt, {"subset_accuracy": True}))
+
+    for preds, target, kw in cases:
+        args = (jnp.asarray(preds), jnp.asarray(target), kw.get("threshold", 0.5), kw.get("top_k"),
+                kw.get("subset_accuracy", False))
+        fast = acc_mod._accuracy_fast_update(*args)
+        assert fast is not None, kw
+        with monkeypatch.context() as mp:
+            mp.setattr(acc_mod, "_accuracy_fast_update", lambda *a, **k: None)
+            slow = acc_mod._accuracy_update(*args)
+        assert int(fast[0]) == int(slow[0]) and int(fast[1]) == int(slow[1]), (kw, fast, slow)
+
+
+def test_fast_update_keeps_validation_errors():
+    """The fused kernel path must raise the same eager validation errors as
+    the canonical path (same messages)."""
+    probs = jnp.asarray([[0.5, 0.5], [0.9, 0.1]])
+    with pytest.raises(ValueError, match="probabilities, but values were detected"):
+        accuracy(jnp.asarray([1.5, -0.2]), jnp.asarray([1, 0]))
+    with pytest.raises(ValueError, match="sum up to 1"):
+        accuracy(jnp.asarray([[0.9, 0.9], [0.1, 0.1]]), jnp.asarray([1, 0]))
+    with pytest.raises(ValueError, match="smaller than the size of the `C` dimension"):
+        accuracy(probs, jnp.asarray([1, 3]))
+    with pytest.raises(ValueError, match="threshold"):
+        accuracy(jnp.asarray([0.4, 0.6]), jnp.asarray([1, 0]), threshold=1.5)
+    # first-dim mismatch parses as a valid (N, C)/(M,) pair in case detection
+    # but must still raise the canonical error, not a kernel broadcast crash
+    with pytest.raises(ValueError, match="same first dimension"):
+        accuracy(jnp.asarray(np.random.rand(8, 3).astype(np.float32)), jnp.asarray([0, 1, 2]))
+
+
+def test_fast_update_top_k_error_parity():
+    """Invalid top_k must raise the canonical message, not lax.top_k's."""
+    probs = jnp.asarray(np.random.RandomState(3).rand(8, 3).astype(np.float32))
+    probs = probs / probs.sum(1, keepdims=True)
+    target = jnp.asarray([0, 1, 2, 0, 1, 2, 0, 1])
+    with pytest.raises(ValueError, match="strictly smaller than the `C` dimension"):
+        accuracy(probs, target, top_k=5)
+    with pytest.raises(ValueError, match="has to be an integer larger than 0"):
+        accuracy(probs, target, top_k=0)

@@ -1,4 +1,5 @@
 """BinnedAUROC: streaming histogram AUROC (TPU-native extension, SURVEY §5.7)."""
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -219,6 +220,77 @@ def test_binned_multiclass_validation():
     m.update(probs, jnp.asarray([0, 0, 1, 1]))
     with pytest.raises(ValueError, match="never occurred"):
         m.compute()
+
+
+def test_binned_multiclass_update_is_trace_safe():
+    """The multiclass update must work under jax.jit (value probes skipped
+    when traced), like the binary path — the streaming psum-able state is
+    designed to live inside jitted eval steps."""
+    from metrics_tpu import BinnedAUROC
+
+    num_bins = 8
+    rng = np.random.RandomState(19)
+    probs = (np.floor(rng.rand(64, 3) * num_bins) / num_bins).astype(np.float32)
+    target = rng.randint(3, size=64).astype(np.int32)
+
+    def histograms(p, t):
+        m = BinnedAUROC(num_bins=num_bins, num_classes=3, average=None)
+        m.update(p, t)
+        return m.hist_pos, m.hist_neg
+
+    eager_pos, eager_neg = histograms(jnp.asarray(probs), jnp.asarray(target))
+    jit_pos, jit_neg = jax.jit(histograms)(jnp.asarray(probs), jnp.asarray(target))
+    assert np.allclose(np.asarray(jit_pos), np.asarray(eager_pos))
+    assert np.allclose(np.asarray(jit_neg), np.asarray(eager_neg))
+    # the out-of-range validation still fires eagerly
+    with pytest.raises(ValueError, match="target labels"):
+        histograms(jnp.asarray(probs), jnp.asarray([5] * 64))
+
+
+def test_binned_multiclass_forward_tolerates_absent_class():
+    """forward()'s batch-local value averages over the classes the batch
+    contains; only the epoch-end compute() fails loudly on absent classes."""
+    from metrics_tpu import BinnedAUROC
+
+    num_bins = 16
+    rng = np.random.RandomState(23)
+    probs = (np.floor(rng.rand(64, 3) * num_bins) / num_bins).astype(np.float32)
+    target = rng.randint(2, size=64).astype(np.int32)  # class 2 never occurs
+
+    per_class = BinnedAUROC(num_bins=num_bins, num_classes=3, average=None)
+    per_class.update(jnp.asarray(probs), jnp.asarray(target))
+    expected_macro = np.nanmean(np.asarray(per_class.compute()))
+
+    m = BinnedAUROC(num_bins=num_bins, num_classes=3, average="macro")
+    step_val = m(jnp.asarray(probs), jnp.asarray(target))  # must not raise
+    assert np.allclose(float(step_val), expected_macro, atol=1e-6)
+
+    weighted = BinnedAUROC(num_bins=num_bins, num_classes=3, average="weighted")
+    step_w = weighted(jnp.asarray(probs), jnp.asarray(target))
+    support = np.bincount(target, minlength=3)[:2]
+    expected_w = float(np.sum(np.asarray(per_class.compute())[:2] * support / support.sum()))
+    assert np.allclose(float(step_w), expected_w, atol=1e-6)
+
+    # a batch where no class has a defined OvR score -> NaN, not an error
+    degenerate = BinnedAUROC(num_bins=num_bins, num_classes=3, average="macro")
+    val = degenerate(jnp.asarray(probs[:4]), jnp.asarray([0, 0, 0, 0]))
+    assert np.isnan(float(val))
+
+    # epoch-end compute keeps the loud failure
+    with pytest.raises(ValueError, match="never occurred"):
+        m.compute()
+
+    # the batch-local flag propagates through metric arithmetic
+    comp = BinnedAUROC(num_bins=num_bins, num_classes=3, average="macro") + 0.0
+    comp_val = comp(jnp.asarray(probs), jnp.asarray(target))
+    assert np.allclose(float(comp_val), expected_macro, atol=1e-6)
+
+    # a metric unpickled from a pre-flag version (no instance attribute)
+    # falls back to the class-level default
+    legacy = BinnedAUROC(num_bins=num_bins, num_classes=3, average=None)
+    legacy.__dict__.pop("_batch_local_compute", None)
+    legacy.update(jnp.asarray(probs), jnp.asarray(target))
+    assert np.asarray(legacy.compute()).shape == (3,)
 
 
 def test_binned_multiclass_ddp_sync():

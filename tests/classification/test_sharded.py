@@ -235,6 +235,25 @@ def test_forward_returns_batch_local_value():
     assert m._n_seen == 64
 
 
+def test_forward_ovr_tolerates_absent_class_epoch_compute_loud():
+    """forward()'s batch-local value averages over present classes (a
+    mini-batch legitimately misses some); epoch-end compute() keeps the loud
+    absent-class failure. Same `_average_ovr` semantics as the binned family."""
+    rng = np.random.RandomState(29)
+    probs = rng.rand(32, 3).astype(np.float32)
+    target = rng.randint(2, size=32)  # class 2 never occurs
+
+    per_class = ShardedAUROC(capacity_per_device=16, num_classes=3, average=None)
+    per_class.update(jnp.asarray(probs), jnp.asarray(target))
+    expected = np.nanmean(np.asarray(per_class.compute()))
+
+    m = ShardedAUROC(capacity_per_device=16, num_classes=3, average="macro")
+    step_val = m(jnp.asarray(probs), jnp.asarray(target))  # must not raise
+    assert np.allclose(float(step_val), expected, atol=1e-6)
+    with pytest.raises(ValueError, match="never occurred"):
+        m.compute()
+
+
 def test_repeated_forward_accumulates_and_overflow_still_loud():
     """Regression: forward()'s snapshot/reset/restore must preserve the
     host-side fill level — a forgotten `_n_seen` would silently drop samples

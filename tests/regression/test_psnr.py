@@ -113,9 +113,13 @@ class TestPSNR(MetricTester):
         )
 
     def test_psnr_half_cpu(self, preds, target, data_range, reduction, dim, base, sk_metric):
-        if dim is not None:
-            pytest.skip("list-state PSNR path tested at full precision")
-        self.run_precision_test_cpu(preds, target, PSNR, psnr)
+        """bf16 support across BOTH state modes: scalar counters (dim=None)
+        and the list-state per-slice path (dim set). The inputs are small
+        integers, exactly representable in bf16; the per-slice squared-error
+        sums stay within bf16's ~3 significant digits, so the standard
+        half-precision tolerance applies."""
+        _args = {"data_range": data_range, "base": base, "reduction": reduction, "dim": dim}
+        self.run_precision_test_cpu(preds, target, PSNR, psnr, metric_args=_args)
 
 
 @pytest.mark.parametrize("reduction", ["none", "sum"])
