@@ -1,4 +1,4 @@
-"""TPU kernel ops: exact AUROC kernel, histogram ops, pallas histogram."""
+"""TPU kernel ops: exact AUROC kernel and histogram ops."""
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -6,7 +6,6 @@ from sklearn.metrics import roc_auc_score
 
 from metrics_tpu.ops.auroc_kernel import binary_auroc
 from metrics_tpu.ops.histogram import histogram_auroc, histogram_roc, score_histograms
-from metrics_tpu.ops.pallas_histogram import score_histograms_pallas
 
 
 @pytest.mark.parametrize("quant", [None, 10, 2])
@@ -99,18 +98,3 @@ def test_score_histograms_mask():
     hp, hn = score_histograms(p, t, 4, mask=jnp.asarray([True, True, False]))
     assert float(hp.sum()) == 1.0 and float(hn.sum()) == 1.0
 
-
-def test_pallas_histogram_matches_xla():
-    """Interpreter-mode pallas kernel agrees with the XLA formulation."""
-    rng = np.random.RandomState(5)
-    p = jnp.asarray(rng.rand(3000).astype(np.float32))
-    t = jnp.asarray(rng.randint(2, size=3000).astype(np.int32))
-    hp1, hn1 = score_histograms_pallas(p, t, 256, interpret=True)
-    hp2, hn2 = score_histograms(p, t, 256)
-    assert np.allclose(np.asarray(hp1), np.asarray(hp2))
-    assert np.allclose(np.asarray(hn1), np.asarray(hn2))
-
-
-def test_pallas_histogram_bad_bins():
-    with pytest.raises(ValueError, match="multiple of 128"):
-        score_histograms_pallas(jnp.zeros(8), jnp.zeros(8, jnp.int32), 100, interpret=True)
