@@ -1,9 +1,16 @@
-.PHONY: test doctest bench dryrun clean
+.PHONY: test test-tpu doctest bench dryrun clean
 
 test:
 	# full suite: sklearn/scipy oracles + package doctests + 8-virtual-device
 	# collective tests (tests/conftest.py provisions the mesh)
 	python -m pytest tests/ -q
+
+test-tpu:
+	# accelerator correctness tier: one representative metric per family on
+	# the real chip vs fp64 oracles (analog of the reference's GPU CI tier,
+	# azure-pipelines.yml:59). Opt-in, probe-gated, timeout-hardened; writes
+	# TPU_TEST.json. Exits non-zero if any check fails or the chip is gone.
+	python tpu_correctness.py
 
 doctest:
 	# standalone doctest run (the default `make test` already includes these

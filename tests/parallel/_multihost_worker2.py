@@ -1,0 +1,122 @@
+"""Worker for the deep multi-host sharded-metric tests (test_multihost.py).
+
+Two processes × two virtual CPU devices each = a 4-device mesh whose axis
+spans the process boundary (the DCN topology of a real pod: multiple chips
+per host, multiple hosts). Covers, cross-process: every Sharded* family,
+the non-divisible-global-batch loud failure, and checkpoint SAVE (the
+matching load-on-one-process path runs in the parent test).
+"""
+import sys
+
+
+def main(coordinator: str, num_processes: int, process_id: int, out_npz: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+    from sklearn.metrics import average_precision_score, roc_auc_score
+
+    import metrics_tpu as M
+
+    world = len(jax.devices())
+    assert world == 2 * num_processes, f"expected 2 devices/process, got {world} total"
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    assert len(mesh.local_devices) < mesh.devices.size, "mesh must span processes"
+
+    N, batch = 256, 32
+    half = batch // num_processes
+    lo = process_id * half
+    rng = np.random.RandomState(0)
+    preds = rng.rand(N // batch, batch).astype(np.float32)
+    target = rng.randint(2, size=(N // batch, batch))
+    flat_p, flat_t = preds.reshape(-1), target.reshape(-1)
+
+    def feed(metric, *cols):
+        for i in range(N // batch):
+            metric.update(*(jnp.asarray(c[i, lo:lo + half]) for c in cols))
+        return metric
+
+    # --- every scalar curve family, exact vs sklearn across the boundary
+    # headroom beyond N: the parent test keeps accumulating after restoring
+    # this metric's checkpoint
+    sh_auroc = feed(M.ShardedAUROC(capacity_per_device=N // world + 8, mesh=mesh), preds, target)
+    assert abs(float(sh_auroc.compute()) - roc_auc_score(flat_t, flat_p)) < 1e-6
+
+    sh_ap = feed(M.ShardedAveragePrecision(capacity_per_device=N // world, mesh=mesh), preds, target)
+    assert abs(float(sh_ap.compute()) - average_precision_score(flat_t, flat_p)) < 1e-6
+
+    # --- curve-output families vs the replicated functional on the full stream
+    from metrics_tpu.functional import precision_recall_curve, roc
+
+    sh_roc = feed(M.ShardedROC(capacity_per_device=N // world, mesh=mesh), preds, target)
+    got = sh_roc.compute()
+    want = roc(jnp.asarray(flat_p), jnp.asarray(flat_t), num_classes=1)
+    for g, w in zip(got, want):
+        assert np.allclose(np.asarray(g), np.asarray(w), atol=1e-6)
+
+    sh_prc = feed(M.ShardedPrecisionRecallCurve(capacity_per_device=N // world, mesh=mesh), preds, target)
+    got = sh_prc.compute()
+    want = precision_recall_curve(jnp.asarray(flat_p), jnp.asarray(flat_t), num_classes=1)
+    for g, w in zip(got, want):
+        assert np.allclose(np.asarray(g), np.asarray(w), atol=1e-6)
+
+    # --- the retrieval family: 3 streams, one bitcast-stacked all_gather;
+    # oracle = replicated metric fed the FULL batches with sync disabled
+    q_idx = rng.randint(20, size=(N // batch, batch)).astype(np.int64)
+    q_rel = rng.randint(2, size=(N // batch, batch)).astype(np.int64)
+    no_sync = {"dist_sync_fn": lambda x, group=None: [x]}
+    for sharded_cls, local_cls, kwargs in [
+        (M.ShardedRetrievalMRR, M.RetrievalMRR, {}),
+        (M.ShardedRetrievalPrecision, M.RetrievalPrecision, {"k": 3}),
+        (M.ShardedRetrievalRecall, M.RetrievalRecall, {"k": 3}),
+    ]:
+        sharded = feed(
+            sharded_cls(capacity_per_device=N // world, mesh=mesh, **kwargs), q_idx, preds, q_rel
+        )
+        local = local_cls(**kwargs, **no_sync)
+        for i in range(N // batch):
+            local.update(jnp.asarray(q_idx[i]), jnp.asarray(preds[i]), jnp.asarray(q_rel[i]))
+        got, want = float(sharded.compute()), float(local.compute())
+        assert abs(got - want) < 1e-6, (sharded_cls.__name__, got, want)
+
+    # --- non-divisible global batch fails loudly on every process
+    uneven = M.ShardedAUROC(capacity_per_device=8, mesh=mesh)
+    try:
+        uneven.update(jnp.asarray(flat_p[: world // 2 + 1]), jnp.asarray(flat_t[: world // 2 + 1]))
+    except ValueError as err:
+        assert "not divisible" in str(err), err
+    else:
+        raise AssertionError("uneven global batch did not raise")
+
+    # --- checkpoint SAVE on the 2-process mesh: the state lives on devices
+    # this process cannot address, so materialize the global streams with the
+    # metric's own single-collective gather (the multi-host-safe route to a
+    # host checkpoint), then rank 0 writes it; the parent test loads it on a
+    # single process through load_state_dict's mesh-validation paths
+    from metrics_tpu.parallel.sharded_metric import replica0
+
+    sh_auroc.persistent(True)
+    assert set(sh_auroc.state_dict()) == {"buf_preds", "buf_target", "counts"}
+    (g_preds, g_target), mask = sh_auroc._gather_streams()
+    g_preds, g_target, mask = (np.asarray(replica0(x)) for x in (g_preds, g_target, mask))
+    host_sd = {
+        "buf_preds": g_preds,
+        "buf_target": g_target,
+        "counts": mask.reshape(world, -1).sum(1).astype(np.int32),
+    }
+    if process_id == 0:
+        np.savez(out_npz, **host_sd)
+
+    print(f"rank {process_id}: OK2")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])

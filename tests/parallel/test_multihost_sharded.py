@@ -1,0 +1,129 @@
+"""Deep multi-host coverage for the Sharded* families (VERDICT r2 item 6).
+
+Extends the basic 2-process test (test_multihost.py) with: a mesh whose
+axis spans processes AND has multiple devices per process (2×2 — the real
+pod topology), every Sharded* family exercised across the boundary, the
+non-divisible-global-batch loud failure, and a checkpoint saved on the
+2-process mesh then loaded on ONE process through load_state_dict's
+mesh-validation paths (`parallel/sharded_metric.py:268-300`).
+
+Reference analog: `/root/reference/tests/bases/test_ddp.py:59-88`.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def two_process_checkpoint(tmp_path_factory):
+    """Run the 2-process × 2-device worker once; yield its checkpoint path."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    worker = os.path.join(os.path.dirname(__file__), "_multihost_worker2.py")
+    out_npz = str(tmp_path_factory.mktemp("ckpt") / "sharded_auroc.npz")
+    env = dict(os.environ)
+    # two virtual CPU devices per process -> 4-device mesh across 2 processes
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, coordinator, "2", str(rank), out_npz],
+            cwd=repo_root,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for rank in range(2)
+    ]
+    try:
+        outputs = [p.communicate(timeout=240)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    for rank, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, f"rank {rank} failed:\n{out}"
+        assert f"rank {rank}: OK2" in out, out
+    return out_npz
+
+
+@pytest.mark.timeout(300)
+def test_all_sharded_families_across_processes(two_process_checkpoint):
+    """The worker asserts every family internally; reaching here means all
+    cross-process checks passed on both ranks."""
+    assert os.path.exists(two_process_checkpoint)
+
+
+@pytest.mark.timeout(300)
+def test_checkpoint_saved_on_two_processes_loads_on_one(two_process_checkpoint):
+    """Pod-to-analysis-host flow: state accumulated on a 4-device mesh over
+    2 processes, checkpointed, restored in THIS single process on a 4-virtual-
+    device mesh, and computed to the identical value."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from sklearn.metrics import roc_auc_score
+
+    from metrics_tpu import ShardedAUROC
+
+    saved = dict(np.load(two_process_checkpoint))
+    world = saved["counts"].shape[0]
+    assert world == 4
+
+    mesh = Mesh(np.array(jax.devices()[:world]), ("data",))
+    capacity_per_device = saved["buf_preds"].shape[0] // world
+    m = ShardedAUROC(capacity_per_device=capacity_per_device, mesh=mesh)
+    m.persistent(True)
+    m.load_state_dict(saved)
+    assert m._n_seen == int(saved["counts"].sum())
+
+    # oracle: the same stream the workers accumulated (seed 0, 256 samples)
+    rng = np.random.RandomState(0)
+    preds = rng.rand(8, 32).astype(np.float32).reshape(-1)
+    target = rng.randint(2, size=(8, 32)).reshape(-1)
+    assert abs(float(m.compute()) - roc_auc_score(target, preds)) < 1e-6
+
+    # continuing to accumulate after restore stays correct
+    extra_p = rng.rand(world * 4).astype(np.float32)
+    extra_t = rng.randint(2, size=world * 4)
+    m.update(jnp.asarray(extra_p), jnp.asarray(extra_t))
+    all_p = np.concatenate([preds, extra_p])
+    all_t = np.concatenate([target, extra_t])
+    m._computed = None
+    assert abs(float(m.compute()) - roc_auc_score(all_t, all_p)) < 1e-6
+
+
+@pytest.mark.timeout(300)
+def test_checkpoint_mesh_validation_errors(two_process_checkpoint):
+    """A 4-device checkpoint must refuse to load into a different world size
+    or capacity — the validation paths at sharded_metric.py:268-300."""
+    import jax
+    from jax.sharding import Mesh
+
+    from metrics_tpu import ShardedAUROC
+
+    saved = dict(np.load(two_process_checkpoint))
+
+    one_dev = Mesh(np.array(jax.devices()[:1]), ("data",))
+    m1 = ShardedAUROC(capacity_per_device=256, mesh=one_dev)
+    with pytest.raises(ValueError, match="4-device mesh axis but this metric shards over 1"):
+        m1.load_state_dict(saved)
+
+    four_dev = Mesh(np.array(jax.devices()[:4]), ("data",))
+    m4 = ShardedAUROC(capacity_per_device=8, mesh=four_dev)  # wrong capacity
+    with pytest.raises(ValueError, match="capacity"):
+        m4.load_state_dict(saved)

@@ -1,0 +1,86 @@
+"""Protocol smoke tests for the on-TPU correctness tier (tpu_correctness.py).
+
+The tier itself needs the real chip (`make test-tpu`); these tests pin the
+harness around it — the child's check protocol, the parser, and the
+probe-gated failure path — on CPU at reduced scale, so a broken harness
+can't silently produce an empty-but-green artifact.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXPECTED_CHECKS = {
+    "accuracy",
+    "auroc_sort_kernel",
+    "confusion_matrix",
+    "ssim_conv",
+    "r2score_moments",
+    "retrieval_map",
+    "sharded_auroc_mesh",
+}
+
+
+def test_child_protocol_and_oracles_cpu():
+    """The child emits one in-tolerance CHECK line per family and DONE."""
+    env = dict(os.environ, TPU_TEST_FORCE_CPU="1", TPU_TEST_SCALE="0.02")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tpu_correctness.py"), "--child"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    lines = proc.stdout.splitlines()
+    checks = {}
+    for line in lines:
+        parts = line.split()
+        if parts and parts[0] == "CHECK":
+            checks[parts[1]] = (float(parts[2]), float(parts[4]))
+    assert any(line.startswith("PLATFORM cpu") for line in lines)
+    assert "DONE" in proc.stdout
+    assert set(checks) == EXPECTED_CHECKS
+    for name, (abs_err, tol) in checks.items():
+        assert abs_err <= tol, (name, abs_err, tol)
+
+
+def test_parent_refuses_cpu_and_partial_runs(monkeypatch, tmp_path):
+    """ok=True requires: probe up, all checks complete+pass, platform != cpu."""
+    import tpu_correctness as tier
+
+    monkeypatch.setattr(tier, "ARTIFACT", str(tmp_path / "TPU_TEST.json"))
+
+    # probe down -> error artifact, no checks
+    monkeypatch.setattr(tier, "_probe_accelerator", lambda *a, **k: False)
+    assert tier.main() == 2
+    saved = json.loads((tmp_path / "TPU_TEST.json").read_text())
+    assert saved["ok"] is False and "probe failed" in saved["error"]
+
+    # canned child outputs through the real parser
+    class FakeProc:
+        def __init__(self, stdout):
+            self.stdout = stdout
+            self.stderr = ""
+            self.returncode = 0
+
+    cases = [
+        # cpu platform must not be ok even with all checks passing
+        ("PLATFORM cpu\nCHECK accuracy 0.0 0.5 1e-6\nDONE\n", False),
+        # a failing check fails the run
+        ("PLATFORM tpu\nCHECK accuracy 0.5 0.5 1e-6\nDONE\n", False),
+        # an incomplete run (no DONE: child died mid-way) fails the run
+        ("PLATFORM tpu\nCHECK accuracy 0.0 0.5 1e-6\n", False),
+        # complete passing tpu run is ok
+        ("PLATFORM tpu\nCHECK accuracy 0.0 0.5 1e-6\nDONE\n", True),
+    ]
+    monkeypatch.setattr(tier, "_probe_accelerator", lambda *a, **k: True)
+    for stdout, want_ok in cases:
+        monkeypatch.setattr(tier.subprocess, "run", lambda *a, **k: FakeProc(stdout))
+        code = tier.main()
+        saved = json.loads((tmp_path / "TPU_TEST.json").read_text())
+        assert saved["ok"] is want_ok, (stdout, saved)
+        assert code == (0 if want_ok else 1)
