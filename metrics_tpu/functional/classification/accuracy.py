@@ -7,16 +7,13 @@ import jax.numpy as jnp
 from jax import lax
 
 from metrics_tpu.utilities.checks import (
-    _check_classification_inputs,
-    _detect_case,
+    _fast_path_inputs,
+    _fast_path_validate,
     _input_format_classification,
-    _is_floating,
-    _Probe,
     _prob_sum_atol,
     _probe_scalars,
-    _squeeze_shape,
+    fast_path_memo,
 )
-from metrics_tpu.utilities.data import _is_concrete
 from metrics_tpu.utilities.enums import DataType
 
 
@@ -103,25 +100,13 @@ def _accuracy_fast_update(
     Validation parity is preserved: the fused kernel returns the same probe
     scalars the canonical path reads, and they run through the identical
     ``_check_classification_inputs`` pipeline (same errors, same order of
-    value checks) before the counts are accepted.
+    value checks — shared ``_fast_path_inputs``/``_fast_path_validate``
+    scaffolding) before the counts are accepted.
     """
-    if not (_is_concrete(preds) and _is_concrete(target)):
-        return None  # traced: the canonical path handles jit semantics
-    if _is_floating(target):
-        return None  # let the canonical path raise its error
-    p_shape = _squeeze_shape(preds.shape)
-    t_shape = _squeeze_shape(target.shape)
-    preds_float = _is_floating(preds)
-
-    if (p_shape[0] if p_shape else 0) != (t_shape[0] if t_shape else 0):
-        # _detect_case tolerates this (an (N, C)/(M,) pair parses fine), but
-        # the kernel would crash on it — the canonical path raises the
-        # parity error before any compute, so defer to it
+    shapes = _fast_path_inputs(preds, target)
+    if shapes is None:
         return None
-    try:
-        case, implied_classes = _detect_case(p_shape, t_shape, preds_float)
-    except ValueError:
-        return None  # canonical path raises the identical error
+    p_shape, t_shape, preds_float, case, implied_classes = shapes
     if case == DataType.MULTIDIM_MULTICLASS:
         return None
     if case == DataType.MULTICLASS and p_shape != t_shape and (len(p_shape) != 2 or implied_classes < 2):
@@ -133,30 +118,26 @@ def _accuracy_fast_update(
     if case == DataType.MULTILABEL and (top_k or not preds_float):
         return None  # top_k raises below; int multilabel has onehot quirks
 
-    raw = _accuracy_probe_count(
-        preds,
-        target,
-        p_shape=p_shape,
-        t_shape=t_shape,
-        case=case.value,
-        threshold=float(threshold),
-        top_k=top_k,
-        subset_accuracy=subset_accuracy,
-        sum_atol=_prob_sum_atol(preds, p_shape, case == DataType.MULTICLASS and preds_float),
-    )
-    probe = _Probe(float(raw[0]), float(raw[1]), int(raw[2]), int(raw[3]), bool(raw[4]))
-    _check_classification_inputs(
-        preds,
-        target,
-        threshold=threshold,
-        num_classes=None,
-        is_multiclass=None,
-        top_k=top_k,
-        p_shape=p_shape,
-        t_shape=t_shape,
-        probe=probe,
-    )
-    return raw[5], raw[6]
+    def compute():
+        raw = _accuracy_probe_count(
+            preds,
+            target,
+            p_shape=p_shape,
+            t_shape=t_shape,
+            case=case.value,
+            threshold=float(threshold),
+            top_k=top_k,
+            subset_accuracy=subset_accuracy,
+            sum_atol=_prob_sum_atol(preds, p_shape, case == DataType.MULTICLASS and preds_float),
+        )
+        _fast_path_validate(
+            preds, target, p_shape, t_shape, raw[:5],
+            threshold=threshold, num_classes=None, is_multiclass=None, top_k=top_k,
+        )
+        return raw[5], raw[6]
+
+    key = ("accuracy", id(preds), id(target), float(threshold), top_k, subset_accuracy)
+    return fast_path_memo(key, (preds, target), compute)
 
 
 def _accuracy_update(

@@ -10,7 +10,14 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities import rank_zero_warn
-from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.checks import (
+    _fast_path_inputs,
+    _fast_path_validate,
+    _input_format_classification,
+    _prob_sum_atol,
+    _probe_scalars,
+    fast_path_memo,
+)
 from metrics_tpu.utilities.data import _is_concrete
 from metrics_tpu.utilities.enums import DataType
 
@@ -42,9 +49,118 @@ def _max_label_probe(preds, target, argmax_first):
     return jnp.maximum(jnp.max(preds), jnp.max(target))
 
 
+@partial(
+    jax.jit,
+    static_argnames=("p_shape", "t_shape", "case", "num_classes", "threshold", "multilabel", "sum_atol"),
+)
+def _confmat_probe_count(preds, target, p_shape, t_shape, case, num_classes, threshold, multilabel, sum_atol):
+    """Single-pass probe + confusion counts straight from RAW inputs.
+
+    The canonical path expands both inputs to ``(N, C)`` one-hots
+    (``_canonicalize_jit``) only for ``_confmat_count`` to ``argmax`` them
+    back into labels — two (N, C) int arrays of traffic for a ``(C, C)``
+    result. This kernel thresholds/argmaxes the raw arrays and bincounts,
+    fused with the validation value probe: one program, one pass.
+    """
+    case = DataType(case)
+    preds = preds.reshape(p_shape)
+    target = target.reshape(t_shape)
+    if preds.dtype in (jnp.float16, jnp.bfloat16):
+        preds = preds.astype(jnp.float32)
+
+    check_prob_sum = (
+        case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
+        and jnp.issubdtype(preds.dtype, jnp.floating)
+        and preds.ndim == target.ndim + 1
+    )
+    pmin, pmax, tmin, tmax, prob_ok = _probe_scalars(preds, target, check_prob_sum, sum_atol)
+
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        if preds.ndim == target.ndim + 1:
+            pred_labels = jnp.argmax(preds, axis=1)  # (N, ...) labels
+        else:
+            pred_labels = (preds >= threshold).astype(jnp.int32)
+    else:
+        pred_labels = preds
+    # out-of-range-label detection needs the POST-argmax/threshold labels
+    # (prob inputs always produce in-range labels; raw label inputs may not)
+    max_label = jnp.maximum(jnp.max(pred_labels), jnp.max(target))
+
+    if multilabel:
+        unique_mapping = ((2 * target + pred_labels) + 4 * jnp.arange(num_classes)).flatten()
+        bins = jnp.bincount(unique_mapping, length=4 * num_classes)
+        confmat = bins.reshape(num_classes, 2, 2)
+    else:
+        unique_mapping = (target.reshape(-1) * num_classes + pred_labels.reshape(-1)).astype(jnp.int32)
+        bins = jnp.bincount(unique_mapping, length=num_classes**2)
+        confmat = bins.reshape(num_classes, num_classes)
+
+    return pmin, pmax, tmin, tmax, prob_ok, max_label, confmat
+
+
+def _confmat_fast_update(
+    preds: jax.Array, target: jax.Array, num_classes: int, threshold: float, multilabel: bool
+) -> Optional[jax.Array]:
+    """Fast path for the common eager cases; None = take the canonical path.
+
+    Validation parity is preserved exactly as in the accuracy fast path
+    (shared ``_fast_path_inputs``/``_fast_path_validate`` scaffolding, with
+    ``num_classes`` left out of the checks, as the canonical path does via
+    ``_num_classes_hint``), plus the confusion-matrix-specific
+    out-of-range-label error.
+    """
+    shapes = _fast_path_inputs(preds, target)
+    if shapes is None:
+        return None
+    p_shape, t_shape, preds_float, case, implied_classes = shapes
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and p_shape != t_shape:
+        if implied_classes < 2:
+            return None
+    if multilabel and not (case == DataType.MULTILABEL and len(p_shape) == 2):
+        # the (C, 2, 2) formula assumes exactly (N, num_classes) columns
+        return None
+    if case == DataType.MULTILABEL and p_shape[1:] != (num_classes,) and multilabel:
+        return None
+
+    def compute():
+        raw = _confmat_probe_count(
+            preds,
+            target,
+            p_shape=p_shape,
+            t_shape=t_shape,
+            case=case.value,
+            num_classes=num_classes,
+            threshold=float(threshold),
+            multilabel=multilabel,
+            sum_atol=_prob_sum_atol(
+                preds, p_shape, case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and preds_float
+            ),
+        )
+        _fast_path_validate(
+            preds, target, p_shape, t_shape, raw[:5],
+            threshold=threshold, num_classes=None, is_multiclass=None, top_k=None,
+        )
+        max_label = int(raw[5])
+        if not multilabel and max_label >= num_classes:
+            raise ValueError(
+                f"Detected class label {max_label} which is larger than or equal to"
+                f" `num_classes`={num_classes} in the confusion matrix computation."
+            )
+        return raw[6]
+
+    # CohenKappa/MatthewsCorrcoef/IoU siblings in one collection share the
+    # kernel run per batch
+    key = ("confusion_matrix", id(preds), id(target), num_classes, float(threshold), multilabel)
+    return fast_path_memo(key, (preds, target), compute)
+
+
 def _confusion_matrix_update(
     preds: jax.Array, target: jax.Array, num_classes: int, threshold: float = 0.5, multilabel: bool = False
 ) -> jax.Array:
+    fast = _confmat_fast_update(jnp.asarray(preds), jnp.asarray(target), num_classes, threshold, multilabel)
+    if fast is not None:
+        return fast
+
     preds, target, mode = _input_format_classification(preds, target, threshold, _num_classes_hint=num_classes)
     argmax_first = mode not in (DataType.BINARY, DataType.MULTILABEL)
     # Fixed-length bincount silently drops out-of-range indices under jit, so

@@ -1,14 +1,27 @@
 """Stat scores (tp/fp/tn/fn) — the shared counting core of the classification pack.
 
 Parity: ``torchmetrics/functional/classification/stat_scores.py``. The
-boolean-mask + sum formulation maps directly onto XLA fused reductions.
+boolean-mask + sum formulation maps directly onto XLA fused reductions; the
+common eager cases skip the one-hot canonicalization entirely via a fused
+probe+count kernel in label space (bincounts), like the accuracy and
+confusion-matrix fast paths.
 """
+from functools import partial
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
-from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.checks import (
+    _fast_path_inputs,
+    _fast_path_validate,
+    _input_format_classification,
+    _prob_sum_atol,
+    _probe_scalars,
+    fast_path_memo,
+)
+from metrics_tpu.utilities.enums import DataType
 
 
 def _del_column(x: jax.Array, index: int) -> jax.Array:
@@ -72,6 +85,212 @@ def _stat_scores_count(preds, target, reduce, mdmc_reduce, ignore_index):
     return tp, fp, tn, fn
 
 
+@partial(
+    jax.jit,
+    static_argnames=(
+        "p_shape", "t_shape", "case", "reduce", "num_classes", "top_k", "threshold", "ignore_index", "sum_atol"
+    ),
+)
+def _stat_scores_probe_count(
+    preds, target, p_shape, t_shape, case, reduce, num_classes, top_k, threshold, ignore_index, sum_atol
+):
+    """Single-pass probe + tp/fp/tn/fn straight from RAW inputs.
+
+    The canonical path expands both inputs to ``(N, C)`` one-hots and sums
+    boolean masks over them; in label space the same per-class counts are
+    three ``bincount``s (predicted-positives, support, hits), and the
+    micro/samples reductions derive from them — one program, one data pass,
+    no ``(N, C)`` intermediates. MDMC-global inputs reach here pre-flattened
+    to the 2-d layout (exactly the canonical `swapaxes+reshape`).
+    """
+    case = DataType(case)
+    preds = preds.reshape(p_shape)
+    target = target.reshape(t_shape)
+    if preds.dtype in (jnp.float16, jnp.bfloat16):
+        preds = preds.astype(jnp.float32)
+
+    check_prob_sum = (
+        case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
+        and jnp.issubdtype(preds.dtype, jnp.floating)
+        and preds.ndim == target.ndim + 1
+    )
+    pmin, pmax, tmin, tmax, prob_ok = _probe_scalars(preds, target, check_prob_sum, sum_atol)
+
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+        num_cols = num_classes
+        if preds.ndim == target.ndim + 1:  # (M, C) probabilities
+            # flatten any trailing dims (MDMC-global layout) to (M, C)/(M,)
+            flat_p = jnp.moveaxis(preds, 1, -1).reshape(-1, preds.shape[1])
+            flat_t = target.reshape(-1)
+            k = top_k or 1
+            if k == 1:
+                pred_labels = jnp.argmax(flat_p, axis=1)
+                hit = pred_labels == flat_t
+                count_pred = jnp.bincount(pred_labels, length=num_cols)
+                memb_ignore = (
+                    pred_labels == ignore_index if ignore_index is not None else None
+                )
+            else:
+                _, idx = lax.top_k(flat_p, k)  # (M, k)
+                hit = jnp.any(idx == flat_t[:, None], axis=1)
+                count_pred = jnp.bincount(idx.reshape(-1), length=num_cols)
+                memb_ignore = (
+                    jnp.any(idx == ignore_index, axis=1) if ignore_index is not None else None
+                )
+        else:  # (M,) label predictions
+            flat_p = preds.reshape(-1)
+            flat_t = target.reshape(-1)
+            k = 1
+            hit = flat_p == flat_t
+            count_pred = jnp.bincount(flat_p, length=num_cols)
+            memb_ignore = flat_p == ignore_index if ignore_index is not None else None
+
+        m = flat_t.shape[0]
+        support = jnp.bincount(flat_t, length=num_cols)
+        # integer weights: float32 scatter-add saturates at 2^24 and would
+        # silently undercount tp on >16.7M-hit classes
+        tp_c = jnp.bincount(flat_t, weights=hit.astype(jnp.int32), length=num_cols).astype(jnp.int32)
+        fn_c = (support - tp_c).astype(jnp.int32)
+        fp_c = (count_pred - tp_c).astype(jnp.int32)
+        tn_c = (m - support - fp_c).astype(jnp.int32)
+
+        if reduce == "macro":
+            tp, fp, tn, fn = tp_c, fp_c, tn_c, fn_c
+            if ignore_index is not None:
+                tp = tp.at[ignore_index].set(-1)
+                fp = fp.at[ignore_index].set(-1)
+                tn = tn.at[ignore_index].set(-1)
+                fn = fn.at[ignore_index].set(-1)
+        elif reduce == "micro":
+            if ignore_index is not None:
+                keep = jnp.arange(num_cols) != ignore_index
+                tp = jnp.sum(tp_c * keep)
+                fp = jnp.sum(fp_c * keep)
+                tn = jnp.sum(tn_c * keep)
+                fn = jnp.sum(fn_c * keep)
+            else:
+                tp, fp, tn, fn = jnp.sum(tp_c), jnp.sum(fp_c), jnp.sum(tn_c), jnp.sum(fn_c)
+        else:  # samples: per-row over the (M, C) binary layout
+            t_valid = flat_t != ignore_index if ignore_index is not None else jnp.ones_like(hit)
+            tp = (hit & t_valid).astype(jnp.int32)
+            kk = k - memb_ignore.astype(jnp.int32) if ignore_index is not None else k
+            cols = num_cols - (1 if ignore_index is not None else 0)
+            fp = (kk - tp).astype(jnp.int32)
+            fn = (t_valid.astype(jnp.int32) - tp).astype(jnp.int32)
+            tn = (cols - tp - fp - fn).astype(jnp.int32)
+    elif case == DataType.MULTILABEL:
+        pbin = (preds >= threshold).astype(jnp.int32)
+        tbin = target.astype(jnp.int32)
+        tp_nc = pbin * tbin
+        fp_nc = pbin * (1 - tbin)
+        fn_nc = (1 - pbin) * tbin
+        tn_nc = (1 - pbin) * (1 - tbin)
+        if reduce == "macro":
+            tp, fp, tn, fn = (x.sum(axis=0).astype(jnp.int32) for x in (tp_nc, fp_nc, tn_nc, fn_nc))
+            if ignore_index is not None:
+                tp = tp.at[ignore_index].set(-1)
+                fp = fp.at[ignore_index].set(-1)
+                tn = tn.at[ignore_index].set(-1)
+                fn = fn.at[ignore_index].set(-1)
+        else:
+            if ignore_index is not None:
+                keep = (jnp.arange(p_shape[1]) != ignore_index)[None, :]
+                tp_nc, fp_nc, fn_nc, tn_nc = (x * keep for x in (tp_nc, fp_nc, fn_nc, tn_nc))
+            axis = (0, 1) if reduce == "micro" else 1
+            tp, fp, tn, fn = (x.sum(axis=axis).astype(jnp.int32) for x in (tp_nc, fp_nc, tn_nc, fn_nc))
+    else:  # BINARY: canonical layout is (N, 1)
+        pbin = (preds >= threshold).astype(jnp.int32)
+        tbin = target.astype(jnp.int32)
+        tp_n = pbin * tbin
+        fp_n = pbin * (1 - tbin)
+        fn_n = (1 - pbin) * tbin
+        tn_n = (1 - pbin) * (1 - tbin)
+        if reduce == "samples":
+            tp, fp, tn, fn = tp_n, fp_n, tn_n, fn_n
+        else:
+            tp, fp, tn, fn = (x.sum().astype(jnp.int32) for x in (tp_n, fp_n, tn_n, fn_n))
+            if reduce == "macro":  # canonical (N, 1) macro output is (1,)
+                tp, fp, tn, fn = (x.reshape(1) for x in (tp, fp, tn, fn))
+
+    return pmin, pmax, tmin, tmax, prob_ok, tp, fp, tn, fn
+
+
+def _stat_scores_fast_update(
+    preds, target, reduce, mdmc_reduce, num_classes, top_k, threshold, is_multiclass, ignore_index
+):
+    """Fast path for the common eager cases; None = take the canonical path.
+
+    Validation parity: the fused kernel's probe scalars run through the
+    identical ``_check_classification_inputs`` pipeline (same arguments the
+    canonical call passes, same errors), then the same ``ignore_index`` /
+    ``mdmc_reduce`` checks in the same order.
+    """
+    if is_multiclass is not None:
+        return None
+    shapes = _fast_path_inputs(preds, target)
+    if shapes is None:
+        return None
+    p_shape, t_shape, preds_float, case, implied_classes = shapes
+
+    if top_k is not None and (
+        not isinstance(top_k, int)
+        or top_k <= 0
+        or top_k >= implied_classes
+        or case in (DataType.BINARY, DataType.MULTILABEL)
+        or not preds_float
+    ):
+        return None  # canonical path raises the parity top_k errors
+    if case == DataType.MULTIDIM_MULTICLASS and mdmc_reduce != "global":
+        return None  # samplewise shapes / missing-mdmc error: canonical path
+    if case == DataType.BINARY and ignore_index is not None:
+        return None  # canonical "can not use ignore_index with binary" error
+    if case == DataType.MULTILABEL and len(p_shape) != 2:
+        return None  # deep multilabel flattens to (N, C*X) canonically
+    if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
+        if p_shape == t_shape or len(p_shape) == len(t_shape):
+            # label predictions: the one-hot width is num_classes (or the
+            # data max, which needs its own probe) — require it static
+            if num_classes is None:
+                return None
+            n_cols = num_classes
+        else:
+            if implied_classes < 2:
+                return None
+            n_cols = implied_classes
+    else:
+        n_cols = p_shape[1] if len(p_shape) > 1 else 1
+
+    def compute():
+        raw = _stat_scores_probe_count(
+            preds,
+            target,
+            p_shape=p_shape,
+            t_shape=t_shape,
+            case=case.value,
+            reduce=reduce,
+            num_classes=n_cols,
+            top_k=top_k,
+            threshold=float(threshold),
+            ignore_index=ignore_index,
+            sum_atol=_prob_sum_atol(
+                preds, p_shape, case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and preds_float
+            ),
+        )
+        _fast_path_validate(
+            preds, target, p_shape, t_shape, raw[:5],
+            threshold=threshold, num_classes=num_classes, is_multiclass=is_multiclass, top_k=top_k,
+        )
+        if ignore_index is not None and not 0 <= ignore_index < n_cols:
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {n_cols} classes")
+        return raw[5], raw[6], raw[7], raw[8]
+
+    # sibling metrics with identical stat-scores arguments (Precision /
+    # Recall / F1 in one collection) share the kernel run per batch
+    key = ("stat_scores", id(preds), id(target), reduce, mdmc_reduce, n_cols,
+           num_classes, top_k, float(threshold), ignore_index)
+    return fast_path_memo(key, (preds, target), compute)
+
+
 def _stat_scores_update(
     preds: jax.Array,
     target: jax.Array,
@@ -84,6 +303,13 @@ def _stat_scores_update(
     ignore_index: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Canonicalize inputs and compute the tp/fp/tn/fn partial statistics."""
+    fast = _stat_scores_fast_update(
+        jnp.asarray(preds), jnp.asarray(target), reduce, mdmc_reduce, num_classes, top_k,
+        threshold, is_multiclass, ignore_index,
+    )
+    if fast is not None:
+        return fast
+
     preds, target, _ = _input_format_classification(
         preds, target, threshold=threshold, num_classes=num_classes, is_multiclass=is_multiclass, top_k=top_k
     )

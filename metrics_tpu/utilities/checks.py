@@ -400,6 +400,88 @@ def shared_canonicalization():
         _canon_memo.store = prev
 
 
+def _fast_path_inputs(preds: jax.Array, target: jax.Array):
+    """Shared eligibility preamble for the fused fast-path kernels
+    (accuracy / confusion-matrix / stat-scores): concrete inputs, int
+    target, matching first dims, and a detectable case. Returns
+    ``(p_shape, t_shape, preds_float, case, implied_classes)`` or None —
+    None always means "take the canonical path", which raises the parity
+    errors for the rejected configurations. ONE definition so the
+    validation-parity contract cannot drift between metrics.
+    """
+    if not (_is_concrete(preds) and _is_concrete(target)):
+        return None  # traced: the canonical path handles jit semantics
+    if _is_floating(target):
+        return None  # canonical path raises the parity error
+    p_shape = _squeeze_shape(preds.shape)
+    t_shape = _squeeze_shape(target.shape)
+    preds_float = _is_floating(preds)
+    if (p_shape[0] if p_shape else 0) != (t_shape[0] if t_shape else 0):
+        # _detect_case tolerates an (N, C)/(M,) pair, but the kernels would
+        # crash on it — the canonical path raises the parity error first
+        return None
+    try:
+        case, implied_classes = _detect_case(p_shape, t_shape, preds_float)
+    except ValueError:
+        return None  # canonical path raises the identical error
+    return p_shape, t_shape, preds_float, case, implied_classes
+
+
+def _fast_path_validate(
+    preds,
+    target,
+    p_shape,
+    t_shape,
+    raw_probe,
+    threshold: float,
+    num_classes: Optional[int],
+    is_multiclass: Optional[bool],
+    top_k: Optional[int],
+) -> None:
+    """Run the canonical validation pipeline from a fused kernel's probe
+    scalars (``raw_probe`` = the first five outputs of a kernel that fused
+    :func:`_probe_scalars`). Raises exactly what the canonical path raises."""
+    probe = _Probe(
+        float(raw_probe[0]), float(raw_probe[1]), int(raw_probe[2]), int(raw_probe[3]), bool(raw_probe[4])
+    )
+    _check_classification_inputs(
+        preds,
+        target,
+        threshold=threshold,
+        num_classes=num_classes,
+        is_multiclass=is_multiclass,
+        top_k=top_k,
+        p_shape=p_shape,
+        t_shape=t_shape,
+        probe=probe,
+    )
+
+
+def fast_path_memo(key: tuple, originals: tuple, compute):
+    """Memoize a fast-path update under :func:`shared_canonicalization`.
+
+    The fused kernels bypass ``_input_format_classification`` (and with it
+    the canonicalization memo), so sibling metrics in a collection — e.g.
+    Precision/Recall/F1, whose stat-scores updates take identical arguments
+    — would re-run the identical device program per step. This gives them
+    the same one-run-per-batch sharing, keyed on input identity + the full
+    option tuple, pinning ``originals`` so the ids stay valid. Outside a
+    sharing context it just runs ``compute``.
+    """
+    store = getattr(_canon_memo, "store", None)
+    if store is None:
+        return compute()
+    hit = store.get(key)
+    if hit is not None:
+        return hit[-1]
+    result = compute()
+    if result is not None:
+        if len(store) >= _CANON_MEMO_MAX:
+            store.clear()  # mis-scoped context: stay bounded
+        store[key] = (*originals, result)
+    return result
+
+
 def _input_format_classification(
     preds,
     target,

@@ -140,29 +140,73 @@ def test_collection_shares_canonicalization_across_siblings():
     probs = probs / probs.sum(1, keepdims=True)
     target = jnp.asarray(rng.randint(3, size=64))
 
+    # is_multiclass=True forces the canonical (one-hot) path: the fused
+    # fast-path kernels (which skip canonicalization per-metric and make the
+    # memo irrelevant) decline any is_multiclass override
+    col = MetricCollection([
+        Precision(num_classes=3, average="macro", is_multiclass=True),
+        Recall(num_classes=3, average="macro", is_multiclass=True),
+        F1(num_classes=3, average="macro", is_multiclass=True),
+    ])
+
+    misses = []
+    orig_canon = checks._canonicalize_jit
+
+    def counting_canon(*args, **kwargs):
+        misses.append(1)
+        return orig_canon(*args, **kwargs)
+
+    # _canonicalize_jit runs only on memo MISS: counting it counts actual
+    # canonicalizations, not memo-served calls
+    with mock.patch.object(checks, "_canonicalize_jit", counting_canon):
+        col.update(probs, target)
+    assert len(misses) == 1, f"expected one shared canonicalization, got {len(misses)}"
+
+    out = col.compute()
+    standalone = Precision(num_classes=3, average="macro", is_multiclass=True)
+    standalone.update(probs, target)
+    assert np.allclose(float(out["Precision"]), float(standalone.compute()), atol=1e-7)
+
+    # outside a collection call, no memo is active
+    assert getattr(checks._canon_memo, "store", None) is None
+
+
+def test_collection_shares_fast_path_kernel_across_siblings():
+    """Precision/Recall/F1 (identical stat-scores arguments) run the fused
+    fast-path kernel ONCE per collection batch — the fast-path analog of the
+    canonicalization memo."""
+    import sys
+    from unittest import mock
+
+    import numpy as np
+
+    from metrics_tpu import F1, MetricCollection, Precision, Recall
+
+    ss_mod = sys.modules["metrics_tpu.functional.classification.stat_scores"]
+
+    rng = np.random.RandomState(11)
+    probs = jnp.asarray(rng.rand(64, 3).astype(np.float32))
+    probs = probs / probs.sum(1, keepdims=True)
+    target = jnp.asarray(rng.randint(3, size=64))
+
     col = MetricCollection([
         Precision(num_classes=3, average="macro"),
         Recall(num_classes=3, average="macro"),
         F1(num_classes=3, average="macro"),
     ])
 
-    real = checks._check_classification_inputs
     calls = []
+    real = ss_mod._stat_scores_probe_count
 
     def counting(*args, **kwargs):
         calls.append(1)
         return real(*args, **kwargs)
 
-    # _check_classification_inputs runs only on memo MISS: counting it counts
-    # actual canonicalizations, not memo-served calls
-    with mock.patch.object(checks, "_check_classification_inputs", counting):
+    with mock.patch.object(ss_mod, "_stat_scores_probe_count", counting):
         col.update(probs, target)
-    assert len(calls) == 1, f"expected one shared canonicalization, got {len(calls)}"
+    assert len(calls) == 1, f"expected one shared kernel run, got {len(calls)}"
 
-    out = col.compute()
+    # and values still match a standalone metric
     standalone = Precision(num_classes=3, average="macro")
     standalone.update(probs, target)
-    assert np.allclose(float(out["Precision"]), float(standalone.compute()), atol=1e-7)
-
-    # outside a collection call, no memo is active
-    assert getattr(checks._canon_memo, "store", None) is None
+    assert np.allclose(float(col.compute()["Precision"]), float(standalone.compute()), atol=1e-7)

@@ -147,3 +147,50 @@ def test_confusion_matrix_jittable():
     result_norm = jitted_norm(preds_lab, target_lab)
     assert not np.any(np.isnan(np.asarray(result_norm)))
     assert np.allclose(np.asarray(result_norm), np.asarray(expected_norm))
+
+
+def test_fast_update_matches_canonical_path(monkeypatch):
+    """The fused single-pass probe+count kernel must agree exactly with the
+    one-hot canonicalization path on every eligible input case."""
+    import sys
+
+    cm_mod = sys.modules["metrics_tpu.functional.classification.confusion_matrix"]
+    rng = np.random.RandomState(43)
+
+    probs = rng.rand(257, 5).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    mdmc_probs = rng.rand(64, 5, 7).astype(np.float32)
+    mdmc_probs /= mdmc_probs.sum(1, keepdims=True)
+    ml_probs = rng.rand(257, 5).astype(np.float32)
+
+    cases = [
+        # (preds, target, num_classes, threshold, multilabel)
+        (probs, rng.randint(5, size=257), 5, 0.5, False),
+        (rng.randint(5, size=257), rng.randint(5, size=257), 5, 0.5, False),
+        (rng.rand(257).astype(np.float32), rng.randint(2, size=257), 2, 0.3, False),
+        (mdmc_probs, rng.randint(5, size=(64, 7)), 5, 0.5, False),
+        (rng.randint(5, size=(64, 7)), rng.randint(5, size=(64, 7)), 5, 0.5, False),
+        (ml_probs, rng.randint(2, size=(257, 5)), 5, 0.5, False),
+        (ml_probs, rng.randint(2, size=(257, 5)), 5, 0.5, True),
+    ]
+    for preds, target, num_classes, threshold, multilabel in cases:
+        args = (jnp.asarray(preds), jnp.asarray(target), num_classes, threshold, multilabel)
+        fast = cm_mod._confmat_fast_update(*args)
+        assert fast is not None, (preds.shape, multilabel)
+        with monkeypatch.context() as mp:
+            mp.setattr(cm_mod, "_confmat_fast_update", lambda *a, **k: None)
+            slow = cm_mod._confusion_matrix_update(*args)
+        assert np.array_equal(np.asarray(fast), np.asarray(slow)), (preds.shape, multilabel)
+
+
+def test_fast_update_keeps_validation_errors():
+    """Same eager validation errors as the canonical path."""
+    probs = jnp.asarray([[0.6, 0.4], [0.3, 0.7]])
+    with pytest.raises(ValueError, match="larger than or equal to"):
+        confusion_matrix(jnp.asarray([0, 3]), jnp.asarray([1, 0]), num_classes=2)
+    with pytest.raises(ValueError, match="sum up to 1"):
+        confusion_matrix(jnp.asarray([[0.9, 0.9], [0.1, 0.1]]), jnp.asarray([1, 0]), num_classes=2)
+    with pytest.raises(ValueError, match="probabilities, but values"):
+        confusion_matrix(jnp.asarray([1.4, -0.1]), jnp.asarray([1, 0]), num_classes=2)
+    with pytest.raises(ValueError, match="same first dimension"):
+        confusion_matrix(probs, jnp.asarray([1, 0, 1]), num_classes=2)

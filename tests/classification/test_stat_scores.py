@@ -220,3 +220,67 @@ class TestStatScores(MetricTester):
                 "top_k": top_k,
             },
         )
+
+
+def test_fast_update_matches_canonical_path(monkeypatch):
+    """The fused label-space bincount kernel must agree exactly with the
+    one-hot canonicalization path on every eligible configuration."""
+    import sys
+
+    ss_mod = sys.modules["metrics_tpu.functional.classification.stat_scores"]
+    rng = np.random.RandomState(47)
+
+    probs = rng.rand(257, 5).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    labels = rng.randint(5, size=257)
+    mdmc_probs = rng.rand(64, 5, 7).astype(np.float32)
+    mdmc_probs /= mdmc_probs.sum(1, keepdims=True)
+    ml_probs = rng.rand(257, 4).astype(np.float32)
+    ml_target = rng.randint(2, size=(257, 4))
+
+    cases = []
+    for reduce in ("micro", "macro", "samples"):
+        for ignore_index in (None, 1):
+            cases.append((probs, labels, dict(reduce=reduce, num_classes=5, ignore_index=ignore_index)))
+            cases.append((rng.randint(5, size=257), labels,
+                          dict(reduce=reduce, num_classes=5, ignore_index=ignore_index)))
+            cases.append((ml_probs, ml_target,
+                          dict(reduce=reduce, num_classes=4, threshold=0.4, ignore_index=ignore_index)))
+            cases.append((mdmc_probs, rng.randint(5, size=(64, 7)),
+                          dict(reduce=reduce, mdmc_reduce="global", num_classes=5, ignore_index=ignore_index)))
+        cases.append((probs, labels, dict(reduce=reduce, num_classes=5, top_k=2)))
+        cases.append((rng.rand(257).astype(np.float32), rng.randint(2, size=257),
+                      dict(reduce=reduce, threshold=0.3)))
+
+    for preds, target, kw in cases:
+        kwargs = dict(
+            reduce=kw.get("reduce", "micro"),
+            mdmc_reduce=kw.get("mdmc_reduce"),
+            num_classes=kw.get("num_classes"),
+            top_k=kw.get("top_k"),
+            threshold=kw.get("threshold", 0.5),
+            is_multiclass=None,
+            ignore_index=kw.get("ignore_index"),
+        )
+        fast = ss_mod._stat_scores_fast_update(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+        assert fast is not None, kw
+        with monkeypatch.context() as mp:
+            mp.setattr(ss_mod, "_stat_scores_fast_update", lambda *a, **k: None)
+            slow = ss_mod._stat_scores_update(jnp.asarray(preds), jnp.asarray(target), **kwargs)
+        for name, f, s in zip("tp fp tn fn".split(), fast, slow):
+            assert np.array_equal(np.asarray(f), np.asarray(s)), (kw, name, f, s)
+
+
+def test_fast_update_keeps_validation_errors():
+    """Same eager validation errors as the canonical path."""
+    probs = jnp.asarray(np.random.RandomState(5).rand(8, 3).astype(np.float32))
+    probs = probs / probs.sum(1, keepdims=True)
+    labels = jnp.asarray([0, 1, 2, 0, 1, 2, 0, 1])
+    with pytest.raises(ValueError, match="smaller than the size of the `C` dimension"):
+        stat_scores(probs, jnp.asarray([0, 1, 2, 0, 1, 2, 0, 5]), reduce="macro", num_classes=3)
+    with pytest.raises(ValueError, match="sum up to 1"):
+        stat_scores(probs * 0.5, labels, reduce="macro", num_classes=3)
+    with pytest.raises(ValueError, match="`ignore_index` 7 is not valid"):
+        stat_scores(probs, labels, reduce="micro", num_classes=3, ignore_index=7)
+    with pytest.raises(ValueError, match="same first dimension"):
+        stat_scores(probs, labels[:4], num_classes=3)
