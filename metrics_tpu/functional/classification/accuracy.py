@@ -10,8 +10,8 @@ from metrics_tpu.utilities.checks import (
     _fast_path_inputs,
     _fast_path_validate,
     _input_format_classification,
+    _fused_probe_preamble,
     _prob_sum_atol,
-    _probe_scalars,
     fast_path_memo,
 )
 from metrics_tpu.utilities.enums import DataType
@@ -52,14 +52,8 @@ def _accuracy_probe_count(preds, target, p_shape, t_shape, case, threshold, top_
     the raw arrays, fused with the validation value probe, so the whole
     update is ONE program and one pass over the data.
     """
+    preds, target, probe = _fused_probe_preamble(preds, target, p_shape, t_shape, case, sum_atol)
     case = DataType(case)
-    preds = preds.reshape(p_shape)
-    target = target.reshape(t_shape)
-    if preds.dtype in (jnp.float16, jnp.bfloat16):
-        preds = preds.astype(jnp.float32)
-
-    check_prob_sum = case == DataType.MULTICLASS and jnp.issubdtype(preds.dtype, jnp.floating) and preds.ndim == 2
-    pmin, pmax, tmin, tmax, prob_ok = _probe_scalars(preds, target, check_prob_sum, sum_atol)
 
     if case == DataType.BINARY:
         hit = (preds >= threshold).astype(target.dtype) == target
@@ -85,7 +79,7 @@ def _accuracy_probe_count(preds, target, p_shape, t_shape, case, threshold, top_
         else:
             correct, total = jnp.sum(hit), jnp.asarray(target.size)
 
-    return pmin, pmax, tmin, tmax, prob_ok, correct.astype(jnp.int32), jnp.asarray(total, jnp.int32)
+    return (*probe, correct.astype(jnp.int32), jnp.asarray(total, jnp.int32))
 
 
 def _accuracy_fast_update(

@@ -14,8 +14,8 @@ from metrics_tpu.utilities.checks import (
     _fast_path_inputs,
     _fast_path_validate,
     _input_format_classification,
+    _fused_probe_preamble,
     _prob_sum_atol,
-    _probe_scalars,
     fast_path_memo,
 )
 from metrics_tpu.utilities.data import _is_concrete
@@ -62,18 +62,7 @@ def _confmat_probe_count(preds, target, p_shape, t_shape, case, num_classes, thr
     result. This kernel thresholds/argmaxes the raw arrays and bincounts,
     fused with the validation value probe: one program, one pass.
     """
-    case = DataType(case)
-    preds = preds.reshape(p_shape)
-    target = target.reshape(t_shape)
-    if preds.dtype in (jnp.float16, jnp.bfloat16):
-        preds = preds.astype(jnp.float32)
-
-    check_prob_sum = (
-        case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
-        and jnp.issubdtype(preds.dtype, jnp.floating)
-        and preds.ndim == target.ndim + 1
-    )
-    pmin, pmax, tmin, tmax, prob_ok = _probe_scalars(preds, target, check_prob_sum, sum_atol)
+    preds, target, probe = _fused_probe_preamble(preds, target, p_shape, t_shape, case, sum_atol)
 
     if jnp.issubdtype(preds.dtype, jnp.floating):
         if preds.ndim == target.ndim + 1:
@@ -95,7 +84,7 @@ def _confmat_probe_count(preds, target, p_shape, t_shape, case, num_classes, thr
         bins = jnp.bincount(unique_mapping, length=num_classes**2)
         confmat = bins.reshape(num_classes, num_classes)
 
-    return pmin, pmax, tmin, tmax, prob_ok, max_label, confmat
+    return (*probe, max_label, confmat)
 
 
 def _confmat_fast_update(

@@ -1,10 +1,19 @@
 """Hamming distance (functional). Parity: ``torchmetrics/functional/classification/hamming_distance.py``."""
-from typing import Tuple, Union
+from functools import partial
+from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
-from metrics_tpu.utilities.checks import _input_format_classification
+from metrics_tpu.utilities.checks import (
+    _fast_path_inputs,
+    _fast_path_validate,
+    _input_format_classification,
+    _fused_probe_preamble,
+    _prob_sum_atol,
+    fast_path_memo,
+)
+from metrics_tpu.utilities.enums import DataType
 
 
 @jax.jit
@@ -12,11 +21,94 @@ def _hamming_count(preds, target):
     return jnp.sum(preds == target)
 
 
+@partial(jax.jit, static_argnames=("p_shape", "t_shape", "case", "threshold", "sum_atol"))
+def _hamming_probe_count(preds, target, p_shape, t_shape, case, threshold, sum_atol):
+    """Single-pass probe + agreement count straight from RAW inputs.
+
+    Over the canonical one-hot layout, a multiclass sample agrees on every
+    cell except exactly TWO when the predicted label is wrong — so
+    ``correct = total - 2 * misses`` and only the miss count needs the data.
+    Elementwise cases (binary/multilabel) compare thresholded raw values
+    directly. Either way: no ``(N, C)`` canonical intermediates.
+    """
+    preds, target, probe = _fused_probe_preamble(preds, target, p_shape, t_shape, case, sum_atol)
+
+    if jnp.issubdtype(preds.dtype, jnp.floating) and preds.ndim == target.ndim:
+        # binary / multilabel: elementwise agreement of thresholded scores
+        count = jnp.sum((preds >= threshold).astype(target.dtype) == target)
+    elif jnp.issubdtype(preds.dtype, jnp.floating):
+        # (N, C, ...) probabilities vs (N, ...) labels: count misses
+        count = jnp.sum(jnp.argmax(preds, axis=1) != target)
+    else:
+        # label predictions vs labels: count misses
+        count = jnp.sum(preds != target)
+
+    return (*probe, count)
+
+
+def _hamming_fast_update(preds, target, threshold) -> Optional[Tuple[jax.Array, int]]:
+    """Fast path for the common eager cases; None = take the canonical path.
+
+    Validation parity via the shared ``_fast_path_inputs`` /
+    ``_fast_path_validate`` scaffolding (same errors, same order).
+    """
+    shapes = _fast_path_inputs(preds, target)
+    if shapes is None:
+        return None
+    p_shape, t_shape, preds_float, case, implied_classes = shapes
+    elementwise = preds_float and len(p_shape) == len(t_shape)
+    label_pairs = not preds_float  # 1-d/N-d int pairs (MC / MDMC cases)
+    if not elementwise and not label_pairs:
+        # probabilities vs labels: require a real class axis
+        if len(p_shape) != len(t_shape) + 1 or implied_classes < 2:
+            return None
+
+    def compute():
+        raw = _hamming_probe_count(
+            preds,
+            target,
+            p_shape=p_shape,
+            t_shape=t_shape,
+            case=case.value,
+            threshold=float(threshold),
+            sum_atol=_prob_sum_atol(
+                preds, p_shape, case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS) and preds_float
+            ),
+        )
+        _fast_path_validate(
+            preds, target, p_shape, t_shape, raw[:5],
+            threshold=threshold, num_classes=None, is_multiclass=None, top_k=None,
+        )
+        n_positions = 1
+        for d in t_shape:
+            n_positions *= d
+        if elementwise:
+            n_cells = 1
+            for d in p_shape:
+                n_cells *= d
+            return raw[5], n_cells
+        if label_pairs:
+            # canonical one-hot width is inferred from the data maximum
+            # (to_onehot floor of 2), read from the probe scalars
+            width = max(2, max(int(raw[1]), int(raw[3])) + 1)
+        else:
+            width = implied_classes
+        total = n_positions * width
+        return total - 2 * raw[5], total
+
+    key = ("hamming", id(preds), id(target), float(threshold))
+    return fast_path_memo(key, (preds, target), compute)
+
+
 def _hamming_distance_update(
     preds: jax.Array,
     target: jax.Array,
     threshold: float = 0.5,
 ) -> Tuple[jax.Array, int]:
+    fast = _hamming_fast_update(jnp.asarray(preds), jnp.asarray(target), threshold)
+    if fast is not None:
+        return fast
+
     preds, target, _ = _input_format_classification(preds, target, threshold=threshold)
 
     correct = _hamming_count(preds, target)

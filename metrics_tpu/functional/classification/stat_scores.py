@@ -17,8 +17,8 @@ from metrics_tpu.utilities.checks import (
     _fast_path_inputs,
     _fast_path_validate,
     _input_format_classification,
+    _fused_probe_preamble,
     _prob_sum_atol,
-    _probe_scalars,
     fast_path_memo,
 )
 from metrics_tpu.utilities.enums import DataType
@@ -103,18 +103,8 @@ def _stat_scores_probe_count(
     no ``(N, C)`` intermediates. MDMC-global inputs reach here pre-flattened
     to the 2-d layout (exactly the canonical `swapaxes+reshape`).
     """
+    preds, target, probe = _fused_probe_preamble(preds, target, p_shape, t_shape, case, sum_atol)
     case = DataType(case)
-    preds = preds.reshape(p_shape)
-    target = target.reshape(t_shape)
-    if preds.dtype in (jnp.float16, jnp.bfloat16):
-        preds = preds.astype(jnp.float32)
-
-    check_prob_sum = (
-        case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
-        and jnp.issubdtype(preds.dtype, jnp.floating)
-        and preds.ndim == target.ndim + 1
-    )
-    pmin, pmax, tmin, tmax, prob_ok = _probe_scalars(preds, target, check_prob_sum, sum_atol)
 
     if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
         num_cols = num_classes
@@ -212,7 +202,7 @@ def _stat_scores_probe_count(
             if reduce == "macro":  # canonical (N, 1) macro output is (1,)
                 tp, fp, tn, fn = (x.reshape(1) for x in (tp, fp, tn, fn))
 
-    return pmin, pmax, tmin, tmax, prob_ok, tp, fp, tn, fn
+    return (*probe, tp, fp, tn, fn)
 
 
 def _stat_scores_fast_update(
@@ -247,7 +237,7 @@ def _stat_scores_fast_update(
     if case == DataType.MULTILABEL and len(p_shape) != 2:
         return None  # deep multilabel flattens to (N, C*X) canonically
     if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
-        if p_shape == t_shape or len(p_shape) == len(t_shape):
+        if len(p_shape) == len(t_shape):
             # label predictions: the one-hot width is num_classes (or the
             # data max, which needs its own probe) — require it static
             if num_classes is None:

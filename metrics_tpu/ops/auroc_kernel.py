@@ -119,6 +119,15 @@ def multiclass_auroc_ovr(preds: jax.Array, target: jax.Array) -> jax.Array:
     per-class Python loop over ``roc`` (``functional/.../auroc.py:79-86``).
     Classes absent from ``target`` (or covering all of it) yield NaN, like
     the reference's 0/0 rate normalization.
+
+    Measured (100k×16, CPU, idle host): this fused program 847ms vs 676ms
+    for a per-class Python loop over :func:`binary_auroc` and 2.7s for the
+    reference-style per-class curve path — XLA:CPU gains nothing from
+    batching independent sorts. The one-program form is the TPU-first bet
+    (batched sorts amortize launch/layout and fill the chip; it is also the
+    only form an SPMD class-sharded compute can use — see
+    ``classification/sharded._ovr_program``); re-measure on a real chip
+    before swapping in a backend branch for CPU.
     """
     num_classes = preds.shape[1]
     onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)

@@ -78,6 +78,29 @@ def _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum, sum_atol=1
     return _probe_scalars(preds, target, check_prob_sum, sum_atol)
 
 
+def _fused_probe_preamble(preds, target, p_shape, t_shape, case, sum_atol):
+    """Traced at the top of every fused fast-path kernel: squeeze-reshape,
+    half-precision upcast, and the probe scalars with the canonical
+    probabilities-sum-to-1 condition. ONE definition (like
+    :func:`_probe_scalars`) so the kernels' validation probes cannot drift
+    from the canonical :func:`_value_probe_jit` semantics.
+
+    Returns ``(preds, target, probe_tuple)`` with ``preds``/``target``
+    reshaped and upcast, ready for the kernel's counting math.
+    """
+    case = DataType(case)
+    preds = preds.reshape(p_shape)
+    target = target.reshape(t_shape)
+    if preds.dtype in (jnp.float16, jnp.bfloat16):
+        preds = preds.astype(jnp.float32)
+    check_prob_sum = (
+        case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS)
+        and jnp.issubdtype(preds.dtype, jnp.floating)
+        and preds.ndim == target.ndim + 1
+    )
+    return preds, target, _probe_scalars(preds, target, check_prob_sum, sum_atol)
+
+
 def _prob_sum_atol(preds: jax.Array, p_shape: Tuple[int, ...], check_prob_sum: bool) -> float:
     """Tolerance for the probabilities-sum-to-1 check.
 

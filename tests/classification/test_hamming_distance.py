@@ -81,3 +81,40 @@ def test_wrong_params(threshold):
 
     with pytest.raises(ValueError):
         hamming_distance(jnp.asarray(preds), jnp.asarray(target), threshold=threshold)
+
+
+def test_fast_update_matches_canonical_path(monkeypatch):
+    """The fused miss-count kernel must agree exactly with the one-hot
+    canonicalization path on every eligible input case (the multiclass
+    total depends on the inferred one-hot width — exactly 2 differing cells
+    per wrong sample)."""
+    import sys
+
+    import numpy as np
+
+    hd_mod = sys.modules["metrics_tpu.functional.classification.hamming_distance"]
+    rng = np.random.RandomState(53)
+
+    probs = rng.rand(257, 5).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    mdmc_probs = rng.rand(64, 3, 7).astype(np.float32)
+    mdmc_probs /= mdmc_probs.sum(1, keepdims=True)
+
+    cases = [
+        (probs, rng.randint(5, size=257)),                      # MC probs
+        (rng.randint(5, size=257), rng.randint(5, size=257)),   # MC labels
+        (rng.randint(2, size=257), rng.randint(2, size=257)),   # binary-ish labels (width floor 2)
+        (rng.rand(257).astype(np.float32), rng.randint(2, size=257)),          # binary probs
+        (rng.rand(257, 4).astype(np.float32), rng.randint(2, size=(257, 4))),  # multilabel
+        (mdmc_probs, rng.randint(3, size=(64, 7))),             # MDMC probs
+        (rng.randint(3, size=(64, 7)), rng.randint(3, size=(64, 7))),          # MDMC labels
+    ]
+    for preds, target in cases:
+        args = (jnp.asarray(preds), jnp.asarray(target), 0.5)
+        fast = hd_mod._hamming_fast_update(*args)
+        assert fast is not None, preds.shape
+        with monkeypatch.context() as mp:
+            mp.setattr(hd_mod, "_hamming_fast_update", lambda *a, **k: None)
+            slow = hd_mod._hamming_distance_update(*args)
+        assert int(fast[0]) == int(slow[0]), (preds.shape, fast, slow)
+        assert int(fast[1]) == int(slow[1]), (preds.shape, fast, slow)
