@@ -1,0 +1,163 @@
+"""Randomized differential sweep: fused fast paths vs the canonical path.
+
+The per-family tests pin hand-picked configurations; this sweep samples the
+whole eligibility space (case × options × shapes, seeded) and asserts the
+fused kernels and the one-hot canonical path agree EXACTLY — both on values
+and on which configurations raise (same exception type and message). This
+is the anti-drift guard for the fast-path surface as it grows.
+"""
+import importlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.helpers import seed_all
+
+seed_all(61)
+
+# NB: `import metrics_tpu.functional.classification.accuracy as m` would bind
+# the same-named FUNCTION re-exported by the package __init__; import_module
+# always yields the module object
+acc_mod = importlib.import_module("metrics_tpu.functional.classification.accuracy")
+cm_mod = importlib.import_module("metrics_tpu.functional.classification.confusion_matrix")
+ss_mod = importlib.import_module("metrics_tpu.functional.classification.stat_scores")
+hd_mod = importlib.import_module("metrics_tpu.functional.classification.hamming_distance")
+
+# how many trials actually exercised each fast path (a trial where the fast
+# update declines compares canonical-vs-canonical, which guards nothing)
+_fast_hits = {"accuracy": 0, "confusion_matrix": 0, "stat_scores": 0, "hamming": 0}
+
+
+def _spy(module, attr, family):
+    real = getattr(module, attr)
+
+    def spy(*args, **kwargs):
+        result = real(*args, **kwargs)
+        if result is not None:
+            _fast_hits[family] += 1
+        return result
+
+    return spy
+
+
+def _sample_inputs(rng):
+    """One random classification input configuration (mostly valid, with a
+    sprinkle of deliberately-invalid values to check error parity)."""
+    n = int(rng.randint(3, 70))
+    c = int(rng.randint(2, 7))
+    kind = rng.choice(["mc_prob", "mc_label", "binary_prob", "binary_label", "ml_prob", "mdmc_prob", "mdmc_label"])
+    x = int(rng.randint(2, 5))
+    if kind == "mc_prob":
+        preds = rng.rand(n, c).astype(np.float32)
+        preds /= preds.sum(1, keepdims=True)
+        target = rng.randint(c, size=n)
+    elif kind == "mc_label":
+        preds = rng.randint(c, size=n)
+        target = rng.randint(c, size=n)
+    elif kind == "binary_prob":
+        preds = rng.rand(n).astype(np.float32)
+        target = rng.randint(2, size=n)
+    elif kind == "binary_label":
+        preds = rng.randint(2, size=n)
+        target = rng.randint(2, size=n)
+    elif kind == "ml_prob":
+        preds = rng.rand(n, c).astype(np.float32)
+        target = rng.randint(2, size=(n, c))
+    elif kind == "mdmc_prob":
+        preds = rng.rand(n, c, x).astype(np.float32)
+        preds /= preds.sum(1, keepdims=True)
+        target = rng.randint(c, size=(n, x))
+    else:
+        preds = rng.randint(c, size=(n, x))
+        target = rng.randint(c, size=(n, x))
+
+    # ~8%: poison a value so the validation paths get fuzzed too
+    poison = rng.rand()
+    if poison < 0.04 and np.issubdtype(np.asarray(preds).dtype, np.floating):
+        preds = np.asarray(preds).copy()
+        preds.flat[int(rng.randint(preds.size))] = 1.7  # out of [0,1]
+    elif poison < 0.08:
+        target = np.asarray(target).copy()
+        target.flat[int(rng.randint(target.size))] = c + 3  # out-of-range label
+    return kind, c, x, jnp.asarray(preds), jnp.asarray(target)
+
+
+def _run(fn, *args, **kwargs):
+    try:
+        return ("ok", fn(*args, **kwargs))
+    except ValueError as err:
+        return ("raise", str(err))
+
+
+def _compare(name, got, want, cfg):
+    __tracebackhide__ = True
+    assert got[0] == want[0], (name, cfg, got, want)
+    if got[0] == "raise":
+        assert got[1] == want[1], (name, cfg, got, want)
+        return
+    g, w = got[1], want[1]
+    if not isinstance(g, tuple):
+        g, w = (g,), (w,)
+    for gi, wi in zip(g, w):
+        np.testing.assert_array_equal(np.asarray(gi), np.asarray(wi), err_msg=f"{name} {cfg}")
+
+
+@pytest.mark.parametrize("trial", range(120))
+def test_fast_paths_match_canonical_everywhere(trial, monkeypatch):
+    rng = np.random.RandomState(10_000 + trial)
+    kind, c, x, preds, target = _sample_inputs(rng)
+
+    # --- accuracy
+    top_k = int(rng.randint(1, c)) if kind == "mc_prob" and rng.rand() < 0.4 else None
+    subset = bool(rng.rand() < 0.3)
+    threshold = float(rng.choice([0.3, 0.5, 0.7]))
+    args = (preds, target, threshold, top_k, subset)
+    monkeypatch.setattr(acc_mod, "_accuracy_fast_update", _spy(acc_mod, "_accuracy_fast_update", "accuracy"))
+    monkeypatch.setattr(cm_mod, "_confmat_fast_update", _spy(cm_mod, "_confmat_fast_update", "confusion_matrix"))
+    monkeypatch.setattr(ss_mod, "_stat_scores_fast_update", _spy(ss_mod, "_stat_scores_fast_update", "stat_scores"))
+    monkeypatch.setattr(hd_mod, "_hamming_fast_update", _spy(hd_mod, "_hamming_fast_update", "hamming"))
+    fast = _run(acc_mod._accuracy_update, *args)
+    with monkeypatch.context() as mp:
+        mp.setattr(acc_mod, "_accuracy_fast_update", lambda *a, **k: None)
+        slow = _run(acc_mod._accuracy_update, *args)
+    _compare("accuracy", fast, slow, (kind, threshold, top_k, subset))
+
+    # --- confusion matrix
+    multilabel = kind == "ml_prob" and rng.rand() < 0.5
+    cm_args = (preds, target, c, threshold, multilabel)
+    fast = _run(cm_mod._confusion_matrix_update, *cm_args)
+    with monkeypatch.context() as mp:
+        mp.setattr(cm_mod, "_confmat_fast_update", lambda *a, **k: None)
+        slow = _run(cm_mod._confusion_matrix_update, *cm_args)
+    _compare("confusion_matrix", fast, slow, (kind, c, multilabel))
+
+    # --- stat scores
+    reduce = str(rng.choice(["micro", "macro", "samples"]))
+    ignore_index = int(rng.randint(c)) if rng.rand() < 0.4 else None
+    mdmc = "global" if kind.startswith("mdmc") else None
+    ss_kwargs = dict(
+        reduce=reduce, mdmc_reduce=mdmc, num_classes=c, top_k=top_k,
+        threshold=threshold, is_multiclass=None, ignore_index=ignore_index,
+    )
+    fast = _run(ss_mod._stat_scores_update, preds, target, **ss_kwargs)
+    with monkeypatch.context() as mp:
+        mp.setattr(ss_mod, "_stat_scores_fast_update", lambda *a, **k: None)
+        slow = _run(ss_mod._stat_scores_update, preds, target, **ss_kwargs)
+    _compare("stat_scores", fast, slow, (kind, reduce, ignore_index, top_k))
+
+    # --- hamming
+    hd_args = (preds, target, threshold)
+    fast = _run(hd_mod._hamming_distance_update, *hd_args)
+    with monkeypatch.context() as mp:
+        mp.setattr(hd_mod, "_hamming_fast_update", lambda *a, **k: None)
+        slow = _run(hd_mod._hamming_distance_update, *hd_args)
+    _compare("hamming", fast, slow, (kind, threshold))
+
+
+def test_fuzz_sweep_actually_exercised_every_fast_path():
+    """Anti-vacuity: the sweep above must have HIT each fused fast path many
+    times — an eligibility regression that silently declines everything
+    would otherwise make all 120 trials compare canonical-vs-canonical."""
+    for family, hits in _fast_hits.items():
+        assert hits >= 20, (family, hits, _fast_hits)
