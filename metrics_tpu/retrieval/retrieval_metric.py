@@ -128,8 +128,19 @@ class RetrievalMetric(Metric, ABC):
 
         Built-in subclasses override this with a single segment-reduction XLA
         program. User subclasses that only implement the reference-style
-        per-query :meth:`_metric` get correct (slower) behavior from this
-        host-side loop.
+        per-query :meth:`_metric` get correct behavior from this host-side
+        loop, with two caveats:
+
+        * cost is O(num_queries) host round-trips — at 10k+ queries,
+          override ``_score_groups`` with a vectorized program instead
+          (see ``functional/retrieval`` for the segment-stat building
+          blocks);
+        * ``_metric`` receives SYNTHESIZED rank-order scores
+          (``0, -1, -2, ...``), not the original prediction values: the
+          ranking (and therefore any rank-based metric) is exactly
+          preserved, but score magnitudes and tie structure are not — a
+          ``_metric`` that breaks ties by score or uses score values
+          directly must override ``_score_groups``.
         """
         scores = []
         for g in range(int(stats.pos_per_group.shape[0])):

@@ -27,6 +27,7 @@ hd_mod = importlib.import_module("metrics_tpu.functional.classification.hamming_
 # how many trials actually exercised each fast path (a trial where the fast
 # update declines compares canonical-vs-canonical, which guards nothing)
 _fast_hits = {"accuracy": 0, "confusion_matrix": 0, "stat_scores": 0, "hamming": 0}
+_trials_run = 0
 
 
 def _spy(module, attr, family):
@@ -105,6 +106,8 @@ def _compare(name, got, want, cfg):
 
 @pytest.mark.parametrize("trial", range(120))
 def test_fast_paths_match_canonical_everywhere(trial, monkeypatch):
+    global _trials_run
+    _trials_run += 1
     rng = np.random.RandomState(10_000 + trial)
     kind, c, x, preds, target = _sample_inputs(rng)
 
@@ -159,5 +162,7 @@ def test_fuzz_sweep_actually_exercised_every_fast_path():
     """Anti-vacuity: the sweep above must have HIT each fused fast path many
     times — an eligibility regression that silently declines everything
     would otherwise make all 120 trials compare canonical-vs-canonical."""
+    if _trials_run < 120:
+        pytest.skip(f"only {_trials_run}/120 sweep trials ran in this process (test selection/distribution)")
     for family, hits in _fast_hits.items():
         assert hits >= 20, (family, hits, _fast_hits)

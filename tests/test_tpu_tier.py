@@ -20,6 +20,7 @@ EXPECTED_CHECKS = {
     "r2score_moments",
     "retrieval_map",
     "sharded_auroc_mesh",
+    "binned_auroc_histogram",
 }
 
 

@@ -155,6 +155,17 @@ def _child() -> None:
     sh.update(jnp.asarray(scores), jnp.asarray(bt))
     check("sharded_auroc_mesh", float(sh.compute()), roc_auc_score(bt, scores), 1e-5)
 
+    # BinnedAUROC — exercises the TPU-only histogram formulation (chunked
+    # one-hot contraction on the MXU; the CPU suite only ever runs the
+    # scatter-add branch of ops/histogram.py). Scores quantized to the bin
+    # grid make the binned value exact.
+    nb = 512
+    qscores = (np.floor(rng.rand(sz(200_000)) * nb) / nb + 0.5 / nb).astype(np.float32)
+    qt = rng.randint(2, size=sz(200_000))
+    bm = M.BinnedAUROC(num_bins=nb)
+    bm.update(jnp.asarray(qscores), jnp.asarray(qt))
+    check("binned_auroc_histogram", float(bm.compute()), roc_auc_score(qt, qscores), 1e-5)
+
     print("DONE", flush=True)
 
 
