@@ -88,11 +88,13 @@ def _stat_scores_count(preds, target, reduce, mdmc_reduce, ignore_index):
 @partial(
     jax.jit,
     static_argnames=(
-        "p_shape", "t_shape", "case", "reduce", "num_classes", "top_k", "threshold", "ignore_index", "sum_atol"
+        "p_shape", "t_shape", "case", "reduce", "mdmc_reduce", "num_classes", "top_k", "threshold",
+        "ignore_index", "sum_atol",
     ),
 )
 def _stat_scores_probe_count(
-    preds, target, p_shape, t_shape, case, reduce, num_classes, top_k, threshold, ignore_index, sum_atol
+    preds, target, p_shape, t_shape, case, reduce, mdmc_reduce, num_classes, top_k, threshold,
+    ignore_index, sum_atol,
 ):
     """Single-pass probe + tp/fp/tn/fn straight from RAW inputs.
 
@@ -100,67 +102,85 @@ def _stat_scores_probe_count(
     boolean masks over them; in label space the same per-class counts are
     three ``bincount``s (predicted-positives, support, hits), and the
     micro/samples reductions derive from them — one program, one data pass,
-    no ``(N, C)`` intermediates. MDMC-global inputs reach here pre-flattened
-    to the 2-d layout (exactly the canonical `swapaxes+reshape`).
+    no ``(N, C)`` intermediates. MDMC-global flattens to the 2-d layout
+    (exactly the canonical `swapaxes+reshape`); MDMC-samplewise keeps a
+    per-sample axis by bincounting over ``sample_id * C + label``.
     """
     preds, target, probe = _fused_probe_preamble(preds, target, p_shape, t_shape, case, sum_atol)
     case = DataType(case)
+    samplewise = case == DataType.MULTIDIM_MULTICLASS and mdmc_reduce == "samplewise"
 
     if case in (DataType.MULTICLASS, DataType.MULTIDIM_MULTICLASS):
         num_cols = num_classes
-        if preds.ndim == target.ndim + 1:  # (M, C) probabilities
-            # flatten any trailing dims (MDMC-global layout) to (M, C)/(M,)
+        n_samples = t_shape[0]
+        if preds.ndim == target.ndim + 1:  # (.., C, ..) probabilities
+            # class axis last, rows flat: (M, C)/(M,) in (sample-major) order
             flat_p = jnp.moveaxis(preds, 1, -1).reshape(-1, preds.shape[1])
             flat_t = target.reshape(-1)
             k = top_k or 1
             if k == 1:
                 pred_labels = jnp.argmax(flat_p, axis=1)
                 hit = pred_labels == flat_t
-                count_pred = jnp.bincount(pred_labels, length=num_cols)
                 memb_ignore = (
                     pred_labels == ignore_index if ignore_index is not None else None
                 )
             else:
                 _, idx = lax.top_k(flat_p, k)  # (M, k)
                 hit = jnp.any(idx == flat_t[:, None], axis=1)
-                count_pred = jnp.bincount(idx.reshape(-1), length=num_cols)
                 memb_ignore = (
                     jnp.any(idx == ignore_index, axis=1) if ignore_index is not None else None
                 )
-        else:  # (M,) label predictions
+        else:  # label predictions
             flat_p = preds.reshape(-1)
             flat_t = target.reshape(-1)
             k = 1
+            pred_labels = flat_p
+            idx = None
             hit = flat_p == flat_t
-            count_pred = jnp.bincount(flat_p, length=num_cols)
             memb_ignore = flat_p == ignore_index if ignore_index is not None else None
 
         m = flat_t.shape[0]
-        support = jnp.bincount(flat_t, length=num_cols)
+        # per-(group, class) counts: one flat bincount; group = the whole
+        # stream for global reductions, the sample for MDMC-samplewise
+        if samplewise:
+            groups, x = n_samples, m // n_samples
+            sid = jnp.repeat(jnp.arange(groups), x)
+            t_bins = sid * num_cols + flat_t
+            if k == 1:
+                p_bins = sid * num_cols + pred_labels
+            else:
+                p_bins = (sid[:, None] * num_cols + idx).reshape(-1)
+        else:
+            groups, x = 1, m
+            t_bins, p_bins = flat_t, (pred_labels if k == 1 else idx.reshape(-1))
+        length = groups * num_cols
+        gshape = (groups, num_cols) if samplewise else (num_cols,)
+        support = jnp.bincount(t_bins, length=length).reshape(gshape)
         # integer weights: float32 scatter-add saturates at 2^24 and would
         # silently undercount tp on >16.7M-hit classes
-        tp_c = jnp.bincount(flat_t, weights=hit.astype(jnp.int32), length=num_cols).astype(jnp.int32)
+        tp_c = jnp.bincount(t_bins, weights=hit.astype(jnp.int32), length=length).reshape(gshape).astype(jnp.int32)
+        count_pred = jnp.bincount(p_bins, length=length).reshape(gshape)
         fn_c = (support - tp_c).astype(jnp.int32)
         fp_c = (count_pred - tp_c).astype(jnp.int32)
-        tn_c = (m - support - fp_c).astype(jnp.int32)
+        tn_c = (x - support - fp_c).astype(jnp.int32)
 
         if reduce == "macro":
             tp, fp, tn, fn = tp_c, fp_c, tn_c, fn_c
             if ignore_index is not None:
-                tp = tp.at[ignore_index].set(-1)
-                fp = fp.at[ignore_index].set(-1)
-                tn = tn.at[ignore_index].set(-1)
-                fn = fn.at[ignore_index].set(-1)
+                tp = tp.at[..., ignore_index].set(-1)
+                fp = fp.at[..., ignore_index].set(-1)
+                tn = tn.at[..., ignore_index].set(-1)
+                fn = fn.at[..., ignore_index].set(-1)
         elif reduce == "micro":
             if ignore_index is not None:
                 keep = jnp.arange(num_cols) != ignore_index
-                tp = jnp.sum(tp_c * keep)
-                fp = jnp.sum(fp_c * keep)
-                tn = jnp.sum(tn_c * keep)
-                fn = jnp.sum(fn_c * keep)
+                tp = jnp.sum(tp_c * keep, axis=-1)
+                fp = jnp.sum(fp_c * keep, axis=-1)
+                tn = jnp.sum(tn_c * keep, axis=-1)
+                fn = jnp.sum(fn_c * keep, axis=-1)
             else:
-                tp, fp, tn, fn = jnp.sum(tp_c), jnp.sum(fp_c), jnp.sum(tn_c), jnp.sum(fn_c)
-        else:  # samples: per-row over the (M, C) binary layout
+                tp, fp, tn, fn = (jnp.sum(v, axis=-1) for v in (tp_c, fp_c, tn_c, fn_c))
+        else:  # samples: per-position over the binary layout
             t_valid = flat_t != ignore_index if ignore_index is not None else jnp.ones_like(hit)
             tp = (hit & t_valid).astype(jnp.int32)
             kk = k - memb_ignore.astype(jnp.int32) if ignore_index is not None else k
@@ -168,6 +188,8 @@ def _stat_scores_probe_count(
             fp = (kk - tp).astype(jnp.int32)
             fn = (t_valid.astype(jnp.int32) - tp).astype(jnp.int32)
             tn = (cols - tp - fp - fn).astype(jnp.int32)
+            if samplewise:  # (N, X) per-sample rows, as the canonical dim=1
+                tp, fp, tn, fn = (v.reshape(n_samples, -1) for v in (tp, fp, tn, fn))
     elif case == DataType.MULTILABEL:
         pbin = (preds >= threshold).astype(jnp.int32)
         tbin = target.astype(jnp.int32)
@@ -230,8 +252,8 @@ def _stat_scores_fast_update(
         or not preds_float
     ):
         return None  # canonical path raises the parity top_k errors
-    if case == DataType.MULTIDIM_MULTICLASS and mdmc_reduce != "global":
-        return None  # samplewise shapes / missing-mdmc error: canonical path
+    if case == DataType.MULTIDIM_MULTICLASS and mdmc_reduce not in ("global", "samplewise"):
+        return None  # missing-mdmc error: canonical path raises it
     if case == DataType.BINARY and ignore_index is not None:
         return None  # canonical "can not use ignore_index with binary" error
     if case == DataType.MULTILABEL and len(p_shape) != 2:
@@ -258,6 +280,7 @@ def _stat_scores_fast_update(
             t_shape=t_shape,
             case=case.value,
             reduce=reduce,
+            mdmc_reduce=mdmc_reduce,
             num_classes=n_cols,
             top_k=top_k,
             threshold=float(threshold),

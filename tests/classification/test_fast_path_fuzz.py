@@ -66,9 +66,15 @@ def _sample_inputs(rng):
         preds = rng.rand(n, c).astype(np.float32)
         target = rng.randint(2, size=(n, c))
     elif kind == "mdmc_prob":
-        preds = rng.rand(n, c, x).astype(np.float32)
-        preds /= preds.sum(1, keepdims=True)
-        target = rng.randint(c, size=(n, x))
+        if rng.rand() < 0.3:  # two extra dims
+            y = int(rng.randint(2, 4))
+            preds = rng.rand(n, c, x, y).astype(np.float32)
+            preds /= preds.sum(1, keepdims=True)
+            target = rng.randint(c, size=(n, x, y))
+        else:
+            preds = rng.rand(n, c, x).astype(np.float32)
+            preds /= preds.sum(1, keepdims=True)
+            target = rng.randint(c, size=(n, x))
     else:
         preds = rng.randint(c, size=(n, x))
         target = rng.randint(c, size=(n, x))
@@ -112,7 +118,7 @@ def test_fast_paths_match_canonical_everywhere(trial, monkeypatch):
     kind, c, x, preds, target = _sample_inputs(rng)
 
     # --- accuracy
-    top_k = int(rng.randint(1, c)) if kind == "mc_prob" and rng.rand() < 0.4 else None
+    top_k = int(rng.randint(1, c)) if kind in ("mc_prob", "mdmc_prob") and rng.rand() < 0.4 else None
     subset = bool(rng.rand() < 0.3)
     threshold = float(rng.choice([0.3, 0.5, 0.7]))
     args = (preds, target, threshold, top_k, subset)
@@ -138,7 +144,7 @@ def test_fast_paths_match_canonical_everywhere(trial, monkeypatch):
     # --- stat scores
     reduce = str(rng.choice(["micro", "macro", "samples"]))
     ignore_index = int(rng.randint(c)) if rng.rand() < 0.4 else None
-    mdmc = "global" if kind.startswith("mdmc") else None
+    mdmc = str(rng.choice(["global", "samplewise"])) if kind.startswith("mdmc") else None
     ss_kwargs = dict(
         reduce=reduce, mdmc_reduce=mdmc, num_classes=c, top_k=top_k,
         threshold=threshold, is_multiclass=None, ignore_index=ignore_index,
