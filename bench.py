@@ -167,6 +167,22 @@ def _bench_reference() -> float:
         sys.path.remove("/root/reference")
 
 
+
+def _leg_stdout(proc, leg: str) -> str:
+    """Shared subprocess-leg guard: non-zero exit raises with truncated stderr."""
+    if proc.returncode != 0:
+        raise RuntimeError(f"{leg} leg failed: {proc.stderr[-1000:]}")
+    return proc.stdout
+
+
+def _marker_values(stdout: str, marker: str, leg: str) -> list:
+    """Return the fields after the first ``marker`` line, or raise."""
+    for line in stdout.splitlines():
+        if line.startswith(marker + " "):
+            return line.split()[1:]
+    raise RuntimeError(f"{leg} leg produced no {marker} line: {stdout[-400:]}")
+
+
 def _bench_sync_cpu() -> float:
     """Distributed sync+compute leg: 8-virtual-device CPU mesh, so the step
     contains a real XLA collective (all_gather of the sharded AUROC state).
@@ -205,12 +221,52 @@ assert abs(v - roc_auc_score(target, preds)) < 1e-6, v
 print("SYNC_MS", min(times) * 1e3)
 """
     proc = run_in_virtual_mesh(code, 8, cwd=repo)
-    if proc.returncode != 0:
-        raise RuntimeError(f"sync leg failed: {proc.stderr[-1000:]}")
-    for line in proc.stdout.splitlines():
-        if line.startswith("SYNC_MS"):
-            return float(line.split()[1])
-    raise RuntimeError("sync leg produced no timing")
+    return float(_marker_values(_leg_stdout(proc, "sync"), "SYNC_MS", "sync")[0])
+
+
+def _bench_module_forward() -> float:
+    """Library-level hot loop: a 4-metric MetricCollection forward at 1M×4
+    multiclass preds — the fused one-update forward + single-pass kernels +
+    sibling kernel sharing, end to end through the public API.
+
+    Runs CPU-forced in a subprocess (the remote-TPU tunnel's ~65ms RTT would
+    swamp the eager-validation host reads this path makes by design; on a
+    local accelerator host those are microseconds). Fully blocked: the timed
+    quantity includes the merged STATE chain, not just the step values.
+    """
+    import os
+    import subprocess
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = """
+import time
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp, numpy as np
+from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
+
+rng = np.random.RandomState(0)
+probs = jnp.asarray(rng.rand(1_000_000, 4).astype(np.float32))
+probs = probs / probs.sum(1, keepdims=True)
+target = jnp.asarray(rng.randint(4, size=1_000_000))
+
+col = MetricCollection([Accuracy(), Precision(num_classes=4, average="macro"),
+                        Recall(num_classes=4, average="macro"), F1(num_classes=4, average="macro")])
+v = col(probs, target)
+jax.block_until_ready(col["Accuracy"].correct); jax.block_until_ready(col["F1"].tp)
+t0 = time.perf_counter()
+for _ in range(10):
+    v = col(probs, target)
+for m in col.values():
+    for name in m._defaults:
+        jax.block_until_ready(getattr(m, name))
+jax.block_until_ready(v["F1"])
+print("FORWARD_MS", (time.perf_counter() - t0) / 10 * 1e3)
+"""
+    proc = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=480, cwd=repo
+    )
+    return round(float(_marker_values(_leg_stdout(proc, "module forward"), "FORWARD_MS", "module forward")[0]), 1)
 
 
 def _bench_binned_sync() -> dict:
@@ -271,10 +327,9 @@ for name, t in [("uniform", target), ("informative", informative)]:
         print("BINNED_ERR", name, num_bins, abs(binned - exact))
 """
     proc = run_in_virtual_mesh(code, 8, cwd=repo)
-    if proc.returncode != 0:
-        raise RuntimeError(f"binned sync leg failed: {proc.stderr[-1000:]}")
+    stdout = _leg_stdout(proc, "binned sync")
     out = {"binned_abs_err": {}}
-    for line in proc.stdout.splitlines():
+    for line in stdout.splitlines():
         if line.startswith("BINNED_SYNC_MS"):
             out["binned_sync_8dev_cpu_ms"] = round(float(line.split()[1]), 3)
         elif line.startswith("BINNED_ERR"):
@@ -348,13 +403,10 @@ def _run_jax_leg_isolated() -> tuple:
             env=env,
             cwd=os.path.dirname(here),
         )
-        if proc.returncode != 0:
-            raise RuntimeError(proc.stderr[-800:])
-        for line in proc.stdout.splitlines():
-            if line.startswith("JAXLEG "):
-                _, per_step, acc, auroc, platform = line.split()
-                return float(per_step), float(acc), float(auroc), platform
-        raise RuntimeError(f"no JAXLEG line in output: {proc.stdout[-400:]}")
+        per_step, acc, auroc, platform = _marker_values(
+            _leg_stdout(proc, "accelerator"), "JAXLEG", "accelerator"
+        )
+        return float(per_step), float(acc), float(auroc), platform
 
     primary_timeout = float(os.environ.get("BENCH_JAX_TIMEOUT", 480))
     retries = int(os.environ.get("BENCH_JAX_RETRIES", 3))
@@ -410,6 +462,12 @@ def main() -> None:
         print(f"WARNING: binned sync leg failed ({err!r})", file=sys.stderr)
         binned = {}
 
+    try:
+        forward_ms = _bench_module_forward()
+    except Exception as err:
+        print(f"WARNING: module forward leg failed ({err!r})", file=sys.stderr)
+        forward_ms = None
+
     value_ms = jax_time * 1e3
     vs_baseline = round(ref_time / jax_time, 3) if ref_time else None
 
@@ -429,6 +487,9 @@ def main() -> None:
         # the O(bins) scalable sync story: histogram states, one psum,
         # with the measured |binned - exact| cost of the approximation
         **binned,
+        # library-level hot loop: 4-metric collection forward at 1M×4
+        # (fused one-update forward + single-pass kernels + sibling sharing)
+        "collection_forward_1m_cpu_ms": forward_ms,
         "platform": platform,
     }
 
