@@ -93,6 +93,67 @@ def _sorted_tie_groups(preds: jax.Array, rel: jax.Array, weight: jax.Array = Non
     return tps, fps, is_last, tps_prev, fps_prev
 
 
+def _host_mw_stats(key, rel):
+    """Sorted positive/negative key arrays + per-positive negative counts.
+
+    numpy's u32 sort is a radix sort (~5ms at 1M vs ~540ms for XLA:CPU's
+    payload co-sort), which makes the host formulation the fast CPU path:
+    two key-only sorts, then ``searchsorted`` counts of negatives at/below
+    each positive's key. Ascending key == DESCENDING score.
+    """
+    key = np.asarray(key)
+    rel = np.asarray(rel).astype(bool)
+    kp = np.sort(key[rel])
+    kn = np.sort(key[~rel])
+    lo = np.searchsorted(kn, kp, side="left")   # negs with score strictly greater
+    hi = np.searchsorted(kn, kp, side="right")  # negs with score greater or tied
+    return kp, kn, lo, hi
+
+
+def _host_mw_auroc(key, rel):
+    """Tie-corrected AUROC as the Mann-Whitney U statistic (host/numpy)."""
+    kp, kn, lo, hi = _host_mw_stats(key, rel)
+    n_pos, n_neg = kp.size, kn.size
+    if n_pos == 0 or n_neg == 0:
+        return np.float32(np.nan)
+    below = (n_neg - hi).astype(np.float64)  # negatives with smaller score
+    tied = (hi - lo).astype(np.float64)
+    return np.float32((below.sum() + 0.5 * tied.sum()) / (float(n_pos) * n_neg))
+
+
+def _host_mw_average_precision(key, rel):
+    """Tie-corrected AP over distinct positive-bearing thresholds (host)."""
+    kp, kn, lo, hi = _host_mw_stats(key, rel)
+    n_pos = kp.size
+    if n_pos == 0:
+        return np.float32(np.nan)
+    is_last = np.empty(n_pos, bool)
+    is_last[:-1] = kp[:-1] != kp[1:]
+    is_last[-1] = True
+    tps = np.arange(1, n_pos + 1, dtype=np.float64)[is_last]  # cum pos incl. group
+    fps = hi[is_last].astype(np.float64)  # negs with score >= the group score
+    prev = np.concatenate([[0.0], tps[:-1]])
+    return np.float32(np.sum((tps - prev) * tps / (tps + fps)) / n_pos)
+
+
+def _use_host_sort() -> bool:
+    """Trace-time dispatch: the host (numpy radix-sort) formulation on CPU
+    backends, the co-sort XLA program elsewhere. XLA:CPU's sort-with-payload
+    is ~10× slower than the whole numpy Mann-Whitney computation at 1M; on
+    TPU the co-sort runs ~2ms and callbacks would round-trip the tunnel.
+    Only the UNSHARDED kernels dispatch — the masked variants also run
+    inside shard_map collectives where host callbacks don't belong.
+    """
+    return jax.default_backend() == "cpu"
+
+
+@jax.jit
+def _binary_auroc_xla(preds: jax.Array, rel: jax.Array) -> jax.Array:
+    """The pure-XLA co-sort formulation (every non-CPU backend; also kept
+    independently tested on CPU so the TPU program logic has coverage)."""
+    return _auroc_from_groups(*_sorted_tie_groups(preds, rel))
+
+
 @jax.jit
 def binary_auroc(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax.Array:
     """Exact AUROC of 1-d scores vs binary targets, jittable end-to-end.
@@ -108,7 +169,15 @@ def binary_auroc(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax
     rel = (target == pos_label).astype(jnp.float32)
     # degenerate targets (single class) surface NaN under jit (the eager
     # functional path raises before reaching here)
-    return _auroc_from_groups(*_sorted_tie_groups(preds, rel))
+    if _use_host_sort():
+        return jax.pure_callback(
+            _host_mw_auroc,
+            jax.ShapeDtypeStruct((), jnp.float32),
+            _descending_key(preds),
+            rel,
+            vmap_method="sequential",
+        )
+    return _binary_auroc_xla(preds, rel)
 
 
 @jax.jit
@@ -120,14 +189,15 @@ def multiclass_auroc_ovr(preds: jax.Array, target: jax.Array) -> jax.Array:
     Classes absent from ``target`` (or covering all of it) yield NaN, like
     the reference's 0/0 rate normalization.
 
-    Measured (100k×16, CPU, idle host): this fused program 847ms vs 676ms
-    for a per-class Python loop over :func:`binary_auroc` and 2.7s for the
-    reference-style per-class curve path — XLA:CPU gains nothing from
-    batching independent sorts. The one-program form is the TPU-first bet
-    (batched sorts amortize launch/layout and fill the chip; it is also the
-    only form an SPMD class-sharded compute can use — see
-    ``classification/sharded._ovr_program``); re-measure on a real chip
-    before swapping in a backend branch for CPU.
+    On non-CPU backends this is one XLA program — C batched sorts via the
+    vmapped co-sort (the TPU-first form: batched sorts amortize launch and
+    fill the chip, and it is the only form an SPMD class-sharded compute can
+    use — see ``classification/sharded._ovr_program``). On CPU backends the
+    vmapped :func:`binary_auroc` dispatches to the host Mann-Whitney
+    formulation, run sequentially per class — measured at 100k×16: 38ms vs
+    847ms for the vmapped XLA co-sort (XLA:CPU gains nothing from batching
+    independent sorts; a per-class Python loop over the XLA kernel measured
+    676ms) and 2.7s for the reference-style per-class curve path.
     """
     num_classes = preds.shape[1]
     onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
@@ -184,6 +254,13 @@ def masked_binary_average_precision(
 
 
 @jax.jit
+def _binary_average_precision_xla(preds: jax.Array, rel: jax.Array) -> jax.Array:
+    """The pure-XLA co-sort AP (every non-CPU backend; independently tested)."""
+    tps, fps, is_last, tps_prev, _ = _sorted_tie_groups(preds, rel)
+    return _ap_from_groups(tps, fps, is_last, tps_prev)
+
+
+@jax.jit
 def binary_average_precision(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax.Array:
     """Exact average precision of 1-d scores vs binary targets, jittable.
 
@@ -195,9 +272,17 @@ def binary_average_precision(preds: jax.Array, target: jax.Array, pos_label: int
 
     Example:
         >>> import jax.numpy as jnp
-        >>> binary_average_precision(jnp.array([0.1, 0.4, 0.35, 0.8]), jnp.array([0, 0, 1, 1]))
-        Array(0.8333334, dtype=float32)
+        >>> round(float(binary_average_precision(
+        ...     jnp.array([0.1, 0.4, 0.35, 0.8]), jnp.array([0, 0, 1, 1]))), 4)
+        0.8333
     """
     rel = (target == pos_label).astype(jnp.float32)
-    tps, fps, is_last, tps_prev, _ = _sorted_tie_groups(preds, rel)
-    return _ap_from_groups(tps, fps, is_last, tps_prev)
+    if _use_host_sort():
+        return jax.pure_callback(
+            _host_mw_average_precision,
+            jax.ShapeDtypeStruct((), jnp.float32),
+            _descending_key(preds),
+            rel,
+            vmap_method="sequential",
+        )
+    return _binary_average_precision_xla(preds, rel)

@@ -98,3 +98,83 @@ def test_score_histograms_mask():
     hp, hn = score_histograms(p, t, 4, mask=jnp.asarray([True, True, False]))
     assert float(hp.sum()) == 1.0 and float(hn.sum()) == 1.0
 
+
+
+def test_host_and_xla_auroc_formulations_agree():
+    """binary_auroc dispatches to the host (numpy radix-sort Mann-Whitney)
+    formulation on CPU; the pure-XLA co-sort program must stay equivalent —
+    both are pinned against sklearn AND each other, on streams with heavy
+    ties, signed zeros, and ±inf logits."""
+    from metrics_tpu.ops.auroc_kernel import (
+        _binary_auroc_xla,
+        _binary_average_precision_xla,
+        binary_average_precision,
+    )
+    from sklearn.metrics import average_precision_score
+
+    rng = np.random.RandomState(71)
+    p = np.round(rng.randn(4096) * 3).astype(np.float32) / 3  # heavy ties
+    p[:2] = [np.inf, -np.inf]
+    p[2:6] = [0.0, -0.0, 0.0, -0.0]
+    t = rng.randint(2, size=4096)
+    finite = np.where(np.isposinf(p), 1e30, np.where(np.isneginf(p), -1e30, p))
+
+    rel = jnp.asarray((t == 1).astype(np.float32))
+    dispatch = float(binary_auroc(jnp.asarray(p), jnp.asarray(t)))
+    xla = float(_binary_auroc_xla(jnp.asarray(p), rel))
+    sk = roc_auc_score(t, finite)
+    assert abs(dispatch - sk) < 1e-6
+    assert abs(xla - sk) < 1e-6
+    assert abs(dispatch - xla) < 1e-6
+
+    ap_dispatch = float(binary_average_precision(jnp.asarray(p), jnp.asarray(t)))
+    ap_xla = float(_binary_average_precision_xla(jnp.asarray(p), rel))
+    ap_sk = average_precision_score(t, finite)
+    assert abs(ap_dispatch - ap_sk) < 1e-6
+    assert abs(ap_xla - ap_sk) < 1e-6
+
+    # degenerate targets -> NaN from both formulations
+    assert np.isnan(float(binary_auroc(jnp.asarray([0.1, 0.9]), jnp.asarray([1, 1]))))
+    assert np.isnan(float(_binary_auroc_xla(jnp.asarray([0.1, 0.9]), jnp.asarray([1.0, 1.0]))))
+    assert np.isnan(float(binary_average_precision(jnp.asarray([0.2, 0.4]), jnp.asarray([0, 0]))))
+
+
+def test_host_dispatch_under_vmap_matches_per_class():
+    """multiclass_auroc_ovr vmaps binary_auroc: the host callback must give
+    identical per-class values under vmap (sequential) as standalone calls."""
+    from metrics_tpu.ops.auroc_kernel import multiclass_auroc_ovr
+
+    rng = np.random.RandomState(73)
+    probs = rng.rand(512, 5).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    target = rng.randint(5, size=512)
+    per_class = np.asarray(multiclass_auroc_ovr(jnp.asarray(probs), jnp.asarray(target)))
+    for c in range(5):
+        want = roc_auc_score((target == c).astype(int), probs[:, c])
+        assert abs(per_class[c] - want) < 1e-6, c
+
+
+def test_host_mw_functions_directly():
+    """Backend-independent coverage of the host Mann-Whitney formulations:
+    on a TPU host the dispatch never reaches them, so call them directly on
+    the computed keys."""
+    from sklearn.metrics import average_precision_score
+
+    from metrics_tpu.ops.auroc_kernel import (
+        _descending_key,
+        _host_mw_auroc,
+        _host_mw_average_precision,
+    )
+
+    rng = np.random.RandomState(79)
+    p = np.round(rng.rand(4096) * 50).astype(np.float32) / 50  # heavy ties
+    t = rng.randint(2, size=4096)
+    key = np.asarray(jnp.asarray(_descending_key(jnp.asarray(p))))
+    rel = (t == 1).astype(np.float32)
+
+    assert abs(float(_host_mw_auroc(key, rel)) - roc_auc_score(t, p)) < 1e-6
+    assert abs(float(_host_mw_average_precision(key, rel)) - average_precision_score(t, p)) < 1e-6
+    # degenerate: single-class targets
+    assert np.isnan(_host_mw_auroc(key, np.ones_like(rel)))
+    assert np.isnan(_host_mw_auroc(key, np.zeros_like(rel)))
+    assert np.isnan(_host_mw_average_precision(key, np.zeros_like(rel)))
