@@ -36,7 +36,13 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu.metric import Metric
-from metrics_tpu.ops.auroc_kernel import masked_binary_auroc, masked_binary_average_precision
+from metrics_tpu.ops.auroc_kernel import (
+    _use_host_sort,
+    host_masked_binary_auroc,
+    host_masked_binary_average_precision,
+    masked_binary_auroc,
+    masked_binary_average_precision,
+)
 from metrics_tpu.parallel.sharded_metric import (  # noqa: F401  (re-exported for tests/users)
     ShardedStreamsMixin,
     _default_mesh,
@@ -217,6 +223,7 @@ class _ShardedOVRMetric(ShardedCurveMetric):
     Subclasses set ``_masked_kernel``."""
 
     _masked_kernel = None
+    _host_kernel = None  # CPU epilogue twin (outside collectives only)
 
     def __init__(
         self,
@@ -240,7 +247,12 @@ class _ShardedOVRMetric(ShardedCurveMetric):
         if not self.preds_suffix:
             # the gathered stream is replicated; run the epilogue kernel on
             # one local replica (identical wall-clock on a pod, 1/world the
-            # work on a shared-host mesh — see replica0)
+            # work on a shared-host mesh — see replica0). This is a PLAIN
+            # jit outside any collective, so on CPU backends it can take the
+            # host radix-sort formulation (the shard_map OvR program below
+            # must stay pure XLA)
+            if self._host_kernel is not None and _use_host_sort():
+                return self._host_kernel(replica0(preds), replica0(target), replica0(mask), self.pos_label)
             return self._masked_kernel(replica0(preds), replica0(target), replica0(mask), self.pos_label)
         # shard the one-vs-rest class axis over the mesh: each device
         # co-sorts only ceil(C/world) classes (pad classes give NaN per-class
@@ -279,6 +291,7 @@ class ShardedAUROC(_ShardedOVRMetric):
     """
 
     _masked_kernel = staticmethod(masked_binary_auroc)
+    _host_kernel = staticmethod(host_masked_binary_auroc)
 
 
 class ShardedAveragePrecision(_ShardedOVRMetric):
@@ -297,6 +310,7 @@ class ShardedAveragePrecision(_ShardedOVRMetric):
     """
 
     _masked_kernel = staticmethod(masked_binary_average_precision)
+    _host_kernel = staticmethod(host_masked_binary_average_precision)
 
 
 class ShardedROC(ShardedCurveMetric):

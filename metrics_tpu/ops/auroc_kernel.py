@@ -136,6 +136,28 @@ def _host_mw_average_precision(key, rel):
     return np.float32(np.sum((tps - prev) * tps / (tps + fps)) / n_pos)
 
 
+def _host_masked_args(preds, target, mask, pos_label):
+    """Shared prologue of the host masked twins: filtering the mask-invalid
+    slots out BEFORE the key-only sorts is exactly the weight-0 semantics of
+    the masked XLA kernels."""
+    key = np.asarray(_descending_key(jnp.asarray(preds)))
+    valid = np.asarray(mask).astype(bool)
+    rel = np.asarray(target) == pos_label
+    return key[valid], rel[valid]
+
+
+def host_masked_binary_auroc(preds, target, mask, pos_label: int = 1):
+    """Host (numpy radix-sort) masked AUROC — the CPU epilogue for gathered
+    sharded buffers, used OUTSIDE collectives only (the in-shard_map masked
+    kernel stays pure XLA)."""
+    return jnp.asarray(_host_mw_auroc(*_host_masked_args(preds, target, mask, pos_label)))
+
+
+def host_masked_binary_average_precision(preds, target, mask, pos_label: int = 1):
+    """Host masked AP; see :func:`host_masked_binary_auroc`."""
+    return jnp.asarray(_host_mw_average_precision(*_host_masked_args(preds, target, mask, pos_label)))
+
+
 def _use_host_sort() -> bool:
     """Trace-time dispatch: the host (numpy radix-sort) formulation on CPU
     backends, the co-sort XLA program elsewhere. XLA:CPU's sort-with-payload

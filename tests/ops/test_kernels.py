@@ -178,3 +178,30 @@ def test_host_mw_functions_directly():
     assert np.isnan(_host_mw_auroc(key, np.ones_like(rel)))
     assert np.isnan(_host_mw_auroc(key, np.zeros_like(rel)))
     assert np.isnan(_host_mw_average_precision(key, np.zeros_like(rel)))
+
+
+def test_masked_xla_and_host_epilogues_agree():
+    """The sharded epilogue dispatches to the host formulation on CPU, so the
+    masked XLA kernels (still the shard_map/TPU path) must be pinned against
+    the host twins and sklearn explicitly."""
+    from sklearn.metrics import average_precision_score
+
+    from metrics_tpu.ops.auroc_kernel import (
+        host_masked_binary_auroc,
+        host_masked_binary_average_precision,
+        masked_binary_auroc,
+        masked_binary_average_precision,
+    )
+
+    rng = np.random.RandomState(83)
+    p = np.round(rng.rand(2048) * 64).astype(np.float32) / 64
+    t = rng.randint(2, size=2048)
+    mask = rng.rand(2048) < 0.8
+    pj, tj, mj = jnp.asarray(p), jnp.asarray(t), jnp.asarray(mask)
+
+    sk_auroc = roc_auc_score(t[mask], p[mask])
+    sk_ap = average_precision_score(t[mask], p[mask])
+    assert abs(float(masked_binary_auroc(pj, tj, mj)) - sk_auroc) < 1e-6
+    assert abs(float(host_masked_binary_auroc(pj, tj, mj)) - sk_auroc) < 1e-6
+    assert abs(float(masked_binary_average_precision(pj, tj, mj)) - sk_ap) < 1e-6
+    assert abs(float(host_masked_binary_average_precision(pj, tj, mj)) - sk_ap) < 1e-6
