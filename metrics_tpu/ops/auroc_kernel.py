@@ -163,8 +163,11 @@ def _use_host_sort() -> bool:
     backends, the co-sort XLA program elsewhere. XLA:CPU's sort-with-payload
     is ~10× slower than the whole numpy Mann-Whitney computation at 1M; on
     TPU the co-sort runs ~2ms and callbacks would round-trip the tunnel.
-    Only the UNSHARDED kernels dispatch — the masked variants also run
-    inside shard_map collectives where host callbacks don't belong.
+    The rule is COLLECTIVE-scoped, not kernel-scoped: dispatch is fine from
+    any eager/plain-jit call site (unsharded kernels, the sharded metrics'
+    replica0 epilogues, `ranked_group_stats`), but code that runs INSIDE a
+    shard_map collective (the masked kernels in `_ovr_program`) must stay
+    pure XLA — host callbacks don't belong in collectives.
     """
     return jax.default_backend() == "cpu"
 

@@ -13,6 +13,9 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.ops.auroc_kernel import _descending_key, _use_host_sort
 
 
 class RankedGroupStats(NamedTuple):
@@ -28,6 +31,22 @@ class RankedGroupStats(NamedTuple):
     rank: jax.Array  # (N,) float32 1-based rank within the group
     cum_relevant: jax.Array  # (N,) float32 within-group inclusive cumsum of relevance
     pos_per_group: jax.Array  # (G,) float32 number of relevant docs per group
+
+
+def _host_lex_order(group, key):
+    """Stable (group asc, score desc) permutation via one numpy radix
+    argsort of a composite u64 key."""
+    composite = (np.asarray(group).astype(np.uint64) << np.uint64(32)) | np.asarray(key).astype(np.uint64)
+    return np.argsort(composite, kind="stable").astype(np.int32)
+
+
+@jax.jit
+def _lex_order_xla(group, preds):
+    """The pure-XLA (group asc, score desc, stable) permutation — the TPU
+    program, kept separately jitted so it stays independently tested on CPU
+    (the dispatch below routes CPU through the host radix path)."""
+    order_by_score = jnp.argsort(-preds, stable=True)
+    return order_by_score[jnp.argsort(group[order_by_score], stable=True)]
 
 
 @partial(jax.jit, static_argnames=("num_groups",))
@@ -48,10 +67,21 @@ def ranked_group_stats(
     n = preds.shape[0]
     group = group.astype(jnp.int32)
 
-    # Lexicographic (group asc, score desc) via a stable composite sort:
-    # sort by -score first, then a stable sort by group preserves score order.
-    order_by_score = jnp.argsort(-preds, stable=True)
-    order = order_by_score[jnp.argsort(group[order_by_score], stable=True)]
+    if _use_host_sort():
+        # XLA:CPU's double argsort+gather costs ~15× numpy's radix argsort
+        # of one composite u64 key (group<<32 | descending-score key) —
+        # identical permutation incl. stable tie-break by original position.
+        # This callback is eager/plain-jit territory only (retrieval compute
+        # and the sharded replica0 epilogue), never inside collectives.
+        order = jax.pure_callback(
+            _host_lex_order,
+            jax.ShapeDtypeStruct((n,), jnp.int32),
+            group,
+            _descending_key(preds),
+            vmap_method="sequential",
+        )
+    else:
+        order = _lex_order_xla(group, preds)
 
     g_sorted = group[order]
     t_sorted = target[order].astype(jnp.float32)

@@ -205,3 +205,26 @@ def test_masked_xla_and_host_epilogues_agree():
     assert abs(float(host_masked_binary_auroc(pj, tj, mj)) - sk_auroc) < 1e-6
     assert abs(float(masked_binary_average_precision(pj, tj, mj)) - sk_ap) < 1e-6
     assert abs(float(host_masked_binary_average_precision(pj, tj, mj)) - sk_ap) < 1e-6
+
+
+def test_lex_order_host_and_xla_agree():
+    """ranked_group_stats dispatches its lexicographic sort to the host
+    radix path on CPU; the XLA double-argsort program (the TPU path) must
+    produce the IDENTICAL permutation — including score ties, signed zeros,
+    and stable original-position tie-breaks."""
+    from metrics_tpu.ops.auroc_kernel import _descending_key
+    from metrics_tpu.ops.segment import _host_lex_order, _lex_order_xla
+
+    rng = np.random.RandomState(89)
+    group = rng.randint(7, size=3000).astype(np.int32)
+    preds = np.round(rng.rand(3000) * 20).astype(np.float32) / 20  # heavy ties
+    preds[:4] = [0.0, -0.0, 0.0, -0.0]
+
+    xla = np.asarray(_lex_order_xla(jnp.asarray(group), jnp.asarray(preds)))
+    host = _host_lex_order(group, np.asarray(_descending_key(jnp.asarray(preds))))
+    assert np.array_equal(xla, host)
+    # and the permutation is actually (group asc, score desc, position asc)
+    g_s, p_s = group[xla], preds[xla]
+    assert (np.diff(g_s) >= 0).all()
+    same_g = np.diff(g_s) == 0
+    assert (np.diff(p_s)[same_g] <= 0).all()
