@@ -17,11 +17,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.ops.auroc_kernel import _use_host_sort
 from metrics_tpu.utilities import rank_zero_warn
+from metrics_tpu.utilities.data import _is_concrete
 
 
 @partial(jax.jit, static_argnames=("weighted",))
-def _sorted_cumulants(preds, target, pos_label, sample_weights=None, weighted: bool = False):
+def _sorted_cumulants_xla(preds, target, pos_label, sample_weights=None, weighted: bool = False):
     """Descending-score sort and cumulative true/false-positive counts.
 
     One fixed-shape XLA program: argsort (stable), gather, two cumsums and the
@@ -36,6 +38,36 @@ def _sorted_cumulants(preds, target, pos_label, sample_weights=None, weighted: b
     fps = jnp.cumsum((1.0 - target_s) * weight)
     distinct = preds_s[1:] != preds_s[:-1]
     return preds_s, tps, fps, distinct
+
+
+def _sorted_cumulants_host(preds, target, pos_label):
+    """Literal numpy mirror of the unweighted :func:`_sorted_cumulants_xla`.
+
+    XLA:CPU's argsort+gather chain costs ~4× numpy's at 1M; the operations
+    are identical step for step (stable descending argsort incl. unsigned
+    negation wrap, 0/1-cumsum — exact in f32 up to 2^24), so the outputs are
+    bit-identical to the XLA program on the same inputs. Host-only: callers
+    dispatch via ``_use_host_sort()`` (collective-scoped rule; curve compute
+    is always an eager epoch-end call).
+    """
+    preds_np = np.asarray(preds)
+    target_np = np.asarray(target)
+    order = np.argsort(-preds_np, kind="stable")
+    preds_s = preds_np[order]
+    target_s = (target_np[order] == pos_label).astype(np.float32)
+    tps = np.cumsum(target_s, dtype=np.float32)
+    fps = np.cumsum((1.0 - target_s), dtype=np.float32)
+    distinct = preds_s[1:] != preds_s[:-1]
+    # `distinct` stays a numpy bool array deliberately: the sole consumer
+    # (_binary_clf_curve) immediately calls np.asarray on it for the
+    # host-side dedup, so a device round-trip would be pure waste
+    return jnp.asarray(preds_s), jnp.asarray(tps), jnp.asarray(fps), distinct
+
+
+def _sorted_cumulants(preds, target, pos_label, sample_weights=None, weighted: bool = False):
+    if not weighted and _use_host_sort() and _is_concrete(preds) and _is_concrete(target):
+        return _sorted_cumulants_host(preds, target, pos_label)
+    return _sorted_cumulants_xla(preds, target, pos_label, sample_weights, weighted=weighted)
 
 
 def _binary_clf_curve(

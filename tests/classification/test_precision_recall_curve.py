@@ -105,3 +105,28 @@ def test_pr_curve(pred, target, expected_p, expected_r, expected_t):
     assert np.allclose(np.asarray(p), np.asarray(expected_p))
     assert np.allclose(np.asarray(r), np.asarray(expected_r))
     assert np.allclose(np.asarray(t), np.asarray(expected_t))
+
+
+def test_sorted_cumulants_host_and_xla_bit_identical():
+    """The CPU host mirror of the curve sort must be BIT-identical to the
+    XLA program (same stable descending argsort, same exact 0/1 cumsums) —
+    on floats with heavy ties and signed zeros, and on integer scores."""
+    import importlib
+
+    # NB: `from metrics_tpu.functional.classification import
+    # precision_recall_curve` binds the same-named re-exported FUNCTION;
+    # import_module always yields the module object
+    prc_mod = importlib.import_module("metrics_tpu.functional.classification.precision_recall_curve")
+    rng = np.random.RandomState(91)
+
+    for preds in [
+        np.round(rng.rand(3000) * 25).astype(np.float32) / 25,
+        rng.randint(0, 9, size=3000).astype(np.int32),
+    ]:
+        if preds.dtype == np.float32:
+            preds[:4] = [0.0, -0.0, 0.0, -0.0]
+        target = rng.randint(2, size=3000)
+        host = prc_mod._sorted_cumulants_host(jnp.asarray(preds), jnp.asarray(target), 1)
+        xla = prc_mod._sorted_cumulants_xla(jnp.asarray(preds), jnp.asarray(target), 1)
+        for h, x in zip(host, xla):
+            np.testing.assert_array_equal(np.asarray(h), np.asarray(x))
