@@ -12,9 +12,12 @@ plus O(N) scans. No gather, no searchsorted, no host round-trip.
 
 Cost profile on TPU (1M f32): the co-sort (``lax.sort`` of a monotone u32
 key with one packed payload operand, instead of an argsort+gather) dominates
-at ~2ms; the scans are memory-bound element-wise passes. Measured losers,
-for the record: argsort+gather and ``searchsorted`` formulations (~170ms),
-f32 keys (+7% TPU / +12% CPU), a third co-sorted operand (+20%).
+at ~0.9ms unstable (stable: 1.6ms — not needed, see ``_sorted_tie_groups``);
+the scans are memory-bound element-wise passes (full AUROC program ~1.8ms).
+Measured losers, for the record: argsort+gather and ``searchsorted``
+formulations (~170ms), f32 keys (+7% TPU / +12% CPU), a third co-sorted
+operand (+20%), u8 payload (no win over f32), deriving ``fps`` from
+position minus ``tps`` to drop a cumsum (no win — XLA fuses the scans).
 """
 import jax
 import jax.numpy as jnp
@@ -67,9 +70,17 @@ def _sorted_tie_groups(preds: jax.Array, rel: jax.Array, weight: jax.Array = Non
     without score sentinels.
     """
     key = _descending_key(preds)
+    # UNSTABLE sort, deliberately: every consumer of this function
+    # (`_auroc_from_groups` / `_ap_from_groups`) reads cumulative counts only
+    # at tie-group boundaries — group-end values at `is_last` and
+    # previous-group-end values forward-filled from `is_first` — and both are
+    # sums over whole key-equal groups, invariant to any permutation WITHIN a
+    # group, which is all an unstable sort can change (`is_first`/`is_last`
+    # are functions of the sorted keys alone). Measured on TPU at 1M:
+    # stable 1.62 ms vs unstable 0.92 ms for the co-sort.
     if weight is None:
         # one co-sorted relevance payload: no argsort+gather round-trip
-        key_s, rel_s = lax.sort((key, rel), num_keys=1, is_stable=True)
+        key_s, rel_s = lax.sort((key, rel), num_keys=1, is_stable=False)
         pos_w = rel_s
         neg_w = 1.0 - rel_s
     else:
@@ -77,7 +88,7 @@ def _sorted_tie_groups(preds: jax.Array, rel: jax.Array, weight: jax.Array = Non
         # one fewer co-sorted array is ~20% off the sort, and the key is
         # unchanged so tie grouping is identical
         packed = rel + 2.0 * weight
-        key_s, packed_s = lax.sort((key, packed), num_keys=1, is_stable=True)
+        key_s, packed_s = lax.sort((key, packed), num_keys=1, is_stable=False)
         pos_w = (packed_s == 3.0).astype(jnp.float32)  # rel=1, w=1
         neg_w = (packed_s == 2.0).astype(jnp.float32)  # rel=0, w=1
     tps = jnp.cumsum(pos_w)
@@ -162,7 +173,7 @@ def _use_host_sort() -> bool:
     """Trace-time dispatch: the host (numpy radix-sort) formulation on CPU
     backends, the co-sort XLA program elsewhere. XLA:CPU's sort-with-payload
     is ~10× slower than the whole numpy Mann-Whitney computation at 1M; on
-    TPU the co-sort runs ~2ms and callbacks would round-trip the tunnel.
+    TPU the co-sort runs ~0.9ms and callbacks would round-trip the tunnel.
     The rule is COLLECTIVE-scoped, not kernel-scoped: dispatch is fine from
     any eager/plain-jit call site (unsharded kernels, the sharded metrics'
     replica0 epilogues, `ranked_group_stats`), but code that runs INSIDE a
