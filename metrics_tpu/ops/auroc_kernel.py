@@ -183,10 +183,47 @@ def _use_host_sort() -> bool:
     return jax.default_backend() == "cpu"
 
 
+def _use_pallas_epilogue() -> bool:
+    """Trace-time dispatch: the single-pass Pallas segmented scan
+    (``ops/tie_scan_pallas``) replaces the post-sort cumsum/cummax programs
+    on TPU backends — XLA:TPU lowers each cumulative op to a multi-pass
+    program (~0.25-0.45 ms each at 1M), the Pallas scan does the whole
+    epilogue in one HBM pass (exact-AUROC program 1.8 → ~1.05 ms at 1M).
+    ``METRICS_TPU_NO_PALLAS=1`` restores the pure-XLA epilogue (debug/
+    comparison) — set it before the process first calls a curve kernel:
+    the branch is baked into the jit cache at first trace. CPU backends
+    never take it (Mosaic kernels don't run on XLA:CPU — interpret mode
+    covers the logic in tests).
+    """
+    import os
+
+    flag = os.environ.get("METRICS_TPU_NO_PALLAS", "").strip().lower()
+    return jax.default_backend() == "tpu" and flag in ("", "0", "false")
+
+
+def _pallas_auroc_ap(preds: jax.Array, rel: jax.Array, weight: jax.Array = None):
+    """Co-sort + fused tie-group scan → ``(auroc, ap)``.
+
+    The ONE Pallas dispatch site: same u32 key and the same
+    ``rel + 2*weight`` packed payload as :func:`_sorted_tie_groups` (one
+    kernel serves plain and masked variants because weight-0 elements are
+    inert in the scan), so tie grouping is identical across epilogues.
+    """
+    from metrics_tpu.ops.tie_scan_pallas import auroc_ap_from_stats, tie_group_reduce
+
+    key = _descending_key(preds)
+    payload = rel + 2.0 * (jnp.ones_like(rel) if weight is None else weight)
+    key_s, pay_s = lax.sort((key, payload), num_keys=1, is_stable=False)
+    return auroc_ap_from_stats(tie_group_reduce(key_s, pay_s))
+
+
 @jax.jit
 def _binary_auroc_xla(preds: jax.Array, rel: jax.Array) -> jax.Array:
-    """The pure-XLA co-sort formulation (every non-CPU backend; also kept
-    independently tested on CPU so the TPU program logic has coverage)."""
+    """The on-device co-sort formulation (every non-CPU backend; the XLA
+    epilogue is also kept independently tested on CPU so the program logic
+    has coverage there)."""
+    if _use_pallas_epilogue():
+        return _pallas_auroc_ap(preds, rel)[0]
     return _auroc_from_groups(*_sorted_tie_groups(preds, rel))
 
 
@@ -270,6 +307,8 @@ def masked_binary_auroc(preds: jax.Array, target: jax.Array, mask: jax.Array, po
     """
     w = mask.astype(jnp.float32)
     rel = (target == pos_label).astype(jnp.float32)
+    if _use_pallas_epilogue():
+        return _pallas_auroc_ap(preds, rel, w)[0]
     tps, fps, is_last, tps_prev, fps_prev = _sorted_tie_groups(preds, rel, w)
     return _auroc_from_groups(tps, fps, is_last, tps_prev, fps_prev)
 
@@ -285,13 +324,18 @@ def masked_binary_average_precision(
     """
     w = mask.astype(jnp.float32)
     rel = (target == pos_label).astype(jnp.float32)
+    if _use_pallas_epilogue():
+        return _pallas_auroc_ap(preds, rel, w)[1]
     tps, fps, is_last, tps_prev, _ = _sorted_tie_groups(preds, rel, w)
     return _ap_from_groups(tps, fps, is_last, tps_prev)
 
 
 @jax.jit
 def _binary_average_precision_xla(preds: jax.Array, rel: jax.Array) -> jax.Array:
-    """The pure-XLA co-sort AP (every non-CPU backend; independently tested)."""
+    """The on-device co-sort AP (every non-CPU backend; the XLA epilogue is
+    independently tested on CPU)."""
+    if _use_pallas_epilogue():
+        return _pallas_auroc_ap(preds, rel)[1]
     tps, fps, is_last, tps_prev, _ = _sorted_tie_groups(preds, rel)
     return _ap_from_groups(tps, fps, is_last, tps_prev)
 

@@ -1,0 +1,163 @@
+"""Parity tests for the single-pass Pallas AUROC/AP epilogue.
+
+The Mosaic kernel only runs on real TPUs; here its logic runs in Pallas
+interpret mode on CPU and is pinned against the independently-tested XLA
+formulation (``_sorted_tie_groups`` + ``_auroc_from_groups`` /
+``_ap_from_groups``) across the hazards specific to the scan design:
+tie groups spanning block boundaries, exact-block-size streams (no tail
+padding), mask-invalid elements, signed zeros sharing a key, degenerate
+single-class targets, and sub-block streams.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from metrics_tpu.ops.auroc_kernel import (
+    _descending_key,
+    masked_binary_auroc,
+    masked_binary_average_precision,
+)
+from metrics_tpu.ops.tie_scan_pallas import auroc_ap_from_stats, tie_group_reduce
+
+jax = pytest.importorskip("jax")
+
+
+def _pallas_scores(preds, rel, w=None):
+    preds = jnp.asarray(preds, jnp.float32)
+    rel = jnp.asarray(rel, jnp.float32)
+    w = jnp.ones_like(rel) if w is None else jnp.asarray(w, jnp.float32)
+    key_s, pay_s = lax.sort(
+        (_descending_key(preds), rel + 2.0 * w), num_keys=1, is_stable=False
+    )
+    return auroc_ap_from_stats(tie_group_reduce(key_s, pay_s, interpret=True))
+
+
+def _xla_scores(preds, rel, w=None):
+    preds = jnp.asarray(preds, jnp.float32)
+    rel = jnp.asarray(rel, jnp.int32)
+    mask = jnp.ones_like(rel, bool) if w is None else jnp.asarray(w, bool)
+    return (
+        masked_binary_auroc(preds, rel, mask),
+        masked_binary_average_precision(preds, rel, mask),
+    )
+
+
+def _assert_matches(preds, rel, w=None):
+    pa, pp = (float(x) for x in _pallas_scores(preds, rel, w))
+    xa, xp = (float(x) for x in _xla_scores(preds, rel, w))
+    assert (np.isnan(pa) and np.isnan(xa)) or abs(pa - xa) < 2e-6, (pa, xa)
+    assert (np.isnan(pp) and np.isnan(xp)) or abs(pp - xp) < 2e-5, (pp, xp)
+
+
+def test_canonical_four_points():
+    _assert_matches([0.1, 0.4, 0.35, 0.8], [0, 0, 1, 1])
+
+
+def test_all_one_tie_group():
+    _assert_matches([0.5] * 6, [0, 1, 0, 1, 0, 1])
+
+
+def test_degenerate_single_class_is_nan():
+    pa, pp = _pallas_scores([0.1, 0.4, 0.35, 0.8], [1, 1, 1, 1])
+    assert np.isnan(float(pa)) and float(pp) == pytest.approx(1.0)
+
+
+def test_signed_zeros_share_a_key():
+    _assert_matches([0.0, -0.0, 0.0, -0.0], [1, 0, 1, 0])
+
+
+@pytest.mark.parametrize("n", [1, 7, 100, 5000, 33000])
+def test_tie_heavy_random(n):
+    rng = np.random.default_rng(n)
+    _assert_matches(np.round(rng.standard_normal(n), 1), rng.integers(0, 2, n))
+
+
+def test_masked_elements_are_inert():
+    rng = np.random.default_rng(3)
+    n = 20000
+    preds = np.round(rng.standard_normal(n), 1)
+    rel = rng.integers(0, 2, n)
+    mask = rng.random(n) < 0.7
+    _assert_matches(preds, rel, mask)
+    # masked-off entries must not influence the result at all
+    garbage = preds.copy()
+    garbage[~mask] = 1e30
+    pa1, _ = _pallas_scores(preds, rel, mask)
+    pa2, _ = _pallas_scores(garbage, rel, mask)
+    assert float(pa1) == float(pa2)
+
+
+def test_one_group_spanning_blocks():
+    # 33k equal scores cross the 32768-element block boundary
+    rng = np.random.default_rng(5)
+    _assert_matches(np.zeros(33000), rng.integers(0, 2, 33000))
+
+
+def test_exact_block_size_no_padding():
+    rng = np.random.default_rng(6)
+    _assert_matches(np.round(rng.standard_normal(32768), 2), rng.integers(0, 2, 32768))
+
+
+def test_dispatch_glue_routes_correct_scores(monkeypatch):
+    """Drive the REAL dispatch sites in ``ops/auroc_kernel`` through the
+    Pallas path on CPU: force ``_use_pallas_epilogue`` on and run the
+    kernel in interpret mode, so a glue bug (e.g. swapped AUROC/AP indices
+    in a branch) fails here instead of only on real TPUs."""
+    from metrics_tpu.ops import auroc_kernel as ak
+    from metrics_tpu.ops import tie_scan_pallas as tsp
+
+    monkeypatch.setattr(ak, "_use_pallas_epilogue", lambda: True)
+    calls = []
+    real_reduce = tsp.tie_group_reduce
+
+    def _recording_reduce(key_s, payload_s):
+        calls.append(1)
+        return real_reduce(key_s, payload_s, interpret=True)
+
+    monkeypatch.setattr(tsp, "tie_group_reduce", _recording_reduce)
+
+    # unique length so the jit caches can't serve a pre-patch trace
+    rng = np.random.default_rng(11)
+    n = 1237
+    preds = jnp.asarray(np.round(rng.standard_normal(n), 1), jnp.float32)
+    rel = jnp.asarray(rng.integers(0, 2, n), jnp.float32)
+    target = rel.astype(jnp.int32)
+    mask = jnp.asarray(rng.random(n) < 0.8)
+
+    xa = float(ak._auroc_from_groups(*ak._sorted_tie_groups(preds, rel)))
+    tps, fps, is_last, tps_prev, _ = ak._sorted_tie_groups(preds, rel)
+    xp = float(ak._ap_from_groups(tps, fps, is_last, tps_prev))
+
+    assert float(ak._binary_auroc_xla(preds, rel)) == pytest.approx(xa, abs=2e-6)
+    assert float(ak._binary_average_precision_xla(preds, rel)) == pytest.approx(xp, abs=2e-5)
+
+    w = mask.astype(jnp.float32)
+    tps, fps, is_last, tps_prev, fps_prev = ak._sorted_tie_groups(preds, rel, w)
+    mxa = float(ak._auroc_from_groups(tps, fps, is_last, tps_prev, fps_prev))
+    mxp = float(ak._ap_from_groups(tps, fps, is_last, tps_prev))
+    assert float(ak.masked_binary_auroc(preds, target, mask)) == pytest.approx(mxa, abs=2e-6)
+    assert float(ak.masked_binary_average_precision(preds, target, mask)) == pytest.approx(
+        mxp, abs=2e-5
+    )
+    # prove the Pallas path (not the XLA fallback) produced those values
+    assert len(calls) == 4
+
+
+def test_vmap_batches_classes():
+    rng = np.random.default_rng(8)
+    n, c = 2000, 3
+    probs = np.round(rng.random((n, c)), 2).astype(np.float32)
+    tc = rng.integers(0, c, n)
+    onehot = (jnp.asarray(tc)[:, None] == jnp.arange(c)).astype(jnp.float32)
+
+    def one(p, r):
+        key_s, pay_s = lax.sort(
+            (_descending_key(p), r + 2.0), num_keys=1, is_stable=False
+        )
+        return auroc_ap_from_stats(tie_group_reduce(key_s, pay_s, interpret=True))[0]
+
+    batched = jax.vmap(one, in_axes=(1, 1))(jnp.asarray(probs), onehot)
+    for ci in range(c):
+        xa, _ = _xla_scores(probs[:, ci], (tc == ci).astype(int))
+        assert abs(float(batched[ci]) - float(xa)) < 2e-6
