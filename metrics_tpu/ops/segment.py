@@ -14,6 +14,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from metrics_tpu.ops.auroc_kernel import _descending_key, _use_host_sort
 
@@ -42,11 +43,27 @@ def _host_lex_order(group, key):
 
 @jax.jit
 def _lex_order_xla(group, preds):
-    """The pure-XLA (group asc, score desc, stable) permutation — the TPU
-    program, kept separately jitted so it stays independently tested on CPU
-    (the dispatch below routes CPU through the host radix path)."""
+    """The (group asc, score desc, stable) permutation as XLA argsorts —
+    kept as the reference formulation for the co-sort below and for the
+    host-path parity test, NOT the TPU hot path: argsort+gather measured
+    46.5 ms at 1M/10k groups on the chip vs 18.9 ms for the two-key
+    co-sort (index-chasing loses to co-sorting, same lesson as the AUROC
+    kernel)."""
     order_by_score = jnp.argsort(-preds, stable=True)
     return order_by_score[jnp.argsort(group[order_by_score], stable=True)]
+
+
+@jax.jit
+def _lex_cosort_xla(group, preds, target):
+    """One stable two-key ``lax.sort`` — (group asc, score desc), ``target``
+    co-sorted as payload. Returns ``(g_sorted, t_sorted)`` WITHOUT ever
+    materializing a permutation: the downstream segment stats only need the
+    sorted arrays, which is what makes the co-sort formulation available.
+    Tie-break by original position matches the argsort formulation because
+    the sort is stable."""
+    key = _descending_key(preds)
+    g_s, _, t_s = lax.sort((group, key, target.astype(jnp.float32)), num_keys=2, is_stable=True)
+    return g_s, t_s
 
 
 @partial(jax.jit, static_argnames=("num_groups",))
@@ -80,11 +97,12 @@ def ranked_group_stats(
             _descending_key(preds),
             vmap_method="sequential",
         )
+        g_sorted = group[order]
+        t_sorted = target[order].astype(jnp.float32)
     else:
-        order = _lex_order_xla(group, preds)
-
-    g_sorted = group[order]
-    t_sorted = target[order].astype(jnp.float32)
+        # TPU and other accelerators: two-key co-sort, no permutation
+        # materialized (46.5 → 18.9 ms at 1M/10k groups on the chip)
+        g_sorted, t_sorted = _lex_cosort_xla(group, preds, target)
 
     # 1-based rank within each group: global position minus the group's start.
     # searchsorted on the sorted group ids gives each group's start offset.

@@ -228,3 +228,23 @@ def test_lex_order_host_and_xla_agree():
     assert (np.diff(g_s) >= 0).all()
     same_g = np.diff(g_s) == 0
     assert (np.diff(p_s)[same_g] <= 0).all()
+
+
+def test_lex_cosort_matches_argsort_formulation():
+    """The accelerator hot path (`_lex_cosort_xla`, two-key co-sort with no
+    materialized permutation) must yield exactly the sorted (group, target)
+    streams the argsort formulation produces — ties, signed zeros, and
+    stable position tie-breaks included (the tie-break matters: downstream
+    rank-based retrieval scores change if equal-score documents swap)."""
+    from metrics_tpu.ops.segment import _lex_cosort_xla, _lex_order_xla
+
+    rng = np.random.RandomState(91)
+    group = rng.randint(7, size=3000).astype(np.int32)
+    preds = np.round(rng.rand(3000) * 20).astype(np.float32) / 20  # heavy ties
+    preds[:4] = [0.0, -0.0, 0.0, -0.0]
+    target = rng.randint(2, size=3000).astype(np.int32)
+
+    order = np.asarray(_lex_order_xla(jnp.asarray(group), jnp.asarray(preds)))
+    g_s, t_s = _lex_cosort_xla(jnp.asarray(group), jnp.asarray(preds), jnp.asarray(target))
+    assert np.array_equal(np.asarray(g_s), group[order])
+    assert np.array_equal(np.asarray(t_s), target[order].astype(np.float32))
