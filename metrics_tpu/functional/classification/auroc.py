@@ -11,6 +11,7 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.histogram import label_bincount
 from metrics_tpu.functional.classification.auc import _auc_compute
 from metrics_tpu.functional.classification.roc import roc
 from metrics_tpu.utilities.checks import _input_format_classification
@@ -146,7 +147,7 @@ def _auroc_compute(
 
         auc_scores = list(multiclass_auroc_ovr(preds, target))
         return _reduce_auroc(
-            auc_scores, average, lambda: jnp.bincount(target.reshape(-1).astype(jnp.int32), length=num_classes)
+            auc_scores, average, lambda: label_bincount(target.reshape(-1).astype(jnp.int32), length=num_classes)
         )
     else:
         fpr, tpr, _ = roc(preds, target, num_classes, pos_label, sample_weights)
@@ -162,7 +163,7 @@ def _auroc_compute(
             def support_fn():
                 if mode == DataType.MULTILABEL:
                     return jnp.sum(target, axis=0)
-                return jnp.bincount(target.reshape(-1).astype(jnp.int32), length=num_classes)
+                return label_bincount(target.reshape(-1).astype(jnp.int32), length=num_classes)
 
             return _reduce_auroc(auc_scores, average, support_fn)
 

@@ -9,6 +9,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.ops.histogram import label_bincount
 from metrics_tpu.utilities import rank_zero_warn
 from metrics_tpu.utilities.checks import (
     _fast_path_inputs,
@@ -35,7 +36,7 @@ def _confmat_count(preds, target, num_classes, multilabel, argmax_first):
         unique_mapping = (target.reshape(-1) * num_classes + preds.reshape(-1)).astype(jnp.int32)
         minlength = num_classes ** 2
 
-    bins = jnp.bincount(unique_mapping, length=minlength)
+    bins = label_bincount(unique_mapping, length=minlength)
     if multilabel:
         return bins.reshape(num_classes, 2, 2)
     return bins.reshape(num_classes, num_classes)
@@ -77,11 +78,11 @@ def _confmat_probe_count(preds, target, p_shape, t_shape, case, num_classes, thr
 
     if multilabel:
         unique_mapping = ((2 * target + pred_labels) + 4 * jnp.arange(num_classes)).flatten()
-        bins = jnp.bincount(unique_mapping, length=4 * num_classes)
+        bins = label_bincount(unique_mapping, length=4 * num_classes)
         confmat = bins.reshape(num_classes, 2, 2)
     else:
         unique_mapping = (target.reshape(-1) * num_classes + pred_labels.reshape(-1)).astype(jnp.int32)
-        bins = jnp.bincount(unique_mapping, length=num_classes**2)
+        bins = label_bincount(unique_mapping, length=num_classes**2)
         confmat = bins.reshape(num_classes, num_classes)
 
     return (*probe, max_label, confmat)
@@ -129,12 +130,13 @@ def _confmat_fast_update(
             preds, target, p_shape, t_shape, raw[:5],
             threshold=threshold, num_classes=None, is_multiclass=None, top_k=None,
         )
-        max_label = int(raw[5])
-        if not multilabel and max_label >= num_classes:
-            raise ValueError(
-                f"Detected class label {max_label} which is larger than or equal to"
-                f" `num_classes`={num_classes} in the confusion matrix computation."
-            )
+        if _is_concrete(raw[5]):  # value probe: eager-only, like canonical
+            max_label = int(raw[5])
+            if not multilabel and max_label >= num_classes:
+                raise ValueError(
+                    f"Detected class label {max_label} which is larger than or equal to"
+                    f" `num_classes`={num_classes} in the confusion matrix computation."
+                )
         return raw[6]
 
     # CohenKappa/MatthewsCorrcoef/IoU siblings in one collection share the

@@ -13,6 +13,7 @@ from metrics_tpu.utilities.checks import (
     _prob_sum_atol,
     fast_path_memo,
 )
+from metrics_tpu.utilities.data import _is_concrete
 from metrics_tpu.utilities.enums import DataType
 
 
@@ -62,6 +63,10 @@ def _hamming_fast_update(preds, target, threshold) -> Optional[Tuple[jax.Array, 
         # probabilities vs labels: require a real class axis
         if len(p_shape) != len(t_shape) + 1 or implied_classes < 2:
             return None
+    if label_pairs and not (_is_concrete(preds) and _is_concrete(target)):
+        # the canonical one-hot width comes from the data maximum — a value
+        # probe; under tracing the canonical path owns that failure mode
+        return None
 
     def compute():
         raw = _hamming_probe_count(

@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from metrics_tpu.ops.histogram import label_bincount
 from metrics_tpu.utilities.checks import (
     _fast_path_inputs,
     _fast_path_validate,
@@ -155,11 +156,13 @@ def _stat_scores_probe_count(
             t_bins, p_bins = flat_t, (pred_labels if k == 1 else idx.reshape(-1))
         length = groups * num_cols
         gshape = (groups, num_cols) if samplewise else (num_cols,)
-        support = jnp.bincount(t_bins, length=length).reshape(gshape)
+        support = label_bincount(t_bins, length=length).reshape(gshape)
         # integer weights: float32 scatter-add saturates at 2^24 and would
         # silently undercount tp on >16.7M-hit classes
-        tp_c = jnp.bincount(t_bins, weights=hit.astype(jnp.int32), length=length).reshape(gshape).astype(jnp.int32)
-        count_pred = jnp.bincount(p_bins, length=length).reshape(gshape)
+        # bool weights: the TPU contraction path requires 0/1 contributions
+        # (general int weights could exceed per-chunk f32 exactness)
+        tp_c = label_bincount(t_bins, length=length, weights=hit).reshape(gshape).astype(jnp.int32)
+        count_pred = label_bincount(p_bins, length=length).reshape(gshape)
         fn_c = (support - tp_c).astype(jnp.int32)
         fp_c = (count_pred - tp_c).astype(jnp.int32)
         tn_c = (x - support - fp_c).astype(jnp.int32)

@@ -24,6 +24,81 @@ import jax
 import jax.numpy as jnp
 
 
+# past this many buckets the chunked one-hot contraction's N x K compare
+# work overtakes TPU scatter-add (measured at 1M: contraction 0.2-1.5 ms for
+# K <= 1024, 5.1 ms at K=4096 vs scatter's flat ~8.8 ms — crossover ~4k;
+# 2048 keeps a safety margin)
+_CONTRACTION_MAX_LENGTH = 2048
+_CONTRACTION_CHUNK = 262144
+
+
+@partial(jax.jit, static_argnames=("length",))
+def label_bincount(indices: jax.Array, length: int, weights: jax.Array = None) -> jax.Array:
+    """``jnp.bincount`` with a TPU-shaped formulation for small lengths.
+
+    XLA:TPU lowers scatter-add serially (~8.8 ms flat at 1M regardless of
+    ``length``); for the label-space counts the fused classification kernels
+    need (confusion cells, per-class support/hits — ``length`` = C or C²),
+    a chunked one-hot MXU contraction is 6-40× faster. Per-chunk counts are
+    exact in f32 (0/1 contributions, chunk < 2²⁴) and accumulate in int32,
+    so nothing saturates the way a single f32 scatter-add would. The
+    contraction therefore requires ``weights`` to be None or boolean —
+    general integer weights could exceed f32 exactness within a chunk and
+    fall back to ``jnp.bincount``, as do CPU backends (scatter lowers fine
+    there) and large lengths (MDMC-samplewise group counts).
+
+    Out-of-range behavior matches ``jnp.bincount(..., length=...)`` on both
+    paths — negatives clamp to bucket 0, ``>= length`` drops — because
+    under tracing the eager range validation is skipped and the two paths
+    must not diverge across backends on invalid labels.
+    """
+    bool_weights = weights is None or weights.dtype == jnp.bool_
+    if (
+        jax.default_backend() != "tpu"
+        or length > _CONTRACTION_MAX_LENGTH
+        or not bool_weights
+    ):
+        if weights is not None and weights.dtype == jnp.bool_:
+            # int scatter-add: a float one saturates at 2^24 contributions
+            weights = weights.astype(jnp.int32)
+        return jnp.bincount(indices, weights=weights, length=length)
+    out = _contraction_bincount(indices, length, weights)
+    if weights is not None and weights.dtype != jnp.bool_:
+        return out.astype(weights.dtype)
+    return out
+
+
+def _contraction_bincount(indices: jax.Array, length: int, weights: jax.Array = None) -> jax.Array:
+    """The chunked one-hot MXU contraction (plain XLA — testable on any
+    backend; :func:`label_bincount` routes TPU here)."""
+    # negatives clamp to bucket 0 and >= length drops, exactly like the
+    # jnp.bincount fallback — backends must agree on invalid labels
+    idx = jnp.maximum(indices.astype(jnp.int32), 0)
+    n = idx.shape[0]
+    chunk = _CONTRACTION_CHUNK
+
+    def count_chunk(part_idx, part_w):
+        onehot = (part_idx[:, None] == jnp.arange(length)).astype(jnp.float32)
+        return (part_w[None, :] @ onehot)[0].astype(jnp.int32)
+
+    w_full = (
+        jnp.ones((n,), jnp.float32) if weights is None else weights.astype(jnp.float32)
+    )
+    if n <= chunk:
+        return count_chunk(idx, w_full)
+    pad = (-n) % chunk
+    idx_c = jnp.pad(idx, (0, pad), constant_values=0).reshape(-1, chunk)
+    # padding must count nowhere: weight 0 (pad index 0 is in range)
+    w_c = jnp.pad(w_full, (0, pad)).reshape(-1, chunk)
+
+    def body(carry, xs):
+        b, bw = xs
+        return carry + count_chunk(b, bw), None
+
+    out, _ = jax.lax.scan(body, jnp.zeros((length,), jnp.int32), (idx_c, w_c))
+    return out
+
+
 @partial(jax.jit, static_argnames=("num_bins",))
 def score_histograms(
     preds: jax.Array, target: jax.Array, num_bins: int = 256, mask: jax.Array = None

@@ -425,15 +425,20 @@ def shared_canonicalization():
 
 def _fast_path_inputs(preds: jax.Array, target: jax.Array):
     """Shared eligibility preamble for the fused fast-path kernels
-    (accuracy / confusion-matrix / stat-scores): concrete inputs, int
-    target, matching first dims, and a detectable case. Returns
+    (accuracy / hamming / confusion-matrix / stat-scores): int target,
+    matching first dims, and a detectable case. Returns
     ``(p_shape, t_shape, preds_float, case, implied_classes)`` or None —
     None always means "take the canonical path", which raises the parity
     errors for the rejected configurations. ONE definition so the
     validation-parity contract cannot drift between metrics.
+
+    Every check here is STATIC (shapes/dtypes), so tracers qualify too:
+    under a user ``jit`` the fused kernels replace the canonical
+    one-hot-and-reduce path (the canonicalization materializes two (N, C)
+    intermediates — measured 8.8 ms vs ~1 ms at 1M×4 on TPU), with value
+    validation skipped exactly as the canonical traced path skips it
+    (:func:`_fast_path_validate` no-ops on tracers).
     """
-    if not (_is_concrete(preds) and _is_concrete(target)):
-        return None  # traced: the canonical path handles jit semantics
     if _is_floating(target):
         return None  # canonical path raises the parity error
     p_shape = _squeeze_shape(preds.shape)
@@ -463,7 +468,14 @@ def _fast_path_validate(
 ) -> None:
     """Run the canonical validation pipeline from a fused kernel's probe
     scalars (``raw_probe`` = the first five outputs of a kernel that fused
-    :func:`_probe_scalars`). Raises exactly what the canonical path raises."""
+    :func:`_probe_scalars`). Raises exactly what the canonical path raises.
+
+    No-op under tracing: value checks are eager-only across the whole
+    library (the canonical path guards each probe with ``_is_concrete``),
+    so the fused fast path skips them identically when inputs are traced.
+    """
+    if not (_is_concrete(preds) and _is_concrete(target)):
+        return
     probe = _Probe(
         float(raw_probe[0]), float(raw_probe[1]), int(raw_probe[2]), int(raw_probe[3]), bool(raw_probe[4])
     )

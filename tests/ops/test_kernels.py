@@ -248,3 +248,52 @@ def test_lex_cosort_matches_argsort_formulation():
     g_s, t_s = _lex_cosort_xla(jnp.asarray(group), jnp.asarray(preds), jnp.asarray(target))
     assert np.array_equal(np.asarray(g_s), group[order])
     assert np.array_equal(np.asarray(t_s), target[order].astype(np.float32))
+
+
+def test_contraction_bincount_matches_scatter():
+    """`label_bincount`'s TPU formulation (chunked one-hot MXU contraction)
+    must count exactly like `jnp.bincount` — incl. multi-chunk streams where
+    tail padding must count nowhere, and boolean hit weights (the only
+    weight dtype the contraction admits: 0/1 contributions keep per-chunk
+    f32 sums exact). Run directly on CPU: the contraction is plain XLA."""
+    from metrics_tpu.ops.histogram import _CONTRACTION_CHUNK, _contraction_bincount
+
+    rng = np.random.RandomState(17)
+    for n in (0, 1, 1000, _CONTRACTION_CHUNK, _CONTRACTION_CHUNK + 1, 3 * _CONTRACTION_CHUNK + 7):
+        for k in (1, 16, 257):
+            idx = rng.randint(k, size=n).astype(np.int32)
+            got = np.asarray(_contraction_bincount(jnp.asarray(idx), k))
+            want = np.bincount(idx, minlength=k)
+            assert np.array_equal(got, want), (n, k)
+            w = rng.randint(2, size=n).astype(bool)
+            got_w = np.asarray(_contraction_bincount(jnp.asarray(idx), k, jnp.asarray(w)))
+            want_w = np.bincount(idx, weights=w, minlength=k).astype(np.int64)
+            assert np.array_equal(got_w, want_w), (n, k, "weighted")
+
+
+def test_contraction_bincount_invalid_labels_match_scatter():
+    """Out-of-range labels must behave identically on both paths (under
+    tracing the eager range validation is skipped, so backends must not
+    diverge): negatives clamp to bucket 0, >= length drops."""
+    from metrics_tpu.ops.histogram import _contraction_bincount
+
+    idx = np.array([-1, 0, 2, 9, 5], np.int32)
+    got = np.asarray(_contraction_bincount(jnp.asarray(idx), 7))
+    want = np.asarray(jnp.bincount(jnp.asarray(idx), length=7))
+    assert np.array_equal(got, want), (got, want)
+
+
+def test_label_bincount_cpu_falls_back_to_scatter():
+    from metrics_tpu.ops.histogram import label_bincount
+
+    idx = jnp.asarray(np.array([0, 2, 2, 5], np.int32))
+    got = np.asarray(label_bincount(idx, 7))
+    assert np.array_equal(got, [1, 0, 2, 0, 0, 1, 0])
+    w = jnp.asarray(np.array([1.5, 0.5, 1.0, 2.0], np.float32))  # float weights: scatter path
+    got_w = np.asarray(label_bincount(idx, 7, w))
+    assert np.allclose(got_w, [1.5, 0, 1.5, 0, 0, 2.0, 0])
+    # bool weights promote to int scatter on the fallback (no f32 saturation)
+    wb = jnp.asarray(np.array([True, False, True, True]))
+    got_b = np.asarray(label_bincount(idx, 7, wb))
+    assert np.array_equal(got_b, [1, 0, 1, 0, 0, 1, 0])
+    assert jnp.issubdtype(label_bincount(idx, 7, wb).dtype, jnp.integer)
