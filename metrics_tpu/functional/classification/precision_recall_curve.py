@@ -7,8 +7,10 @@ jitted, fixed-shape XLA program (``_sorted_cumulants``); only the
 distinct-threshold deduplication — whose output length is data-dependent
 (reference ``precision_recall_curve.py:51``, an XLA dynamic-shape hazard per
 SURVEY §7) — runs eagerly at epoch-end ``compute()``, where it executes once
-per epoch and is off the hot path. ``jnp.argsort`` is stable, so tie handling
-needs no workaround.
+per epoch and is off the hot path. Only group-end cumulants (selected by
+the dedup mask, a function of the sorted scores alone) are ever consumed,
+which is what lets the accelerator branch use an unstable co-sort; the CPU
+branches keep stable argsorts.
 """
 from functools import partial
 from typing import List, Optional, Sequence, Tuple, Union
@@ -17,7 +19,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from metrics_tpu.ops.auroc_kernel import _use_host_sort
+from metrics_tpu.ops.auroc_kernel import _descending_key, _score_from_key, _use_host_sort
 from metrics_tpu.utilities import rank_zero_warn
 from metrics_tpu.utilities.data import _is_concrete
 
@@ -26,17 +28,40 @@ from metrics_tpu.utilities.data import _is_concrete
 def _sorted_cumulants_xla(preds, target, pos_label, sample_weights=None, weighted: bool = False):
     """Descending-score sort and cumulative true/false-positive counts.
 
-    One fixed-shape XLA program: argsort (stable), gather, two cumsums and the
-    adjacent-distinct mask are fused by XLA; everything downstream of the
-    data-dependent dedup stays outside.
+    One fixed-shape XLA program. On accelerators (f32 scores — every other
+    dtype keeps its exact argsort path, since the u32 key would round
+    int/f64 scores) this is a co-sort of the u32 descending key with the
+    relevance (and weight) payloads — no permutation materialized, scores
+    recovered by inverting the key (argsort+gather loses to co-sorting on
+    TPU, same lesson as the AUROC kernel; unstable is safe because every
+    consumer reads group-end cumulants via the dedup mask). The dedup mask
+    uses IEEE inequality on the recovered scores, not raw key inequality,
+    so NaN scores stay individually distinct exactly as on the argsort
+    branches (their tie-order among themselves is unspecified either way).
+    XLA:CPU keeps the argsort formulation (its payload co-sort is ~5×
+    slower than argsort+gather; the eager epoch-end call dispatches to the
+    numpy mirror anyway — this branch is its traced/weighted fallback).
     """
-    order = jnp.argsort(-preds)  # descending; stable, so ties keep input order
-    preds_s = preds[order]
-    target_s = (target[order] == pos_label).astype(jnp.float32)
-    weight = sample_weights[order] if weighted else jnp.ones((), jnp.float32)
+    rel = (target == pos_label).astype(jnp.float32)
+    if not _use_host_sort() and preds.dtype == jnp.float32:
+        key = _descending_key(preds)
+        if weighted:
+            key_s, target_s, weight = jax.lax.sort(
+                (key, rel, sample_weights.astype(jnp.float32)), num_keys=1, is_stable=False
+            )
+        else:
+            key_s, target_s = jax.lax.sort((key, rel), num_keys=1, is_stable=False)
+            weight = jnp.ones((), jnp.float32)
+        preds_s = _score_from_key(key_s)
+        distinct = preds_s[1:] != preds_s[:-1]
+    else:
+        order = jnp.argsort(-preds)  # descending; stable, ties keep input order
+        preds_s = preds[order]
+        target_s = rel[order]
+        weight = sample_weights[order] if weighted else jnp.ones((), jnp.float32)
+        distinct = preds_s[1:] != preds_s[:-1]
     tps = jnp.cumsum(target_s * weight)
     fps = jnp.cumsum((1.0 - target_s) * weight)
-    distinct = preds_s[1:] != preds_s[:-1]
     return preds_s, tps, fps, distinct
 
 

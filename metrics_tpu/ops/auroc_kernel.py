@@ -52,6 +52,18 @@ def _descending_key(preds: jax.Array) -> jax.Array:
     return jnp.where(jnp.isnan(p), jnp.uint32(0xFFFFFFFF), ~u)
 
 
+def _score_from_key(key: jax.Array) -> jax.Array:
+    """Invert :func:`_descending_key`: recover the f32 score from its u32
+    sort key, so co-sorts need no score payload operand (a third co-sorted
+    operand costs ~20% of the sort). Exact for every float except the two
+    canonicalized representations: ``-0.0`` comes back as ``+0.0`` (equal
+    value) and NaNs come back as *a* NaN.
+    """
+    u = ~key
+    b = jnp.where(u >= _SIGN, u ^ _SIGN, ~u)
+    return lax.bitcast_convert_type(b, jnp.float32)
+
+
 def _sorted_tie_groups(preds: jax.Array, rel: jax.Array, weight: jax.Array = None):
     """Co-sort by descending score; return cumulative counts + tie masks.
 

@@ -297,3 +297,73 @@ def test_label_bincount_cpu_falls_back_to_scatter():
     got_b = np.asarray(label_bincount(idx, 7, wb))
     assert np.array_equal(got_b, [1, 0, 1, 0, 0, 1, 0])
     assert jnp.issubdtype(label_bincount(idx, 7, wb).dtype, jnp.integer)
+
+
+def test_score_from_key_roundtrip():
+    """`_score_from_key` must invert `_descending_key` exactly for every
+    float except the canonicalized pair (-0.0 -> +0.0, NaN -> a NaN)."""
+    from metrics_tpu.ops.auroc_kernel import _descending_key, _score_from_key
+
+    rng = np.random.RandomState(23)
+    # random bit patterns cover denormals/extremes; exclude NaNs
+    bits = rng.randint(0, 2**32, size=20000, dtype=np.uint32)
+    vals = bits.view(np.float32)
+    vals = vals[~np.isnan(vals)]
+    vals = np.concatenate([vals, [0.0, -0.0, np.inf, -np.inf, 1e-45, -1e-45]]).astype(np.float32)
+    back = np.asarray(_score_from_key(_descending_key(jnp.asarray(vals))))
+    # -0.0 canonicalizes to +0.0: compare by value, then bits away from zero
+    assert np.array_equal(back, vals), "value mismatch"
+    nonzero = vals != 0
+    assert np.array_equal(back[nonzero].view(np.uint32), vals[nonzero].view(np.uint32))
+
+
+def test_sorted_cumulants_cosort_matches_argsort_branch():
+    """The accelerator co-sort branch of `_sorted_cumulants_xla` must give
+    the same curve points as the argsort branch: group-end cumulants and
+    thresholds, on tie-heavy streams with signed zeros, and with weights."""
+    import importlib
+
+    prc = importlib.import_module("metrics_tpu.functional.classification.precision_recall_curve")
+
+    rng = np.random.RandomState(29)
+    n = 4000
+    preds = np.round(rng.randn(n), 1).astype(np.float32)
+    preds[:4] = [0.0, -0.0, 0.0, -0.0]
+    target = rng.randint(2, size=n)
+    weights = rng.rand(n).astype(np.float32)
+
+    # call the UNJITTED function (__wrapped__): a jitted call would cache
+    # the first-traced branch and compare it against itself
+    raw_fn = prc._sorted_cumulants_xla.__wrapped__
+    real = prc._use_host_sort
+    try:
+        for weighted in (False, True):
+            sw = None if not weighted else jnp.asarray(weights)
+            prc._use_host_sort = lambda: False  # co-sort branch
+            co = raw_fn(jnp.asarray(preds), jnp.asarray(target), 1, sw, weighted=weighted)
+            prc._use_host_sort = lambda: True  # argsort branch
+            ar = raw_fn(jnp.asarray(preds), jnp.asarray(target), 1, sw, weighted=weighted)
+            co_p, co_t, co_f, co_d = (np.asarray(x) for x in co)
+            ar_p, ar_t, ar_f, ar_d = (np.asarray(x) for x in ar)
+            assert np.array_equal(co_d, ar_d), "distinct masks differ"
+            ends = np.concatenate([np.nonzero(co_d)[0], [len(co_p) - 1]])
+            assert np.array_equal(co_p[ends], ar_p[ends])
+            assert np.allclose(co_t[ends], ar_t[ends], atol=1e-3)
+            assert np.allclose(co_f[ends], ar_f[ends], atol=1e-3)
+
+        # int scores keep the exact argsort path even on accelerators (the
+        # u32 key is f32-based and would round large ints)
+        prc._use_host_sort = lambda: False
+        ints = jnp.asarray(np.array([2**24, 2**24 + 1, 0, 5], np.int32))
+        ip, it, if_, idist = raw_fn(ints, jnp.asarray([1, 0, 1, 0]), 1, None, weighted=False)
+        assert ip.dtype == ints.dtype
+        assert int(np.asarray(idist).sum()) == 3  # all four values distinct
+
+        # NaN scores stay individually distinct on the co-sort branch too
+        pn = jnp.asarray(np.array([0.5, np.nan, np.nan, 0.1], np.float32))
+        _, _, _, dist_nan = raw_fn(pn, jnp.asarray([1, 0, 1, 0]), 1, None, weighted=False)
+        prc._use_host_sort = lambda: True
+        _, _, _, dist_nan_ar = raw_fn(pn, jnp.asarray([1, 0, 1, 0]), 1, None, weighted=False)
+        assert np.array_equal(np.asarray(dist_nan), np.asarray(dist_nan_ar))
+    finally:
+        prc._use_host_sort = real
