@@ -75,10 +75,13 @@ def bleu_score(
     num = jnp.asarray(numerator, dtype=jnp.float32)
     denom = jnp.asarray(denominator, dtype=jnp.float32)
     if smooth:
-        # Lin & Och (2004) add-1 smoothing; unigram precision stays unsmoothed
-        # (matching nltk's SmoothingFunction.method2)
-        ones = jnp.asarray([0.0] + [1.0] * (n_gram - 1), dtype=jnp.float32)
-        precision_scores = (num + ones) / (denom + ones)
+        # add-1 smoothing on EVERY order, unigram included — the reference's
+        # behavior (functional/nlp.py:102-103). Current nltk method2 leaves
+        # the unigram unsmoothed (a post-reference nltk change; the
+        # reference's own smooth tests fail against modern nltk), so the two
+        # differ by ~1e-3 whenever unigram precision < 1. Reference-library
+        # parity wins: a switching user must see identical scores.
+        precision_scores = (num + 1.0) / (denom + 1.0)
     else:
         precision_scores = num / denom
 

@@ -31,6 +31,11 @@ def retrieval_average_precision(preds: jax.Array, target: jax.Array) -> jax.Arra
     (bool or 0/1 ints), ``preds`` float scores. Returns 0 if no ``target``
     is positive.
 
+    Tied scores rank in input order (stable sort) — deterministic across
+    backends. The reference's value under ties follows torch's *unstable*
+    descending argsort, an arbitrary tie permutation that differs across
+    torch versions/devices, so exact parity on tied inputs is undefined.
+
     Example:
         >>> import jax.numpy as jnp
         >>> preds = jnp.array([0.2, 0.3, 0.5])

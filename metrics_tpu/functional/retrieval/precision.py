@@ -25,7 +25,9 @@ def retrieval_precision(preds: jax.Array, target: jax.Array, k: Optional[int] = 
     Args:
         preds: estimated relevance scores per document.
         target: binary ground-truth relevance per document.
-        k: consider only the top k elements (default: all).
+        k: consider only the top k elements (default: all). Tied scores
+            rank in input order (stable sort; see
+            :func:`~metrics_tpu.functional.retrieval_average_precision`).
 
     Example:
         >>> import jax.numpy as jnp

@@ -20,7 +20,8 @@ def retrieval_reciprocal_rank(preds: jax.Array, target: jax.Array) -> jax.Array:
     """Computes reciprocal rank for information retrieval over one query.
 
     Returns ``1/rank`` of the highest-scored relevant document, or 0 if no
-    ``target`` is positive.
+    ``target`` is positive. Tied scores rank in input order (stable sort;
+    see :func:`~metrics_tpu.functional.retrieval_average_precision`).
 
     Example:
         >>> import jax.numpy as jnp

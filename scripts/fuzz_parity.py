@@ -1,0 +1,554 @@
+"""Long-run randomized differential fuzz: metrics_tpu vs the reference.
+
+The CI parity suite (``tests/test_reference_parity.py``) runs fixed seeds and
+a 40-config canonicalizer sweep; this script drives the FULL functional
+surface — every exported metric — with randomized shapes, dtypes, value
+patterns (ties, constants, single-class targets, tiny n) and option
+combinations, comparing values AND acceptance (both libraries must accept or
+reject the same input) against the reference at ``/root/reference``.
+
+Usage:
+    python scripts/fuzz_parity.py --trials 2000 [--seed 0]
+
+Prints one line per mismatch with a self-contained repro tuple; exits 0 iff
+no mismatches. Not part of `make test` (runtime scales with --trials);
+CI-equivalent coverage lives in the parity suite.
+
+Known, deliberate divergences the generators avoid (documented in the
+corresponding functionals' docstrings):
+- retrieval_* on TIED scores: the reference ranks ties by torch's unstable
+  descending argsort (arbitrary permutation, varies across torch versions/
+  devices); ours is stable-by-input-order. The retrieval generators
+  therefore emit unique scores.
+
+Finds to date (fixed): bleu_score(smooth=True) previously followed modern
+nltk method2 (unigram unsmoothed) instead of the reference's all-orders
+add-1 smoothing.
+"""
+import argparse
+import os
+import sys
+import types
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+
+
+def _install_reference():
+    if "pkg_resources" not in sys.modules:
+        shim = types.ModuleType("pkg_resources")
+
+        class DistributionNotFound(Exception):
+            pass
+
+        def get_distribution(name):
+            raise DistributionNotFound(name)
+
+        shim.DistributionNotFound = DistributionNotFound
+        shim.get_distribution = get_distribution
+        sys.modules["pkg_resources"] = shim
+    sys.path.insert(0, "/root/reference")
+    import torchmetrics.functional as ref_f
+
+    return ref_f
+
+
+def _to_np(x):
+    import torch
+
+    if isinstance(x, torch.Tensor):
+        return x.detach().numpy()
+    return np.asarray(x)
+
+
+def _compare(ours, theirs, atol):
+    ours_seq, theirs_seq = isinstance(ours, (tuple, list)), isinstance(theirs, (tuple, list))
+    if ours_seq or theirs_seq:
+        if not (ours_seq and theirs_seq) or len(ours) != len(theirs):
+            return (
+                f"structure mismatch: {len(ours) if ours_seq else type(ours).__name__} "
+                f"vs {len(theirs) if theirs_seq else type(theirs).__name__}"
+            )
+        for i, (a, b) in enumerate(zip(ours, theirs)):
+            err = _compare(a, b, atol)
+            if err:
+                return f"[{i}] {err}"
+        return None
+    a, b = np.asarray(ours, dtype=np.float64), _to_np(theirs).astype(np.float64)
+    if a.shape != b.shape:
+        return f"shape {a.shape} vs {b.shape}"
+    # elementwise "bad" mask instead of allclose+nanargmax: one-sided NaNs
+    # must report as a mismatch, not crash an all-NaN argmax
+    both_nan = np.isnan(a) & np.isnan(b)
+    with np.errstate(invalid="ignore"):  # inf - inf inside the masked-off arm
+        bad = ~(both_nan | (a == b) | (np.abs(a - b) <= atol))  # a==b covers ±inf
+    if bad.any():
+        i = int(np.argmax(bad.ravel()))
+        return f"{int(bad.sum())} elements differ, first at {i}: {a.ravel()[i]!r} vs {b.ravel()[i]!r}"
+    return None
+
+
+# ----------------------------------------------------------------------
+# input generators
+# ----------------------------------------------------------------------
+
+def _scores(rng, shape):
+    """Float scores in [0,1] with a randomized tie structure."""
+    mode = rng.randint(4)
+    x = rng.rand(*shape)
+    if mode == 1:  # heavy ties
+        x = np.round(x * rng.choice([2, 5, 10])) / 10
+    x = np.clip(x, 0.0, 1.0)
+    if mode == 2:  # constant
+        x = np.full(shape, float(rng.rand()))
+    elif mode == 3 and x.size:  # signed zeros, per-element sign (clip would
+        # erase -0.0, so inject after it)
+        zeros = np.where(rng.rand(*shape) < 0.5, 0.0, -0.0)
+        x = np.where(rng.rand(*shape) < 0.3, zeros, x)
+    return x.astype(np.float32)
+
+
+def _target(rng, shape, c=2):
+    mode = rng.randint(3)
+    if mode == 1:
+        return np.zeros(shape, dtype=np.int64)  # single class
+    if mode == 2:
+        return np.full(shape, c - 1, dtype=np.int64)
+    return rng.randint(c, size=shape).astype(np.int64)
+
+
+def _cls_inputs(rng):
+    """(preds, target, meta) in one of the reference's input cases."""
+    n = int(rng.choice([1, 2, 3, 17, 64, 257]))
+    c = int(rng.randint(2, 6))
+    x = int(rng.randint(2, 4))
+    kind = rng.randint(6)
+    if kind == 0:  # binary labels
+        return rng.randint(2, size=n), _target(rng, (n,)), {"kind": "bin_lab", "c": 2}
+    if kind == 1:  # binary probs
+        return _scores(rng, (n,)), _target(rng, (n,)), {"kind": "bin_prob", "c": 2}
+    if kind == 2:  # multilabel probs
+        return _scores(rng, (n, c)), _target(rng, (n, c)), {"kind": "ml_prob", "c": c}
+    if kind == 3:  # multiclass labels
+        return _target(rng, (n,), c), _target(rng, (n,), c), {"kind": "mc_lab", "c": c}
+    if kind == 4:  # multiclass probs
+        e = np.exp(rng.rand(n, c))
+        return (e / e.sum(1, keepdims=True)).astype(np.float32), _target(rng, (n,), c), {"kind": "mc_prob", "c": c}
+    e = np.exp(rng.rand(n, c, x))  # multidim multiclass probs
+    return (e / e.sum(1, keepdims=True)).astype(np.float32), _target(rng, (n, x), c), {"kind": "mdmc_prob", "c": c}
+
+
+def _maybe(rng, p, value):
+    return value if rng.rand() < p else None
+
+
+# ----------------------------------------------------------------------
+# fuzz domains: name -> (ours_fn_name, gen(rng) -> (args_np, kwargs), atol)
+# args are numpy; ours gets jnp.asarray, reference gets torch.from_numpy
+# ----------------------------------------------------------------------
+
+def _gen_accuracy(rng):
+    p, t, meta = _cls_inputs(rng)
+    kw = {}
+    if rng.rand() < 0.5:
+        kw["threshold"] = float(rng.uniform(0.1, 0.9))
+    if meta["kind"] in ("mc_prob", "mdmc_prob") and rng.rand() < 0.3:
+        kw["top_k"] = 2
+    if rng.rand() < 0.3:
+        kw["subset_accuracy"] = True
+    return (p, t), kw
+
+
+def _gen_stat_scores(rng):
+    p, t, meta = _cls_inputs(rng)
+    kw = {"reduce": str(rng.choice(["micro", "macro", "samples"]))}
+    if meta["kind"] == "mdmc_prob":
+        kw["mdmc_reduce"] = str(rng.choice(["global", "samplewise"]))
+    if kw["reduce"] == "macro" or rng.rand() < 0.5:
+        kw["num_classes"] = meta["c"]
+    if rng.rand() < 0.3 and kw.get("num_classes"):
+        kw["ignore_index"] = int(rng.randint(kw["num_classes"]))
+    if rng.rand() < 0.4:
+        kw["threshold"] = float(rng.uniform(0.1, 0.9))
+    return (p, t), kw
+
+
+def _gen_prf(rng):
+    p, t, meta = _cls_inputs(rng)
+    kw = {"average": str(rng.choice(["micro", "macro", "weighted", "none"]))}
+    if meta["kind"] == "mdmc_prob":
+        kw["mdmc_average"] = str(rng.choice(["global", "samplewise"]))
+    if kw["average"] in ("macro", "weighted", "none") or rng.rand() < 0.5:
+        kw["num_classes"] = meta["c"]
+    if rng.rand() < 0.3 and kw.get("num_classes"):
+        kw["ignore_index"] = int(rng.randint(kw["num_classes"]))
+    if rng.rand() < 0.4:
+        kw["threshold"] = float(rng.uniform(0.1, 0.9))
+    return (p, t), kw
+
+
+def _gen_fbeta(rng):
+    args, kw = _gen_prf(rng)
+    kw["beta"] = float(rng.choice([0.5, 1.0, 2.0]))
+    return args, kw
+
+
+def _gen_confmat(rng):
+    p, t, meta = _cls_inputs(rng)
+    kw = {"num_classes": meta["c"]}
+    if rng.rand() < 0.6:
+        kw["normalize"] = str(rng.choice(["true", "pred", "all"]))
+    if rng.rand() < 0.4:
+        kw["threshold"] = float(rng.uniform(0.1, 0.9))
+    if meta["kind"] == "ml_prob" and rng.rand() < 0.5:
+        kw["multilabel"] = True
+    return (p, t), kw
+
+
+def _gen_cohen_kappa(rng):
+    p, t, meta = _cls_inputs(rng)
+    return (p, t), {
+        "num_classes": meta["c"],
+        "weights": rng.choice([None, "linear", "quadratic"]),
+    }
+
+
+def _gen_matthews(rng):
+    p, t, meta = _cls_inputs(rng)
+    return (p, t), {"num_classes": meta["c"]}
+
+
+def _gen_iou(rng):
+    p, t, meta = _cls_inputs(rng)
+    kw = {"num_classes": meta["c"]}
+    if rng.rand() < 0.3:
+        kw["ignore_index"] = int(rng.randint(meta["c"]))
+    if rng.rand() < 0.3:
+        kw["absent_score"] = float(rng.choice([0.0, 0.5, 1.0, -1.0]))
+    if rng.rand() < 0.3:
+        kw["reduction"] = str(rng.choice(["elementwise_mean", "sum", "none"]))
+    return (p, t), kw
+
+
+def _gen_hamming(rng):
+    p, t, _ = _cls_inputs(rng)
+    kw = {}
+    if rng.rand() < 0.5:
+        kw["threshold"] = float(rng.uniform(0.1, 0.9))
+    return (p, t), kw
+
+
+def _gen_hinge(rng):
+    n = int(rng.choice([2, 16, 65]))
+    if rng.rand() < 0.5:  # binary margin: preds real, target 0/1
+        return (rng.randn(n).astype(np.float32), rng.randint(2, size=n)), {
+            "squared": bool(rng.rand() < 0.5)
+        }
+    c = int(rng.randint(2, 5))
+    return (rng.randn(n, c).astype(np.float32), rng.randint(c, size=n)), {
+        "squared": bool(rng.rand() < 0.5),
+        "multiclass_mode": rng.choice([None, "crammer-singer", "one-vs-all"]),
+    }
+
+
+def _gen_auroc(rng):
+    kind = rng.randint(2)
+    n = int(rng.choice([8, 64, 513]))
+    if kind == 0:
+        p, t = _scores(rng, (n,)), rng.randint(2, size=n)
+        kw = {}
+        if rng.rand() < 0.3:
+            kw["max_fpr"] = float(rng.uniform(0.1, 0.95))
+        return (p, t), kw
+    c = int(rng.randint(2, 5))
+    e = np.exp(rng.rand(n, c))
+    p, t = (e / e.sum(1, keepdims=True)).astype(np.float32), rng.randint(c, size=n)
+    # every class must appear, or macro-average AUROC is undefined both sides
+    t[:c] = np.arange(c)
+    return (p, t), {"num_classes": c, "average": str(rng.choice(["macro", "weighted"]))}
+
+
+def _gen_ap(rng):
+    kind = rng.randint(2)
+    n = int(rng.choice([8, 64, 513]))
+    if kind == 0:
+        return (_scores(rng, (n,)), rng.randint(2, size=n)), {}
+    c = int(rng.randint(2, 5))
+    e = np.exp(rng.rand(n, c))
+    return ((e / e.sum(1, keepdims=True)).astype(np.float32), rng.randint(c, size=n)), {"num_classes": c}
+
+
+def _gen_curve(rng):
+    kind = rng.randint(2)
+    n = int(rng.choice([4, 33, 129]))
+    if kind == 0:
+        return (_scores(rng, (n,)), rng.randint(2, size=n)), {}
+    c = int(rng.randint(2, 5))
+    e = np.exp(rng.rand(n, c))
+    return ((e / e.sum(1, keepdims=True)).astype(np.float32), rng.randint(c, size=n)), {"num_classes": c}
+
+
+def _gen_auc(rng):
+    n = int(rng.choice([2, 9, 65]))
+    x = np.sort(rng.rand(n)).astype(np.float32)
+    if rng.rand() < 0.5:
+        x = x[::-1].copy()
+    y = rng.rand(n).astype(np.float32)
+    kw = {}
+    if rng.rand() < 0.5:
+        x = rng.permutation(x)
+        kw["reorder"] = True
+    return (x, y), kw
+
+
+def _gen_dice(rng):
+    n, c = int(rng.choice([3, 33])), int(rng.randint(2, 5))
+    e = np.exp(rng.rand(n, c))
+    p = (e / e.sum(1, keepdims=True)).astype(np.float32)
+    t = rng.randint(c, size=n)
+    kw = {}
+    if rng.rand() < 0.4:
+        kw["bg"] = True
+    if rng.rand() < 0.4:
+        kw["nan_score"] = float(rng.choice([0.0, 0.5, 1.0]))
+    if rng.rand() < 0.4:
+        kw["no_fg_score"] = float(rng.choice([0.0, 1.0]))
+    return (p, t), kw
+
+
+def _gen_mse(rng):
+    n = int(rng.choice([1, 17, 256]))
+    shape = (n,) if rng.rand() < 0.6 else (n, int(rng.randint(2, 4)))
+    return (rng.randn(*shape).astype(np.float32), rng.randn(*shape).astype(np.float32)), {}
+
+
+def _gen_msle(rng):
+    n = int(rng.choice([1, 17, 256]))
+    return (rng.rand(n).astype(np.float32) * 3, rng.rand(n).astype(np.float32) * 3), {}
+
+
+def _gen_explained_variance(rng):
+    n = int(rng.choice([2, 17, 256]))
+    if rng.rand() < 0.5:
+        shape = (n,)
+    else:
+        shape = (n, int(rng.randint(2, 4)))
+    t = (rng.randn(*shape) * rng.uniform(0.5, 3)).astype(np.float32)
+    p = (t + rng.randn(*shape) * rng.uniform(0.1, 2)).astype(np.float32)
+    return (p, t), {
+        "multioutput": str(rng.choice(["uniform_average", "raw_values", "variance_weighted"]))
+    }
+
+
+def _gen_r2(rng):
+    n = int(rng.choice([2, 17, 256]))
+    shape = (n,) if rng.rand() < 0.5 else (n, int(rng.randint(2, 4)))
+    t = (rng.randn(*shape) * rng.uniform(0.5, 3)).astype(np.float32)
+    p = (t + rng.randn(*shape) * rng.uniform(0.1, 2)).astype(np.float32)
+    kw = {"multioutput": str(rng.choice(["uniform_average", "raw_values", "variance_weighted"]))}
+    if rng.rand() < 0.3 and n > 3:
+        kw["adjusted"] = int(rng.randint(1, 3))
+    return (p, t), kw
+
+
+def _gen_psnr(rng):
+    shape = (int(rng.choice([2, 4])), int(rng.choice([8, 16])), int(rng.choice([8, 16])))
+    p = rng.rand(*shape).astype(np.float32)
+    t = rng.rand(*shape).astype(np.float32)
+    kw = {}
+    if rng.rand() < 0.6:
+        kw["data_range"] = float(rng.uniform(0.5, 2.0))
+    if rng.rand() < 0.3:
+        kw["base"] = float(rng.choice([2.0, 10.0]))
+    if rng.rand() < 0.4:
+        kw["dim"] = [0, (1, 2)][rng.randint(2)]
+        kw["data_range"] = kw.get("data_range", 1.0)  # dim needs data_range
+        if rng.rand() < 0.5:
+            kw["reduction"] = str(rng.choice(["elementwise_mean", "sum", "none"]))
+    return (p, t), kw
+
+
+def _gen_ssim(rng):
+    h = int(rng.choice([16, 24]))
+    shape = (int(rng.choice([1, 3])), int(rng.choice([1, 3])), h, h)
+    p = rng.rand(*shape).astype(np.float32)
+    t = np.clip(p + rng.randn(*shape).astype(np.float32) * 0.1, 0, 1)
+    kw = {}
+    if rng.rand() < 0.4:
+        kw["kernel_size"] = (5, 5)
+    if rng.rand() < 0.4:
+        kw["sigma"] = (float(rng.uniform(0.8, 2.5)),) * 2
+    if rng.rand() < 0.5:
+        kw["data_range"] = 1.0
+    return (p, t), kw
+
+
+def _gen_mre(rng):
+    n = int(rng.choice([1, 17, 256]))
+    t = rng.randn(n).astype(np.float32)
+    if rng.rand() < 0.3:
+        t[rng.randint(n)] = 0.0  # zero-denominator guard path
+    return (rng.randn(n).astype(np.float32), t), {}
+
+
+def _gen_retrieval(rng):
+    # unique scores only: under ties the reference's ranking is an artifact
+    # of torch's UNSTABLE descending argsort (arbitrary tie permutation,
+    # varies across torch backends/versions), while ours is stable-by-input-
+    # order — a documented divergence, not a parity target
+    n = int(rng.choice([1, 5, 33]))
+    p = rng.permutation(np.linspace(0.05, 0.95, n)).astype(np.float32)
+    t = rng.randint(2, size=n)
+    if t.sum() == 0:
+        t[rng.randint(n)] = 1  # reference errors on no-positive queries
+    return (p, t), {}
+
+
+def _gen_retrieval_k(rng):
+    (p, t), _ = _gen_retrieval(rng)
+    kw = {}
+    if rng.rand() < 0.6:
+        kw["k"] = int(rng.randint(1, len(p) + 1))
+    return (p, t), kw
+
+
+def _gen_embsim(rng):
+    b, d = int(rng.randint(2, 9)), int(rng.choice([3, 8, 33]))
+    return (rng.randn(b, d).astype(np.float32),), {
+        "similarity": str(rng.choice(["cosine", "dot"])),
+        "reduction": str(rng.choice(["none", "sum", "mean"])),
+        "zero_diagonal": bool(rng.rand() < 0.5),
+    }
+
+
+def _gen_image_gradients(rng):
+    shape = (int(rng.choice([1, 2])), int(rng.choice([1, 3])), int(rng.choice([4, 9])), int(rng.choice([4, 9])))
+    return (rng.rand(*shape).astype(np.float32),), {}
+
+
+_WORDS = "the a cat dog sat mat on ran fast blue red green bird tree house".split()
+
+
+def _gen_bleu(rng):
+    def sentence():
+        return [str(w) for w in rng.choice(_WORDS, size=rng.randint(3, 9))]
+
+    n = int(rng.randint(1, 4))
+    translate = [sentence() for _ in range(n)]
+    reference_corpus = [[sentence() for _ in range(rng.randint(1, 3))] for _ in range(n)]
+    return (translate, reference_corpus), {
+        "n_gram": int(rng.randint(1, 5)),
+        "smooth": bool(rng.rand() < 0.5),
+    }
+
+
+DOMAINS = {
+    # name: (gen, atol, tensor_args?)  — bleu passes python lists through
+    "accuracy": (_gen_accuracy, 1e-6, True),
+    "stat_scores": (_gen_stat_scores, 0.0, True),
+    "precision": (_gen_prf, 1e-6, True),
+    "recall": (_gen_prf, 1e-6, True),
+    "f1": (_gen_prf, 1e-6, True),
+    "fbeta": (_gen_fbeta, 1e-6, True),
+    "confusion_matrix": (_gen_confmat, 1e-6, True),
+    "cohen_kappa": (_gen_cohen_kappa, 1e-5, True),
+    "matthews_corrcoef": (_gen_matthews, 1e-5, True),
+    "iou": (_gen_iou, 1e-6, True),
+    "hamming_distance": (_gen_hamming, 1e-6, True),
+    "hinge": (_gen_hinge, 1e-5, True),
+    "auroc": (_gen_auroc, 1e-5, True),
+    "average_precision": (_gen_ap, 1e-5, True),
+    "roc": (_gen_curve, 1e-6, True),
+    "precision_recall_curve": (_gen_curve, 1e-6, True),
+    "auc": (_gen_auc, 1e-5, True),
+    "dice_score": (_gen_dice, 1e-5, True),
+    "mean_squared_error": (_gen_mse, 1e-5, True),
+    "mean_absolute_error": (_gen_mse, 1e-5, True),
+    "mean_squared_log_error": (_gen_msle, 1e-5, True),
+    "explained_variance": (_gen_explained_variance, 1e-4, True),
+    "r2score": (_gen_r2, 1e-4, True),
+    "psnr": (_gen_psnr, 1e-4, True),
+    "ssim": (_gen_ssim, 1e-4, True),
+    "mean_relative_error": (_gen_mre, 1e-5, True),
+    "retrieval_average_precision": (_gen_retrieval, 1e-5, True),
+    "retrieval_reciprocal_rank": (_gen_retrieval, 1e-5, True),
+    "retrieval_precision": (_gen_retrieval_k, 1e-6, True),
+    "retrieval_recall": (_gen_retrieval_k, 1e-6, True),
+    "embedding_similarity": (_gen_embsim, 1e-4, True),
+    "image_gradients": (_gen_image_gradients, 1e-6, True),
+    "bleu_score": (_gen_bleu, 1e-6, False),
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=500)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--domain", default=None, help="restrict to one metric")
+    args = ap.parse_args()
+
+    import torch
+
+    ref_f = _install_reference()
+    import metrics_tpu.functional as ours_f
+
+    names = [args.domain] if args.domain else sorted(DOMAINS)
+    rng = np.random.RandomState(args.seed)
+    mismatches = 0
+    counts = {"value": 0, "reject_both": 0}
+    for trial in range(args.trials):
+        name = names[rng.randint(len(names))]
+        gen, atol, tensorize = DOMAINS[name]
+        state = rng.get_state()[1][:2]  # enough to label the repro
+        call_args, kwargs = gen(rng)
+
+        if tensorize:
+            ref_args = tuple(torch.from_numpy(np.asarray(a)) for a in call_args)
+            our_args = tuple(jnp.asarray(a) for a in call_args)
+        else:
+            ref_args = our_args = call_args
+
+        try:
+            theirs = getattr(ref_f, name)(*ref_args, **kwargs)
+            ref_err = None
+        except Exception as err:  # noqa: BLE001 — acceptance parity needs everything
+            theirs, ref_err = None, err
+        try:
+            ours = getattr(ours_f, name)(*our_args, **kwargs)
+            our_err = None
+        except Exception as err:  # noqa: BLE001
+            ours, our_err = None, err
+
+        if (ref_err is None) != (our_err is None):
+            mismatches += 1
+            print(
+                f"ACCEPTANCE MISMATCH {name} trial={trial} kwargs={kwargs} "
+                f"shapes={[np.asarray(a).shape for a in call_args] if tensorize else '-'} "
+                f"ours={our_err!r} ref={ref_err!r}"
+            )
+            continue
+        if ref_err is not None:
+            counts["reject_both"] += 1
+            continue
+        err = _compare(ours, theirs, atol)
+        if err:
+            mismatches += 1
+            print(f"VALUE MISMATCH {name} trial={trial} kwargs={kwargs} seedhead={state}: {err}")
+        else:
+            counts["value"] += 1
+
+    print(
+        f"fuzz_parity: {args.trials} trials, {counts['value']} value-matched, "
+        f"{counts['reject_both']} rejected-by-both, {mismatches} MISMATCHES"
+    )
+    return 1 if mismatches else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,16 +27,23 @@ HYPOTHESES = [HYP1, HYP2]
 smooth_func = SmoothingFunction().method2
 
 
+# The smooth rows get a loose tolerance: `smooth=True` replicates the
+# reference's smoothing (add-1 on EVERY order, unigram included —
+# reference functional/nlp.py:102), which matched nltk's method2 when the
+# reference was written; nltk later changed method2 to leave the unigram
+# unsmoothed, so on this image the two differ by ~1e-3 on this fixture and
+# the reference's own smooth tests fail verbatim. Exact smoothing parity
+# vs the reference library is pinned in tests/test_reference_parity.py.
 @pytest.mark.parametrize(
-    ["weights", "n_gram", "smooth_func", "smooth"],
+    ["weights", "n_gram", "smooth_func", "smooth", "atol"],
     [
-        pytest.param([1], 1, None, False),
-        pytest.param([0.5, 0.5], 2, smooth_func, True),
-        pytest.param([0.333333, 0.333333, 0.333333], 3, None, False),
-        pytest.param([0.25, 0.25, 0.25, 0.25], 4, smooth_func, True),
+        pytest.param([1], 1, None, False, 1e-6),
+        pytest.param([0.5, 0.5], 2, smooth_func, True, 5e-3),
+        pytest.param([0.333333, 0.333333, 0.333333], 3, None, False, 1e-6),
+        pytest.param([0.25, 0.25, 0.25, 0.25], 4, smooth_func, True, 5e-3),
     ],
 )
-def test_bleu_score(weights, n_gram, smooth_func, smooth):
+def test_bleu_score(weights, n_gram, smooth_func, smooth, atol):
     nltk_output = sentence_bleu(
         [REFERENCE1, REFERENCE2, REFERENCE3],
         HYPOTHESIS1,
@@ -44,11 +51,11 @@ def test_bleu_score(weights, n_gram, smooth_func, smooth):
         smoothing_function=smooth_func,
     )
     output = bleu_score([HYPOTHESIS1], [[REFERENCE1, REFERENCE2, REFERENCE3]], n_gram=n_gram, smooth=smooth)
-    assert np.allclose(np.asarray(output), nltk_output, atol=1e-6)
+    assert np.allclose(np.asarray(output), nltk_output, atol=atol)
 
     nltk_output = corpus_bleu(LIST_OF_REFERENCES, HYPOTHESES, weights=weights, smoothing_function=smooth_func)
     output = bleu_score(HYPOTHESES, LIST_OF_REFERENCES, n_gram=n_gram, smooth=smooth)
-    assert np.allclose(np.asarray(output), nltk_output, atol=1e-6)
+    assert np.allclose(np.asarray(output), nltk_output, atol=atol)
 
 
 def test_bleu_empty():

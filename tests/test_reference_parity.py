@@ -697,6 +697,20 @@ def test_bleu_variants_match_reference(reference):
         theirs = reference.bleu_score(translate, ref_corpus, **kwargs)
         _close(ours, theirs, atol=1e-5)
 
+    # smoothing with IMPERFECT unigram precision — separates the reference's
+    # all-orders add-1 smoothing (functional/nlp.py:102, which we replicate)
+    # from modern nltk method2 (unigram unsmoothed): at p1 < 1 they differ by
+    # ~5e-2 on this input, so 1e-6 pins the reference's convention exactly
+    # (n_gram capped at 3: this hypothesis has a single 4-gram that misses,
+    # so n_gram=4 early-returns 0.0 on both sides before smoothing runs and
+    # would pin nothing)
+    translate_miss = [["the", "dog", "ran", "blue"]]
+    ref_miss = [[["the", "dog", "ran", "fast"]]]
+    for n_gram in (1, 2, 3):
+        ours = bleu_score(translate_miss, ref_miss, n_gram=n_gram, smooth=True)
+        theirs = reference.bleu_score(translate_miss, ref_miss, n_gram=n_gram, smooth=True)
+        _close(ours, theirs, atol=1e-6)
+
 
 def test_auroc_max_fpr_matches_reference(reference):
     from metrics_tpu.functional import auroc
