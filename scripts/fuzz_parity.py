@@ -114,6 +114,12 @@ def _scores(rng, shape):
     return x.astype(np.float32)
 
 
+def _probs(rng, *shape):
+    """Softmax probabilities over axis 1 of ``shape``."""
+    e = np.exp(rng.rand(*shape))
+    return (e / e.sum(1, keepdims=True)).astype(np.float32)
+
+
 def _target(rng, shape, c=2):
     mode = rng.randint(3)
     if mode == 1:
@@ -138,10 +144,9 @@ def _cls_inputs(rng):
     if kind == 3:  # multiclass labels
         return _target(rng, (n,), c), _target(rng, (n,), c), {"kind": "mc_lab", "c": c}
     if kind == 4:  # multiclass probs
-        e = np.exp(rng.rand(n, c))
-        return (e / e.sum(1, keepdims=True)).astype(np.float32), _target(rng, (n,), c), {"kind": "mc_prob", "c": c}
-    e = np.exp(rng.rand(n, c, x))  # multidim multiclass probs
-    return (e / e.sum(1, keepdims=True)).astype(np.float32), _target(rng, (n, x), c), {"kind": "mdmc_prob", "c": c}
+        return _probs(rng, n, c), _target(rng, (n,), c), {"kind": "mc_prob", "c": c}
+    # multidim multiclass probs
+    return _probs(rng, n, c, x), _target(rng, (n, x), c), {"kind": "mdmc_prob", "c": c}
 
 
 def _maybe(rng, p, value):
@@ -267,8 +272,7 @@ def _gen_auroc(rng):
             kw["max_fpr"] = float(rng.uniform(0.1, 0.95))
         return (p, t), kw
     c = int(rng.randint(2, 5))
-    e = np.exp(rng.rand(n, c))
-    p, t = (e / e.sum(1, keepdims=True)).astype(np.float32), rng.randint(c, size=n)
+    p, t = _probs(rng, n, c), rng.randint(c, size=n)
     # every class must appear, or macro-average AUROC is undefined both sides
     t[:c] = np.arange(c)
     return (p, t), {"num_classes": c, "average": str(rng.choice(["macro", "weighted"]))}
@@ -280,8 +284,7 @@ def _gen_ap(rng):
     if kind == 0:
         return (_scores(rng, (n,)), rng.randint(2, size=n)), {}
     c = int(rng.randint(2, 5))
-    e = np.exp(rng.rand(n, c))
-    return ((e / e.sum(1, keepdims=True)).astype(np.float32), rng.randint(c, size=n)), {"num_classes": c}
+    return (_probs(rng, n, c), rng.randint(c, size=n)), {"num_classes": c}
 
 
 def _gen_curve(rng):
@@ -290,8 +293,7 @@ def _gen_curve(rng):
     if kind == 0:
         return (_scores(rng, (n,)), rng.randint(2, size=n)), {}
     c = int(rng.randint(2, 5))
-    e = np.exp(rng.rand(n, c))
-    return ((e / e.sum(1, keepdims=True)).astype(np.float32), rng.randint(c, size=n)), {"num_classes": c}
+    return (_probs(rng, n, c), rng.randint(c, size=n)), {"num_classes": c}
 
 
 def _gen_auc(rng):
@@ -309,9 +311,7 @@ def _gen_auc(rng):
 
 def _gen_dice(rng):
     n, c = int(rng.choice([3, 33])), int(rng.randint(2, 5))
-    e = np.exp(rng.rand(n, c))
-    p = (e / e.sum(1, keepdims=True)).astype(np.float32)
-    t = rng.randint(c, size=n)
+    p, t = _probs(rng, n, c), rng.randint(c, size=n)
     kw = {}
     if rng.rand() < 0.4:
         kw["bg"] = True
@@ -486,24 +486,364 @@ DOMAINS = {
 }
 
 
+# ----------------------------------------------------------------------
+# module layer: stateful classes — multi-batch forward (compute_on_step
+# values), epoch compute, reset, re-accumulate. Exercises the Metric base
+# runtime (cache/forward/accumulate semantics) that functionals can't.
+# Each domain: gen(rng) -> (ctor_kwargs, batch_gen) where batch_gen(rng)
+# emits consistently-shaped (args...) batches for the whole trial.
+# ----------------------------------------------------------------------
+
+def _mgen_accuracy(rng):
+    kw = {}
+    if rng.rand() < 0.5:
+        kw["threshold"] = float(rng.uniform(0.2, 0.8))
+    if rng.rand() < 0.3:
+        kw["subset_accuracy"] = True
+    n, c = int(rng.choice([3, 16, 65])), int(rng.randint(2, 5))
+    kind = rng.randint(3)
+
+    def batch(rng):
+        if kind == 0:
+            return _scores(rng, (n,)), rng.randint(2, size=n)
+        if kind == 1:
+            return _probs(rng, n, c), rng.randint(c, size=n)
+        return _scores(rng, (n, c)), rng.randint(2, size=(n, c))
+
+    return kw, batch
+
+
+def _mgen_stat_family(rng):
+    c = int(rng.randint(2, 5))
+    kw = {"num_classes": c, "average": str(rng.choice(["micro", "macro", "weighted"]))}
+    if rng.rand() < 0.3:
+        kw["ignore_index"] = int(rng.randint(c))
+    n = int(rng.choice([4, 33]))
+
+    def batch(rng):
+        return _probs(rng, n, c), rng.randint(c, size=n)
+
+    return kw, batch
+
+
+def _mgen_statscores(rng):
+    c = int(rng.randint(2, 5))
+    kw = {"num_classes": c, "reduce": str(rng.choice(["micro", "macro"]))}
+    n = int(rng.choice([4, 33]))
+
+    def batch(rng):
+        return rng.randint(c, size=n), rng.randint(c, size=n)
+
+    return kw, batch
+
+
+def _mgen_confmat(rng):
+    c = int(rng.randint(2, 5))
+    kw = {"num_classes": c}
+    if rng.rand() < 0.5:
+        kw["normalize"] = str(rng.choice(["true", "pred", "all"]))
+    n = int(rng.choice([4, 33]))
+
+    def batch(rng):
+        return rng.randint(c, size=n), rng.randint(c, size=n)
+
+    return kw, batch
+
+
+def _mgen_cohen_kappa(rng):
+    c = int(rng.randint(2, 5))
+    kw = {"num_classes": c, "weights": rng.choice([None, "linear", "quadratic"])}
+    n = int(rng.choice([4, 33]))
+
+    def batch(rng):
+        return rng.randint(c, size=n), rng.randint(c, size=n)
+
+    return kw, batch
+
+
+def _mgen_iou(rng):
+    c = int(rng.randint(2, 5))
+    kw = {"num_classes": c}
+    if rng.rand() < 0.3:
+        kw["absent_score"] = 0.5
+    n = int(rng.choice([4, 33]))
+
+    def batch(rng):
+        return rng.randint(c, size=n), rng.randint(c, size=n)
+
+    return kw, batch
+
+
+def _mgen_hamming(rng):
+    kw = {"threshold": float(rng.uniform(0.2, 0.8))} if rng.rand() < 0.5 else {}
+    n = int(rng.choice([4, 33]))
+
+    def batch(rng):
+        return _scores(rng, (n,)), rng.randint(2, size=n)
+
+    return kw, batch
+
+
+def _mgen_auroc(rng):
+    n = int(rng.choice([16, 65]))
+    if rng.rand() < 0.6:
+        kw = {}
+        if rng.rand() < 0.3:
+            kw["max_fpr"] = float(rng.uniform(0.2, 0.9))
+
+        def batch(rng):
+            p = _scores(rng, (n,))
+            t = rng.randint(2, size=n)
+            t[0], t[1] = 0, 1  # both classes in every batch: step AUROC defined
+            return p, t
+
+        return kw, batch
+    c = int(rng.randint(2, 4))
+
+    def batch(rng):
+        t = rng.randint(c, size=n)
+        t[:c] = np.arange(c)
+        return _probs(rng, n, c), t
+
+    return {"num_classes": c}, batch
+
+
+def _mgen_ap(rng):
+    n = int(rng.choice([16, 65]))
+
+    def batch(rng):
+        p = _scores(rng, (n,))
+        t = rng.randint(2, size=n)
+        t[0] = 1
+        return p, t
+
+    return {}, batch
+
+
+def _mgen_curve_cls(rng):
+    n = int(rng.choice([8, 33]))
+
+    def batch(rng):
+        return _scores(rng, (n,)), rng.randint(2, size=n)
+
+    return {}, batch
+
+
+def _mgen_mse(rng):
+    n = int(rng.choice([4, 33]))
+
+    def batch(rng):
+        return rng.randn(n).astype(np.float32), rng.randn(n).astype(np.float32)
+
+    return {}, batch
+
+
+def _mgen_explained_variance(rng):
+    kw = {"multioutput": str(rng.choice(["uniform_average", "raw_values", "variance_weighted"]))}
+    n, k = int(rng.choice([4, 33])), int(rng.randint(1, 4))
+    shape = (n,) if k == 1 else (n, k)
+
+    def batch(rng):
+        t = (rng.randn(*shape) * 2).astype(np.float32)
+        return (t + rng.randn(*shape)).astype(np.float32), t
+
+    return kw, batch
+
+
+def _mgen_r2(rng):
+    k = int(rng.randint(1, 4))
+    kw = {"num_outputs": k} if k > 1 else {}
+    n = int(rng.choice([4, 33]))
+    shape = (n,) if k == 1 else (n, k)
+
+    def batch(rng):
+        t = (rng.randn(*shape) * 2).astype(np.float32)
+        return (t + rng.randn(*shape)).astype(np.float32), t
+
+    return kw, batch
+
+
+def _mgen_psnr(rng):
+    kw = {"data_range": 1.0} if rng.rand() < 0.7 else {}
+    shape = (int(rng.choice([2, 4])), 8, 8)
+
+    def batch(rng):
+        return rng.rand(*shape).astype(np.float32), rng.rand(*shape).astype(np.float32)
+
+    return kw, batch
+
+
+def _mgen_ssim(rng):
+    kw = {"data_range": 1.0}
+    if rng.rand() < 0.4:
+        kw["kernel_size"] = (5, 5)
+    shape = (int(rng.choice([1, 2])), int(rng.choice([1, 3])), 16, 16)
+
+    def batch(rng):
+        p = rng.rand(*shape).astype(np.float32)
+        return p, np.clip(p + rng.randn(*shape).astype(np.float32) * 0.1, 0, 1)
+
+    return kw, batch
+
+
+def _mgen_retrieval(rng):
+    kw = {"empty_target_action": str(rng.choice(["skip", "neg", "pos"]))}
+    n, q = int(rng.choice([8, 33])), int(rng.randint(1, 6))
+    calls = [0]  # batches pool into the same queries, so scores must be
+    # unique across the WHOLE trial, not just within a batch (tie order
+    # diverges — see the retrieval functional generators)
+
+    def batch(rng):
+        base = calls[0] * n
+        calls[0] += 1
+        p = (rng.permutation(n) + base + 1).astype(np.float32) / (16 * n + 1)
+        return rng.randint(q, size=n), p, rng.randint(2, size=n)
+
+    return kw, batch
+
+
+def _mgen_retrieval_k(rng):
+    kw, batch = _mgen_retrieval(rng)
+    if rng.rand() < 0.5:
+        kw["k"] = int(rng.randint(1, 5))
+    return kw, batch
+
+
+MODULE_DOMAINS = {
+    "Accuracy": (_mgen_accuracy, 1e-6),
+    "StatScores": (_mgen_statscores, 0.0),
+    "Precision": (_mgen_stat_family, 1e-6),
+    "Recall": (_mgen_stat_family, 1e-6),
+    "F1": (_mgen_stat_family, 1e-6),
+    "ConfusionMatrix": (_mgen_confmat, 1e-6),
+    "CohenKappa": (_mgen_cohen_kappa, 1e-5),
+    "IoU": (_mgen_iou, 1e-6),
+    "HammingDistance": (_mgen_hamming, 1e-6),
+    "AUROC": (_mgen_auroc, 1e-5),
+    "AveragePrecision": (_mgen_ap, 1e-5),
+    "ROC": (_mgen_curve_cls, 1e-6),
+    "PrecisionRecallCurve": (_mgen_curve_cls, 1e-6),
+    "MeanSquaredError": (_mgen_mse, 1e-5),
+    "MeanAbsoluteError": (_mgen_mse, 1e-5),
+    "ExplainedVariance": (_mgen_explained_variance, 1e-4),
+    "R2Score": (_mgen_r2, 1e-4),
+    "PSNR": (_mgen_psnr, 1e-4),
+    "SSIM": (_mgen_ssim, 1e-4),
+    "RetrievalMAP": (_mgen_retrieval, 1e-5),
+    "RetrievalMRR": (_mgen_retrieval, 1e-5),
+    "RetrievalPrecision": (_mgen_retrieval_k, 1e-6),
+    "RetrievalRecall": (_mgen_retrieval_k, 1e-6),
+}
+
+
+def _run_module_trial(name, rng, ours_mod, ref_mod, torch):
+    """One stateful trial: ("match"|"reject"|"mismatch", detail_or_None)."""
+    gen, atol = MODULE_DOMAINS[name]
+    ctor_kwargs, batch_gen = gen(rng)
+    try:
+        theirs_m = getattr(ref_mod, name)(**ctor_kwargs)
+        ref_err = None
+    except Exception as err:  # noqa: BLE001
+        theirs_m, ref_err = None, err
+    try:
+        ours_m = getattr(ours_mod, name)(**ctor_kwargs)
+        our_err = None
+    except Exception as err:  # noqa: BLE001
+        ours_m, our_err = None, err
+    if (ref_err is None) != (our_err is None):
+        return "mismatch", f"ctor acceptance: ours={our_err!r} ref={ref_err!r} kwargs={ctor_kwargs}"
+    if ref_err is not None:
+        return "reject", None
+
+    for round_ in range(2):  # second round exercises reset()
+        n_batches = int(rng.randint(1, 4))
+        batches = [batch_gen(rng) for _ in range(n_batches)]
+        for bi, b in enumerate(batches):
+            try:
+                theirs_v = theirs_m(*[torch.from_numpy(np.asarray(a)) for a in b])
+                ref_err = None
+            except Exception as err:  # noqa: BLE001
+                theirs_v, ref_err = None, err
+            try:
+                ours_v = ours_m(*[jnp.asarray(a) for a in b])
+                our_err = None
+            except Exception as err:  # noqa: BLE001
+                ours_v, our_err = None, err
+            if (ref_err is None) != (our_err is None):
+                return "mismatch", (
+                    f"forward acceptance r{round_} b{bi}: ours={our_err!r} "
+                    f"ref={ref_err!r} kwargs={ctor_kwargs}"
+                )
+            if ref_err is not None:
+                return "reject", None  # rejected identically; state unusable
+            err = _compare(ours_v, theirs_v, atol)
+            if err:
+                return "mismatch", f"forward value r{round_} b{bi} kwargs={ctor_kwargs}: {err}"
+        try:
+            theirs_v, ref_err = theirs_m.compute(), None
+        except Exception as e:  # noqa: BLE001
+            theirs_v, ref_err = None, e
+        try:
+            ours_v, our_err = ours_m.compute(), None
+        except Exception as e:  # noqa: BLE001
+            ours_v, our_err = None, e
+        if (ref_err is None) != (our_err is None):
+            return "mismatch", f"compute acceptance r{round_}: ours={our_err!r} ref={ref_err!r} kwargs={ctor_kwargs}"
+        if ref_err is None:
+            err = _compare(ours_v, theirs_v, atol)
+            if err:
+                return "mismatch", f"epoch compute r{round_} kwargs={ctor_kwargs}: {err}"
+        theirs_m.reset()
+        ours_m.reset()
+    return "match", None
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--trials", type=int, default=500)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--domain", default=None, help="restrict to one metric")
+    ap.add_argument(
+        "--layer",
+        choices=["functional", "module", "all"],
+        default="all",
+        help="functional surface, stateful module classes, or both",
+    )
     args = ap.parse_args()
 
     import torch
 
     ref_f = _install_reference()
+    import torchmetrics as ref_mod
+
+    import metrics_tpu as ours_mod
     import metrics_tpu.functional as ours_f
 
-    names = [args.domain] if args.domain else sorted(DOMAINS)
+    fn_names = sorted(DOMAINS) if args.layer in ("functional", "all") else []
+    mod_names = sorted(MODULE_DOMAINS) if args.layer in ("module", "all") else []
+    if args.domain:
+        fn_names = [n for n in fn_names if n == args.domain]
+        mod_names = [n for n in mod_names if n == args.domain]
+    names = [("fn", n) for n in fn_names] + [("mod", n) for n in mod_names]
+    if not names:
+        print(f"no domain matches {args.domain!r}")
+        return 2
     rng = np.random.RandomState(args.seed)
     mismatches = 0
-    counts = {"value": 0, "reject_both": 0}
+    counts = {"value": 0, "reject_both": 0, "module": 0}
     for trial in range(args.trials):
-        name = names[rng.randint(len(names))]
+        layer, name = names[rng.randint(len(names))]
+        if layer == "mod":
+            state = rng.get_state()[1][:2]  # repro label, as the fn path
+            status, detail = _run_module_trial(name, rng, ours_mod, ref_mod, torch)
+            if status == "mismatch":
+                mismatches += 1
+                print(f"MODULE MISMATCH {name} trial={trial} seedhead={state}: {detail}")
+            elif status == "reject":
+                counts["reject_both"] += 1
+            else:
+                counts["module"] += 1
+            continue
         gen, atol, tensorize = DOMAINS[name]
         state = rng.get_state()[1][:2]  # enough to label the repro
         call_args, kwargs = gen(rng)
@@ -545,7 +885,8 @@ def main() -> int:
 
     print(
         f"fuzz_parity: {args.trials} trials, {counts['value']} value-matched, "
-        f"{counts['reject_both']} rejected-by-both, {mismatches} MISMATCHES"
+        f"{counts['module']} module-matched, {counts['reject_both']} rejected-by-both, "
+        f"{mismatches} MISMATCHES"
     )
     return 1 if mismatches else 0
 
