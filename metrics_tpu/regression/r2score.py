@@ -1,5 +1,5 @@
 """R2Score (module). Parity: ``torchmetrics/regression/r2score.py``."""
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -50,11 +50,13 @@ class R2Score(Metric):
         compute_on_step: bool = True,
         dist_sync_on_step: bool = False,
         process_group: Optional[Any] = None,
+        dist_sync_fn: Optional[Callable] = None,
     ):
         super().__init__(
             compute_on_step=compute_on_step,
             dist_sync_on_step=dist_sync_on_step,
             process_group=process_group,
+            dist_sync_fn=dist_sync_fn,
         )
         self.num_outputs = num_outputs
 

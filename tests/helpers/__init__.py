@@ -1,4 +1,7 @@
+import contextlib
 import random
+import sys
+import types
 
 import numpy as np
 
@@ -6,3 +9,34 @@ import numpy as np
 def seed_all(seed: int = 42) -> None:
     random.seed(seed)
     np.random.seed(seed)
+
+
+def install_pkg_resources_shim() -> None:
+    """The reference imports ``pkg_resources``, gone in this Python; shim it
+    once per process (idempotent). Shared by every suite that imports the
+    reference (tests/test_reference_parity.py, tests/test_api_surface.py,
+    scripts/fuzz_parity.py has its own copy to stay standalone)."""
+    if "pkg_resources" in sys.modules:
+        return
+    shim = types.ModuleType("pkg_resources")
+
+    class DistributionNotFound(Exception):
+        pass
+
+    def get_distribution(name):
+        raise DistributionNotFound(name)
+
+    shim.DistributionNotFound = DistributionNotFound
+    shim.get_distribution = get_distribution
+    sys.modules["pkg_resources"] = shim
+
+
+@contextlib.contextmanager
+def reference_on_path():
+    """Shim installed + ``/root/reference`` importable inside the block."""
+    install_pkg_resources_shim()
+    sys.path.insert(0, "/root/reference")
+    try:
+        yield
+    finally:
+        sys.path.remove("/root/reference")

@@ -6,15 +6,12 @@ suite additionally runs the REFERENCE library itself (torchmetrics at
 values directly — end-to-end behavioral-parity evidence, including the
 reference's own conventions wherever they differ from sklearn's.
 """
-import sys
-import types
-
 import numpy as np
 import pytest
 
 import jax.numpy as jnp
 
-from tests.helpers import seed_all
+from tests.helpers import reference_on_path, seed_all
 
 seed_all(1234)
 
@@ -22,25 +19,10 @@ seed_all(1234)
 @pytest.fixture(scope="module")
 def reference():
     """Import the reference torchmetrics from /root/reference (torch CPU)."""
-    if "pkg_resources" not in sys.modules:  # gone in this Python; shim it
-        shim = types.ModuleType("pkg_resources")
-
-        class DistributionNotFound(Exception):
-            pass
-
-        def get_distribution(name):
-            raise DistributionNotFound(name)
-
-        shim.DistributionNotFound = DistributionNotFound
-        shim.get_distribution = get_distribution
-        sys.modules["pkg_resources"] = shim
-    sys.path.insert(0, "/root/reference")
-    try:
+    with reference_on_path():
         import torchmetrics.functional as ref_f
 
         yield ref_f
-    finally:
-        sys.path.remove("/root/reference")
 
 
 def _binary(n=512, seed=0):
@@ -243,8 +225,7 @@ def test_module_forward_semantics_match_reference(reference):
     import torch
     from metrics_tpu import Accuracy
 
-    sys.path.insert(0, "/root/reference")
-    try:
+    with reference_on_path():
         from torchmetrics import Accuracy as RefAccuracy
 
         rng = np.random.RandomState(21)
@@ -260,8 +241,6 @@ def test_module_forward_semantics_match_reference(reference):
         ours.update(jnp.asarray(probs), jnp.asarray(target))
         theirs.update(_torch(probs), _torch(target))
         _close(ours.compute(), theirs.compute())  # post-reset accumulation
-    finally:
-        sys.path.remove("/root/reference")
 
 
 def test_metric_arithmetic_matches_reference(reference):
@@ -269,8 +248,7 @@ def test_metric_arithmetic_matches_reference(reference):
     updates produces the same value."""
     from metrics_tpu import MeanAbsoluteError, MeanSquaredError
 
-    sys.path.insert(0, "/root/reference")
-    try:
+    with reference_on_path():
         from torchmetrics import MeanAbsoluteError as RefMAE, MeanSquaredError as RefMSE
 
         rng = np.random.RandomState(23)
@@ -282,16 +260,13 @@ def test_metric_arithmetic_matches_reference(reference):
         ours.update(jnp.asarray(p), jnp.asarray(t))
         theirs.update(_torch(p), _torch(t))
         _close(ours.compute(), theirs.compute())
-    finally:
-        sys.path.remove("/root/reference")
 
 
 def test_metric_collection_matches_reference(reference):
     """MetricCollection naming and fan-out parity."""
     from metrics_tpu import Accuracy, MetricCollection, Precision
 
-    sys.path.insert(0, "/root/reference")
-    try:
+    with reference_on_path():
         from torchmetrics import (
             Accuracy as RefAccuracy,
             MetricCollection as RefCollection,
@@ -307,8 +282,6 @@ def test_metric_collection_matches_reference(reference):
         assert set(got) == set(want)
         for key in got:
             _close(got[key], want[key])
-    finally:
-        sys.path.remove("/root/reference")
 
 
 def test_dice_and_auc_and_mre_match_reference(reference):
@@ -408,8 +381,7 @@ def test_input_canonicalizer_matches_reference(reference):
     including threshold / top_k / is_multiclass options."""
     from metrics_tpu.utilities.checks import _input_format_classification
 
-    sys.path.insert(0, "/root/reference")
-    try:
+    with reference_on_path():
         from torchmetrics.utilities.checks import (
             _input_format_classification as ref_canon,
         )
@@ -441,8 +413,6 @@ def test_input_canonicalizer_matches_reference(reference):
             assert str(ours_case) == str(ref_case), (i, ours_case, ref_case)
             assert np.array_equal(np.asarray(ours_p), ref_p.numpy()), i
             assert np.array_equal(np.asarray(ours_t), ref_t.numpy()), i
-    finally:
-        sys.path.remove("/root/reference")
 
 
 def _softmax(a):
@@ -460,8 +430,7 @@ def test_error_messages_match_reference(reference):
     from metrics_tpu.functional import accuracy, confusion_matrix
     from metrics_tpu.utilities.checks import _input_format_classification
 
-    sys.path.insert(0, "/root/reference")
-    try:
+    with reference_on_path():
         import torch
         from torchmetrics.utilities.checks import (
             _input_format_classification as ref_canon,
@@ -491,8 +460,6 @@ def test_error_messages_match_reference(reference):
                 ours_err = str(err)
             assert ref_err is not None, f"case {i}: reference accepted this input"
             assert ours_err == ref_err, (i, ours_err, ref_err)
-    finally:
-        sys.path.remove("/root/reference")
 
 
 def test_all_arithmetic_operators_match_reference(reference):
@@ -504,8 +471,7 @@ def test_all_arithmetic_operators_match_reference(reference):
 
     import metrics_tpu
 
-    sys.path.insert(0, "/root/reference")
-    try:
+    with reference_on_path():
         import torchmetrics
 
         def ours_const(v):
@@ -566,8 +532,6 @@ def test_all_arithmetic_operators_match_reference(reference):
             got = op(_CI(), 3).compute()
             want = op(_RI(), 3).compute()
             assert int(np.asarray(got)) == int(want), op
-    finally:
-        sys.path.remove("/root/reference")
 
 
 def test_multiclass_roc_lists_match_reference(reference):
@@ -741,8 +705,7 @@ def test_canonicalizer_fuzz_sweep_matches_reference(reference):
 
     from metrics_tpu.utilities.checks import _input_format_classification
 
-    sys.path.insert(0, "/root/reference")
-    try:
+    with reference_on_path():
         from torchmetrics.utilities.checks import (
             _input_format_classification as ref_canon,
         )
@@ -799,8 +762,6 @@ def test_canonicalizer_fuzz_sweep_matches_reference(reference):
             else:
                 n_reject += 1
         assert n_match >= 20, (n_match, n_reject)  # the sweep must mostly exercise accepts
-    finally:
-        sys.path.remove("/root/reference")
 
 
 def test_retrieval_module_classes_match_reference(reference):
@@ -810,8 +771,7 @@ def test_retrieval_module_classes_match_reference(reference):
 
     from metrics_tpu import RetrievalMAP, RetrievalMRR
 
-    sys.path.insert(0, "/root/reference")
-    try:
+    with reference_on_path():
         from torchmetrics import RetrievalMAP as RefMAP, RetrievalMRR as RefMRR
 
         rng = np.random.RandomState(81)
@@ -829,8 +789,6 @@ def test_retrieval_module_classes_match_reference(reference):
                 theirs2.update(torch.from_numpy(idx), torch.from_numpy(preds), torch.from_numpy(target))
             _close(ours.compute(), theirs.compute())
             _close(ours2.compute(), theirs2.compute())
-    finally:
-        sys.path.remove("/root/reference")
 
 
 def test_multilabel_confusion_matrix_matches_reference(reference):
@@ -851,8 +809,7 @@ def test_tensor_utilities_match_reference(reference):
 
     from metrics_tpu.utilities.data import select_topk, to_categorical, to_onehot
 
-    sys.path.insert(0, "/root/reference")
-    try:
+    with reference_on_path():
         from torchmetrics.utilities.data import (
             select_topk as ref_topk,
             to_categorical as ref_cat,
@@ -874,15 +831,12 @@ def test_tensor_utilities_match_reference(reference):
             np.asarray(to_categorical(jnp.asarray(probs))),
             ref_cat(torch.from_numpy(probs)).numpy(),
         )
-    finally:
-        sys.path.remove("/root/reference")
 
 
 def test_collection_clone_prefix_matches_reference(reference):
     from metrics_tpu import Accuracy, MetricCollection
 
-    sys.path.insert(0, "/root/reference")
-    try:
+    with reference_on_path():
         from torchmetrics import Accuracy as RefAccuracy, MetricCollection as RefCollection
 
         probs, target = _multiclass(n=64, seed=90)
@@ -893,5 +847,3 @@ def test_collection_clone_prefix_matches_reference(reference):
         got, want = ours.compute(), theirs.compute()
         assert set(got) == set(want) == {"val_Accuracy"}
         _close(got["val_Accuracy"], want["val_Accuracy"])
-    finally:
-        sys.path.remove("/root/reference")
