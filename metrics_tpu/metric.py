@@ -603,6 +603,17 @@ class CompositionalMetric(Metric):
     Parity with reference ``metric.py:459-537``: ``update`` fans out with
     kwargs filtering, ``compute`` applies the operator to child results, and
     ``_sync_dist`` is a no-op because children sync themselves.
+
+    Deliberate divergence — ``forward`` preserves accumulation: the
+    reference composite registers no states, so its inherited forward's
+    snapshot/restore cycle caches nothing, destroying the operands'
+    accumulated state and leaving their ``_computed`` caches batch-local
+    (epoch ``compute()`` after forward returns the LAST batch's value
+    there). Here the snapshot recurses into the operands
+    (:meth:`_snapshot_state`) and their caches are cleared on restore, so
+    step values match the reference while epoch compute stays the true
+    aggregate (``tests/bases/test_composition.py::
+    test_forward_preserves_operand_accumulation``).
     """
 
     def __init__(

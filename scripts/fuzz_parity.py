@@ -20,6 +20,15 @@ corresponding functionals' docstrings):
   descending argsort (arbitrary permutation, varies across torch versions/
   devices); ours is stable-by-input-order. The retrieval generators
   therefore emit unique scores.
+- CompositionalMetric driven by forward(): the reference composite has no
+  registered states, so forward's snapshot/reset/restore cycle caches
+  nothing — it destroys the operands' accumulation and leaves their
+  ``_computed`` caches holding batch-local values; epoch compute() then
+  returns the LAST BATCH's value. Ours recurses the snapshot into the
+  operands and clears their caches (pinned by
+  tests/bases/test_composition.py::test_forward_preserves_operand_accumulation),
+  so the arithmetic domain drives update() directly, where both libraries
+  agree.
 
 Finds to date (fixed): bleu_score(smooth=True) previously followed modern
 nltk method2 (unigram unsmoothed) instead of the reference's all-orders
@@ -68,6 +77,16 @@ def _to_np(x):
 
 
 def _compare(ours, theirs, atol):
+    if isinstance(ours, dict) or isinstance(theirs, dict):
+        if not (isinstance(ours, dict) and isinstance(theirs, dict)) or sorted(ours) != sorted(theirs):
+            ko = sorted(ours) if isinstance(ours, dict) else type(ours).__name__
+            kt = sorted(theirs) if isinstance(theirs, dict) else type(theirs).__name__
+            return f"dict keys {ko} vs {kt}"
+        for k in sorted(ours):
+            err = _compare(ours[k], theirs[k], atol)
+            if err:
+                return f"[{k}] {err}"
+        return None
     ours_seq, theirs_seq = isinstance(ours, (tuple, list)), isinstance(theirs, (tuple, list))
     if ours_seq or theirs_seq:
         if not (ours_seq and theirs_seq) or len(ours) != len(theirs):
@@ -87,7 +106,13 @@ def _compare(ours, theirs, atol):
     # must report as a mismatch, not crash an all-NaN argmax
     both_nan = np.isnan(a) & np.isnan(b)
     with np.errstate(invalid="ignore"):  # inf - inf inside the masked-off arm
-        bad = ~(both_nan | (a == b) | (np.abs(a - b) <= atol))  # a==b covers ±inf
+        # the 1e-6 relative term keeps large-magnitude outputs (e.g. PSNR
+        # reduction='sum' over thousands of samples) from tripping a purely
+        # absolute tolerance on f32 accumulation-order noise; finite-only so
+        # an inf reference can't widen the tolerance to inf (matching infs
+        # pass via a==b, finite-vs-inf must report)
+        tol = atol + 1e-6 * np.where(np.isfinite(b), np.abs(b), 0.0)
+        bad = ~(both_nan | (a == b) | (np.abs(a - b) <= tol))
     if bad.any():
         i = int(np.argmax(bad.ravel()))
         return f"{int(bad.sum())} elements differ, first at {i}: {a.ravel()[i]!r} vs {b.ravel()[i]!r}"
@@ -638,6 +663,58 @@ def _mgen_mse(rng):
     return {}, batch
 
 
+def _mgen_msle(rng):
+    n = int(rng.choice([4, 33]))
+
+    def batch(rng):
+        return (rng.rand(n) * 3).astype(np.float32), (rng.rand(n) * 3).astype(np.float32)
+
+    return {}, batch
+
+
+def _mgen_fbeta(rng):
+    kw, batch = _mgen_stat_family(rng)
+    kw["beta"] = float(rng.choice([0.5, 2.0]))
+    return kw, batch
+
+
+def _mgen_matthews(rng):
+    c = int(rng.randint(2, 5))
+    n = int(rng.choice([4, 33]))
+
+    def batch(rng):
+        return rng.randint(c, size=n), rng.randint(c, size=n)
+
+    return {"num_classes": c}, batch
+
+
+def _mgen_hinge(rng):
+    # binary margin scores; target 0/1 (the multiclass module path shares
+    # the functional's fuzz coverage)
+    n = int(rng.choice([8, 33]))
+    kw = {"squared": True} if rng.rand() < 0.5 else {}
+
+    def batch(rng):
+        return rng.randn(n).astype(np.float32), rng.randint(2, size=n)
+
+    return kw, batch
+
+
+def _mgen_auc_module(rng):
+    # x must stay monotonic across the CONCATENATED batches (epoch compute
+    # sees all of them, reorder defaults False) — offset each batch's range
+    n = int(rng.choice([4, 17]))
+    calls = [0]
+
+    def batch(rng):
+        base = calls[0]
+        calls[0] += 1
+        x = (np.sort(rng.rand(n)) + base).astype(np.float32)
+        return x, rng.rand(n).astype(np.float32)
+
+    return {}, batch
+
+
 def _mgen_explained_variance(rng):
     kw = {"multioutput": str(rng.choice(["uniform_average", "raw_values", "variance_weighted"]))}
     n, k = int(rng.choice([4, 33])), int(rng.randint(1, 4))
@@ -709,7 +786,56 @@ def _mgen_retrieval_k(rng):
     return kw, batch
 
 
+def _default_builder(ns, name, ctor_kwargs):
+    return getattr(ns, name)(**ctor_kwargs)
+
+
+def _collection_builder(ns, name, ctor_kwargs):
+    """ctor_kwargs: {"specs": [(class_name, kwargs), ...]}."""
+    return ns.MetricCollection([getattr(ns, cn)(**kw) for cn, kw in ctor_kwargs["specs"]])
+
+
+def _arithmetic_builder(ns, name, ctor_kwargs):
+    """Random operator pipeline over two regression metrics (same-signature
+    update so the composite's fan-out reaches both operands)."""
+    a, b = ns.MeanSquaredError(), ns.MeanAbsoluteError()
+    expr = {"add": lambda: 2 * a + b, "sub_const": lambda: a - 0.5,
+            "div": lambda: a / (b + 1.0), "abs_neg": lambda: abs(-a),
+            "pow": lambda: (a + 1.0) ** 2, "mixed": lambda: 2 * a + abs(b) / 4 - 1}
+    return expr[ctor_kwargs["op"]]()
+
+
+def _mgen_collection(rng):
+    pool = [("Accuracy", {}), ("HammingDistance", {}),
+            ("Precision", {"num_classes": 3, "average": "macro"}),
+            ("Recall", {"num_classes": 3, "average": "macro"}),
+            ("F1", {"num_classes": 3, "average": "macro"})]
+    take = rng.choice(len(pool), size=int(rng.randint(2, 4)), replace=False)
+    kw = {"specs": [pool[i] for i in take]}
+    n = int(rng.choice([4, 33]))
+
+    def batch(rng):
+        return _probs(rng, n, 3), rng.randint(3, size=n)
+
+    return kw, batch
+
+
+def _mgen_arithmetic(rng):
+    op = str(rng.choice(["add", "sub_const", "div", "abs_neg", "pow", "mixed"]))
+    n = int(rng.choice([4, 33]))
+
+    def batch(rng):
+        return rng.randn(n).astype(np.float32), rng.randn(n).astype(np.float32)
+
+    return {"op": op}, batch
+
+
 MODULE_DOMAINS = {
+    "AUC": (_mgen_auc_module, 1e-5),
+    "FBeta": (_mgen_fbeta, 1e-6),
+    "Hinge": (_mgen_hinge, 1e-5),
+    "MatthewsCorrcoef": (_mgen_matthews, 1e-5),
+    "MeanSquaredLogError": (_mgen_msle, 1e-5),
     "Accuracy": (_mgen_accuracy, 1e-6),
     "StatScores": (_mgen_statscores, 0.0),
     "Precision": (_mgen_stat_family, 1e-6),
@@ -729,6 +855,10 @@ MODULE_DOMAINS = {
     "R2Score": (_mgen_r2, 1e-4),
     "PSNR": (_mgen_psnr, 1e-4),
     "SSIM": (_mgen_ssim, 1e-4),
+    "MetricCollection": (_mgen_collection, 1e-6, _collection_builder, "forward"),
+    # update-driven: the reference composite's forward destroys operand
+    # accumulation (see the known-divergences note in the module docstring)
+    "CompositionalArithmetic": (_mgen_arithmetic, 1e-5, _arithmetic_builder, "update"),
     "RetrievalMAP": (_mgen_retrieval, 1e-5),
     "RetrievalMRR": (_mgen_retrieval, 1e-5),
     "RetrievalPrecision": (_mgen_retrieval_k, 1e-6),
@@ -738,15 +868,18 @@ MODULE_DOMAINS = {
 
 def _run_module_trial(name, rng, ours_mod, ref_mod, torch):
     """One stateful trial: ("match"|"reject"|"mismatch", detail_or_None)."""
-    gen, atol = MODULE_DOMAINS[name]
+    entry = MODULE_DOMAINS[name]
+    gen, atol = entry[0], entry[1]
+    builder = entry[2] if len(entry) > 2 else _default_builder
+    drive = entry[3] if len(entry) > 3 else "forward"
     ctor_kwargs, batch_gen = gen(rng)
     try:
-        theirs_m = getattr(ref_mod, name)(**ctor_kwargs)
+        theirs_m = builder(ref_mod, name, ctor_kwargs)
         ref_err = None
     except Exception as err:  # noqa: BLE001
         theirs_m, ref_err = None, err
     try:
-        ours_m = getattr(ours_mod, name)(**ctor_kwargs)
+        ours_m = builder(ours_mod, name, ctor_kwargs)
         our_err = None
     except Exception as err:  # noqa: BLE001
         ours_m, our_err = None, err
@@ -759,13 +892,15 @@ def _run_module_trial(name, rng, ours_mod, ref_mod, torch):
         n_batches = int(rng.randint(1, 4))
         batches = [batch_gen(rng) for _ in range(n_batches)]
         for bi, b in enumerate(batches):
+            ref_call = theirs_m.update if drive == "update" else theirs_m
+            our_call = ours_m.update if drive == "update" else ours_m
             try:
-                theirs_v = theirs_m(*[torch.from_numpy(np.asarray(a)) for a in b])
+                theirs_v = ref_call(*[torch.from_numpy(np.asarray(a)) for a in b])
                 ref_err = None
             except Exception as err:  # noqa: BLE001
                 theirs_v, ref_err = None, err
             try:
-                ours_v = ours_m(*[jnp.asarray(a) for a in b])
+                ours_v = our_call(*[jnp.asarray(a) for a in b])
                 our_err = None
             except Exception as err:  # noqa: BLE001
                 ours_v, our_err = None, err
@@ -776,6 +911,8 @@ def _run_module_trial(name, rng, ours_mod, ref_mod, torch):
                 )
             if ref_err is not None:
                 return "reject", None  # rejected identically; state unusable
+            if drive == "update":
+                continue  # update() returns no step value to compare
             err = _compare(ours_v, theirs_v, atol)
             if err:
                 return "mismatch", f"forward value r{round_} b{bi} kwargs={ctor_kwargs}: {err}"

@@ -21,6 +21,15 @@ EXPECTED_CHECKS = {
     "retrieval_map",
     "sharded_auroc_mesh",
     "binned_auroc_histogram",
+    "roc_curve_len",
+    "roc_curve_fpr",
+    "roc_curve_tpr",
+    "roc_curve_thresholds",
+    "average_precision_sort_kernel",
+    "f1_macro_stat_scores",
+    "cohen_kappa_quadratic",
+    "psnr_minmax_states",
+    "embedding_similarity_matmul",
 }
 
 
