@@ -287,6 +287,11 @@ def _gen_hinge(rng):
     }
 
 
+def _weights(rng, n):
+    """Optional sample_weights (positive floats; the reference normalizes)."""
+    return (rng.rand(n) + 0.1).astype(np.float32).tolist()
+
+
 def _gen_auroc(rng):
     kind = rng.randint(2)
     n = int(rng.choice([8, 64, 513]))
@@ -295,6 +300,8 @@ def _gen_auroc(rng):
         kw = {}
         if rng.rand() < 0.3:
             kw["max_fpr"] = float(rng.uniform(0.1, 0.95))
+        elif rng.rand() < 0.3:
+            kw["sample_weights"] = _weights(rng, n)
         return (p, t), kw
     c = int(rng.randint(2, 5))
     p, t = _probs(rng, n, c), rng.randint(c, size=n)
@@ -316,9 +323,22 @@ def _gen_curve(rng):
     kind = rng.randint(2)
     n = int(rng.choice([4, 33, 129]))
     if kind == 0:
-        return (_scores(rng, (n,)), rng.randint(2, size=n)), {}
+        kw = {}
+        if rng.rand() < 0.25:
+            kw["sample_weights"] = _weights(rng, n)
+        return (_scores(rng, (n,)), rng.randint(2, size=n)), kw
     c = int(rng.randint(2, 5))
     return (_probs(rng, n, c), rng.randint(c, size=n)), {"num_classes": c}
+
+
+def _gen_precision_recall_pair(rng):
+    # the tuple-returning combined functional (reference
+    # functional/classification/precision_recall.py:348)
+    p, t, meta = _cls_inputs(rng)
+    kw = {"average": str(rng.choice(["micro", "macro", "weighted"]))}
+    if kw["average"] != "micro" or rng.rand() < 0.5:
+        kw["num_classes"] = meta["c"]
+    return (p, t), kw
 
 
 def _gen_auc(rng):
@@ -491,6 +511,7 @@ DOMAINS = {
     "average_precision": (_gen_ap, 1e-5, True),
     "roc": (_gen_curve, 1e-6, True),
     "precision_recall_curve": (_gen_curve, 1e-6, True),
+    "precision_recall": (_gen_precision_recall_pair, 1e-6, True),
     "auc": (_gen_auc, 1e-5, True),
     "dice_score": (_gen_dice, 1e-5, True),
     "mean_squared_error": (_gen_mse, 1e-5, True),
