@@ -62,6 +62,15 @@ def _sorted_cumulants_xla(preds, target, pos_label, sample_weights=None, weighte
         distinct = preds_s[1:] != preds_s[:-1]
     tps = jnp.cumsum(target_s * weight)
     fps = jnp.cumsum((1.0 - target_s) * weight)
+    if weighted:
+        # XLA lowers cumsum to a reassociated parallel scan; float prefix
+        # sums of positive weights can dip by an ulp (observed -6e-8 at
+        # n=513), and a non-monotone fpr trips auc()'s direction check.
+        # True prefix sums of non-negative terms are non-decreasing, so a
+        # cummax repairs the dips exactly. (The unweighted 0/1 cumsums are
+        # integer-exact in f32 below 2^24 — no repair needed.)
+        tps = jax.lax.cummax(tps)
+        fps = jax.lax.cummax(fps)
     return preds_s, tps, fps, distinct
 
 

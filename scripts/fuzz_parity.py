@@ -288,7 +288,8 @@ def _gen_hinge(rng):
 
 
 def _weights(rng, n):
-    """Optional sample_weights (positive floats; the reference normalizes)."""
+    """Optional sample_weights: positive floats, O(1) scale (the reference
+    cumsums RAW weights — only ratio-style consumers are scale-free)."""
     return (rng.rand(n) + 0.1).astype(np.float32).tolist()
 
 
@@ -298,9 +299,11 @@ def _gen_auroc(rng):
     if kind == 0:
         p, t = _scores(rng, (n,)), rng.randint(2, size=n)
         kw = {}
+        # independent draws: the max_fpr+weights combination is supported
+        # and must stay fuzzed
         if rng.rand() < 0.3:
             kw["max_fpr"] = float(rng.uniform(0.1, 0.95))
-        elif rng.rand() < 0.3:
+        if rng.rand() < 0.3:
             kw["sample_weights"] = _weights(rng, n)
         return (p, t), kw
     c = int(rng.randint(2, 5))
@@ -336,6 +339,8 @@ def _gen_precision_recall_pair(rng):
     # functional/classification/precision_recall.py:348)
     p, t, meta = _cls_inputs(rng)
     kw = {"average": str(rng.choice(["micro", "macro", "weighted"]))}
+    if meta["kind"] == "mdmc_prob":
+        kw["mdmc_average"] = str(rng.choice(["global", "samplewise"]))
     if kw["average"] != "micro" or rng.rand() < 0.5:
         kw["num_classes"] = meta["c"]
     return (p, t), kw

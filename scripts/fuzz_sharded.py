@@ -1,0 +1,278 @@
+"""Randomized self-consistency fuzz for the TPU-native state designs.
+
+The ``Sharded*`` (mesh-sharded bounded buffers, SURVEY §5.7) and ``Binned*``
+(O(bins) psum-able histograms) families have no reference counterpart — their
+contract is agreement with the EXACT replicated metrics this library also
+ships. This script drives that contract with randomized batch counts/sizes,
+capacities, class counts, tie structures and option combinations on the
+8-virtual-device CPU mesh:
+
+- Sharded{AUROC, AveragePrecision, ROC, PrecisionRecallCurve} vs the
+  replicated exact twins (tie-heavy scores allowed: the curve kernels are
+  tie-group exact, so the device-block permutation of the gathered stream
+  cannot change values);
+- ShardedAUROC's bf16 buffer mode vs the exact twin on bf16-rounded scores
+  (the documented quantize-on-append semantics);
+- ShardedRetrieval{MAP, MRR, Precision, Recall} vs the replicated retrieval
+  classes (unique scores: the gathered stream is a permutation of the
+  input, so tied scores would exercise the documented input-order tie
+  semantics differently);
+- Binned{AUROC, AveragePrecision, PrecisionRecallCurve} vs the exact twins
+  on scores pre-quantized to the bin grid (where binning is lossless).
+
+Usage:
+    python scripts/fuzz_sharded.py --trials 200 [--seed 0]
+
+Self-provisions the virtual mesh: re-execs with
+``--xla_force_host_platform_device_count=8`` when fewer devices exist.
+Exits 0 iff no mismatches.
+"""
+import argparse
+import os
+import subprocess
+import sys
+
+_MARKER = "_FUZZ_SHARDED_CHILD"
+
+if os.environ.get(_MARKER) != "1" and "--no-reexec" not in sys.argv:
+    env = dict(
+        os.environ,
+        **{
+            _MARKER: "1",
+            "XLA_FLAGS": os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8",
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__), *sys.argv[1:]], env=env)
+    sys.exit(proc.returncode)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+
+from fuzz_parity import _compare  # noqa: E402  (shared comparison core)
+
+WORLD = 8
+
+
+def _batches(rng, max_total):
+    """1-3 batches, each a multiple of WORLD, fitting the capacity."""
+    out, total = [], 0
+    for _ in range(int(rng.randint(1, 4))):
+        n = WORLD * int(rng.randint(1, 4))
+        if total + n > max_total:
+            break
+        out.append(n)
+        total += n
+    return out or [WORLD]
+
+
+def _tied_scores(rng, n):
+    mode = rng.randint(3)
+    x = rng.rand(n)
+    if mode == 1:
+        x = np.round(x * rng.choice([2, 5, 10])) / 10
+    elif mode == 2:
+        x = np.full(n, float(rng.rand()))
+    return x.astype(np.float32)
+
+
+def _fz_auroc_binary(rng, M):
+    cap = int(rng.choice([16, 64]))
+    sh = M.ShardedAUROC(capacity_per_device=cap)
+    ex = M.AUROC()
+    for n in _batches(rng, cap * WORLD):
+        p, t = _tied_scores(rng, n), rng.randint(2, size=n)
+        sh.update(jnp.asarray(p), jnp.asarray(t))
+        ex.update(jnp.asarray(p), jnp.asarray(t))
+    return sh.compute(), ex.compute(), 1e-6
+
+
+def _fz_auroc_bf16(rng, M):
+    cap = int(rng.choice([16, 64]))
+    sh = M.ShardedAUROC(capacity_per_device=cap, preds_dtype=jnp.bfloat16)
+    ex = M.AUROC()
+    for n in _batches(rng, cap * WORLD):
+        p, t = _tied_scores(rng, n), rng.randint(2, size=n)
+        sh.update(jnp.asarray(p), jnp.asarray(t))
+        # the documented contract: exact metric of the bf16-quantized scores
+        ex.update(jnp.asarray(p).astype(jnp.bfloat16).astype(jnp.float32), jnp.asarray(t))
+    return sh.compute(), ex.compute(), 1e-6
+
+
+def _fz_auroc_ovr(rng, M):
+    cap, c = int(rng.choice([16, 64])), int(rng.randint(2, 5))
+    average = [None, "macro", "weighted"][rng.randint(3)]
+    sh = M.ShardedAUROC(capacity_per_device=cap, num_classes=c, average=average)
+    ex = M.AUROC(num_classes=c, average=average) if average else None
+    per_class_want = []
+    batches = []
+    for n in _batches(rng, cap * WORLD):
+        e = np.exp(rng.rand(n, c))
+        p = (e / e.sum(1, keepdims=True)).astype(np.float32)
+        t = rng.randint(c, size=n)
+        t[:c] = np.arange(c)  # all classes present: averaged modes defined
+        batches.append((p, t))
+        sh.update(jnp.asarray(p), jnp.asarray(t))
+    allp = np.concatenate([p for p, _ in batches])
+    allt = np.concatenate([t for _, t in batches])
+    if average:
+        ex.update(jnp.asarray(allp), jnp.asarray(allt))
+        return sh.compute(), ex.compute(), 1e-6
+    # per-class mode: compare against binary AUROC per one-vs-rest column
+    from metrics_tpu.ops.auroc_kernel import binary_auroc
+
+    for k in range(c):
+        per_class_want.append(binary_auroc(jnp.asarray(allp[:, k]), jnp.asarray((allt == k).astype(np.int32))))
+    return sh.compute(), jnp.stack(per_class_want), 1e-6
+
+
+def _fz_ap_binary(rng, M):
+    cap = int(rng.choice([16, 64]))
+    sh = M.ShardedAveragePrecision(capacity_per_device=cap)
+    ex = M.AveragePrecision()
+    for n in _batches(rng, cap * WORLD):
+        p, t = _tied_scores(rng, n), rng.randint(2, size=n)
+        sh.update(jnp.asarray(p), jnp.asarray(t))
+        ex.update(jnp.asarray(p), jnp.asarray(t))
+    return sh.compute(), ex.compute(), 1e-6
+
+
+def _fz_curves(rng, M):
+    cap = int(rng.choice([16, 64]))
+    cls_sh, cls_ex = (M.ShardedROC, M.ROC) if rng.rand() < 0.5 else (
+        M.ShardedPrecisionRecallCurve, M.PrecisionRecallCurve)
+    sh, ex = cls_sh(capacity_per_device=cap), cls_ex()
+    for n in _batches(rng, cap * WORLD):
+        p, t = _tied_scores(rng, n), rng.randint(2, size=n)
+        sh.update(jnp.asarray(p), jnp.asarray(t))
+        ex.update(jnp.asarray(p), jnp.asarray(t))
+    return tuple(np.asarray(v) for v in sh.compute()), tuple(np.asarray(v) for v in ex.compute()), 1e-6
+
+
+def _fz_retrieval(rng, M):
+    cap = int(rng.choice([16, 64]))
+    name = ["MAP", "MRR", "Precision", "Recall"][rng.randint(4)]
+    kw = {}
+    if name in ("Precision", "Recall") and rng.rand() < 0.5:
+        kw["k"] = int(rng.randint(1, 5))
+    action = ["skip", "neg", "pos"][rng.randint(3)]
+    sh = getattr(M, f"ShardedRetrieval{name}")(capacity_per_device=cap, empty_target_action=action, **kw)
+    ex = getattr(M, f"Retrieval{name}")(empty_target_action=action, **kw)
+    total = 0
+    sizes = _batches(rng, cap * WORLD)
+    grand = sum(sizes)
+    for n in sizes:
+        q = rng.randint(4, size=n).astype(np.int32)
+        # unique across the trial: draw from disjoint offset blocks
+        p = rng.permutation((np.arange(n) + total + 1).astype(np.float32) / (grand + 1))
+        t = rng.randint(2, size=n).astype(np.int32)
+        total += n
+        sh.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+        ex.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    try:
+        want = ex.compute()
+        ex_err = None
+    except ValueError as err:
+        want, ex_err = None, err
+    try:
+        got = sh.compute()
+        sh_err = None
+    except ValueError as err:
+        got, sh_err = None, err
+    if (ex_err is None) != (sh_err is None):
+        return f"acceptance: sharded={sh_err!r} exact={ex_err!r}", None, 0
+    if ex_err is not None:
+        return None, None, 0  # both rejected (e.g. empty_target_action paths)
+    return got, want, 1e-6
+
+
+def _fz_binned(rng, M):
+    nb = int(rng.choice([64, 256]))
+    which = rng.randint(3)
+    n_total = WORLD * int(rng.randint(2, 9))
+    # quantize to bin centers: binning is lossless there
+    p = ((np.floor(rng.rand(n_total) * nb) + 0.5) / nb).astype(np.float32)
+    t = rng.randint(2, size=n_total)
+    t[:2] = [0, 1]
+    if which == 0:
+        b, ex = M.BinnedAUROC(num_bins=nb), M.AUROC()
+    elif which == 1:
+        b, ex = M.BinnedAveragePrecision(num_bins=nb), M.AveragePrecision()
+    else:
+        b, ex = M.BinnedPrecisionRecallCurve(num_bins=nb), None
+    b.update(jnp.asarray(p), jnp.asarray(t))
+    if ex is None:
+        # every binned (precision, recall, threshold) point must equal the
+        # directly-computed value at that threshold (score >= thr predicts
+        # positive; precision defined 1 when nothing predicts positive)
+        prec, rec, thr = (np.asarray(v) for v in b.compute())
+        sel = p[None, :] >= thr[:, None]
+        pp = sel.sum(1).astype(np.float64)
+        tp = (sel & (t == 1)[None, :]).sum(1).astype(np.float64)
+        want_prec = np.where(pp > 0, tp / np.maximum(pp, 1), 1.0)
+        want_rec = tp / max(int((t == 1).sum()), 1)
+        return (prec, rec), (want_prec, want_rec), 1e-6
+    ex.update(jnp.asarray(p), jnp.asarray(t))
+    return b.compute(), ex.compute(), 1e-6
+
+
+DOMAINS = {
+    "sharded_auroc_binary": _fz_auroc_binary,
+    "sharded_auroc_bf16": _fz_auroc_bf16,
+    "sharded_auroc_ovr": _fz_auroc_ovr,
+    "sharded_ap_binary": _fz_ap_binary,
+    "sharded_curves": _fz_curves,
+    "sharded_retrieval": _fz_retrieval,
+    "binned_vs_exact": _fz_binned,
+}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trials", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--domain", default=None)
+    ap.add_argument("--no-reexec", action="store_true", help="(internal)")
+    args = ap.parse_args()
+
+    assert len(jax.devices()) >= WORLD, f"need {WORLD} devices, got {len(jax.devices())}"
+
+    import metrics_tpu as M
+
+    names = [args.domain] if args.domain else sorted(DOMAINS)
+    rng = np.random.RandomState(args.seed)
+    mismatches = matched = rejected = 0
+    for trial in range(args.trials):
+        name = names[rng.randint(len(names))]
+        state = rng.get_state()[1][:2]
+        got, want, atol = DOMAINS[name](rng, M)
+        if isinstance(got, str):  # acceptance mismatch message
+            mismatches += 1
+            print(f"MISMATCH {name} trial={trial} seedhead={state}: {got}")
+            continue
+        if got is None and want is None:
+            rejected += 1
+            continue
+        err = _compare(got, want, atol)
+        if err:
+            mismatches += 1
+            print(f"MISMATCH {name} trial={trial} seedhead={state}: {err}")
+        else:
+            matched += 1
+
+    print(
+        f"fuzz_sharded: {args.trials} trials on {len(jax.devices())} devices, "
+        f"{matched} matched, {rejected} rejected-by-both, {mismatches} MISMATCHES"
+    )
+    return 1 if mismatches else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
