@@ -768,6 +768,13 @@ def _mgen_r2(rng):
 
 def _mgen_psnr(rng):
     kw = {"data_range": 1.0} if rng.rand() < 0.7 else {}
+    if rng.rand() < 0.3:
+        # dim= switches PSNR to its list-state mode (the only dual-mode
+        # state design in the inventory); data_range becomes required
+        kw["dim"] = (1, 2)
+        kw["data_range"] = 1.0
+        if rng.rand() < 0.5:
+            kw["reduction"] = str(rng.choice(["elementwise_mean", "sum", "none"]))
     shape = (int(rng.choice([2, 4])), 8, 8)
 
     def batch(rng):
