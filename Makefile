@@ -1,4 +1,4 @@
-.PHONY: test test-tpu doctest bench dryrun clean
+.PHONY: test test-tpu doctest bench dryrun fuzz fuzz-sharded clean
 
 test:
 	# full suite: sklearn/scipy oracles + package doctests + 8-virtual-device
@@ -20,6 +20,16 @@ doctest:
 bench:
 	# north-star benchmark; prints one JSON line (real TPU when available)
 	python bench.py
+
+fuzz:
+	# randomized differential parity vs the reference library (functional +
+	# stateful module layers); exits non-zero on any mismatch
+	python scripts/fuzz_parity.py --trials 1000
+
+fuzz-sharded:
+	# randomized self-consistency of the TPU-native Sharded*/Binned* state
+	# designs vs the exact replicated metrics, on an 8-virtual-device mesh
+	python scripts/fuzz_sharded.py --trials 200
 
 dryrun:
 	# multi-chip sharded eval step on an 8-device mesh (self-provisions a
