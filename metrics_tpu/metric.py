@@ -22,6 +22,7 @@ TPU-native design decisions:
 """
 import functools
 import inspect
+import operator
 from abc import ABC, abstractmethod
 from collections.abc import Sequence
 from copy import deepcopy
@@ -490,95 +491,95 @@ class Metric(ABC):
     # metric arithmetic (reference metric.py:351-452)
     # ------------------------------------------------------------------
     def __add__(self, other: Any):
-        return CompositionalMetric(jnp.add, self, other)
+        return CompositionalMetric(_add, self, other)
 
     def __and__(self, other: Any):
-        return CompositionalMetric(jnp.bitwise_and, self, other)
+        return CompositionalMetric(operator.and_, self, other)
 
     def __eq__(self, other: Any):
-        return CompositionalMetric(jnp.equal, self, other)
+        return CompositionalMetric(_eq, self, other)
 
     def __floordiv__(self, other: Any):
-        return CompositionalMetric(jnp.floor_divide, self, other)
+        return CompositionalMetric(operator.floordiv, self, other)
 
     def __ge__(self, other: Any):
-        return CompositionalMetric(jnp.greater_equal, self, other)
+        return CompositionalMetric(_ge, self, other)
 
     def __gt__(self, other: Any):
-        return CompositionalMetric(jnp.greater, self, other)
+        return CompositionalMetric(_gt, self, other)
 
     def __le__(self, other: Any):
-        return CompositionalMetric(jnp.less_equal, self, other)
+        return CompositionalMetric(_le, self, other)
 
     def __lt__(self, other: Any):
-        return CompositionalMetric(jnp.less, self, other)
+        return CompositionalMetric(_lt, self, other)
 
     def __matmul__(self, other: Any):
-        return CompositionalMetric(jnp.matmul, self, other)
+        return CompositionalMetric(operator.matmul, self, other)
 
     def __mod__(self, other: Any):
-        return CompositionalMetric(jnp.fmod, self, other)
+        return CompositionalMetric(_fmod, self, other)
 
     def __mul__(self, other: Any):
-        return CompositionalMetric(jnp.multiply, self, other)
+        return CompositionalMetric(_mul, self, other)
 
     def __ne__(self, other: Any):
-        return CompositionalMetric(jnp.not_equal, self, other)
+        return CompositionalMetric(_ne, self, other)
 
     def __or__(self, other: Any):
-        return CompositionalMetric(jnp.bitwise_or, self, other)
+        return CompositionalMetric(operator.or_, self, other)
 
     def __pow__(self, other: Any):
-        return CompositionalMetric(jnp.power, self, other)
+        return CompositionalMetric(operator.pow, self, other)
 
     def __radd__(self, other: Any):
-        return CompositionalMetric(jnp.add, other, self)
+        return CompositionalMetric(_add, other, self)
 
     def __rand__(self, other: Any):
         # bitwise_and is commutative
-        return CompositionalMetric(jnp.bitwise_and, self, other)
+        return CompositionalMetric(operator.and_, self, other)
 
     def __rfloordiv__(self, other: Any):
-        return CompositionalMetric(jnp.floor_divide, other, self)
+        return CompositionalMetric(operator.floordiv, other, self)
 
     def __rmatmul__(self, other: Any):
-        return CompositionalMetric(jnp.matmul, other, self)
+        return CompositionalMetric(operator.matmul, other, self)
 
     def __rmod__(self, other: Any):
-        return CompositionalMetric(jnp.fmod, other, self)
+        return CompositionalMetric(_fmod, other, self)
 
     def __rmul__(self, other: Any):
-        return CompositionalMetric(jnp.multiply, other, self)
+        return CompositionalMetric(_mul, other, self)
 
     def __ror__(self, other: Any):
-        return CompositionalMetric(jnp.bitwise_or, other, self)
+        return CompositionalMetric(operator.or_, other, self)
 
     def __rpow__(self, other: Any):
-        return CompositionalMetric(jnp.power, other, self)
+        return CompositionalMetric(operator.pow, other, self)
 
     def __rsub__(self, other: Any):
-        return CompositionalMetric(jnp.subtract, other, self)
+        return CompositionalMetric(operator.sub, other, self)
 
     def __rtruediv__(self, other: Any):
-        return CompositionalMetric(jnp.true_divide, other, self)
+        return CompositionalMetric(operator.truediv, other, self)
 
     def __rxor__(self, other: Any):
-        return CompositionalMetric(jnp.bitwise_xor, other, self)
+        return CompositionalMetric(operator.xor, other, self)
 
     def __sub__(self, other: Any):
-        return CompositionalMetric(jnp.subtract, self, other)
+        return CompositionalMetric(operator.sub, self, other)
 
     def __truediv__(self, other: Any):
-        return CompositionalMetric(jnp.true_divide, self, other)
+        return CompositionalMetric(operator.truediv, self, other)
 
     def __xor__(self, other: Any):
-        return CompositionalMetric(jnp.bitwise_xor, self, other)
+        return CompositionalMetric(operator.xor, self, other)
 
     def __abs__(self):
-        return CompositionalMetric(jnp.abs, self, None)
+        return CompositionalMetric(operator.abs, self, None)
 
     def __inv__(self):
-        return CompositionalMetric(jnp.bitwise_not, self, None)
+        return CompositionalMetric(operator.invert, self, None)
 
     def __invert__(self):
         return self.__inv__()
@@ -587,10 +588,74 @@ class Metric(ABC):
         return CompositionalMetric(_neg, self, None)
 
     def __pos__(self):
-        return CompositionalMetric(jnp.abs, self, None)
+        return CompositionalMetric(operator.abs, self, None)
 
     def __getitem__(self, idx):
-        return CompositionalMetric(lambda x: x[idx], self, None)
+        return CompositionalMetric(functools.partial(_getitem_op, idx=idx), self, None)
+
+
+def _reject_sequence_operands(*vals: Any) -> None:
+    """Arithmetic on tuple/list-valued computes (curve metrics) must raise,
+    as the reference's ``torch.add``-family does — Python's sequence
+    semantics for ``+``/``*``/comparisons would silently concatenate,
+    repeat, or compare lexicographically instead."""
+    for v in vals:
+        if isinstance(v, (tuple, list)):
+            raise TypeError(
+                "metric arithmetic is not defined for tuple/list-valued"
+                " compute() results (e.g. curve metrics)"
+            )
+
+
+def _add(a: Any, b: Any) -> Any:
+    _reject_sequence_operands(a, b)
+    return operator.add(a, b)
+
+
+def _mul(a: Any, b: Any) -> Any:
+    _reject_sequence_operands(a, b)
+    return operator.mul(a, b)
+
+
+def _eq(a: Any, b: Any) -> Any:
+    _reject_sequence_operands(a, b)
+    return operator.eq(a, b)
+
+
+def _ne(a: Any, b: Any) -> Any:
+    _reject_sequence_operands(a, b)
+    return operator.ne(a, b)
+
+
+def _lt(a: Any, b: Any) -> Any:
+    _reject_sequence_operands(a, b)
+    return operator.lt(a, b)
+
+
+def _le(a: Any, b: Any) -> Any:
+    _reject_sequence_operands(a, b)
+    return operator.le(a, b)
+
+
+def _gt(a: Any, b: Any) -> Any:
+    _reject_sequence_operands(a, b)
+    return operator.gt(a, b)
+
+
+def _ge(a: Any, b: Any) -> Any:
+    _reject_sequence_operands(a, b)
+    return operator.ge(a, b)
+
+
+def _fmod(a: Any, b: Any) -> Array:
+    """C-style remainder (sign follows the dividend) — the reference's `%`
+    is ``torch.fmod`` (metric.py:394), NOT Python's ``%``/``jnp.remainder``
+    (sign follows the divisor). Module-level so composites pickle."""
+    return jnp.fmod(a, b)
+
+
+def _getitem_op(x: Any, idx: Any) -> Any:
+    return x[idx]
 
 
 def _neg(x: Array) -> Array:

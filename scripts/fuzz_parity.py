@@ -925,6 +925,29 @@ def _run_module_trial(name, rng, ours_mod, ref_mod, torch):
         n_batches = int(rng.randint(1, 4))
         batches = [batch_gen(rng) for _ in range(n_batches)]
         for bi, b in enumerate(batches):
+            if bi == 1 and rng.rand() < 0.5:
+                # pickle round-trip MID-ACCUMULATION (reference contract:
+                # metric.py:270-278 re-wraps bound methods on unpickle);
+                # the remaining batches and computes run on the clones.
+                # Acceptance protocol per side, like every other probe.
+                import pickle
+
+                try:
+                    theirs_m2, ref_err = pickle.loads(pickle.dumps(theirs_m)), None
+                except Exception as err:  # noqa: BLE001
+                    theirs_m2, ref_err = None, err
+                try:
+                    ours_m2, our_err = pickle.loads(pickle.dumps(ours_m)), None
+                except Exception as err:  # noqa: BLE001
+                    ours_m2, our_err = None, err
+                if (ref_err is None) != (our_err is None):
+                    return "mismatch", (
+                        f"pickle acceptance r{round_}: ours={our_err!r} "
+                        f"ref={ref_err!r} kwargs={ctor_kwargs}"
+                    )
+                if ref_err is not None:
+                    return "reject", None  # both unpicklable for this config
+                theirs_m, ours_m = theirs_m2, ours_m2
             ref_call = theirs_m.update if drive == "update" else theirs_m
             our_call = ours_m.update if drive == "update" else ours_m
             try:

@@ -153,7 +153,21 @@ def _fz_curves(rng, M):
         p, t = _tied_scores(rng, n), rng.randint(2, size=n)
         sh.update(jnp.asarray(p), jnp.asarray(t))
         ex.update(jnp.asarray(p), jnp.asarray(t))
-    return tuple(np.asarray(v) for v in sh.compute()), tuple(np.asarray(v) for v in ex.compute()), 1e-6
+    # single-class streams legitimately raise (e.g. ROC's no-positives
+    # error); both sides must agree on acceptance
+    try:
+        want, ex_err = tuple(np.asarray(v) for v in ex.compute()), None
+    except Exception as err:  # noqa: BLE001 — acceptance parity, any type
+        want, ex_err = None, err
+    try:
+        got, sh_err = tuple(np.asarray(v) for v in sh.compute()), None
+    except Exception as err:  # noqa: BLE001
+        got, sh_err = None, err
+    if (ex_err is None) != (sh_err is None):
+        return f"acceptance: sharded={sh_err!r} exact={ex_err!r}", None, 0
+    if ex_err is not None:
+        return None, None, 0
+    return got, want, 1e-6
 
 
 def _fz_retrieval(rng, M):
