@@ -273,3 +273,49 @@ def test_epoch_compute_not_served_from_batch_local_cache():
     assert np.isfinite(float(step))  # tolerant batch-local value
     with pytest.raises(ValueError, match="never occurred"):
         comp.compute()  # epoch-end keeps the loud failure
+
+
+def test_composite_pickles_mid_accumulation():
+    """Composites built by metric arithmetic must pickle with accumulated
+    state (regression: jnp ufunc operands made every composite unpicklable;
+    the reference's torch-function composites pickle fine)."""
+    import pickle
+
+    from metrics_tpu import MeanAbsoluteError, MeanSquaredError
+
+    expr = 2 * MeanSquaredError() + abs(MeanAbsoluteError()) / 4 - 1
+    expr.update(jnp.asarray([1.0, 2.0]), jnp.asarray([1.5, 3.0]))
+    clone = pickle.loads(pickle.dumps(expr))
+    assert float(clone.compute()) == float(expr.compute())
+    # the clone keeps accumulating independently
+    clone.update(jnp.asarray([0.0]), jnp.asarray([4.0]))
+    assert float(clone.compute()) != float(expr.compute())
+    # fmod keeps the reference's C-style sign (torch.fmod, metric.py:394):
+    # -7 % 3 is -1 under fmod but 2 under Python/jnp remainder
+    from tests.helpers.testers import DummyMetricSum
+
+    comp = pickle.loads(pickle.dumps(DummyMetricSum() % 3))
+    comp.metric_a.update(jnp.asarray(-7.0))
+    assert float(comp.compute()) == -1.0
+
+
+def test_sequence_valued_operand_raises():
+    """Arithmetic over tuple-valued computes (curve metrics) must raise as
+    the reference's torch operators do — Python sequence semantics would
+    silently concatenate (+), repeat (*), or compare lexicographically."""
+    from metrics_tpu import ROC
+
+    preds = jnp.asarray([0.2, 0.8, 0.5, 0.7])
+    target = jnp.asarray([0, 1, 0, 1])
+
+    for build in (lambda: ROC() + ROC(), lambda: 2 * ROC(), lambda: ROC() == ROC()):
+        comp = build()
+        comp.update(preds, target)
+        with pytest.raises(TypeError, match="tuple/list-valued"):
+            comp.compute()
+
+    # indexing a curve metric stays supported (element extraction is
+    # well-defined on the tuple result)
+    fpr = ROC()[0]
+    fpr.update(preds, target)
+    assert fpr.compute().ndim == 1

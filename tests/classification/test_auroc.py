@@ -180,3 +180,27 @@ def test_multiclass_average_precision_uses_fused_kernel(monkeypatch):
     got = [float(x) for x in m.compute()]
     want = [average_precision_score((target == c).astype(int), probs[:, c]) for c in range(4)]
     assert np.allclose(got, want, atol=1e-5)
+
+
+def test_weighted_auroc_survives_scan_reassociation():
+    """Regression: XLA lowers cumsum to a reassociated parallel scan, so
+    float prefix sums of positive sample weights can dip by an ulp — the
+    non-monotone fpr then tripped ``auc()``'s direction check and weighted
+    AUROC raised. The cumulants are now cummax-repaired (exact for
+    non-negative weights). n=513 with this seed is a caught-in-the-wild
+    repro; the value must also match sklearn's weighted oracle."""
+    rng = np.random.RandomState(2)
+    n = 513
+    preds = rng.rand(n).astype(np.float32)
+    target = rng.randint(2, size=n)
+    weights = (rng.rand(n) + 0.1).astype(np.float32)
+
+    got = float(auroc(jnp.asarray(preds), jnp.asarray(target), sample_weights=weights.tolist()))
+    want = sk_roc_auc_score(target, preds, sample_weight=weights)
+    assert abs(got - want) < 1e-5
+
+    # the max_fpr + weights combination goes through the same cumulants
+    partial_val = float(
+        auroc(jnp.asarray(preds), jnp.asarray(target), sample_weights=weights.tolist(), max_fpr=0.5)
+    )
+    assert 0.0 <= partial_val <= 1.0
