@@ -103,8 +103,13 @@ def _sorted_tie_groups(preds: jax.Array, rel: jax.Array, weight: jax.Array = Non
         key_s, packed_s = lax.sort((key, packed), num_keys=1, is_stable=False)
         pos_w = (packed_s == 3.0).astype(jnp.float32)  # rel=1, w=1
         neg_w = (packed_s == 2.0).astype(jnp.float32)  # rel=0, w=1
-    tps = jnp.cumsum(pos_w)
-    fps = jnp.cumsum(neg_w)
+    # count in i32 (exact to 2^31), not f32: an f32 cumsum of {0,1} sticks at
+    # 2^24 — every later element adds 1.0 to 16777216.0 and rounds back down,
+    # so any class with >16.7M members silently flatlines its cumulant. The
+    # i32→f32 convert AFTER accumulation only rounds each value (≤0.5 ulp,
+    # relative ~6e-8 past the boundary), it cannot stick.
+    tps = jnp.cumsum(pos_w.astype(jnp.int32)).astype(jnp.float32)
+    fps = jnp.cumsum(neg_w.astype(jnp.int32)).astype(jnp.float32)
 
     boundary = key_s[1:] != key_s[:-1]
     is_first = jnp.concatenate([jnp.ones((1,), bool), boundary])

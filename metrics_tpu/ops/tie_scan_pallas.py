@@ -75,7 +75,7 @@ def _flat_cummax(v):
     return jnp.maximum(v, t)
 
 
-def _tie_scan_kernel(key_ref, pay_ref, out_ref, carry_ref, lastkey_ref):
+def _tie_scan_kernel(key_ref, pay_ref, out_ref, cnt_ref, carry_ref, lastkey_ref):
     b = pl.program_id(0)
 
     k = key_ref[...]
@@ -85,15 +85,21 @@ def _tie_scan_kernel(key_ref, pay_ref, out_ref, carry_ref, lastkey_ref):
 
     @pl.when(b == 0)
     def _init():
-        for i in range(6):
+        cnt_ref[0] = jnp.int32(0)
+        cnt_ref[1] = jnp.int32(0)
+        for i in range(4):
             carry_ref[i] = jnp.float32(0.0)
         # differ from the stream's first key so element 0 opens a group
         lastkey_ref[0] = ~k[0, 0]
 
-    c_tps = carry_ref[0]
-    c_fps = carry_ref[1]
-    c_mt = carry_ref[2]
-    c_mf = carry_ref[3]
+    # count carries live in i32: an f32 carry sticks at 2^24 (block sums of
+    # ~32k stay exact, but 16777216.0 + small-block remainders round away
+    # one element at a time once a class crosses 16.7M). The i32→f32
+    # convert below only rounds (≤0.5 ulp), it cannot stick.
+    c_tps = cnt_ref[0].astype(jnp.float32)
+    c_fps = cnt_ref[1].astype(jnp.float32)
+    c_mt = carry_ref[0]
+    c_mf = carry_ref[1]
 
     # flattened exclusive prefix counts, lane scan on the MXU:
     # incl[r, j] = sum_{i<=j} x[r, i]  via  x @ upper-triangular ones
@@ -127,19 +133,22 @@ def _tie_scan_kernel(key_ref, pay_ref, out_ref, carry_ref, lastkey_ref):
     prec = ctps_prev / jnp.maximum(ctps_prev + cfps_prev, 1.0)
     ap_term = jnp.where(is_first, (ctps_prev - mt) * prec, 0.0)
 
-    new_tps = c_tps + jnp.sum(pos)
-    new_fps = c_fps + jnp.sum(neg)
+    # block sums are ≤ 32768 and integer-valued in f32 — the i32 cast is exact
+    new_tps_i = cnt_ref[0] + jnp.sum(pos).astype(jnp.int32)
+    new_fps_i = cnt_ref[1] + jnp.sum(neg).astype(jnp.int32)
+    new_tps = new_tps_i.astype(jnp.float32)
+    new_fps = new_fps_i.astype(jnp.float32)
     new_mt = jnp.maximum(c_mt, jnp.max(v))
     new_mf = jnp.maximum(c_mf, jnp.max(w))
 
-    new_area = carry_ref[4] + jnp.sum(chord)
-    new_ap = carry_ref[5] + jnp.sum(ap_term)
-    carry_ref[0] = new_tps
-    carry_ref[1] = new_fps
-    carry_ref[2] = new_mt
-    carry_ref[3] = new_mf
-    carry_ref[4] = new_area
-    carry_ref[5] = new_ap
+    new_area = carry_ref[2] + jnp.sum(chord)
+    new_ap = carry_ref[3] + jnp.sum(ap_term)
+    cnt_ref[0] = new_tps_i
+    cnt_ref[1] = new_fps_i
+    carry_ref[0] = new_mt
+    carry_ref[1] = new_mf
+    carry_ref[2] = new_area
+    carry_ref[3] = new_ap
     lastkey_ref[0] = k[_ROWS - 1, _LANES - 1]
 
     # every step writes the as-if-final values (closing the currently-open
@@ -193,7 +202,8 @@ def tie_group_reduce(key_s: jax.Array, payload_s: jax.Array, interpret: bool = F
         out_specs=pl.BlockSpec((8, _LANES), lambda b: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((8, _LANES), jnp.float32),
         scratch_shapes=[
-            pltpu.SMEM((6,), jnp.float32),
+            pltpu.SMEM((2,), jnp.int32),  # exact tps/fps count carries
+            pltpu.SMEM((4,), jnp.float32),  # mt, mf, area, ap carries
             pltpu.SMEM((1,), jnp.uint32),
         ],
         interpret=interpret,

@@ -190,19 +190,28 @@ def _fz_retrieval(rng, M):
         total += n
         sh.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
         ex.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    # Exception, not ValueError: acceptance parity means ANY failure mode
+    # must match between the sharded and exact paths — a different exception
+    # type from one side is a divergence to count, not a fuzzer crash
+    # (matches the net used by _fz_curves and fuzz_parity)
     try:
         want = ex.compute()
         ex_err = None
-    except ValueError as err:
+    except Exception as err:
         want, ex_err = None, err
     try:
         got = sh.compute()
         sh_err = None
-    except ValueError as err:
+    except Exception as err:
         got, sh_err = None, err
     if (ex_err is None) != (sh_err is None):
         return f"acceptance: sharded={sh_err!r} exact={ex_err!r}", None, 0
     if ex_err is not None:
+        # both raised — but a different exception TYPE from the sharded side
+        # (e.g. TypeError vs the exact path's legitimate ValueError) is a
+        # sharded-path bug, not a shared rejection
+        if type(ex_err) is not type(sh_err):
+            return f"acceptance type: sharded={sh_err!r} exact={ex_err!r}", None, 0
         return None, None, 0  # both rejected (e.g. empty_target_action paths)
     return got, want, 1e-6
 

@@ -51,11 +51,25 @@ def test_bleu_score(weights, n_gram, smooth_func, smooth, atol):
         smoothing_function=smooth_func,
     )
     output = bleu_score([HYPOTHESIS1], [[REFERENCE1, REFERENCE2, REFERENCE3]], n_gram=n_gram, smooth=smooth)
-    assert np.allclose(np.asarray(output), nltk_output, atol=atol)
+    _assert_close(output, nltk_output, atol, smooth)
 
     nltk_output = corpus_bleu(LIST_OF_REFERENCES, HYPOTHESES, weights=weights, smoothing_function=smooth_func)
     output = bleu_score(HYPOTHESES, LIST_OF_REFERENCES, n_gram=n_gram, smooth=smooth)
-    assert np.allclose(np.asarray(output), nltk_output, atol=atol)
+    _assert_close(output, nltk_output, atol, smooth)
+
+
+def _assert_close(output, nltk_output, atol, smooth):
+    """Smooth rows must show the known divergence, not merely fall inside a
+    tolerance wide enough to accept either smoothing convention: the
+    reference smooths the unigram too (add-1 raises a <1 precision), nltk's
+    method2 leaves it unsmoothed — so our score sits strictly ABOVE nltk's,
+    by less than the tolerance. Exact parity is pinned separately against
+    the reference library in tests/test_reference_parity.py."""
+    diff = float(np.asarray(output)) - float(nltk_output)
+    if smooth:
+        assert 0 < diff < atol, (diff, atol)
+    else:
+        assert abs(diff) < atol, (diff, atol)
 
 
 def test_bleu_empty():

@@ -1,0 +1,41 @@
+"""Fixed-seed fuzz smoke in the default suite.
+
+The long differential sweeps stay manual (`make fuzz`, `make fuzz-sharded` —
+~1,000/200 trials), but a NEW divergence class should fail CI within one
+round, not wait for the next manual sweep: these run the same fuzzers at
+small N with a pinned seed, as subprocesses so the reference-library install
+(sys.path/sys.modules shims in fuzz_parity._install_reference) never touches
+the pytest process.
+"""
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, trials):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", script),
+         "--trials", str(trials), "--seed", "7"],
+        capture_output=True,
+        text=True,
+        timeout=900,
+        cwd=REPO,
+        env=dict(os.environ),  # inherits the suite's virtual-device XLA_FLAGS
+    )
+    assert proc.returncode == 0, (
+        f"{script} exit={proc.returncode}\n{proc.stdout[-2000:]}\n{proc.stderr[-800:]}"
+    )
+    return proc.stdout
+
+
+def test_fuzz_parity_smoke():
+    out = _run("fuzz_parity.py", 50)
+    # exit code guards mismatches; the summary line guards a silent no-op run
+    assert "50 trials" in out and "0 MISMATCHES" in out, out[-500:]
+
+
+def test_fuzz_sharded_smoke():
+    out = _run("fuzz_sharded.py", 20)
+    assert "20 trials" in out and "0 MISMATCHES" in out, out[-500:]

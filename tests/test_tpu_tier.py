@@ -30,6 +30,13 @@ EXPECTED_CHECKS = {
     "cohen_kappa_quadratic",
     "psnr_minmax_states",
     "embedding_similarity_matmul",
+    "adv_auroc_signed_zero",
+    "adv_auroc_inf_scores",
+    "adv_auroc_tie_storm",
+    "adv_ap_tie_storm",
+    "adv_auroc_degenerate_nan",
+    "adv_auroc_permutation_invariance",
+    "adv_auroc_2p24_counts",
 }
 
 
@@ -50,7 +57,9 @@ def test_child_protocol_and_oracles_cpu():
     for line in lines:
         parts = line.split()
         if parts and parts[0] == "CHECK":
-            checks[parts[1]] = (float(parts[2]), float(parts[4]))
+            # CHECK <name> <abs_err> <tol> <want_min> <want_max> <n>
+            assert len(parts) == 7, line
+            checks[parts[1]] = (float(parts[2]), float(parts[3]))
     assert any(line.startswith("PLATFORM cpu") for line in lines)
     assert "DONE" in proc.stdout
     assert set(checks) == EXPECTED_CHECKS
@@ -63,6 +72,7 @@ def test_parent_refuses_cpu_and_partial_runs(monkeypatch, tmp_path):
     import tpu_correctness as tier
 
     monkeypatch.setattr(tier, "ARTIFACT", str(tmp_path / "TPU_TEST.json"))
+    monkeypatch.setattr(tier, "LAST_GOOD", str(tmp_path / "TPU_TEST_last_good.json"))
 
     # probe down -> error artifact, no checks
     monkeypatch.setattr(tier, "_probe_accelerator", lambda *a, **k: False)
@@ -79,13 +89,13 @@ def test_parent_refuses_cpu_and_partial_runs(monkeypatch, tmp_path):
 
     cases = [
         # cpu platform must not be ok even with all checks passing
-        ("PLATFORM cpu\nCHECK accuracy 0.0 0.5 1e-6\nDONE\n", False),
+        ("PLATFORM cpu\nCHECK accuracy 0.0 1e-6 0.5 0.5 1\nDONE\n", False),
         # a failing check fails the run
-        ("PLATFORM tpu\nCHECK accuracy 0.5 0.5 1e-6\nDONE\n", False),
+        ("PLATFORM tpu\nCHECK accuracy 0.5 1e-6 0.5 0.5 1\nDONE\n", False),
         # an incomplete run (no DONE: child died mid-way) fails the run
-        ("PLATFORM tpu\nCHECK accuracy 0.0 0.5 1e-6\n", False),
+        ("PLATFORM tpu\nCHECK accuracy 0.0 1e-6 0.5 0.5 1\n", False),
         # complete passing tpu run is ok
-        ("PLATFORM tpu\nCHECK accuracy 0.0 0.5 1e-6\nDONE\n", True),
+        ("PLATFORM tpu\nCHECK accuracy 0.0 1e-6 0.5 0.5 1\nDONE\n", True),
     ]
     monkeypatch.setattr(tier, "_probe_accelerator", lambda *a, **k: True)
     for stdout, want_ok in cases:
@@ -94,3 +104,12 @@ def test_parent_refuses_cpu_and_partial_runs(monkeypatch, tmp_path):
         saved = json.loads((tmp_path / "TPU_TEST.json").read_text())
         assert saved["ok"] is want_ok, (stdout, saved)
         assert code == (0 if want_ok else 1)
+
+    # a green run lands in LAST_GOOD; a later failed run carries it forward
+    # instead of clobbering the evidence
+    good = json.loads((tmp_path / "TPU_TEST_last_good.json").read_text())
+    assert good["ok"] is True and good["platform"] == "tpu"
+    monkeypatch.setattr(tier, "_probe_accelerator", lambda *a, **k: False)
+    assert tier.main() == 2
+    saved = json.loads((tmp_path / "TPU_TEST.json").read_text())
+    assert saved["ok"] is False and saved["last_good"]["ok"] is True
