@@ -43,6 +43,12 @@ from metrics_tpu.ops.auroc_kernel import (
     masked_binary_auroc,
     masked_binary_average_precision,
 )
+from metrics_tpu.parallel.sample_sort import (
+    _no_samplesort,
+    host_sample_sort_auroc_ap,
+    sample_sort_auroc_ap,
+    use_host_twin,
+)
 from metrics_tpu.parallel.sharded_metric import (  # noqa: F401  (re-exported for tests/users)
     ShardedStreamsMixin,
     _default_mesh,
@@ -215,6 +221,20 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
         mask = np.asarray(mask)
         return np.asarray(preds)[mask], np.asarray(target)[mask]
 
+    def _shard_triples(self):
+        """Per-device ``(preds_shard, target_shard, fill)`` triples for the
+        host sample-sort twin, in mesh-axis order (shard start offset)."""
+        def by_start(shards):
+            return sorted(shards, key=lambda s: s.index[0].start or 0)
+
+        p_shards = by_start(self.buf_preds.addressable_shards)
+        t_shards = by_start(self.buf_target.addressable_shards)
+        c_shards = by_start(self.counts.addressable_shards)
+        return [
+            (np.asarray(p.data), np.asarray(t.data), int(np.asarray(c.data)[0]))
+            for p, t, c in zip(p_shards, t_shards, c_shards)
+        ]
+
 
 class _ShardedOVRMetric(ShardedCurveMetric):
     """Shared init/compute for scalar one-vs-rest curve metrics: binary by
@@ -242,7 +262,30 @@ class _ShardedOVRMetric(ShardedCurveMetric):
         self.num_classes = num_classes
         self.average = average
 
+    # which of sample_sort's (auroc, ap) pair this metric reports
+    _samplesort_output: int = None
+
     def compute(self) -> jax.Array:
+        if (
+            not self.preds_suffix
+            and self._samplesort_output is not None
+            and self.world > 1
+            and not _no_samplesort()
+        ):
+            # the O(N/W)-per-device exact epilogue: splitter-based
+            # redistribution instead of gathering the whole stream to every
+            # device (see parallel/sample_sort.py). The host twin covers CPU
+            # backends when every shard is local; multi-host CPU falls
+            # through to the legacy gather
+            if use_host_twin() and self.n_processes == 1:
+                return host_sample_sort_auroc_ap(self._shard_triples(), self.pos_label)[
+                    self._samplesort_output
+                ]
+            if not use_host_twin():
+                return sample_sort_auroc_ap(
+                    self.buf_preds, self.buf_target, self.counts,
+                    self.mesh, self.axis_name, self.pos_label,
+                )[self._samplesort_output]
         preds, target, mask = self._gathered()
         if not self.preds_suffix:
             # the gathered stream is replicated; run the epilogue kernel on
@@ -292,6 +335,7 @@ class ShardedAUROC(_ShardedOVRMetric):
 
     _masked_kernel = staticmethod(masked_binary_auroc)
     _host_kernel = staticmethod(host_masked_binary_auroc)
+    _samplesort_output = 0
 
 
 class ShardedAveragePrecision(_ShardedOVRMetric):
@@ -311,6 +355,7 @@ class ShardedAveragePrecision(_ShardedOVRMetric):
 
     _masked_kernel = staticmethod(masked_binary_average_precision)
     _host_kernel = staticmethod(host_masked_binary_average_precision)
+    _samplesort_output = 1
 
 
 class ShardedROC(ShardedCurveMetric):

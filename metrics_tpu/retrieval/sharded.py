@@ -16,11 +16,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
+from metrics_tpu.ops.auroc_kernel import _use_host_sort
+from metrics_tpu.parallel.sample_sort import _no_samplesort, sample_sort_retrieval
 from metrics_tpu.parallel.sharded_metric import ShardedStreamsMixin, replica0
-from metrics_tpu.retrieval.mean_average_precision import RetrievalMAP
-from metrics_tpu.retrieval.mean_reciprocal_rank import RetrievalMRR
-from metrics_tpu.retrieval.precision import RetrievalPrecision
-from metrics_tpu.retrieval.recall import RetrievalRecall
+from metrics_tpu.retrieval.mean_average_precision import RetrievalMAP, _map_segments
+from metrics_tpu.retrieval.mean_reciprocal_rank import RetrievalMRR, _mrr_segments
+from metrics_tpu.retrieval.precision import RetrievalPrecision, _precision_segments
+from metrics_tpu.retrieval.recall import RetrievalRecall, _recall_segments
 from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
 from metrics_tpu.utilities.checks import _check_retrieval_inputs
 
@@ -70,7 +72,25 @@ class ShardedRetrievalMetric(ShardedStreamsMixin, RetrievalMetric):
         idx, preds, target = _check_retrieval_inputs(idx, preds, target, ignore=self.exclude)
         self._append_streams(idx.flatten(), preds.flatten(), target.flatten())
 
+    # module-level (scorer_fn, static_kwargs) for the distributed sample-sort
+    # epilogue; None on subclasses without a vectorized scorer
+    def _samplesort_scorer(self):
+        return None
+
     def compute(self) -> jax.Array:
+        scorer = self._samplesort_scorer()
+        if scorer is not None and self.world > 1 and not _use_host_sort() and not _no_samplesort():
+            # accelerator meshes: redistribute by query id and score each
+            # query on the device that owns its range — O(N/world) per
+            # device, no replication (parallel/sample_sort.py). CPU backends
+            # keep the gather path below: its epilogue is already one host
+            # radix sort, and host callbacks cannot run inside collectives.
+            fn, static = scorer
+            return sample_sort_retrieval(
+                self.buf_idx, self.buf_preds, self.buf_target, self.counts,
+                self.mesh, self.axis_name, fn, static,
+                self.empty_target_action, self.exclude,
+            )
         (idx, preds, target), mask = self._gather_streams()
         # buffer-slot validity folds into _compute_from_arrays' single
         # host-side filter pass (query-id densification is host-side anyway);
@@ -94,6 +114,9 @@ class ShardedRetrievalMAP(ShardedRetrievalMetric, RetrievalMAP):
         0.7083
     """
 
+    def _samplesort_scorer(self):
+        return _map_segments, ()
+
 
 class ShardedRetrievalMRR(ShardedRetrievalMetric, RetrievalMRR):
     """Mean reciprocal rank over queries, sharded bounded accumulation.
@@ -107,6 +130,9 @@ class ShardedRetrievalMRR(ShardedRetrievalMetric, RetrievalMRR):
         >>> round(float(m.compute()), 4)
         0.6667
     """
+
+    def _samplesort_scorer(self):
+        return _mrr_segments, ()
 
 
 class ShardedRetrievalPrecision(ShardedRetrievalMetric, RetrievalPrecision):
@@ -122,6 +148,9 @@ class ShardedRetrievalPrecision(ShardedRetrievalMetric, RetrievalPrecision):
         0.25
     """
 
+    def _samplesort_scorer(self):
+        return _precision_segments, (("k", self.k),)
+
 
 class ShardedRetrievalRecall(ShardedRetrievalMetric, RetrievalRecall):
     """Recall@k over queries, sharded bounded accumulation.
@@ -135,3 +164,6 @@ class ShardedRetrievalRecall(ShardedRetrievalMetric, RetrievalRecall):
         >>> round(float(m.compute()), 4)
         0.5
     """
+
+    def _samplesort_scorer(self):
+        return _recall_segments, (("k", self.k),)

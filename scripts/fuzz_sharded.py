@@ -246,8 +246,73 @@ def _fz_binned(rng, M):
     return b.compute(), ex.compute(), 1e-6
 
 
+def _fz_samplesort_spmd(rng, M):
+    """The pure-SPMD sample-sort programs (all_to_all redistribution) vs the
+    replicated exact metrics. compute() on this CPU backend dispatches to
+    the host twin, so without this domain the shard_map path would only be
+    fuzzed on real accelerator meshes."""
+    from metrics_tpu.parallel.sample_sort import sample_sort_auroc_ap
+
+    cap = int(rng.choice([16, 64]))
+    sh = M.ShardedAUROC(capacity_per_device=cap)
+    ex_a, ex_p = M.AUROC(), M.AveragePrecision()
+    for n in _batches(rng, cap * WORLD):
+        p, t = _tied_scores(rng, n), rng.randint(2, size=n)
+        t[:2] = [0, 1]  # both classes present: exact modules never reject
+        sh.update(jnp.asarray(p), jnp.asarray(t))
+        ex_a.update(jnp.asarray(p), jnp.asarray(t))
+        ex_p.update(jnp.asarray(p), jnp.asarray(t))
+    a, ap_v = sample_sort_auroc_ap(sh.buf_preds, sh.buf_target, sh.counts, sh.mesh, sh.axis_name)
+    got = np.asarray([float(a), float(ap_v)])
+    want = np.asarray([float(ex_a.compute()), float(ex_p.compute())])
+    return got, want, 1e-5
+
+
+def _fz_samplesort_retrieval(rng, M):
+    """Query-redistribution SPMD retrieval epilogue vs the replicated exact
+    metric (compute() on CPU keeps the gather path, so the shard_map
+    programs need their own fuzz domain)."""
+    from metrics_tpu.parallel.sample_sort import sample_sort_retrieval
+    from metrics_tpu.retrieval.mean_average_precision import _map_segments
+    from metrics_tpu.retrieval.mean_reciprocal_rank import _mrr_segments
+    from metrics_tpu.retrieval.precision import _precision_segments
+    from metrics_tpu.retrieval.recall import _recall_segments
+
+    cap = int(rng.choice([16, 64]))
+    name, scorer = [
+        ("MAP", _map_segments), ("MRR", _mrr_segments),
+        ("Precision", _precision_segments), ("Recall", _recall_segments),
+    ][rng.randint(4)]
+    static = ()
+    kw = {}
+    if name in ("Precision", "Recall"):
+        k = int(rng.randint(1, 5)) if rng.rand() < 0.7 else None
+        static, kw = (("k", k),), {"k": k}
+    action = ["skip", "neg", "pos"][rng.randint(3)]
+    sh = getattr(M, f"ShardedRetrieval{name}")(capacity_per_device=cap,
+                                               empty_target_action=action, **kw)
+    ex = getattr(M, f"Retrieval{name}")(empty_target_action=action, **kw)
+    total = 0
+    sizes = _batches(rng, cap * WORLD)
+    grand = sum(sizes)
+    for n in sizes:
+        q = rng.randint(4, size=n).astype(np.int32)
+        p = rng.permutation((np.arange(n) + total + 1).astype(np.float32) / (grand + 1))
+        t = rng.randint(2, size=n).astype(np.int32)
+        if rng.rand() < 0.3:
+            t[rng.rand(n) < 0.2] = -100  # excluded entries
+        total += n
+        sh.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+        ex.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    got = sample_sort_retrieval(sh.buf_idx, sh.buf_preds, sh.buf_target, sh.counts,
+                                sh.mesh, sh.axis_name, scorer, static, action)
+    return got, ex.compute(), 1e-6
+
+
 DOMAINS = {
     "sharded_auroc_binary": _fz_auroc_binary,
+    "sharded_samplesort_spmd": _fz_samplesort_spmd,
+    "sharded_samplesort_retrieval": _fz_samplesort_retrieval,
     "sharded_auroc_bf16": _fz_auroc_bf16,
     "sharded_auroc_ovr": _fz_auroc_ovr,
     "sharded_ap_binary": _fz_ap_binary,

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Tunnel watcher: probe until the remote TPU answers, then run the on-chip
+# correctness tier and the accelerator bench leg back-to-back (the tunnel
+# flaps as the day goes on — round 3 lost its green tier artifact to an
+# afternoon outage). Artifacts: TPU_TEST.json + TPU_TEST_last_good.json,
+# .bench_last_good.json. Exits after one green tier+bench pair.
+cd /root/repo
+log() { echo "[$(date -u +%H:%M:%SZ)] $*"; }
+TIER_OK=0
+BENCH_OK=0
+for i in $(seq 1 120); do
+  b=$(timeout 60 python -c "import bench; print(bench._probe_backend() or 'none')" 2>/dev/null | tail -1)
+  log "probe $i: backend=$b tier_ok=$TIER_OK bench_ok=$BENCH_OK"
+  if [ "$b" != "tpu" ]; then sleep 240; continue; fi
+  if [ "$TIER_OK" = 0 ]; then
+    log "running tier..."
+    if timeout 1200 python tpu_correctness.py > tier_watch.out 2>&1; then
+      TIER_OK=1; log "tier GREEN"
+    else
+      log "tier failed: $(tail -2 tier_watch.out | head -1)"
+    fi
+  fi
+  if [ "$BENCH_OK" = 0 ]; then
+    log "running bench..."
+    if timeout 1800 python bench.py > bench_watch.out 2>&1; then
+      grep -q '"platform": "tpu"' bench_watch.out && { BENCH_OK=1; log "bench TPU GREEN"; } || log "bench ran but platform != tpu"
+    else
+      log "bench failed"
+    fi
+  fi
+  [ "$TIER_OK" = 1 ] && [ "$BENCH_OK" = 1 ] && { log "both green, exiting"; exit 0; }
+  sleep 240
+done
+log "gave up after max probes"
+exit 1

@@ -1,0 +1,198 @@
+"""Exactness of the distributed sample-sort curve epilogue.
+
+Both implementations of the algorithm are pinned against sklearn and
+against each other on the 8-virtual-device mesh:
+
+* the SPMD programs (``sample_sort_auroc_ap``): pure-XLA shard_map — what
+  runs on TPU meshes, runnable (slowly) on the CPU mesh;
+* the host twin (``host_sample_sort_auroc_ap``): what CPU backends use.
+
+The properties that make the algorithm exact are each given an adversarial
+case: tie groups never straddle buckets (tie storm where every group spans
+many devices), the count-clamped bounds exclude padding but keep valid
+maximal-key elements (NaN scores), offsets are integers (signed zeros,
+pos_label), and empty/uneven shards contribute nothing.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from sklearn.metrics import average_precision_score, roc_auc_score
+
+import metrics_tpu as M
+from metrics_tpu.ops.auroc_kernel import masked_binary_auroc, masked_binary_average_precision
+from metrics_tpu.parallel.sample_sort import host_sample_sort_auroc_ap, sample_sort_auroc_ap
+
+WORLD = 8
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:WORLD]), ("data",))
+
+
+def _stage(mesh, preds, target, fills):
+    """Build sharded (capacity,) buffers + per-device counts from per-device
+    host rows — the raw state layout of ShardedCurveMetric, but with full
+    control over uneven fills."""
+    cap = preds.shape[1]
+    sharding = NamedSharding(mesh, P("data"))
+    bp = jax.device_put(jnp.asarray(preds.reshape(WORLD * cap)), sharding)
+    bt = jax.device_put(jnp.asarray(target.reshape(WORLD * cap)), sharding)
+    counts = jax.device_put(jnp.asarray(np.asarray(fills, np.int32)), sharding)
+    return bp, bt, counts
+
+
+def _valid(preds, target, fills):
+    ps = [preds[i, : fills[i]] for i in range(WORLD)]
+    ts = [target[i, : fills[i]] for i in range(WORLD)]
+    return np.concatenate(ps), np.concatenate(ts)
+
+
+def _both_paths(mesh, preds, target, fills, pos_label=1):
+    bp, bt, counts = _stage(mesh, preds, target, fills)
+    a_spmd, ap_spmd = sample_sort_auroc_ap(bp, bt, counts, mesh, "data", pos_label)
+    triples = [(preds[i], target[i], fills[i]) for i in range(WORLD)]
+    a_host, ap_host = host_sample_sort_auroc_ap(triples, pos_label)
+    return (float(a_spmd), float(ap_spmd)), (float(a_host), float(ap_host))
+
+
+@pytest.mark.parametrize("cap,fills", [
+    (512, [512] * 8),                       # full buffers
+    (512, [100, 512, 0, 37, 512, 1, 250, 8]),  # uneven + empty devices
+])
+def test_random_scores_match_sklearn(cap, fills):
+    rng = np.random.RandomState(11)
+    preds = rng.rand(WORLD, cap).astype(np.float32)
+    target = (rng.rand(WORLD, cap) < preds).astype(np.int32)
+    vp, vt = _valid(preds, target, fills)
+    want_a = roc_auc_score(vt, vp)
+    want_ap = average_precision_score(vt, vp)
+    (a_s, ap_s), (a_h, ap_h) = _both_paths(_mesh(), preds, target, fills)
+    assert abs(a_s - want_a) < 1e-5 and abs(a_h - want_a) < 1e-6
+    assert abs(ap_s - want_ap) < 1e-5 and abs(ap_h - want_ap) < 1e-6
+
+
+def test_tie_storm_groups_span_devices():
+    """6 distinct scores across 8 devices: every tie group spans every
+    device, and the splitters collapse onto tied keys."""
+    rng = np.random.RandomState(5)
+    preds = (rng.randint(6, size=(WORLD, 256)) / 6).astype(np.float32)
+    target = (rng.rand(WORLD, 256) < 0.4).astype(np.int32)
+    fills = [256] * 8
+    vp, vt = _valid(preds, target, fills)
+    want_a = roc_auc_score(vt, vp)
+    want_ap = average_precision_score(vt, vp)
+    (a_s, ap_s), (a_h, ap_h) = _both_paths(_mesh(), preds, target, fills)
+    assert abs(a_s - want_a) < 1e-5 and abs(a_h - want_a) < 1e-6
+    assert abs(ap_s - want_ap) < 1e-5 and abs(ap_h - want_ap) < 1e-6
+
+
+def test_signed_zero_and_inf_scores():
+    rng = np.random.RandomState(7)
+    preds = rng.randn(WORLD, 128).astype(np.float32)
+    target = (rng.rand(WORLD, 128) < 0.5).astype(np.int32)
+    preds[target == 1] = np.where(rng.rand(*preds[target == 1].shape) < 0.3, -0.0,
+                                  preds[target == 1]).astype(np.float32)
+    preds[:, 0] = np.inf
+    preds[:, 1] = -np.inf
+    fills = [128] * 8
+    vp, vt = _valid(preds, target, fills)
+    finite = np.where(np.isposinf(vp), 1e30, np.where(np.isneginf(vp), -1e30, vp))
+    # sklearn rejects inf; rank-equivalent finite stand-ins give the oracle.
+    # +0.0 and -0.0 compare equal in float order, so the stand-in is exact.
+    want_a = roc_auc_score(vt, finite)
+    (a_s, _), (a_h, _) = _both_paths(_mesh(), preds, target, fills)
+    assert abs(a_s - want_a) < 1e-5 and abs(a_h - want_a) < 1e-6
+
+
+def test_nan_scores_match_masked_kernel():
+    """Valid elements with NaN scores share the maximal key with padding;
+    the count clamp must keep them (they count) and drop padding (inert).
+    Oracle: the replicated masked kernel on the concatenated stream."""
+    rng = np.random.RandomState(9)
+    preds = rng.rand(WORLD, 64).astype(np.float32)
+    preds[:, 5] = np.nan
+    target = (rng.rand(WORLD, 64) < 0.5).astype(np.int32)
+    fills = [64, 32, 64, 6, 64, 64, 40, 64]
+    vp, vt = _valid(preds, target, fills)
+    mask = jnp.ones(vp.shape[0], bool)
+    want_a = float(masked_binary_auroc(jnp.asarray(vp), jnp.asarray(vt), mask))
+    want_ap = float(masked_binary_average_precision(jnp.asarray(vp), jnp.asarray(vt), mask))
+    (a_s, ap_s), (a_h, ap_h) = _both_paths(_mesh(), preds, target, fills)
+    assert abs(a_s - want_a) < 1e-6 and abs(a_h - want_a) < 1e-6
+    assert abs(ap_s - want_ap) < 1e-6 and abs(ap_h - want_ap) < 1e-6
+
+
+def test_pos_label_zero():
+    rng = np.random.RandomState(13)
+    preds = rng.rand(WORLD, 200).astype(np.float32)
+    target = (rng.rand(WORLD, 200) < 0.5).astype(np.int32)
+    fills = [200] * 8
+    vp, vt = _valid(preds, target, fills)
+    want = roc_auc_score(1 - vt, vp)
+    (a_s, _), (a_h, _) = _both_paths(_mesh(), preds, target, fills, pos_label=0)
+    assert abs(a_s - want) < 1e-5 and abs(a_h - want) < 1e-6
+
+
+def test_degenerate_single_class_is_nan():
+    rng = np.random.RandomState(3)
+    preds = rng.rand(WORLD, 32).astype(np.float32)
+    target = np.ones((WORLD, 32), np.int32)
+    (a_s, ap_s), (a_h, ap_h) = _both_paths(_mesh(), preds, target, [32] * 8)
+    assert np.isnan(a_s) and np.isnan(a_h)
+    assert not np.isnan(ap_s) and not np.isnan(ap_h)  # all-positive: AP defined (=1)
+    target0 = np.zeros((WORLD, 32), np.int32)
+    (a_s, ap_s), (a_h, ap_h) = _both_paths(_mesh(), preds, target0, [32] * 8)
+    assert np.isnan(a_s) and np.isnan(a_h) and np.isnan(ap_s) and np.isnan(ap_h)
+
+
+def test_module_routes_through_sample_sort(monkeypatch):
+    """ShardedAUROC/AveragePrecision compute() uses the sample-sort epilogue
+    (host twin on this CPU backend) and still equals sklearn; the env escape
+    hatch restores the legacy gather path with the same value."""
+    rng = np.random.RandomState(21)
+    n = WORLD * 500
+    p = rng.rand(n).astype(np.float32)
+    t = (rng.rand(n) < p).astype(np.int32)
+
+    m = M.ShardedAUROC(capacity_per_device=512)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    calls = {}
+    import metrics_tpu.classification.sharded as sh
+
+    orig = sh.host_sample_sort_auroc_ap
+
+    def spy(*a, **k):
+        calls["hit"] = True
+        return orig(*a, **k)
+
+    monkeypatch.setattr(sh, "host_sample_sort_auroc_ap", spy)
+    got = float(m.compute())
+    assert calls.get("hit"), "sample-sort epilogue was not used"
+    assert abs(got - roc_auc_score(t, p)) < 1e-6
+
+    monkeypatch.setenv("METRICS_TPU_NO_SAMPLESORT", "1")
+    m._computed = None
+    legacy = float(m.compute())
+    assert abs(legacy - got) < 1e-6
+
+    ap = M.ShardedAveragePrecision(capacity_per_device=512)
+    ap.update(jnp.asarray(p), jnp.asarray(t))
+    monkeypatch.delenv("METRICS_TPU_NO_SAMPLESORT")
+    assert abs(float(ap.compute()) - average_precision_score(t, p)) < 1e-6
+
+
+def test_spmd_slot_growth_recompiles_correctly():
+    """Two fills differing enough to change the padded slot size both give
+    exact answers (distinct program B compilations)."""
+    mesh = _mesh()
+    rng = np.random.RandomState(17)
+    for cap, fill in [(256, 17), (256, 256)]:
+        preds = rng.rand(WORLD, cap).astype(np.float32)
+        target = (rng.rand(WORLD, cap) < 0.5).astype(np.int32)
+        fills = [fill] * 8
+        vp, vt = _valid(preds, target, fills)
+        want = roc_auc_score(vt, vp)
+        (a_s, _), _ = _both_paths(mesh, preds, target, fills)
+        assert abs(a_s - want) < 1e-5

@@ -1,0 +1,160 @@
+"""Exactness of the distributed retrieval sample-sort epilogue.
+
+The SPMD programs redistribute by QUERY id (a query is one key, so no
+query ever splits across devices), rank + score locally with the same
+segment arithmetic as ``ops/segment.ranked_group_stats``, and psum the
+query-mean. On this CPU backend the module ``compute()`` keeps the legacy
+gather path (host radix epilogue), so these tests drive the SPMD function
+directly on the virtual mesh — the same call an accelerator mesh makes.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+from metrics_tpu.parallel.sample_sort import sample_sort_retrieval
+from metrics_tpu.retrieval.mean_average_precision import _map_segments
+from metrics_tpu.retrieval.mean_reciprocal_rank import _mrr_segments
+from metrics_tpu.retrieval.precision import _precision_segments
+from metrics_tpu.retrieval.recall import _recall_segments
+
+WORLD = 8
+
+
+def _spmd(m, scorer, static=(), action="skip", exclude=-100):
+    return float(
+        sample_sort_retrieval(
+            m.buf_idx, m.buf_preds, m.buf_target, m.counts,
+            m.mesh, m.axis_name, scorer, static, action, exclude,
+        )
+    )
+
+
+def _fill(m, ex, rng, n, n_queries, all_positive_rate=0.12):
+    """Unique scores (rank ties are order-dependent across layouts) and
+    queries scattered over every device."""
+    q = rng.randint(n_queries, size=n).astype(np.int32)
+    p = rng.permutation(n).astype(np.float32) / n
+    t = (rng.rand(n) < all_positive_rate + 0.3).astype(np.int32)
+    m.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    if ex is not None:
+        ex.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    return q, p, t
+
+
+@pytest.mark.parametrize("cls,ex_cls,scorer,static", [
+    (M.ShardedRetrievalMAP, M.RetrievalMAP, _map_segments, ()),
+    (M.ShardedRetrievalMRR, M.RetrievalMRR, _mrr_segments, ()),
+    (M.ShardedRetrievalPrecision, M.RetrievalPrecision, _precision_segments, (("k", 3),)),
+    (M.ShardedRetrievalRecall, M.RetrievalRecall, _recall_segments, (("k", 3),)),
+])
+def test_spmd_matches_replicated(cls, ex_cls, scorer, static):
+    rng = np.random.RandomState(4)
+    kw = {"k": 3} if static else {}
+    m = cls(capacity_per_device=256, **kw)
+    ex = ex_cls(**kw)
+    _fill(m, ex, rng, WORLD * 200, n_queries=37)
+    got = _spmd(m, scorer, static)
+    want = float(ex.compute())
+    assert abs(got - want) < 1e-6, (got, want)
+    # and the legacy gather path of the same module agrees
+    legacy = float(m.compute())
+    assert abs(legacy - want) < 1e-6
+
+
+def test_uneven_fills_and_many_devices_per_query():
+    """3 distinct queries across 8 devices: every query spans many devices
+    before redistribution; accumulate over multiple uneven batches."""
+    rng = np.random.RandomState(9)
+    m = M.ShardedRetrievalMAP(capacity_per_device=64)
+    ex = M.RetrievalMAP()
+    for n in (WORLD * 4, WORLD * 17, WORLD * 2):
+        q = rng.randint(3, size=n).astype(np.int32)
+        p = (rng.permutation(n) + rng.rand()).astype(np.float32)
+        t = (rng.rand(n) < 0.4).astype(np.int32)
+        m.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+        ex.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    got = _spmd(m, _map_segments)
+    want = float(ex.compute())
+    assert abs(got - want) < 1e-6, (got, want)
+
+
+def test_excluded_targets_leave_rank_space():
+    """ignore-valued targets must not occupy rank positions (the legacy
+    path filters them before ranking; the SPMD path routes them to the
+    sentinel bucket)."""
+    rng = np.random.RandomState(2)
+    m = M.ShardedRetrievalMAP(capacity_per_device=64)
+    ex = M.RetrievalMAP()
+    n = WORLD * 32
+    q = rng.randint(5, size=n).astype(np.int32)
+    p = rng.permutation(n).astype(np.float32) / n
+    t = rng.randint(2, size=n).astype(np.int32)
+    t[rng.rand(n) < 0.25] = -100
+    m.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    ex.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    got = _spmd(m, _map_segments)
+    want = float(ex.compute())
+    assert abs(got - want) < 1e-6, (got, want)
+
+
+@pytest.mark.parametrize("action", ["skip", "pos", "neg"])
+def test_empty_target_actions(action):
+    rng = np.random.RandomState(7)
+    m = M.ShardedRetrievalMAP(capacity_per_device=64, empty_target_action=action)
+    ex = M.RetrievalMAP(empty_target_action=action)
+    n = WORLD * 32
+    q = rng.randint(6, size=n).astype(np.int32)
+    p = rng.permutation(n).astype(np.float32) / n
+    t = rng.randint(2, size=n).astype(np.int32)
+    t[np.isin(q, [1, 4])] = 0  # two queries with no positive target
+    m.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    ex.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    got = _spmd(m, _map_segments, action=action)
+    want = float(ex.compute())
+    assert abs(got - want) < 1e-6, (action, got, want)
+
+
+def test_empty_target_error_raises():
+    rng = np.random.RandomState(3)
+    m = M.ShardedRetrievalMAP(capacity_per_device=16, empty_target_action="error")
+    n = WORLD * 8
+    q = rng.randint(4, size=n).astype(np.int32)
+    p = rng.permutation(n).astype(np.float32) / n
+    t = np.zeros(n, np.int32)
+    t[q != 2] = rng.randint(2, size=(q != 2).sum())
+    t[q == 2] = 0  # query 2 has no positives
+    t[q == 0] = 1
+    m.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    with pytest.raises(ValueError, match="no positive target"):
+        _spmd(m, _map_segments, action="error")
+
+
+def test_tied_scores_match_legacy_rank_order():
+    """Equal scores within a query: the legacy path tie-breaks by gathered
+    buffer order; the SPMD path must reproduce that via its gpos tertiary
+    sort key, not all_to_all arrival order."""
+    rng = np.random.RandomState(31)
+    m = M.ShardedRetrievalMAP(capacity_per_device=64)
+    ex = M.RetrievalMAP()
+    n = WORLD * 48
+    q = rng.randint(6, size=n).astype(np.int32)
+    p = (rng.randint(3, size=n) / 3.0).astype(np.float32)  # massive ties
+    t = (rng.rand(n) < 0.5).astype(np.int32)
+    m.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    ex.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(t))
+    got = _spmd(m, _map_segments)
+    legacy = float(m.compute())
+    want = float(ex.compute())
+    assert abs(got - legacy) < 1e-6, (got, legacy)
+    assert abs(got - want) < 1e-6, (got, want)
+
+
+def test_all_queries_empty_skip_returns_zero():
+    m = M.ShardedRetrievalMAP(capacity_per_device=8)
+    n = WORLD * 4
+    q = np.arange(n).astype(np.int32) % 3
+    p = (np.arange(n) + 1).astype(np.float32) / n
+    m.update(jnp.asarray(q), jnp.asarray(p), jnp.asarray(np.zeros(n, np.int32)))
+    assert _spmd(m, _map_segments) == 0.0
