@@ -201,6 +201,9 @@ def _bench_sync_cpu() -> tuple:
     repo = os.path.dirname(os.path.abspath(__file__))
     code = f"""
 import os, time
+# a parent-exported escape hatch must not silently turn the sample-sort
+# leg into a second gather measurement
+os.environ.pop("METRICS_TPU_NO_SAMPLESORT", None)
 import numpy as np, jax.numpy as jnp
 from metrics_tpu import ShardedAUROC
 from sklearn.metrics import roc_auc_score

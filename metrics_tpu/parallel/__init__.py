@@ -7,3 +7,8 @@ from metrics_tpu.parallel.backend import (  # noqa: F401
     set_sync_backend,
 )
 from metrics_tpu.parallel.collective import masked_cat_sync, sync_array, sync_state  # noqa: F401
+from metrics_tpu.parallel.sample_sort import (  # noqa: F401
+    host_sample_sort_auroc_ap,
+    sample_sort_auroc_ap,
+    sample_sort_retrieval,
+)

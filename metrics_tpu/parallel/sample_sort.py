@@ -268,8 +268,9 @@ def _np_descending_key(p: np.ndarray) -> np.ndarray:
 
 
 def host_sample_sort_auroc_ap(shard_data, pos_label: int = 1):
-    """The CPU-backend twin: numpy radix sorts per shard + the identical
-    splitter/offset assembly, host-side.
+    """CPU-backend twin of :func:`sample_sort_auroc_ap` (numpy radix sorts).
+
+    Same splitter/offset assembly as the SPMD programs, host-side.
 
     ``shard_data`` is ``[(preds_shard, target_shard, fill_count), ...]`` —
     one entry per device shard. XLA:CPU's payload co-sort is ~100× slower

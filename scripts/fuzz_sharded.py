@@ -83,6 +83,21 @@ def _tied_scores(rng, n):
     return x.astype(np.float32)
 
 
+def _adversarial_scores(rng, n):
+    """Raw-float adversaries the module layer's probability validation
+    would reject: signed zeros, ±inf logits, subnormals, ties. For
+    kernel-level domains whose oracle is the host fp64 Mann-Whitney
+    computation, not a module update."""
+    x = rng.randn(n)
+    sel = rng.rand(n)
+    x[sel < 0.15] = 0.0
+    x[(sel >= 0.15) & (sel < 0.3)] = -0.0
+    x[(sel >= 0.3) & (sel < 0.35)] = np.inf
+    x[(sel >= 0.35) & (sel < 0.4)] = -np.inf
+    x[(sel >= 0.4) & (sel < 0.45)] = 1e-42  # subnormal
+    return x.astype(np.float32)
+
+
 def _fz_auroc_binary(rng, M):
     cap = int(rng.choice([16, 64]))
     sh = M.ShardedAUROC(capacity_per_device=cap)
@@ -309,9 +324,53 @@ def _fz_samplesort_retrieval(rng, M):
     return got, ex.compute(), 1e-6
 
 
+def _fz_samplesort_adversarial(rng, M):
+    """SPMD + host-twin sample sort on adversarial raw floats (signed
+    zeros, ±inf, subnormals, tie storms) vs the host fp64 Mann-Whitney
+    oracle, on hand-staged buffers with uneven fills — the module layer's
+    probability validation never sees these, so this domain feeds the
+    kernels directly."""
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from metrics_tpu.ops.auroc_kernel import (
+        _descending_key, _host_mw_auroc, _host_mw_average_precision)
+    from metrics_tpu.parallel.sample_sort import (
+        host_sample_sort_auroc_ap, sample_sort_auroc_ap)
+
+    cap = int(rng.choice([16, 64]))
+    mesh = Mesh(np.array(jax.devices()[:WORLD]), ("data",))
+    preds = np.stack([_adversarial_scores(rng, cap) for _ in range(WORLD)])
+    target = rng.randint(2, size=(WORLD, cap)).astype(np.int32)
+    fills = rng.randint(0, cap + 1, size=WORLD)
+    fills[rng.randint(WORLD)] = cap  # at least one full shard
+
+    sharding = NamedSharding(mesh, P("data"))
+    bp = jax.device_put(jnp.asarray(preds.reshape(-1)), sharding)
+    bt = jax.device_put(jnp.asarray(target.reshape(-1)), sharding)
+    counts = jax.device_put(jnp.asarray(fills.astype(np.int32)), sharding)
+
+    vp = np.concatenate([preds[i, : fills[i]] for i in range(WORLD)])
+    vt = np.concatenate([target[i, : fills[i]] for i in range(WORLD)])
+    key = np.asarray(_descending_key(jnp.asarray(vp)))
+    want = np.asarray([_host_mw_auroc(key, vt), _host_mw_average_precision(key, vt)])
+
+    a_s, ap_s = sample_sort_auroc_ap(bp, bt, counts, mesh, "data")
+    a_h, ap_h = host_sample_sort_auroc_ap(
+        [(preds[i], target[i], int(fills[i])) for i in range(WORLD)])
+    got = np.asarray([float(a_s), float(ap_s)])
+    got_h = np.asarray([float(a_h), float(ap_h)])
+    # NaN (degenerate single-class stream) must agree positionally
+    if not (np.array_equal(np.isnan(got), np.isnan(want))
+            and np.array_equal(np.isnan(got_h), np.isnan(want))):
+        return f"nan pattern: spmd={got} host={got_h} want={want}", None, 0
+    return np.concatenate([got, got_h]), np.concatenate([want, want]), 1e-5
+
+
 DOMAINS = {
     "sharded_auroc_binary": _fz_auroc_binary,
     "sharded_samplesort_spmd": _fz_samplesort_spmd,
+    "sharded_samplesort_adversarial": _fz_samplesort_adversarial,
     "sharded_samplesort_retrieval": _fz_samplesort_retrieval,
     "sharded_auroc_bf16": _fz_auroc_bf16,
     "sharded_auroc_ovr": _fz_auroc_ovr,

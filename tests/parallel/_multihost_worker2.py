@@ -87,6 +87,29 @@ def main(coordinator: str, num_processes: int, process_id: int, out_npz: str) ->
         got, want = float(sharded.compute()), float(local.compute())
         assert abs(got - want) < 1e-6, (sharded_cls.__name__, got, want)
 
+    # --- the sample-sort SPMD programs across the process boundary: the
+    # all_to_all spans DCN, and the host orchestration (splitter read,
+    # slot sizing off the replicated count matrix) must work when most of
+    # the mesh is non-addressable
+    from metrics_tpu.parallel.sample_sort import sample_sort_auroc_ap, sample_sort_retrieval
+    from metrics_tpu.retrieval.mean_reciprocal_rank import _mrr_segments
+
+    ss_a, ss_ap = sample_sort_auroc_ap(
+        sh_auroc.buf_preds, sh_auroc.buf_target, sh_auroc.counts, mesh, "data"
+    )
+    assert abs(float(ss_a) - roc_auc_score(flat_t, flat_p)) < 1e-6, float(ss_a)
+    assert abs(float(ss_ap) - average_precision_score(flat_t, flat_p)) < 1e-6, float(ss_ap)
+
+    sh_mrr = feed(M.ShardedRetrievalMRR(capacity_per_device=N // world, mesh=mesh), q_idx, preds, q_rel)
+    loc_mrr = M.RetrievalMRR(**no_sync)
+    for i in range(N // batch):
+        loc_mrr.update(jnp.asarray(q_idx[i]), jnp.asarray(preds[i]), jnp.asarray(q_rel[i]))
+    ss_mrr = float(sample_sort_retrieval(
+        sh_mrr.buf_idx, sh_mrr.buf_preds, sh_mrr.buf_target, sh_mrr.counts,
+        mesh, "data", _mrr_segments,
+    ))
+    assert abs(ss_mrr - float(loc_mrr.compute())) < 1e-6, ss_mrr
+
     # --- non-divisible global batch fails loudly on every process
     uneven = M.ShardedAUROC(capacity_per_device=8, mesh=mesh)
     try:

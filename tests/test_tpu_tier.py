@@ -20,6 +20,8 @@ EXPECTED_CHECKS = {
     "r2score_moments",
     "retrieval_map",
     "sharded_auroc_mesh",
+    "samplesort_spmd_auroc",
+    "samplesort_spmd_ap",
     "binned_auroc_histogram",
     "roc_curve_len",
     "roc_curve_fpr",

@@ -166,6 +166,17 @@ def _child() -> None:
     sh.update(jnp.asarray(scores), jnp.asarray(bt))
     check("sharded_auroc_mesh", float(sh.compute()), roc_auc_score(bt, scores), 1e-5)
 
+    # sample-sort SPMD programs on the chip (world=1 degenerate mesh): the
+    # all_to_all redistribution epilogue must lower and match on real TPU,
+    # not only on the virtual CPU mesh the test suite uses
+    from sklearn.metrics import average_precision_score
+
+    from metrics_tpu.parallel.sample_sort import sample_sort_auroc_ap
+
+    ss_a, ss_ap = sample_sort_auroc_ap(sh.buf_preds, sh.buf_target, sh.counts, sh.mesh, sh.axis_name)
+    check("samplesort_spmd_auroc", float(ss_a), roc_auc_score(bt, scores), 1e-5)
+    check("samplesort_spmd_ap", float(ss_ap), average_precision_score(bt, scores), 1e-5)
+
     # BinnedAUROC — exercises the TPU-only histogram formulation (chunked
     # one-hot contraction on the MXU; the CPU suite only ever runs the
     # scatter-add branch of ops/histogram.py). Scores quantized to the bin
@@ -291,7 +302,8 @@ def _child() -> None:
 
     # degenerate single-class input must surface NaN (not 0, not garbage)
     # under jit on the chip, as the CPU contract pins
-    got_deg = float(binary_auroc(jnp.asarray(zp[:2048]), jnp.ones(2048, np.int32)))
+    deg_n = min(2048, n_adv)
+    got_deg = float(binary_auroc(jnp.asarray(zp[:deg_n]), jnp.ones(deg_n, np.int32)))
     check("adv_auroc_degenerate_nan", float(np.isnan(got_deg)), 1.0, 0)
 
     # unstable-sort invariance: a permutation of the same stream must give
