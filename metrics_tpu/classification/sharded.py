@@ -94,6 +94,59 @@ def _average_ovr(
 
 
 @functools.lru_cache(maxsize=None)
+def _ovr_a2a_program(mesh: Mesh, axis: str, kernel, num_classes: int):
+    """One-vs-rest scores straight off the SAMPLE-sharded buffers: a class
+    transpose via ``all_to_all`` instead of replicating the whole stream.
+
+    The gather-based path (:func:`_ovr_program`) first replicates the full
+    ``(N, C)`` stream onto every device — O(N·C) received per device. Here
+    each device sends its row shard of class block ``d`` to device ``d``,
+    so a device receives the FULL rows of only its ``C/world`` classes:
+    O(N·C/world) + one tiny target gather. Class padding happens
+    shard-locally in-program (no host resharding), and pad classes yield
+    NaN per-class scores (all-zero one-vs-rest columns), sliced off by the
+    caller — identical semantics to the gather path.
+    """
+
+    def _local(bufp, buft, count):
+        world = jax.lax.axis_size(axis)
+        local_cap = bufp.shape[0]
+        padded = -(-num_classes // world) * world
+        n_local = padded // world
+        if padded != num_classes:
+            bufp = jnp.pad(bufp, ((0, 0), (0, padded - num_classes)))
+        # (local_cap, W, C/W) -> (W, local_cap, C/W); block d to device d;
+        # received blocks concat in rank order -> full rows of MY classes
+        blocks = bufp.reshape(local_cap, world, n_local).transpose(1, 0, 2)
+        recv = jax.lax.all_to_all(blocks, axis, split_axis=0, concat_axis=0, tiled=True)
+        preds_full = recv.reshape(world * local_cap, n_local)
+
+        tgt = jax.lax.all_gather(buft, axis, tiled=True)  # (N,) — rows, not N·C
+        cnts = jax.lax.all_gather(count, axis, tiled=True)  # (1,)/device -> (W,)
+        pos = jnp.arange(world * local_cap)
+        mask = (pos % local_cap) < jnp.minimum(cnts[pos // local_cap], local_cap)
+
+        first = jax.lax.axis_index(axis) * n_local
+        onehot = (tgt[:, None] == (first + jnp.arange(n_local))).astype(jnp.int32)
+        per_class = jax.vmap(kernel, in_axes=(1, 1, None))(preds_full, onehot, mask)
+        support = jnp.sum(onehot * mask[:, None].astype(jnp.int32), axis=0)
+        return (
+            jax.lax.all_gather(per_class, axis, tiled=True),
+            jax.lax.all_gather(support, axis, tiled=True),
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(P(axis), P(axis), P(axis)),
+            out_specs=(P(), P()),
+            check_vma=False,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
 def _ovr_program(mesh: Mesh, axis: str, kernel):
     """One-vs-rest scores with the **class axis sharded over the mesh**.
 
@@ -286,6 +339,17 @@ class _ShardedOVRMetric(ShardedCurveMetric):
                     self.buf_preds, self.buf_target, self.counts,
                     self.mesh, self.axis_name, self.pos_label,
                 )[self._samplesort_output]
+        if self.preds_suffix and self.world > 1 and not _no_samplesort():
+            # one-vs-rest without replicating the stream: class-transpose
+            # all_to_all straight off the sharded buffers — each device
+            # receives only its C/world class block (O(N·C/world), vs the
+            # gather path's O(N·C) onto every device)
+            num_classes = self.preds_suffix[0]
+            program = _ovr_a2a_program(self.mesh, self.axis_name, self._masked_kernel, num_classes)
+            per_class, support = program(self.buf_preds, self.buf_target, self.counts)
+            per_class = replica0(per_class)[:num_classes]
+            support = replica0(support)[:num_classes]
+            return _average_ovr(per_class, support, self.average, batch_local=self._batch_local_compute)
         preds, target, mask = self._gathered()
         if not self.preds_suffix:
             # the gathered stream is replicated; run the epilogue kernel on
@@ -297,8 +361,9 @@ class _ShardedOVRMetric(ShardedCurveMetric):
             if self._host_kernel is not None and _use_host_sort():
                 return self._host_kernel(replica0(preds), replica0(target), replica0(mask), self.pos_label)
             return self._masked_kernel(replica0(preds), replica0(target), replica0(mask), self.pos_label)
-        # shard the one-vs-rest class axis over the mesh: each device
-        # co-sorts only ceil(C/world) classes (pad classes give NaN per-class
+        # gather-everything OvR (the METRICS_TPU_NO_SAMPLESORT twin and the
+        # world==1 degenerate case): shard the one-vs-rest class axis over
+        # the mesh on the replicated stream (pad classes give NaN per-class
         # scores from their all-zero onehot columns and are sliced off)
         num_classes = self.preds_suffix[0]
         padded = -(-num_classes // self.world) * self.world

@@ -75,11 +75,17 @@ def _flat_cummax(v):
     return jnp.maximum(v, t)
 
 
-def _tie_scan_kernel(key_ref, pay_ref, out_ref, cnt_ref, carry_ref, lastkey_ref):
+def _tie_scan_kernel(key_ref, pay_ref, offs_ref, out_ref, cnt_ref, carry_ref, lastkey_ref):
     b = pl.program_id(0)
 
     k = key_ref[...]
     pay = pay_ref[...]
+    # global class counts BELOW this stream (the distributed sample-sort
+    # epilogue's lower buckets; zeros for a single-stream call). They enter
+    # ONLY the AP precision ratio — the area chord's offset term telescopes
+    # to off_p * n_neg and is corrected by the caller instead.
+    off_p = offs_ref[0]
+    off_n = offs_ref[1]
     pos = (pay == 3.0).astype(jnp.float32)  # rel=1, weight=1
     neg = (pay == 2.0).astype(jnp.float32)  # rel=0, weight=1
 
@@ -130,7 +136,7 @@ def _tie_scan_kernel(key_ref, pay_ref, out_ref, cnt_ref, carry_ref, lastkey_ref)
     mf = jnp.maximum(c_mf, _flat_shift1(_flat_cummax(w), fill=ninf))
 
     chord = jnp.where(is_first, 0.5 * (ctps_prev + mt) * (cfps_prev - mf), 0.0)
-    prec = ctps_prev / jnp.maximum(ctps_prev + cfps_prev, 1.0)
+    prec = (ctps_prev + off_p) / jnp.maximum(ctps_prev + cfps_prev + off_p + off_n, 1.0)
     ap_term = jnp.where(is_first, (ctps_prev - mt) * prec, 0.0)
 
     # block sums are ≤ 32768 and integer-valued in f32 — the i32 cast is exact
@@ -158,7 +164,9 @@ def _tie_scan_kernel(key_ref, pay_ref, out_ref, cnt_ref, carry_ref, lastkey_ref)
     mt_f = jnp.maximum(new_mt, 0.0)
     mf_f = jnp.maximum(new_mf, 0.0)
     area_f = new_area + 0.5 * (new_tps + mt_f) * (new_fps - mf_f)
-    ap_f = new_ap + (new_tps - mt_f) * (new_tps / jnp.maximum(new_tps + new_fps, 1.0))
+    ap_f = new_ap + (new_tps - mt_f) * (
+        (new_tps + off_p) / jnp.maximum(new_tps + new_fps + off_p + off_n, 1.0)
+    )
     orow = lax.broadcasted_iota(jnp.int32, (8, _LANES), 0)
     ocol = lax.broadcasted_iota(jnp.int32, (8, _LANES), 1)
     vals = jnp.where(
@@ -168,7 +176,9 @@ def _tie_scan_kernel(key_ref, pay_ref, out_ref, cnt_ref, carry_ref, lastkey_ref)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
-def tie_group_reduce(key_s: jax.Array, payload_s: jax.Array, interpret: bool = False) -> jax.Array:
+def tie_group_reduce(
+    key_s: jax.Array, payload_s: jax.Array, offsets: jax.Array = None, interpret: bool = False
+) -> jax.Array:
     """AUROC area + AP sum + class totals of a key-sorted weighted stream.
 
     Args:
@@ -178,11 +188,19 @@ def tie_group_reduce(key_s: jax.Array, payload_s: jax.Array, interpret: bool = F
             payload 3 (relevant, valid) and 2 (irrelevant, valid) move
             counts — 0/1 (weight-0) elements are inert, which is what makes
             tail padding free.
+        offsets: optional ``(2,)`` f32 ``[off_p, off_n]`` global class
+            counts in all strictly-lower key ranges (the distributed
+            sample-sort epilogue). They shift the AP precision ratio
+            in-kernel; the area stays LOCAL — its offset term telescopes,
+            so the caller adds ``off_p * n_neg`` instead.
 
     Returns:
         ``(4,)`` f32 ``[area, ap_sum, n_pos, n_neg]`` — the sufficient
-        statistics both score formulas normalize from.
+        statistics both score formulas normalize from (``area`` local, see
+        ``offsets``).
     """
+    if offsets is None:
+        offsets = jnp.zeros((2,), jnp.float32)
     n = key_s.shape[0]
     blk = _ROWS * _LANES
     nb = max(1, -(-n // blk))
@@ -198,6 +216,7 @@ def tie_group_reduce(key_s: jax.Array, payload_s: jax.Array, interpret: bool = F
         in_specs=[
             pl.BlockSpec((_ROWS, _LANES), lambda b: (b, 0)),
             pl.BlockSpec((_ROWS, _LANES), lambda b: (b, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((8, _LANES), lambda b: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((8, _LANES), jnp.float32),
@@ -207,7 +226,7 @@ def tie_group_reduce(key_s: jax.Array, payload_s: jax.Array, interpret: bool = F
             pltpu.SMEM((1,), jnp.uint32),
         ],
         interpret=interpret,
-    )(key2, pay2)
+    )(key2, pay2, offsets.astype(jnp.float32))
     return out[0, :4]
 
 

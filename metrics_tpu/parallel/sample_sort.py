@@ -77,7 +77,25 @@ def _tie_stats(key_s, pay_s, off_p, off_n):
     formulas (``_auroc_from_groups``/``_ap_from_groups``) need. Weight-0
     elements (payload < 2: mask-invalid or all-to-all padding) move no
     counts, identically to the masked single-chip kernel.
+
+    On TPU the whole post-sort epilogue is the single-pass Pallas tie
+    scan (``ops/tie_scan_pallas``, offset-aware since the sample-sort
+    extension) — Pallas is per-device code, legal inside ``shard_map``;
+    XLA's cumulative ops each lower to multi-pass programs. The area
+    offset term telescopes (Σ 0.5·(2·off_p)·ΔF = off_p·n_neg — the chord
+    carries a 0.5), so the local Pallas area only needs ``+ off_p·n_neg``
+    here.
     """
+    from metrics_tpu.ops.auroc_kernel import _use_pallas_epilogue
+
+    fo_p = off_p.astype(jnp.float32)
+    fo_n = off_n.astype(jnp.float32)
+    if _use_pallas_epilogue():
+        from metrics_tpu.ops.tie_scan_pallas import tie_group_reduce
+
+        stats = tie_group_reduce(key_s, pay_s, offsets=jnp.stack([fo_p, fo_n]))
+        area = stats[0] + fo_p * stats[3]
+        return area, stats[1], stats[2].astype(jnp.int32), stats[3].astype(jnp.int32)
     pos_w = (pay_s == 3.0).astype(jnp.float32)
     neg_w = (pay_s == 2.0).astype(jnp.float32)
     # i32 counting: exact to 2^31 (an f32 cumulant sticks at 2^24)
@@ -89,8 +107,6 @@ def _tie_stats(key_s, pay_s, off_p, off_n):
     tps_prev = lax.cummax(jnp.where(is_first, tps - pos_w, -jnp.inf))
     fps_prev = lax.cummax(jnp.where(is_first, fps - neg_w, -jnp.inf))
 
-    fo_p = off_p.astype(jnp.float32)
-    fo_n = off_n.astype(jnp.float32)
     # global chord: 0.5 * (T + T_prev + 2·off_p) * (F − F_prev) — the offset
     # cancels inside the width term, so only the height shifts
     area = jnp.sum(jnp.where(is_last, 0.5 * (tps + tps_prev + 2 * fo_p) * (fps - fps_prev), 0.0))

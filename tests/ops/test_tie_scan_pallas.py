@@ -161,3 +161,37 @@ def test_vmap_batches_classes():
     for ci in range(c):
         xa, _ = _xla_scores(probs[:, ci], (tc == ci).astype(int))
         assert abs(float(batched[ci]) - float(xa)) < 2e-6
+
+
+def test_offset_aware_ap_matches_xla_tie_stats(monkeypatch):
+    """The sample-sort extension: off_p/off_n shift the AP precision ratio
+    in-kernel, and the local area plus the telescoped off_p*n_neg term
+    equals the XLA offset formulation — so a mesh bucket computed by the
+    Pallas scan agrees with the pure-XLA _tie_stats bucket exactly."""
+    import metrics_tpu.ops.auroc_kernel as ak
+    from metrics_tpu.parallel.sample_sort import _tie_stats
+
+    # pin the reference to the XLA branch: on a TPU host _tie_stats would
+    # itself dispatch to the Pallas scan and this cross-check would compare
+    # the offset formula against itself
+    monkeypatch.setattr(ak, "_use_pallas_epilogue", lambda: False)
+
+    rng = np.random.RandomState(13)
+    for n, distinct in [(1000, 0), (3000, 5)]:  # distinct=5 -> tie storm
+        p = rng.rand(n).astype(np.float32)
+        if distinct:
+            p = (np.floor(p * distinct) / distinct).astype(np.float32)
+        rel = (rng.rand(n) < 0.4).astype(np.float32)
+        key_s, pay_s = lax.sort(
+            (_descending_key(jnp.asarray(p)), jnp.asarray(rel) + 2.0),
+            num_keys=1, is_stable=False,
+        )
+        for off_p, off_n in [(0, 0), (1234, 777), (10_000_000, 3)]:
+            want = _tie_stats(key_s, pay_s, jnp.int32(off_p), jnp.int32(off_n))
+            offs = jnp.asarray([off_p, off_n], jnp.float32)
+            stats = tie_group_reduce(key_s, pay_s, offsets=offs, interpret=True)
+            area = float(stats[0]) + off_p * float(stats[3])
+            assert np.isclose(area, float(want[0]), rtol=1e-6), (off_p, area, float(want[0]))
+            assert np.isclose(float(stats[1]), float(want[1]), rtol=1e-5), (
+                off_p, float(stats[1]), float(want[1]))
+            assert int(stats[2]) == int(want[2]) and int(stats[3]) == int(want[3])
