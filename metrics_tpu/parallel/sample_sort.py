@@ -241,6 +241,19 @@ def _next_pow2(n: int) -> int:
     return 1 << max(4, int(n - 1).bit_length())
 
 
+def _full_counts(arr: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
+    """``(world,)`` per-device counts marking every slot of ``arr`` valid —
+    the ``counts=None`` convenience for raw sharded eval-loop arrays."""
+    from jax.sharding import NamedSharding
+
+    world = mesh.shape[axis]
+    per_dev = arr.shape[0] // world
+    return jax.jit(
+        functools.partial(jnp.full, (world,), per_dev, jnp.int32),
+        out_shardings=NamedSharding(mesh, P(axis)),
+    )()
+
+
 def sample_sort_auroc_ap(
     preds: jax.Array,
     target: jax.Array,
@@ -253,12 +266,16 @@ def sample_sort_auroc_ap(
 
     Args:
         preds/target: ``(capacity,)`` streams sharded as ``P(axis)``.
-        counts: ``(world,)`` per-device fill counts, sharded as ``P(axis)``.
+        counts: ``(world,)`` per-device fill counts, sharded as ``P(axis)``,
+            or ``None`` when every slot is valid (the ad-hoc eval-loop
+            case: raw sharded batch arrays rather than metric buffers).
 
     The only host round-trip is reading program A's (W, W) count matrix to
     pick the static all-to-all slot size — the data itself never leaves the
     devices, and nothing is ever replicated at O(N).
     """
+    if counts is None:
+        counts = _full_counts(preds, mesh, axis)
     key_s, pay_s, splitters, counts_all = _program_a(mesh, axis)(
         preds, target, counts, jnp.int32(pos_label)
     )
@@ -569,7 +586,10 @@ def sample_sort_retrieval(
     ``(stats, **dict(scorer_static))`` — e.g.
     ``retrieval.mean_average_precision._map_segments``. Raises on
     ``action='error'`` with an empty-target query, like the legacy path.
+    ``counts=None`` marks every slot valid (raw eval-loop arrays).
     """
+    if counts is None:
+        counts = _full_counts(buf_idx, mesh, axis)
     qkey_s, preds_s, pay_s, gpos_s, splitters, counts_all = _retrieval_program_a(
         mesh, axis, int(exclude)
     )(buf_idx, buf_preds, buf_target, counts)

@@ -104,6 +104,11 @@ def _fz_auroc_binary(rng, M):
     ex = M.AUROC()
     for n in _batches(rng, cap * WORLD):
         p, t = _tied_scores(rng, n), rng.randint(2, size=n)
+        # both classes present: the exact module RAISES on single-class
+        # streams (reference contract) while Sharded* documents
+        # NaN-under-jit — a deliberate acceptance difference, not a fuzz
+        # target (the adversarial domain covers degenerate streams)
+        t[:2] = [0, 1]
         sh.update(jnp.asarray(p), jnp.asarray(t))
         ex.update(jnp.asarray(p), jnp.asarray(t))
     return sh.compute(), ex.compute(), 1e-6
@@ -115,6 +120,11 @@ def _fz_auroc_bf16(rng, M):
     ex = M.AUROC()
     for n in _batches(rng, cap * WORLD):
         p, t = _tied_scores(rng, n), rng.randint(2, size=n)
+        # both classes present: the exact module RAISES on single-class
+        # streams (reference contract) while Sharded* documents
+        # NaN-under-jit — a deliberate acceptance difference, not a fuzz
+        # target (the adversarial domain covers degenerate streams)
+        t[:2] = [0, 1]
         sh.update(jnp.asarray(p), jnp.asarray(t))
         # the documented contract: exact metric of the bf16-quantized scores
         ex.update(jnp.asarray(p).astype(jnp.bfloat16).astype(jnp.float32), jnp.asarray(t))
@@ -154,6 +164,11 @@ def _fz_ap_binary(rng, M):
     ex = M.AveragePrecision()
     for n in _batches(rng, cap * WORLD):
         p, t = _tied_scores(rng, n), rng.randint(2, size=n)
+        # both classes present: the exact module RAISES on single-class
+        # streams (reference contract) while Sharded* documents
+        # NaN-under-jit — a deliberate acceptance difference, not a fuzz
+        # target (the adversarial domain covers degenerate streams)
+        t[:2] = [0, 1]
         sh.update(jnp.asarray(p), jnp.asarray(t))
         ex.update(jnp.asarray(p), jnp.asarray(t))
     return sh.compute(), ex.compute(), 1e-6

@@ -183,6 +183,21 @@ def test_module_routes_through_sample_sort(monkeypatch):
     assert abs(float(ap.compute()) - average_precision_score(t, p)) < 1e-6
 
 
+def test_counts_none_marks_everything_valid():
+    """counts=None: raw sharded eval-loop arrays, no fill bookkeeping."""
+    mesh = _mesh()
+    rng = np.random.RandomState(23)
+    n = WORLD * 300
+    p = rng.rand(n).astype(np.float32)
+    t = (rng.rand(n) < p).astype(np.int32)
+    sharding = NamedSharding(mesh, P("data"))
+    bp = jax.device_put(jnp.asarray(p), sharding)
+    bt = jax.device_put(jnp.asarray(t), sharding)
+    a, ap = sample_sort_auroc_ap(bp, bt, None, mesh, "data")
+    assert abs(float(a) - roc_auc_score(t, p)) < 1e-5
+    assert abs(float(ap) - average_precision_score(t, p)) < 1e-5
+
+
 def test_spmd_slot_growth_recompiles_correctly():
     """Two fills differing enough to change the padded slot size both give
     exact answers (distinct program B compilations)."""
