@@ -1,4 +1,4 @@
-.PHONY: test test-tpu doctest bench dryrun fuzz fuzz-sharded clean
+.PHONY: test test-tpu test-tpu-suite doctest bench dryrun fuzz fuzz-sharded clean
 
 test:
 	# full suite: sklearn/scipy oracles + package doctests + 8-virtual-device
@@ -11,6 +11,13 @@ test-tpu:
 	# azure-pipelines.yml:59). Opt-in, probe-gated, timeout-hardened; writes
 	# TPU_TEST.json. Exits non-zero if any check fails or the chip is gone.
 	python tpu_correctness.py
+
+test-tpu-suite:
+	# chip-hosted run of the real suite (single-device subset: ops,
+	# regression, retrieval, classification) — the analog of the reference
+	# running its whole suite on CUDA (azure-pipelines.yml:59). Chunked and
+	# tunnel-hardened; writes TPU_SUITE.json (+ _last_good on green).
+	python scripts/tpu_suite.py
 
 doctest:
 	# standalone doctest run (the default `make test` already includes these

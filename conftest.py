@@ -9,6 +9,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+if os.environ.get("METRICS_TPU_TEST_PLATFORM", "cpu") == "cpu":
+    # see tests/conftest.py: the chip-hosted suite tier keeps the
+    # accelerator backend instead of the deterministic local CPU pin
+    # ("cpu" = the runner's protocol smoke mode, which still pins)
+    jax.config.update("jax_platforms", "cpu")
 
 collect_ignore = ["setup.py"]

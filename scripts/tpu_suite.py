@@ -1,0 +1,191 @@
+"""Chip-hosted run of the real test suite (single-device-meaningful subset).
+
+The on-chip correctness tier (`tpu_correctness.py`) is ~25 representative
+checks; the reference's accelerator CI runs its *entire* suite on CUDA every
+pass (`/root/reference/azure-pipelines.yml:59`). This runner closes that gap:
+it executes `tests/ops tests/regression tests/retrieval tests/classification`
+— the single-device-meaningful subset (tests/parallel needs the 8-device
+virtual mesh; tests/bases is backend-independent runtime plumbing) — with the
+real accelerator as the JAX backend (`METRICS_TPU_TEST_PLATFORM=tpu`, see
+`tests/conftest.py`).
+
+Tunnel-hardened like everything else on this host: the remote-TPU tunnel
+flaps, so the run is CHUNKED (one pytest invocation per directory, per-file
+for the big classification tree), each chunk under its own timeout, and the
+artifact (`TPU_SUITE.json`) is rewritten after every chunk — a mid-run
+tunnel death keeps every chunk that finished. Green runs mirror to the
+git-tracked `TPU_SUITE_last_good.json`; a failed artifact carries the last
+good one (same contract as TPU_TEST.json / .bench_last_good.json).
+
+Exit 0 iff every chunk ran to completion with 0 failures/errors on the
+accelerator platform.
+"""
+import glob
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
+
+from bench import _probe_backend  # noqa: E402
+
+ARTIFACT = os.path.join(HERE, "TPU_SUITE.json")
+LAST_GOOD = os.path.join(HERE, "TPU_SUITE_last_good.json")
+# per-chunk ceilings, not a whole-run budget: first-compile on the chip is
+# slow (~20-40s/program) but cached afterwards (.jax_cache), so early chunks
+# pay most of the cost
+CHUNK_TIMEOUT = float(os.environ.get("TPU_SUITE_CHUNK_TIMEOUT", 1500))
+
+_SUMMARY_RE = re.compile(r"(\d+) (passed|failed|skipped|error(?:s)?|xfailed|xpassed)")
+
+
+# excluded from the chip tier, with reasons (recorded in the artifact so a
+# green run does not overclaim):
+EXCLUDED = {
+    "tests/parallel": "needs the 8-device virtual CPU mesh",
+    "tests/bases": "backend-independent runtime plumbing (pure-Python Metric mechanics)",
+    "tests/integrations": "optax training-loop integration on the virtual mesh",
+    "tests/test_doctests.py": "whole-package doctest sweep; latency-prohibitive through the tunnel",
+    "tests/test_reference_parity.py": "differential vs torch CPU reference; our side re-covered by family suites",
+    "tests/test_fuzz_smoke.py tests/test_bench.py tests/test_tpu_tier.py tests/test_api_surface.py "
+    "tests/test_import.py tests/test_utilities.py": "harness/self-tests, backend-independent",
+}
+
+
+def _chunks():
+    """Small directories whole; the 2k-test classification tree per-file."""
+    chunks = ["tests/ops", "tests/regression", "tests/retrieval", "tests/functional", "tests/wrappers"]
+    chunks += sorted(glob.glob(os.path.join(HERE, "tests/classification/test_*.py")))
+    return [os.path.relpath(c, HERE) if os.path.isabs(c) else c for c in chunks]
+
+
+def _run_chunk(chunk: str) -> dict:
+    env = dict(os.environ, METRICS_TPU_TEST_PLATFORM=os.environ.get("TPU_SUITE_PLATFORM", "tpu"))
+    # the suite conftest must not pin local CPU; drop the force-CPU escape
+    # hatches other harness layers export
+    for k in ("BENCH_FORCE_CPU", "TPU_TEST_FORCE_CPU"):
+        env.pop(k, None)
+    t0 = time.time()
+    entry = {"chunk": chunk}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", chunk, "-q", "--no-header", "-p", "no:cacheprovider"],
+            capture_output=True,
+            text=True,
+            timeout=CHUNK_TIMEOUT,
+            cwd=HERE,
+            env=env,
+        )
+        out = proc.stdout
+        counts = {}
+        # the summary is the last line matching "N passed, M skipped ..."
+        for line in reversed(out.splitlines()):
+            found = _SUMMARY_RE.findall(line)
+            if found:
+                counts = {kind.rstrip("s"): int(n) for n, kind in found}
+                break
+        entry.update(
+            returncode=proc.returncode,
+            seconds=round(time.time() - t0, 1),
+            **{k: counts.get(k, 0) for k in ("passed", "failed", "skipped", "error")},
+        )
+        # returncode 0 = all green; 5 = no tests collected (treat as empty,
+        # not failure); anything else with no parsed failures means the run
+        # died before the summary (import error, backend assert) — keep the
+        # tail as evidence
+        if proc.returncode not in (0, 5) and entry["failed"] == 0 and entry["error"] == 0:
+            entry["error"] = 1
+            entry["tail"] = (proc.stdout + proc.stderr)[-600:]
+        entry["complete"] = True
+    except subprocess.TimeoutExpired as err:
+        partial = err.stdout if isinstance(err.stdout, str) else (err.stdout or b"").decode(errors="replace")
+        entry.update(
+            complete=False,
+            timeout=CHUNK_TIMEOUT,
+            seconds=round(time.time() - t0, 1),
+            passed=partial.count("."),  # -q progress dots: rough floor
+            failed=partial.count("F"),
+            skipped=0,
+            error=1,
+        )
+    return entry
+
+
+def _write(result: dict) -> None:
+    if result.get("ok"):
+        result.pop("last_good", None)  # never nest prior artifacts into a green one
+        with open(LAST_GOOD, "w") as f:
+            json.dump(result, f, indent=1)
+    else:
+        try:
+            with open(LAST_GOOD) as f:
+                result["last_good"] = json.load(f)
+        except Exception:
+            result.pop("last_good", None)
+    with open(ARTIFACT, "w") as f:
+        json.dump(result, f, indent=1)
+
+
+def main() -> int:
+    result = {
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "platform": None,
+        "ok": False,
+        "complete": False,
+        "excluded": EXCLUDED,
+        "chunks": [],
+        "totals": {},
+    }
+
+    backend = _probe_backend()
+    result["platform"] = backend
+    want = os.environ.get("TPU_SUITE_PLATFORM", "tpu")
+    if backend != want:
+        result["error"] = f"accelerator probe saw {backend!r}, need {want!r} (tunnel down?)"
+        _write(result)
+        print(json.dumps(result))
+        return 2
+
+    # resume: a tunnel flap (or the watcher's outer timeout) kills the run
+    # mid-suite; green chunks from a prior same-platform run are carried so
+    # repeated invocations converge instead of restarting from chunk 1
+    done = {}
+    try:
+        with open(ARTIFACT) as f:
+            prior = json.load(f)
+        if prior.get("platform") == want:
+            done = {
+                c["chunk"]: dict(c, cached=True)
+                for c in prior.get("chunks", [])
+                if c.get("complete") and c.get("failed", 1) == 0 and c.get("error", 1) == 0
+            }
+    except Exception:
+        pass
+
+    chunks = _chunks()
+    for i, chunk in enumerate(chunks):
+        entry = done.get(chunk) or _run_chunk(chunk)
+        result["chunks"].append(entry)
+        totals = {k: sum(c.get(k, 0) for c in result["chunks"]) for k in ("passed", "failed", "skipped", "error")}
+        result["totals"] = totals
+        result["complete"] = all(c.get("complete") for c in result["chunks"]) and i == len(chunks) - 1
+        result["ok"] = result["complete"] and totals["failed"] == 0 and totals["error"] == 0 and totals["passed"] > 0
+        _write(result)  # incremental: every finished chunk survives a tunnel death
+        print(f"[{i + 1}/{len(chunks)}] {chunk}: {entry}", flush=True)
+        # a chunk that saw the backend die takes the rest of the run with it;
+        # probing again costs 45s only in the failure path
+        if not entry.get("complete") and _probe_backend() != want:
+            result["error"] = f"backend lost after chunk {chunk}"
+            _write(result)
+            break
+
+    print(json.dumps({k: result[k] for k in ("platform", "ok", "complete", "totals")}))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,16 +1,19 @@
 #!/bin/bash
 # Tunnel watcher: probe until the remote TPU answers, then run the on-chip
-# correctness tier and the accelerator bench leg back-to-back (the tunnel
-# flaps as the day goes on — round 3 lost its green tier artifact to an
-# afternoon outage). Artifacts: TPU_TEST.json + TPU_TEST_last_good.json,
-# .bench_last_good.json. Exits after one green tier+bench pair.
+# correctness tier, the accelerator bench leg, and the chip-hosted test
+# suite back-to-back (the tunnel flaps as the day goes on — round 3 lost
+# its green tier artifact to an afternoon outage; round 4 never saw the
+# chip because the watcher started an hour after the tunnel died).
+# Artifacts: TPU_TEST.json + TPU_TEST_last_good.json, .bench_last_good.json,
+# TPU_SUITE.json + TPU_SUITE_last_good.json. Exits after all three go green.
 cd /root/repo
 log() { echo "[$(date -u +%H:%M:%SZ)] $*"; }
 TIER_OK=0
 BENCH_OK=0
-for i in $(seq 1 120); do
+SUITE_OK=0
+for i in $(seq 1 160); do
   b=$(timeout 60 python -c "import bench; print(bench._probe_backend() or 'none')" 2>/dev/null | tail -1)
-  log "probe $i: backend=$b tier_ok=$TIER_OK bench_ok=$BENCH_OK"
+  log "probe $i: backend=$b tier_ok=$TIER_OK bench_ok=$BENCH_OK suite_ok=$SUITE_OK"
   if [ "$b" != "tpu" ]; then sleep 240; continue; fi
   if [ "$TIER_OK" = 0 ]; then
     log "running tier..."
@@ -22,13 +25,21 @@ for i in $(seq 1 120); do
   fi
   if [ "$BENCH_OK" = 0 ]; then
     log "running bench..."
-    if timeout 1800 python bench.py > bench_watch.out 2>&1; then
+    if timeout 2400 python bench.py > bench_watch.out 2>&1; then
       grep -q '"platform": "tpu"' bench_watch.out && { BENCH_OK=1; log "bench TPU GREEN"; } || log "bench ran but platform != tpu"
     else
       log "bench failed"
     fi
   fi
-  [ "$TIER_OK" = 1 ] && [ "$BENCH_OK" = 1 ] && { log "both green, exiting"; exit 0; }
+  if [ "$SUITE_OK" = 0 ]; then
+    log "running chip-hosted suite (chunked)..."
+    if timeout 10800 python scripts/tpu_suite.py > suite_watch.out 2>&1; then
+      SUITE_OK=1; log "suite GREEN: $(tail -1 suite_watch.out)"
+    else
+      log "suite not green: $(tail -1 suite_watch.out)"
+    fi
+  fi
+  [ "$TIER_OK" = 1 ] && [ "$BENCH_OK" = 1 ] && [ "$SUITE_OK" = 1 ] && { log "all green, exiting"; exit 0; }
   sleep 240
 done
 log "gave up after max probes"

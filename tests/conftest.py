@@ -25,17 +25,31 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 
 import jax  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+# Chip-hosted suite tier (scripts/tpu_suite.py, analog of the reference
+# running its whole suite on CUDA at azure-pipelines.yml:59): when
+# METRICS_TPU_TEST_PLATFORM is set, keep the site hook's accelerator backend
+# instead of pinning local CPU, and hard-fail if the chip is not actually
+# the backend (a silent CPU fallback would fake green on-chip evidence).
+_SUITE_PLATFORM = os.environ.get("METRICS_TPU_TEST_PLATFORM")
+if not _SUITE_PLATFORM or _SUITE_PLATFORM == "cpu":
+    # "cpu" here = protocol smoke-testing of the suite runner without the
+    # accelerator; the pin must still go through jax.config (site hook)
+    jax.config.update("jax_platforms", "cpu")
 
 from metrics_tpu.utilities.jit import enable_persistent_cache  # noqa: E402
 
 enable_persistent_cache(os.environ["JAX_COMPILATION_CACHE_DIR"])
 
 
-def _assert_cpu():
+def _assert_platform():
     devs = jax.devices()
+    if _SUITE_PLATFORM and _SUITE_PLATFORM != "cpu":
+        assert devs[0].platform == _SUITE_PLATFORM, (
+            f"suite tier requires {_SUITE_PLATFORM}, got {devs}"
+        )
+        return
     assert devs[0].platform == "cpu", f"tests must run on local CPU, got {devs}"
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
 
 
-_assert_cpu()
+_assert_platform()
