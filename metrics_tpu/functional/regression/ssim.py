@@ -26,12 +26,41 @@ def _gaussian(kernel_size: int, sigma: float, dtype) -> jax.Array:
     return gauss / gauss.sum()  # (kernel_size,)
 
 
+# above this spatial extent the banded-matmul blur's O(H) MACs/output
+# overtake the conv's O(k); below it, the matmul path wins on both
+# backends (XLA:CPU's depthwise-conv lowering is ~13× slower at 128², and
+# the MXU runs a dense 128×128 contraction at full tilt where a depthwise
+# conv lowers to vector ops)
+_MATMUL_BLUR_MAX_DIM = 512
+
+
+def _blur_matrix(n: int, k: int, sigma: float, dtype) -> jax.Array:
+    """Banded ``(n-k+1, n)`` matrix applying a VALID k-tap Gaussian pass."""
+    g = _gaussian(k, sigma, dtype)
+    out = n - k + 1
+    idx = jnp.arange(out)[:, None] + jnp.arange(k)[None, :]
+    return jnp.zeros((out, n), dtype).at[jnp.arange(out)[:, None], idx].set(g)
+
+
 def _depthwise_blur(stack: jax.Array, kernel_size: Sequence[int], sigma: Sequence[float]) -> jax.Array:
     """Separable Gaussian blur of an ``(N, C, H, W)`` stack, VALID windows.
 
-    Two 1-d depthwise passes (H then W); the window normalizes to 1 per
-    axis, so the composition equals the full rank-1 k×k window.
+    Two 1-d passes (H then W); the window normalizes to 1 per axis, so the
+    composition equals the full rank-1 k×k window. Each pass is a banded
+    matrix contraction (typical image sizes) or a depthwise conv (large
+    spatial dims) — same values to f32 roundoff either way.
+
+    Full precision is pinned throughout: TPU matmuls/convs round f32
+    inputs to bf16 at default precision — a ~1e-3 hit on the SSIM index,
+    and this is a quality metric.
     """
+    h, w = stack.shape[2], stack.shape[3]
+    if max(h, w) <= _MATMUL_BLUR_MAX_DIM:
+        gh = _blur_matrix(h, kernel_size[0], sigma[0], stack.dtype)
+        stack = jnp.einsum("oh,nchw->ncow", gh, stack, precision=jax.lax.Precision.HIGHEST)
+        gw = _blur_matrix(w, kernel_size[1], sigma[1], stack.dtype)
+        return jnp.einsum("pw,nchw->nchp", gw, stack, precision=jax.lax.Precision.HIGHEST)
+
     channel = stack.shape[1]
     for axis, (k, s) in enumerate(zip(kernel_size, sigma)):
         g = _gaussian(k, s, stack.dtype)
@@ -43,9 +72,6 @@ def _depthwise_blur(stack: jax.Array, kernel_size: Sequence[int], sigma: Sequenc
             padding="VALID",
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
             feature_group_count=channel,
-            # TPU convs round f32 inputs to bf16 at default precision —
-            # a ~1e-3 hit on the SSIM index. This is a quality metric;
-            # full-precision windows cost nothing at 11-tap separable size.
             precision=jax.lax.Precision.HIGHEST,
         )
     return stack
