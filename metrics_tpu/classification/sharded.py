@@ -46,15 +46,40 @@ from metrics_tpu.ops.auroc_kernel import (
 from metrics_tpu.parallel.sample_sort import (
     _no_samplesort,
     host_sample_sort_auroc_ap,
+    host_sample_sort_auroc_ap_weighted,
     sample_sort_auroc_ap,
     use_host_twin,
 )
+
+
 from metrics_tpu.parallel.sharded_metric import (  # noqa: F401  (re-exported for tests/users)
     ShardedStreamsMixin,
     _default_mesh,
     _programs,
     replica0,
 )
+
+
+@jax.jit
+def _masked_weighted_auroc_ap(preds, target, mask, weights, pos_label):
+    """Single-replica weighted (AUROC, AP) of a masked gathered stream —
+    the sample-sort epilogue (`parallel/sample_sort._tie_stats_w`) with
+    zero bucket offsets; masked/padding slots carry payload 0 and weight 0,
+    so they move nothing."""
+    from metrics_tpu.ops.auroc_kernel import _descending_key
+    from metrics_tpu.parallel.sample_sort import _PAD_KEY, _tie_stats_w
+
+    key = jnp.where(mask, _descending_key(preds), _PAD_KEY)
+    rel = (target == pos_label).astype(jnp.float32)
+    pay = jnp.where(mask, rel + 2.0, 0.0)
+    w = jnp.where(mask, weights.astype(jnp.float32), 0.0)
+    key_s, inv_s, w_s = jax.lax.sort((key, 3.0 - pay, w), num_keys=2, is_stable=False)
+    pay_s = 3.0 - inv_s
+    zero = jnp.float32(0.0)
+    area, ap, w_pos, w_neg = _tie_stats_w(key_s, pay_s, w_s, zero, zero)
+    auroc = jnp.where(w_pos * w_neg == 0, jnp.nan, area / jnp.maximum(w_pos * w_neg, 1e-30))
+    ap_v = jnp.where(w_pos == 0, jnp.nan, ap / jnp.maximum(w_pos, 1e-30))
+    return auroc, ap_v
 
 
 def _average_ovr(
@@ -202,6 +227,11 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
             scores, ``(C,)`` for per-class score rows.
     """
 
+    # only the scalar one-vs-rest family implements the weighted epilogue;
+    # curve-shaped outputs (ROC/PRCurve) reject with_sample_weights at
+    # construction rather than crashing at compute
+    _supports_sample_weights = False
+
     def __init__(
         self,
         capacity_per_device: int,
@@ -211,29 +241,41 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
         target_dtype=jnp.int32,
         preds_dtype=jnp.float32,
         preds_suffix: Tuple[int, ...] = (),
+        with_sample_weights: bool = False,
         **kwargs: Any,
     ):
         """``preds_dtype=jnp.bfloat16`` halves buffer memory and all-gather
         bandwidth; scores quantize to bf16 on append, so ties coarsen to
         bf16 resolution (the curve kernels upcast keys exactly, so the
-        result is the exact metric of the quantized scores)."""
+        result is the exact metric of the quantized scores).
+
+        ``with_sample_weights=True`` reserves a third per-sample f32 weight
+        stream; every ``update`` must then pass ``sample_weights`` — the
+        sharded analog of the reference curve core's per-call weights
+        (``torchmetrics/functional/classification/precision_recall_curve.py:44-59``)."""
         super().__init__(compute_on_step=compute_on_step, **kwargs)
         self.preds_suffix = tuple(preds_suffix)
-        self._init_streams(
-            {"buf_preds": (preds_dtype, self.preds_suffix), "buf_target": (target_dtype, ())},
-            capacity_per_device,
-            mesh,
-            axis_name,
-        )
+        if with_sample_weights and not self._supports_sample_weights:
+            raise ValueError(
+                f"{type(self).__name__} does not support sample weights;"
+                " the scalar epilogue family (ShardedAUROC,"
+                " ShardedAveragePrecision) does"
+            )
+        self.with_sample_weights = bool(with_sample_weights)
+        streams = {"buf_preds": (preds_dtype, self.preds_suffix), "buf_target": (target_dtype, ())}
+        if self.with_sample_weights:
+            streams["buf_weights"] = (jnp.float32, ())
+        self._init_streams(streams, capacity_per_device, mesh, axis_name)
 
     def _sync_dist(self, dist_sync_fn=None) -> None:
         # sync happens inside compute() as an in-program XLA collective
         pass
 
-    def update(self, preds: jax.Array, target: jax.Array) -> None:
+    def update(self, preds: jax.Array, target: jax.Array, sample_weights=None) -> None:
         """Append a batch of ``(n, *preds_suffix)`` scores / ``(n,)`` targets,
         ``n`` divisible by the mesh-axis size (the usual SPMD batch
-        contract)."""
+        contract). ``sample_weights`` (``(n,)``, non-negative) is required
+        iff the metric was constructed ``with_sample_weights=True``."""
         # keep host inputs on host — _append_streams casts to the stream
         # dtypes and stages exactly once (multi-process staging needs host
         # arrays anyway)
@@ -241,6 +283,26 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
             preds = np.asarray(preds)
         if not hasattr(target, "shape"):
             target = np.asarray(target)
+        if self.with_sample_weights != (sample_weights is not None):
+            raise ValueError(
+                "pass `sample_weights` to every update iff the metric was"
+                f" constructed with_sample_weights=True (got"
+                f" with_sample_weights={self.with_sample_weights},"
+                f" sample_weights={'set' if sample_weights is not None else 'None'})"
+            )
+        if sample_weights is not None:
+            if not hasattr(sample_weights, "shape"):
+                sample_weights = np.asarray(sample_weights, np.float32)
+            if sample_weights.shape != (target.shape[0],):
+                raise ValueError(
+                    f"expected 1-d sample_weights of shape {(target.shape[0],)},"
+                    f" got {sample_weights.shape}"
+                )
+            # eager value probe (same discipline as the label-range check
+            # below): a negative weight breaks the monotone-cumulant design
+            lo = float(sample_weights.min()) if isinstance(sample_weights, np.ndarray) else float(jnp.min(sample_weights))
+            if not lo >= 0:  # catches NaN too
+                raise ValueError(f"sample_weights must be non-negative finite, got min {lo}")
         if target.ndim != 1 or preds.shape != (target.shape[0], *self.preds_suffix):
             shape_desc = "(n" + "".join(f", {d}" for d in self.preds_suffix) + ")"
             raise ValueError(
@@ -260,13 +322,16 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
                     f"target labels must lie in [0, {self.preds_suffix[0]})"
                     f" (the C dimension of preds); got range [{lo}, {hi}]"
                 )
-        self._append_streams(preds, target)
+        if sample_weights is not None:
+            self._append_streams(preds, target, sample_weights)
+        else:
+            self._append_streams(preds, target)
 
-    def _gathered(self) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    def _gathered(self) -> Tuple[jax.Array, ...]:
         """One all-gather: full ``(capacity, ...)`` streams + validity mask,
-        replicated on every device."""
-        (preds, target), mask = self._gather_streams()
-        return preds, target, mask
+        replicated on every device. ``(preds, target[, weights], mask)``."""
+        streams, mask = self._gather_streams()
+        return (*streams, mask)
 
     def _valid_host(self) -> Tuple[np.ndarray, np.ndarray]:
         """Materialize the valid samples on host, in device-rank order."""
@@ -288,6 +353,18 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
             for p, t, c in zip(p_shards, t_shards, c_shards)
         ]
 
+    def _shard_quads(self):
+        """``(preds, target, weights, fill)`` per device, for the weighted
+        host sample-sort twin."""
+        def by_start(shards):
+            return sorted(shards, key=lambda s: s.index[0].start or 0)
+
+        w_shards = by_start(self.buf_weights.addressable_shards)
+        return [
+            (p, t, np.asarray(w.data), c)
+            for (p, t, c), w in zip(self._shard_triples(), w_shards)
+        ]
+
 
 class _ShardedOVRMetric(ShardedCurveMetric):
     """Shared init/compute for scalar one-vs-rest curve metrics: binary by
@@ -297,6 +374,7 @@ class _ShardedOVRMetric(ShardedCurveMetric):
 
     _masked_kernel = None
     _host_kernel = None  # CPU epilogue twin (outside collectives only)
+    _supports_sample_weights = True  # binary-only, enforced in __init__
 
     def __init__(
         self,
@@ -310,6 +388,12 @@ class _ShardedOVRMetric(ShardedCurveMetric):
         if average not in allowed:
             raise ValueError(f"Argument `average` expected to be one of {allowed}, got {average}")
         suffix = () if num_classes in (None, 1) else (num_classes,)
+        if kwargs.get("with_sample_weights") and suffix:
+            raise ValueError(
+                "sample weights are supported on binary score streams only"
+                " (num_classes=None); the one-vs-rest class transpose does"
+                " not carry a weight operand yet"
+            )
         super().__init__(capacity_per_device, preds_suffix=suffix, **kwargs)
         self.pos_label = pos_label
         self.num_classes = num_classes
@@ -319,6 +403,8 @@ class _ShardedOVRMetric(ShardedCurveMetric):
     _samplesort_output: int = None
 
     def compute(self) -> jax.Array:
+        if self.with_sample_weights:
+            return self._compute_weighted()
         if (
             not self.preds_suffix
             and self._samplesort_output is not None
@@ -374,6 +460,33 @@ class _ShardedOVRMetric(ShardedCurveMetric):
         per_class, support = program(preds, target, mask)
         per_class, support = replica0(per_class)[:num_classes], replica0(support)[:num_classes]
         return _average_ovr(per_class, support, self.average, batch_local=self._batch_local_compute)
+
+    def _compute_weighted(self) -> jax.Array:
+        """Weighted epilogue dispatch (binary streams only, enforced at
+        construction) — same backend split as the unweighted path: SPMD
+        sample-sort on accelerator meshes, fp64 host twin on single-process
+        CPU, gathered single-replica epilogue otherwise."""
+        out = self._samplesort_output
+        if self.world > 1 and not _no_samplesort():
+            if use_host_twin() and self.n_processes == 1:
+                return host_sample_sort_auroc_ap_weighted(self._shard_quads(), self.pos_label)[out]
+            if not use_host_twin():
+                return sample_sort_auroc_ap(
+                    self.buf_preds, self.buf_target, self.counts,
+                    self.mesh, self.axis_name, self.pos_label,
+                    weights=self.buf_weights,
+                )[out]
+        preds, target, weights, mask = self._gathered()
+        if use_host_twin():
+            # single shard-free fp64 path on the replicated gather
+            m = np.asarray(replica0(mask))
+            quad = [(np.asarray(replica0(preds))[m], np.asarray(replica0(target))[m],
+                     np.asarray(replica0(weights))[m], int(m.sum()))]
+            return host_sample_sort_auroc_ap_weighted(quad, self.pos_label)[out]
+        return _masked_weighted_auroc_ap(
+            replica0(preds), replica0(target), replica0(mask), replica0(weights),
+            jnp.int32(self.pos_label),
+        )[out]
 
 
 class ShardedAUROC(_ShardedOVRMetric):

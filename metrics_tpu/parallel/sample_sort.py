@@ -26,7 +26,12 @@ so a tie group can never straddle two devices after redistribution, and
 bucket d's local stream is a contiguous segment of the global sorted
 stream. Global cumulative counts are then local cumulants + the class
 totals of all lower buckets (integers, psummed in i32), which is the same
-arithmetic the single-chip kernel does — no approximation anywhere.
+arithmetic the single-chip kernel does — no approximation anywhere in the
+*counting*. One bound on "exact": the i32 bucket offsets enter the area /
+AP ratio terms as f32 (``_tie_stats``), so past 2^24 elements per class
+the offset itself rounds (~6e-8 relative) — the count carries stay
+integer-exact, and the effect is far inside the 1e-5 parity tolerances;
+bit-level value parity past 2^24 would need split-f32 ratio arithmetic.
 
 Cost: per device O(cap) sort + O(N/W + skew) receive instead of O(N)
 receive; bytes on the wire drop from W·N (all-gather) to ~N (one
@@ -41,7 +46,7 @@ same split of responsibilities as ``ops/auroc_kernel._use_host_sort``, and
 the SPMD programs stay pure XLA so the TPU path holds inside collectives.
 """
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -117,17 +122,58 @@ def _tie_stats(key_s, pay_s, off_p, off_n):
     return area, ap, n_pos, n_neg
 
 
+def _tie_stats_w(key_s, pay_s, w_s, off_pw, off_nw):
+    """Weighted :func:`_tie_stats`: cumulants are f32 weight sums, offsets
+    are the weighted class totals of all strictly-lower buckets.
+
+    Same contiguous-segment argument as the unweighted path — a tie group
+    is one key, so per-group weighted cumulants + lower-bucket offsets ARE
+    the global weighted cumulants. Float prefix sums of non-negative
+    weights can dip by an ulp under XLA's reassociated scan; ``cummax``
+    repairs monotonicity exactly (same fix as the replicated weighted
+    curve, ``_sorted_cumulants_xla``). Weights must be non-negative —
+    enforced at update time by the sharded metrics. Invalid/padding slots
+    carry payload 0 AND weight 0, so they move nothing.
+
+    No Pallas branch: the weighted epilogue is XLA-only for now (the Pallas
+    tie scan carries i32 count cumulants; a weighted variant would need f32
+    carries — measured unnecessary at current sizes).
+    """
+    pos_w = jnp.where(pay_s == 3.0, w_s, 0.0)
+    neg_w = jnp.where(pay_s == 2.0, w_s, 0.0)
+    tws = lax.cummax(jnp.cumsum(pos_w))
+    fws = lax.cummax(jnp.cumsum(neg_w))
+    boundary = key_s[1:] != key_s[:-1]
+    is_first = jnp.concatenate([jnp.ones((1,), bool), boundary])
+    is_last = jnp.concatenate([boundary, jnp.ones((1,), bool)])
+    tws_prev = lax.cummax(jnp.where(is_first, tws - pos_w, -jnp.inf))
+    fws_prev = lax.cummax(jnp.where(is_first, fws - neg_w, -jnp.inf))
+
+    area = jnp.sum(jnp.where(is_last, 0.5 * (tws + tws_prev + 2 * off_pw) * (fws - fws_prev), 0.0))
+    # weighted totals can legitimately sit below 1.0 — an epsilon guard,
+    # not the count path's max(·, 1): a zero denominator only occurs when
+    # the numerator increment is zero too, so the term contributes 0 either way
+    prec = (tws + off_pw) / jnp.maximum(tws + fws + off_pw + off_nw, 1e-30)
+    ap = jnp.sum(jnp.where(is_last, (tws - tws_prev) * prec, 0.0))
+    return area, ap, tws[-1], fws[-1]
+
+
 @functools.lru_cache(maxsize=None)
-def _program_a(mesh: Mesh, axis: str):
+def _program_a(mesh: Mesh, axis: str, weighted: bool = False):
     """Local co-sort + splitter selection + per-bucket counts (one program).
 
-    Returns per-device ``(key_s, pay_s)`` (still sharded — program B's
-    input, so the sort happens once) and replicated ``(splitters, counts)``
-    where ``counts[i, d]`` is how many elements device ``i`` holds for
-    bucket ``d`` (the host reads S = max off this).
+    Returns per-device ``(key_s, pay_s[, w_s])`` (still sharded — program
+    B's input, so the sort happens once) and replicated ``(splitters,
+    counts)`` where ``counts[i, d]`` is how many elements device ``i``
+    holds for bucket ``d`` (the host reads S = max off this). With
+    ``weighted``, per-sample weights ride the sort as a passenger operand.
     """
 
-    def _local(preds, target, count, pos_label):
+    def _local(preds, target, *rest):
+        if weighted:
+            weights, count, pos_label = rest
+        else:
+            count, pos_label = rest
         world = lax.axis_size(axis)
         cap = preds.shape[0]
         key = _descending_key(preds)
@@ -141,7 +187,11 @@ def _program_a(mesh: Mesh, axis: str):
         key = jnp.where(valid, key, _PAD_KEY)
         rel = (target == pos_label).astype(jnp.float32)
         payload = jnp.where(valid, rel + 2.0, 0.0)
-        key_s, inv_s = lax.sort((key, 3.0 - payload), num_keys=2, is_stable=False)
+        if weighted:
+            w = jnp.where(valid, weights.astype(jnp.float32), 0.0)
+            key_s, inv_s, w_s = lax.sort((key, 3.0 - payload, w), num_keys=2, is_stable=False)
+        else:
+            key_s, inv_s = lax.sort((key, 3.0 - payload), num_keys=2, is_stable=False)
         pay_s = 3.0 - inv_s
 
         # R evenly-spaced samples from the valid prefix of the sorted run.
@@ -161,29 +211,39 @@ def _program_a(mesh: Mesh, axis: str):
                                   count[:1].astype(upper.dtype)])
         counts = (bounds[1:] - bounds[:-1]).astype(jnp.int32)
         counts_all = lax.all_gather(counts, axis)  # (W, W) replicated
+        if weighted:
+            return key_s, pay_s, w_s, splitters, counts_all
         return key_s, pay_s, splitters, counts_all
 
+    extra = (P(axis),) if weighted else ()
     return jax.jit(
         jax.shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P()),
-            out_specs=(P(axis), P(axis), P(), P()),
+            in_specs=(P(axis), P(axis), *extra, P(axis), P()),
+            out_specs=(P(axis), P(axis), *extra, P(), P()),
             check_vma=False,
         )
     )
 
 
 @functools.lru_cache(maxsize=None)
-def _program_b(mesh: Mesh, axis: str, slot: int):
+def _program_b(mesh: Mesh, axis: str, slot: int, weighted: bool = False):
     """Redistribute by key range (one all_to_all) + exact global epilogue.
 
     ``slot`` (static) is the padded per-(device,bucket) block size; every
     pair's real count fits by construction (host measured it off program
-    A's exact counts).
+    A's exact counts). With ``weighted``, weights ride a third
+    ``all_to_all`` and the epilogue computes f32 weighted cumulants
+    (:func:`_tie_stats_w`) — division guards switch from the count path's
+    ``max(·, 1)`` to an epsilon, since weighted totals can sit below 1.
     """
 
-    def _local(key_s, pay_s, count, splitters):
+    def _local(key_s, pay_s, *rest):
+        if weighted:
+            w_s, count, splitters = rest
+        else:
+            count, splitters = rest
         world = lax.axis_size(axis)
         cap = key_s.shape[0]
         # same count-clamped bounds as program A, so the slices match the
@@ -201,6 +261,32 @@ def _program_b(mesh: Mesh, axis: str, slot: int):
 
         recv_key = lax.all_to_all(send_key, axis, split_axis=0, concat_axis=0, tiled=True)
         recv_pay = lax.all_to_all(send_pay, axis, split_axis=0, concat_axis=0, tiled=True)
+
+        if weighted:
+            send_w = jnp.take(w_s, idx, mode="fill", fill_value=0.0)
+            recv_w = lax.all_to_all(send_w, axis, split_axis=0, concat_axis=0, tiled=True)
+            key_r, pay_r, w_r = lax.sort(
+                (recv_key.reshape(world * slot), recv_pay.reshape(world * slot),
+                 recv_w.reshape(world * slot)),
+                num_keys=1, is_stable=False,
+            )
+            # weighted class totals per bucket -> exclusive prefix offsets
+            my = lax.axis_index(axis)
+            pos_d = jnp.sum(jnp.where(pay_r == 3.0, w_r, 0.0))
+            neg_d = jnp.sum(jnp.where(pay_r == 2.0, w_r, 0.0))
+            totals = lax.all_gather(jnp.stack([pos_d, neg_d]), axis)  # (W, 2)
+            before = jnp.arange(world) < my
+            off_pw = jnp.sum(jnp.where(before, totals[:, 0], 0.0))
+            off_nw = jnp.sum(jnp.where(before, totals[:, 1], 0.0))
+
+            area, ap, _, _ = _tie_stats_w(key_r, pay_r, w_r, off_pw, off_nw)
+            area = lax.psum(area, axis)
+            ap_sum = lax.psum(ap, axis)
+            w_pos = jnp.sum(totals[:, 0])
+            w_neg = jnp.sum(totals[:, 1])
+            auroc = jnp.where(w_pos * w_neg == 0, jnp.nan, area / jnp.maximum(w_pos * w_neg, 1e-30))
+            ap_v = jnp.where(w_pos == 0, jnp.nan, ap_sum / jnp.maximum(w_pos, 1e-30))
+            return auroc, ap_v
 
         # local co-sort of the received disjoint key range (W sorted runs)
         key_r, pay_r = lax.sort(
@@ -226,11 +312,12 @@ def _program_b(mesh: Mesh, axis: str, slot: int):
         ap_v = jnp.where(n_pos == 0, jnp.nan, ap_sum / jnp.maximum(n_pos, 1.0))
         return auroc, ap_v
 
+    extra = (P(axis),) if weighted else ()
     return jax.jit(
         jax.shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis), P()),
+            in_specs=(P(axis), P(axis), *extra, P(axis), P()),
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -261,6 +348,7 @@ def sample_sort_auroc_ap(
     mesh: Mesh,
     axis: str,
     pos_label: int = 1,
+    weights: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Exact global (AUROC, AP) of a mesh-sharded fixed-capacity stream.
 
@@ -269,6 +357,10 @@ def sample_sort_auroc_ap(
         counts: ``(world,)`` per-device fill counts, sharded as ``P(axis)``,
             or ``None`` when every slot is valid (the ad-hoc eval-loop
             case: raw sharded batch arrays rather than metric buffers).
+        weights: optional ``(capacity,)`` non-negative per-sample weights,
+            sharded as ``P(axis)`` — the sharded analog of the reference
+            curve core's ``sample_weights``
+            (``torchmetrics/functional/classification/precision_recall_curve.py:44-59``).
 
     The only host round-trip is reading program A's (W, W) count matrix to
     pick the static all-to-all slot size — the data itself never leaves the
@@ -276,6 +368,12 @@ def sample_sort_auroc_ap(
     """
     if counts is None:
         counts = _full_counts(preds, mesh, axis)
+    if weights is not None:
+        key_s, pay_s, w_s, splitters, counts_all = _program_a(mesh, axis, weighted=True)(
+            preds, target, weights, counts, jnp.int32(pos_label)
+        )
+        slot = _next_pow2(int(np.asarray(counts_all).max()))
+        return _program_b(mesh, axis, slot, weighted=True)(key_s, pay_s, w_s, counts, splitters)
     key_s, pay_s, splitters, counts_all = _program_a(mesh, axis)(
         preds, target, counts, jnp.int32(pos_label)
     )
@@ -363,6 +461,72 @@ def host_sample_sort_auroc_ap(shard_data, pos_label: int = 1):
     return jnp.asarray(auroc), jnp.asarray(ap_v)
 
 
+def host_sample_sort_auroc_ap_weighted(shard_data, pos_label: int = 1):
+    """Weighted CPU-backend twin of :func:`sample_sort_auroc_ap`.
+
+    ``shard_data`` is ``[(preds, target, weights, fill_count), ...]``.
+    Weights break the packed-u64 radix trick (the weight cannot ride the
+    key), so this path argsorts the u32 keys and gathers — still the same
+    splitter/bucket/offset assembly as the SPMD program, with fp64
+    accumulation (this twin doubles as the parity oracle for the f32
+    on-device path).
+    """
+    world = len(shard_data)
+    keys, rels, ws, fills = [], [], [], []
+    for p, t, w, c in shard_data:
+        c = int(c)
+        key = _np_descending_key(np.asarray(p)[:c])
+        order = np.argsort(key, kind="stable")
+        keys.append(key[order])
+        rels.append(np.asarray(t)[:c][order] == pos_label)
+        ws.append(np.asarray(w, np.float64)[:c][order])
+        fills.append(c)
+
+    samples = []
+    for k, c in zip(keys, fills):
+        if k.size == 0:
+            samples.append(np.full(_R, np.uint32(0xFFFFFFFF), np.uint32))
+            continue
+        idx = (np.arange(_R) * max(c, 1)) // _R
+        samples.append(k[np.clip(idx, 0, k.shape[0] - 1)])
+    all_samples = np.sort(np.concatenate(samples))
+    splitters = all_samples[np.arange(1, world) * _R]
+
+    bounds = [
+        np.concatenate([[0], np.searchsorted(k, splitters, side="right"), [k.shape[0]]])
+        for k in keys
+    ]
+    area_total = 0.0
+    ap_total = 0.0
+    off_pw = 0.0
+    off_nw = 0.0
+    for d in range(world):
+        bk = np.concatenate([k[b[d]:b[d + 1]] for k, b in zip(keys, bounds)])
+        if bk.size == 0:
+            continue
+        br = np.concatenate([r[b[d]:b[d + 1]] for r, b in zip(rels, bounds)])
+        bw = np.concatenate([w[b[d]:b[d + 1]] for w, b in zip(ws, bounds)])
+        order = np.argsort(bk, kind="stable")
+        bk, br, bw = bk[order], br[order], bw[order]
+        tws = np.cumsum(np.where(br, bw, 0.0))
+        fws = np.cumsum(np.where(br, 0.0, bw))
+        boundary = bk[1:] != bk[:-1]
+        is_last = np.concatenate([boundary, [True]])
+        t_end = tws[is_last]
+        f_end = fws[is_last]
+        t_prev = np.concatenate([[0.0], t_end[:-1]])
+        f_prev = np.concatenate([[0.0], f_end[:-1]])
+        area_total += float(np.sum(0.5 * (t_end + t_prev + 2 * off_pw) * (f_end - f_prev)))
+        denom = np.maximum(t_end + f_end + off_pw + off_nw, 1e-300)
+        ap_total += float(np.sum((t_end - t_prev) * (t_end + off_pw) / denom))
+        off_pw += float(tws[-1])
+        off_nw += float(fws[-1])
+    w_pos, w_neg = off_pw, off_nw
+    auroc = np.float32(np.nan) if w_pos * w_neg == 0 else np.float32(area_total / (w_pos * w_neg))
+    ap_v = np.float32(np.nan) if w_pos == 0 else np.float32(ap_total / w_pos)
+    return jnp.asarray(auroc), jnp.asarray(ap_v)
+
+
 def _host_bucket_stats(packed_s, off_p, off_n):
     """fp64 host version of :func:`_tie_stats` for one key-sorted packed
     bucket (u64 = key<<1 | rel; every element is valid)."""
@@ -435,7 +599,11 @@ def _retrieval_program_a(mesh: Mesh, axis: str, exclude: int):
         # order of the legacy gathered computation. Carried as a u32 operand
         # (f32 would round past 2^24) and used as the tertiary sort key in
         # program B, so equal-score docs rank identically in both paths.
-        gpos = (lax.axis_index(axis) * cap + jnp.arange(cap)).astype(jnp.uint32)
+        # u32 arithmetic throughout: the i32 product rank*cap overflows once
+        # world × capacity_per_device crosses 2^31 and would scramble tie order
+        gpos = lax.axis_index(axis).astype(jnp.uint32) * jnp.uint32(cap) + jnp.arange(
+            cap, dtype=jnp.uint32
+        )
         qkey_s, preds_s, pay_s, gpos_s = lax.sort(
             (qkey, preds.astype(jnp.float32), pay, gpos), num_keys=1, is_stable=False
         )

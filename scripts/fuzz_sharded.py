@@ -382,9 +382,48 @@ def _fz_samplesort_adversarial(rng, M):
     return np.concatenate([got, got_h]), np.concatenate([want, want]), 1e-5
 
 
+def _fz_samplesort_weighted(rng, M):
+    """Weighted sample-sort (SPMD programs + host twin + module dispatch)
+    vs the host fp64 weighted oracle (sklearn), with randomized weight
+    distributions incl. exact zeros and tie-heavy scores."""
+    from sklearn.metrics import average_precision_score, roc_auc_score
+
+    from metrics_tpu.parallel.sample_sort import sample_sort_auroc_ap
+
+    cap = int(rng.choice([16, 64]))
+    sh = M.ShardedAUROC(capacity_per_device=cap, with_sample_weights=True)
+    all_p, all_t, all_w = [], [], []
+    for n in _batches(rng, cap * WORLD):
+        p, t = _tied_scores(rng, n), rng.randint(2, size=n)
+        t[:2] = [0, 1]
+        w = [
+            lambda: rng.rand(n).astype(np.float32),
+            lambda: rng.exponential(size=n).astype(np.float32),
+            lambda: (rng.rand(n) < 0.7).astype(np.float32),  # exact zeros
+        ][rng.randint(3)]()
+        sh.update(jnp.asarray(p), jnp.asarray(t), sample_weights=jnp.asarray(w))
+        all_p.append(p)
+        all_t.append(t)
+        all_w.append(w)
+    p = np.concatenate(all_p)
+    t = np.concatenate(all_t)
+    w = np.concatenate(all_w)
+    if w[t == 1].sum() == 0 or w[t == 0].sum() == 0:
+        return np.zeros(1), np.zeros(1), 1e-5  # degenerate: oracle undefined
+    # module dispatch (host twin on this CPU mesh) + the raw SPMD programs
+    a_spmd, ap_spmd = sample_sort_auroc_ap(
+        sh.buf_preds, sh.buf_target, sh.counts, sh.mesh, sh.axis_name, weights=sh.buf_weights
+    )
+    got = np.asarray([float(sh.compute()), float(a_spmd), float(ap_spmd)])
+    want_a = roc_auc_score(t, p, sample_weight=w)
+    want = np.asarray([want_a, want_a, average_precision_score(t, p, sample_weight=w)])
+    return got, want, 1e-5
+
+
 DOMAINS = {
     "sharded_auroc_binary": _fz_auroc_binary,
     "sharded_samplesort_spmd": _fz_samplesort_spmd,
+    "sharded_samplesort_weighted": _fz_samplesort_weighted,
     "sharded_samplesort_adversarial": _fz_samplesort_adversarial,
     "sharded_samplesort_retrieval": _fz_samplesort_retrieval,
     "sharded_auroc_bf16": _fz_auroc_bf16,

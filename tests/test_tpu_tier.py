@@ -22,6 +22,10 @@ EXPECTED_CHECKS = {
     "sharded_auroc_mesh",
     "samplesort_spmd_auroc",
     "samplesort_spmd_ap",
+    "samplesort_weighted_auroc",
+    "samplesort_weighted_spmd_auroc",
+    "samplesort_weighted_spmd_ap",
+    "adv_weighted_gather_epilogue",
     "binned_auroc_histogram",
     "roc_curve_len",
     "roc_curve_fpr",

@@ -177,6 +177,33 @@ def _child() -> None:
     check("samplesort_spmd_auroc", float(ss_a), roc_auc_score(bt, scores), 1e-5)
     check("samplesort_spmd_ap", float(ss_ap), average_precision_score(bt, scores), 1e-5)
 
+    # weighted sample-sort SPMD programs on the chip (third co-sorted
+    # operand + weighted f32 cumulant epilogue, parallel/sample_sort.py
+    # _tie_stats_w) vs sklearn's fp64 weighted oracles
+    sw = rng.exponential(size=scores.shape[0]).astype(np.float32)
+    shw = M.ShardedAUROC(capacity_per_device=sz(500_000), with_sample_weights=True)
+    shw.update(jnp.asarray(scores), jnp.asarray(bt), sample_weights=jnp.asarray(sw))
+    check("samplesort_weighted_auroc", float(shw.compute()),
+          roc_auc_score(bt, scores, sample_weight=sw), 1e-5)
+    w_a, w_ap = sample_sort_auroc_ap(
+        shw.buf_preds, shw.buf_target, shw.counts, shw.mesh, shw.axis_name,
+        weights=shw.buf_weights,
+    )
+    check("samplesort_weighted_spmd_auroc", float(w_a),
+          roc_auc_score(bt, scores, sample_weight=sw), 1e-5)
+    check("samplesort_weighted_spmd_ap", float(w_ap),
+          average_precision_score(bt, scores, sample_weight=sw), 1e-5)
+
+    # the gathered weighted XLA epilogue (single-chip dispatch path)
+    from metrics_tpu.classification.sharded import _masked_weighted_auroc_ap
+
+    mw_a, _ = _masked_weighted_auroc_ap(
+        jnp.asarray(scores), jnp.asarray(bt),
+        jnp.ones(scores.shape[0], bool), jnp.asarray(sw), jnp.int32(1),
+    )
+    check("adv_weighted_gather_epilogue", float(mw_a),
+          roc_auc_score(bt, scores, sample_weight=sw), 1e-5)
+
     # BinnedAUROC — exercises the TPU-only histogram formulation (chunked
     # one-hot contraction on the MXU; the CPU suite only ever runs the
     # scatter-add branch of ops/histogram.py). Scores quantized to the bin
