@@ -14,9 +14,10 @@ test-tpu:
 
 test-tpu-suite:
 	# chip-hosted run of the real suite (single-device subset: ops,
-	# regression, retrieval, classification) — the analog of the reference
-	# running its whole suite on CUDA (azure-pipelines.yml:59). Chunked and
-	# tunnel-hardened; writes TPU_SUITE.json (+ _last_good on green).
+	# regression, retrieval, functional, wrappers, classification) — the
+	# analog of the reference running its whole suite on CUDA
+	# (azure-pipelines.yml:59). Chunked and tunnel-hardened; writes
+	# TPU_SUITE.json (+ _last_good on green).
 	python scripts/tpu_suite.py
 
 doctest:

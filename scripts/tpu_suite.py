@@ -3,11 +3,11 @@
 The on-chip correctness tier (`tpu_correctness.py`) is ~25 representative
 checks; the reference's accelerator CI runs its *entire* suite on CUDA every
 pass (`/root/reference/azure-pipelines.yml:59`). This runner closes that gap:
-it executes `tests/ops tests/regression tests/retrieval tests/classification`
-— the single-device-meaningful subset (tests/parallel needs the 8-device
-virtual mesh; tests/bases is backend-independent runtime plumbing) — with the
-real accelerator as the JAX backend (`METRICS_TPU_TEST_PLATFORM=tpu`, see
-`tests/conftest.py`).
+it executes `tests/ops tests/regression tests/retrieval tests/functional
+tests/wrappers tests/classification` — the single-device-meaningful subset —
+with the real accelerator as the JAX backend
+(`METRICS_TPU_TEST_PLATFORM=tpu`, see `tests/conftest.py`). Everything
+omitted is enumerated with a reason in the artifact's `excluded` map.
 
 Tunnel-hardened like everything else on this host: the remote-TPU tunnel
 flaps, so the run is CHUNKED (one pytest invocation per directory, per-file
