@@ -1,4 +1,14 @@
-.PHONY: test test-tpu test-tpu-suite doctest bench dryrun fuzz fuzz-sharded clean
+.PHONY: ci test test-tpu test-tpu-suite doctest bench dryrun fuzz fuzz-sharded clean
+
+ci:
+	# the full CI gate as one machine-runnable target (mirrors
+	# .github/workflows/ci.yml): lint -> suite (incl. doctests + api-surface
+	# guard) -> fuzz smoke -> multi-chip dryrun
+	python -m compileall -q metrics_tpu tests scripts bench.py tpu_correctness.py __graft_entry__.py
+	python -m pytest tests/ -q
+	python scripts/fuzz_parity.py --trials 50
+	python scripts/fuzz_sharded.py --trials 25
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 test:
 	# full suite: sklearn/scipy oracles + package doctests + 8-virtual-device

@@ -202,14 +202,15 @@ def _stat_scores_probe_count(
             if samplewise:  # (N, X) per-sample rows, as the canonical dim=1
                 tp, fp, tn, fn = (v.reshape(n_samples, -1) for v in (tp, fp, tn, fn))
     elif case == DataType.MULTILABEL:
+        # threshold to the canonical 0/1 layout, then the shared
+        # sufficient-stats counting (_stat_scores — the one place the
+        # tp/fp/tn/fn identity lives). ignore_index drops the column
+        # outright for class-blind reductions (exactly _stat_scores_count's
+        # _del_column rule), so the identity's M term shrinks with it.
         pbin = (preds >= threshold).astype(jnp.int32)
         tbin = target.astype(jnp.int32)
-        tp_nc = pbin * tbin
-        fp_nc = pbin * (1 - tbin)
-        fn_nc = (1 - pbin) * tbin
-        tn_nc = (1 - pbin) * (1 - tbin)
         if reduce == "macro":
-            tp, fp, tn, fn = (x.sum(axis=0).astype(jnp.int32) for x in (tp_nc, fp_nc, tn_nc, fn_nc))
+            tp, fp, tn, fn = _stat_scores(pbin, tbin, reduce="macro")
             if ignore_index is not None:
                 tp = tp.at[ignore_index].set(-1)
                 fp = fp.at[ignore_index].set(-1)
@@ -217,23 +218,16 @@ def _stat_scores_probe_count(
                 fn = fn.at[ignore_index].set(-1)
         else:
             if ignore_index is not None:
-                keep = (jnp.arange(p_shape[1]) != ignore_index)[None, :]
-                tp_nc, fp_nc, fn_nc, tn_nc = (x * keep for x in (tp_nc, fp_nc, fn_nc, tn_nc))
-            axis = (0, 1) if reduce == "micro" else 1
-            tp, fp, tn, fn = (x.sum(axis=axis).astype(jnp.int32) for x in (tp_nc, fp_nc, tn_nc, fn_nc))
+                pbin = _del_column(pbin, ignore_index)
+                tbin = _del_column(tbin, ignore_index)
+            tp, fp, tn, fn = _stat_scores(pbin, tbin, reduce=reduce)
     else:  # BINARY: canonical layout is (N, 1)
-        pbin = (preds >= threshold).astype(jnp.int32)
-        tbin = target.astype(jnp.int32)
-        tp_n = pbin * tbin
-        fp_n = pbin * (1 - tbin)
-        fn_n = (1 - pbin) * tbin
-        tn_n = (1 - pbin) * (1 - tbin)
-        if reduce == "samples":
-            tp, fp, tn, fn = tp_n, fp_n, tn_n, fn_n
-        else:
-            tp, fp, tn, fn = (x.sum().astype(jnp.int32) for x in (tp_n, fp_n, tn_n, fn_n))
-            if reduce == "macro":  # canonical (N, 1) macro output is (1,)
-                tp, fp, tn, fn = (x.reshape(1) for x in (tp, fp, tn, fn))
+        pbin = (preds >= threshold).astype(jnp.int32).reshape(-1, 1)
+        tbin = target.astype(jnp.int32).reshape(-1, 1)
+        tp, fp, tn, fn = _stat_scores(pbin, tbin, reduce=reduce)
+        if reduce == "micro":
+            # canonical micro output for (N, 1) is a scalar
+            tp, fp, tn, fn = (x.reshape(()) for x in (tp, fp, tn, fn))
 
     return (*probe, tp, fp, tn, fn)
 

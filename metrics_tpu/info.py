@@ -5,7 +5,7 @@ TPU-native (JAX/XLA) re-design of the capabilities of
 ``/root/reference/torchmetrics/info.py:1``).
 """
 
-__version__ = "0.2.0"
+__version__ = "0.5.0"
 __author__ = "metrics_tpu contributors"
 __license__ = "Apache-2.0"
 __docs__ = (

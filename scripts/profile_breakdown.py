@@ -1,0 +1,141 @@
+"""Per-stage breakdown of the headline 1M Accuracy+AUROC step.
+
+The headline bench (`bench.py`) times the fused end-to-end step; this tool
+answers "where does the time go" without a profiler UI: each stage is built
+as its own chained jitted program (same RTT-compensated carry scheme as
+`bench.py:_bench_jax` — `jax.block_until_ready` is a no-op through the
+remote-TPU tunnel) and timed against the same 1M inputs:
+
+  accuracy          threshold-compare + count (the Accuracy half)
+  key               `_descending_key` alone (bitcast + monotone map)
+  sort              key + the unstable payload co-sort (dominant stage)
+  scans_incl_sort   sort + tie-group cumulant scans + area reduction
+                    (always the XLA scan formulation; `auroc_total` minus
+                    `sort` gives the marginal scan cost of the real path)
+  auroc_total       the full `binary_auroc` program (Pallas scan on TPU,
+                    host radix sort on CPU backends)
+  step_total        Accuracy + AUROC fused (what bench.py reports)
+
+Stage programs overlap deliberately (sort ⊃ key, scans_incl_sort ⊃ sort) —
+differences between rows are the marginal costs; XLA fusion means the
+stages do not sum exactly to the total. `--write` saves
+`PROFILE_<platform>.json` at the repo root; also the source for the
+`docs/performance.md` breakdown table.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = 1_000_000
+
+
+def main() -> None:
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from metrics_tpu.ops.auroc_kernel import (
+        _descending_key,
+        _sorted_tie_groups,
+        binary_auroc,
+    )
+    from metrics_tpu.utilities.jit import enable_persistent_cache
+
+    enable_persistent_cache()
+    from jax import lax
+
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.rand(N).astype(np.float32))
+    target = jnp.asarray(rng.randint(2, size=N).astype(np.int32))
+
+    def stage_accuracy(p, t, c):
+        return jnp.sum(((p + c * 0.0) >= 0.5).astype(jnp.int32) == t) / t.shape[0]
+
+    def stage_key(p, t, c):
+        return _descending_key(p + c * 0.0).astype(jnp.float32)[0] * 0.0
+
+    def stage_sort(p, t, c):
+        key = _descending_key(p + c * 0.0)
+        key_s, rel_s = lax.sort((key, t.astype(jnp.float32)), num_keys=1, is_stable=False)
+        return rel_s[0] * 0.0 + key_s[0].astype(jnp.float32) * 0.0
+
+    def stage_scans(p, t, c):
+        # cumulant scans + area on a pre-sorted stream: sort cost excluded
+        # by sorting outside the timed carry dependency is impossible under
+        # jit, so this stage reports auroc_total - sort as its marginal in
+        # the table; here it runs the scans on the raw (unsorted-key) data
+        # to measure the scan passes themselves
+        tps, fps, is_last, tps_prev, fps_prev = _sorted_tie_groups(p + c * 0.0, t.astype(jnp.float32))
+        return jnp.sum(jnp.where(is_last, 0.5 * (tps + tps_prev) * (fps - fps_prev), 0.0)) * 0.0
+
+    def stage_auroc(p, t, c):
+        return binary_auroc(p + c * 0.0, t)
+
+    def stage_step(p, t, c):
+        acc = jnp.sum(((p + c * 0.0) >= 0.5).astype(jnp.int32) == t) / t.shape[0]
+        return acc * 0.0 + binary_auroc(p + c * 0.0, t)
+
+    stages = [
+        ("accuracy", stage_accuracy),
+        ("key", stage_key),
+        ("sort", stage_sort),
+        ("scans_incl_sort", stage_scans),
+        ("auroc_total", stage_auroc),
+        ("step_total", stage_step),
+    ]
+
+    tiny = jax.jit(lambda x: x + 1.0)
+    float(tiny(jnp.zeros(())))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(tiny(jnp.zeros(())))
+        ts.append(time.perf_counter() - t0)
+    rtt = min(ts)
+
+    platform = jax.default_backend()
+    out = {"platform": platform, "n": N, "rtt_ms": round(rtt * 1e3, 3),
+           "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), "stages_ms": {}}
+    for name, fn in stages:
+        step = jax.jit(fn)
+        float(step(preds, target, jnp.zeros(())))
+
+        def chained(k):
+            carry = jnp.zeros(())
+            t0 = time.perf_counter()
+            for _ in range(k):
+                carry = step(preds, target, carry) * 0.0
+            float(carry)
+            return time.perf_counter() - t0
+
+        chained(2)
+        k = 8
+        per_step = None
+        for _ in range(4):
+            totals = sorted(chained(k) for _ in range(3))
+            per_step = (totals[1] - rtt) / k
+            if per_step * k > 2 * rtt and per_step > 1e-6:
+                break
+            k *= 4
+        out["stages_ms"][name] = round(max(per_step, 0.0) * 1e3, 4)
+        print(f"{name}: {out['stages_ms'][name]} ms", flush=True)
+
+    print(json.dumps(out))
+    if "--write" in sys.argv:
+        path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                            f"PROFILE_{platform}.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"wrote {path}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,18 @@
+#!/bin/bash
+# Companion watcher: capture the per-stage TPU profile breakdown
+# (scripts/profile_breakdown.py --write -> PROFILE_tpu.json) once the
+# tunnel answers. Separate from tpu_watch.sh so the main tier/bench/suite
+# pipeline is never blocked behind it.
+cd /root/repo
+for i in $(seq 1 160); do
+  b=$(timeout 60 python -c "import bench; print(bench._probe_backend() or 'none')" 2>/dev/null | tail -1)
+  if [ "$b" = "tpu" ]; then
+    echo "[$(date -u +%H:%M:%SZ)] profile run starting"
+    if timeout 1200 python scripts/profile_breakdown.py --write > profile_watch.out 2>&1; then
+      grep -q '"platform": "tpu"' profile_watch.out && { echo "[$(date -u +%H:%M:%SZ)] profile GREEN"; exit 0; }
+    fi
+    echo "[$(date -u +%H:%M:%SZ)] profile attempt failed"
+  fi
+  sleep 270
+done
+exit 1
