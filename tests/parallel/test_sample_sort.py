@@ -335,6 +335,12 @@ def test_sample_weights_api_contract():
     with pytest.raises(ValueError, match="binary"):
         M.ShardedAUROC(capacity_per_device=16, num_classes=4, with_sample_weights=True)
 
+    # curve-shaped sharded metrics reject the flag at construction (their
+    # compute has no weighted epilogue)
+    for cls in (M.ShardedROC, M.ShardedPrecisionRecallCurve):
+        with pytest.raises(ValueError, match="does not support sample weights"):
+            cls(capacity_per_device=16, with_sample_weights=True)
+
 
 def test_masked_weighted_xla_epilogue_direct():
     """The pure-XLA gathered weighted epilogue (what a single-chip TPU
