@@ -12,6 +12,7 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from metrics_tpu.metric import Metric
 from metrics_tpu.ops.histogram import (
@@ -66,7 +67,25 @@ class _BinnedScoreMetric(Metric):
     def _is_multiclass(self) -> bool:
         return self.hist_pos.ndim == 2
 
-    def update(self, preds: jax.Array, target: jax.Array) -> None:
+    def update(self, preds: jax.Array, target: jax.Array, sample_weights=None) -> None:
+        """``sample_weights`` (optional ``(n,)`` non-negative) turn the
+        histograms into weighted sums — the O(bins) analog of the curve
+        core's per-call weights; unlike the sharded family no constructor
+        flag is needed (histogram state is weight-shape-free), matching the
+        reference's per-call functional contract."""
+        if sample_weights is not None:
+            sample_weights = jnp.asarray(sample_weights, jnp.float32).flatten()
+            if sample_weights.shape[0] != jnp.asarray(target).size:
+                raise ValueError(
+                    f"expected sample_weights with one weight per target element"
+                    f" ({jnp.asarray(target).size}), got {sample_weights.shape[0]}"
+                )
+            if _is_concrete(sample_weights) and sample_weights.size:
+                lo, hi = (float(v) for v in _min_max_jit(sample_weights))
+                if not (lo >= 0 and np.isfinite(hi)):  # min>=0 catches NaN too
+                    raise ValueError(
+                        f"sample_weights must be non-negative finite, got range [{lo}, {hi}]"
+                    )
         if self._is_multiclass:
             preds = jnp.asarray(preds)
             target = jnp.asarray(target)
@@ -86,12 +105,15 @@ class _BinnedScoreMetric(Metric):
             self._check_prob_range(preds)
             onehot = (target[:, None] == jnp.arange(num_classes)).astype(jnp.int32)
             hist_pos, hist_neg = jax.vmap(
-                lambda p, t: score_histograms(p, t, self.num_bins), in_axes=(1, 1)
+                lambda p, t: score_histograms(p, t, self.num_bins, weights=sample_weights),
+                in_axes=(1, 1),
             )(preds, onehot)
         else:
             preds, target = _check_retrieval_functional_inputs(preds, target)
             self._check_prob_range(preds)
-            hist_pos, hist_neg = score_histograms(preds.flatten(), target.flatten(), self.num_bins)
+            hist_pos, hist_neg = score_histograms(
+                preds.flatten(), target.flatten(), self.num_bins, weights=sample_weights
+            )
         self.hist_pos = self.hist_pos + hist_pos
         self.hist_neg = self.hist_neg + hist_neg
 

@@ -299,10 +299,17 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
                     f" got {sample_weights.shape}"
                 )
             # eager value probe (same discipline as the label-range check
-            # below): a negative weight breaks the monotone-cumulant design
-            lo = float(sample_weights.min()) if isinstance(sample_weights, np.ndarray) else float(jnp.min(sample_weights))
-            if not lo >= 0:  # catches NaN too
-                raise ValueError(f"sample_weights must be non-negative finite, got min {lo}")
+            # below): a negative weight breaks the monotone-cumulant design,
+            # an inf one silently poisons every downstream cumulant
+            if sample_weights.size:
+                if isinstance(sample_weights, np.ndarray):
+                    lo, hi = float(sample_weights.min()), float(sample_weights.max())
+                else:
+                    lo, hi = float(jnp.min(sample_weights)), float(jnp.max(sample_weights))
+                if not (lo >= 0 and np.isfinite(hi)):  # min>=0 catches NaN too
+                    raise ValueError(
+                        f"sample_weights must be non-negative finite, got range [{lo}, {hi}]"
+                    )
         if target.ndim != 1 or preds.shape != (target.shape[0], *self.preds_suffix):
             shape_desc = "(n" + "".join(f", {d}" for d in self.preds_suffix) + ")"
             raise ValueError(

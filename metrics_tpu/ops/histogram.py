@@ -101,14 +101,17 @@ def _contraction_bincount(indices: jax.Array, length: int, weights: jax.Array = 
 
 @partial(jax.jit, static_argnames=("num_bins",))
 def score_histograms(
-    preds: jax.Array, target: jax.Array, num_bins: int = 256, mask: jax.Array = None
+    preds: jax.Array, target: jax.Array, num_bins: int = 256, mask: jax.Array = None,
+    weights: jax.Array = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Per-class score histograms over [0, 1]: ``(hist_pos, hist_neg)``.
 
     Scores are clipped into ``[0, 1]`` and quantized to ``num_bins`` buckets;
     the two histograms are additive over batches and over devices. ``mask``
     (optional, bool) drops entries — used with fixed-capacity sharded buffers
-    whose tail slots are unfilled.
+    whose tail slots are unfilled. ``weights`` (optional, non-negative f32)
+    makes the histograms weighted sums — the binned analog of the curve
+    core's ``sample_weights``.
 
     On TPU the histogram is a chunked one-hot contraction (~9ms steady-state
     at 1M scores x 512 bins on v5e, vs ~350ms for scatter-add, which
@@ -117,6 +120,8 @@ def score_histograms(
     bins = jnp.clip((preds * num_bins).astype(jnp.int32), 0, num_bins - 1)
     rel = (target == 1).astype(jnp.float32)
     valid = jnp.ones_like(rel) if mask is None else mask.astype(jnp.float32)
+    if weights is not None:
+        valid = valid * weights.astype(jnp.float32)
     w_pos = rel * valid
     w_neg = (1.0 - rel) * valid
 

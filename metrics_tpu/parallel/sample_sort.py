@@ -600,7 +600,11 @@ def _retrieval_program_a(mesh: Mesh, axis: str, exclude: int):
         # (f32 would round past 2^24) and used as the tertiary sort key in
         # program B, so equal-score docs rank identically in both paths.
         # u32 arithmetic throughout: the i32 product rank*cap overflows once
-        # world × capacity_per_device crosses 2^31 and would scramble tie order
+        # world × capacity_per_device crosses 2^31 and would scramble tie
+        # order. Past 2^32 GLOBAL elements the u32 position itself wraps —
+        # tie order stays deterministic but diverges from the legacy gather
+        # order; carrying a second u32 high word would lift that if a >4.3B
+        # single-metric stream ever becomes real
         gpos = lax.axis_index(axis).astype(jnp.uint32) * jnp.uint32(cap) + jnp.arange(
             cap, dtype=jnp.uint32
         )

@@ -100,6 +100,28 @@ def main(coordinator: str, num_processes: int, process_id: int, out_npz: str) ->
     assert abs(float(ss_a) - roc_auc_score(flat_t, flat_p)) < 1e-6, float(ss_a)
     assert abs(float(ss_ap) - average_precision_score(flat_t, flat_p)) < 1e-6, float(ss_ap)
 
+    # --- weighted: the third co-sorted operand rides the same DCN
+    # all_to_all, and the module's multi-process CPU dispatch (gathered
+    # replica epilogue) matches sklearn's fp64 weighted oracle
+    weights = rng.exponential(size=(N // batch, batch)).astype(np.float32)
+    flat_w = weights.reshape(-1)
+    sh_w = M.ShardedAUROC(capacity_per_device=N // world, mesh=mesh, with_sample_weights=True)
+    for i in range(N // batch):
+        sh_w.update(
+            jnp.asarray(preds[i, lo:lo + half]),
+            jnp.asarray(target[i, lo:lo + half]),
+            sample_weights=jnp.asarray(weights[i, lo:lo + half]),
+        )
+    want_w = roc_auc_score(flat_t, flat_p, sample_weight=flat_w)
+    assert abs(float(sh_w.compute()) - want_w) < 1e-5, float(sh_w.compute())
+    w_a, w_ap = sample_sort_auroc_ap(
+        sh_w.buf_preds, sh_w.buf_target, sh_w.counts, mesh, "data", weights=sh_w.buf_weights
+    )
+    assert abs(float(w_a) - want_w) < 1e-5, float(w_a)
+    assert abs(
+        float(w_ap) - average_precision_score(flat_t, flat_p, sample_weight=flat_w)
+    ) < 1e-5, float(w_ap)
+
     sh_mrr = feed(M.ShardedRetrievalMRR(capacity_per_device=N // world, mesh=mesh), q_idx, preds, q_rel)
     loc_mrr = M.RetrievalMRR(**no_sync)
     for i in range(N // batch):
