@@ -21,7 +21,11 @@ from metrics_tpu.ops.histogram import (
     histogram_pr_curve,
     score_histograms,
 )
-from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs, _min_max_jit
+from metrics_tpu.utilities.checks import (
+    _check_retrieval_functional_inputs,
+    _check_sample_weights_range,
+    _min_max_jit,
+)
 from metrics_tpu.utilities.data import _is_concrete
 
 
@@ -80,12 +84,7 @@ class _BinnedScoreMetric(Metric):
                     f"expected sample_weights with one weight per target element"
                     f" ({jnp.asarray(target).size}), got {sample_weights.shape[0]}"
                 )
-            if _is_concrete(sample_weights) and sample_weights.size:
-                lo, hi = (float(v) for v in _min_max_jit(sample_weights))
-                if not (lo >= 0 and np.isfinite(hi)):  # min>=0 catches NaN too
-                    raise ValueError(
-                        f"sample_weights must be non-negative finite, got range [{lo}, {hi}]"
-                    )
+            _check_sample_weights_range(sample_weights)
         if self._is_multiclass:
             preds = jnp.asarray(preds)
             target = jnp.asarray(target)

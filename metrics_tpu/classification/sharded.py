@@ -82,6 +82,16 @@ def _masked_weighted_auroc_ap(preds, target, mask, weights, pos_label):
     return auroc, ap_v
 
 
+# per-class weighted kernels for the one-vs-rest programs (module-level so
+# the program caches can key on them)
+def masked_weighted_binary_auroc(preds, target, mask, weights):
+    return _masked_weighted_auroc_ap(preds, target, mask, weights, jnp.int32(1))[0]
+
+
+def masked_weighted_binary_average_precision(preds, target, mask, weights):
+    return _masked_weighted_auroc_ap(preds, target, mask, weights, jnp.int32(1))[1]
+
+
 def _average_ovr(
     per_class: jax.Array, support: jax.Array, average: Optional[str], batch_local: bool = False
 ) -> jax.Array:
@@ -103,7 +113,10 @@ def _average_ovr(
         valid = ~jnp.isnan(per_class)
         weight = valid.astype(jnp.float32) if average == "macro" else jnp.where(valid, support, 0.0)
         total = jnp.sum(weight)
-        score = jnp.sum(jnp.where(valid, per_class, 0.0) * weight) / jnp.maximum(total, 1.0)
+        # epsilon guard, not max(·, 1): weighted supports are f32 sums that
+        # can legitimately total below 1, and a 1-clamp would silently
+        # scale the average; total==0 still returns NaN via the where
+        score = jnp.sum(jnp.where(valid, per_class, 0.0) * weight) / jnp.maximum(total, 1e-30)
         return jnp.where(total > 0, score, jnp.nan)
     absent = np.asarray(support) == 0
     if absent.any():
@@ -115,11 +128,13 @@ def _average_ovr(
         )
     if average == "macro":
         return jnp.mean(per_class)
-    return jnp.sum(per_class * support / jnp.maximum(support.sum(), 1))
+    # absent classes raised above, so support.sum() > 0; the epsilon (not a
+    # 1-clamp) keeps sub-1 f32 weighted support totals undistorted
+    return jnp.sum(per_class * support / jnp.maximum(support.sum(), 1e-30))
 
 
 @functools.lru_cache(maxsize=None)
-def _ovr_a2a_program(mesh: Mesh, axis: str, kernel, num_classes: int):
+def _ovr_a2a_program(mesh: Mesh, axis: str, kernel, num_classes: int, weighted: bool = False):
     """One-vs-rest scores straight off the SAMPLE-sharded buffers: a class
     transpose via ``all_to_all`` instead of replicating the whole stream.
 
@@ -131,9 +146,18 @@ def _ovr_a2a_program(mesh: Mesh, axis: str, kernel, num_classes: int):
     shard-locally in-program (no host resharding), and pad classes yield
     NaN per-class scores (all-zero one-vs-rest columns), sliced off by the
     caller — identical semantics to the gather path.
+
+    With ``weighted``, per-row weights ride the same tiny ``(N,)``
+    all_gather as the targets, the kernel takes them as a fourth operand,
+    and ``support`` becomes the weighted class totals (what sklearn's
+    weighted averaging uses).
     """
 
-    def _local(bufp, buft, count):
+    def _local(bufp, buft, *rest):
+        if weighted:
+            bufw, count = rest
+        else:
+            (count,) = rest
         world = jax.lax.axis_size(axis)
         local_cap = bufp.shape[0]
         padded = -(-num_classes // world) * world
@@ -153,18 +177,24 @@ def _ovr_a2a_program(mesh: Mesh, axis: str, kernel, num_classes: int):
 
         first = jax.lax.axis_index(axis) * n_local
         onehot = (tgt[:, None] == (first + jnp.arange(n_local))).astype(jnp.int32)
-        per_class = jax.vmap(kernel, in_axes=(1, 1, None))(preds_full, onehot, mask)
-        support = jnp.sum(onehot * mask[:, None].astype(jnp.int32), axis=0)
+        if weighted:
+            wts = jax.lax.all_gather(bufw, axis, tiled=True)  # (N,)
+            per_class = jax.vmap(kernel, in_axes=(1, 1, None, None))(preds_full, onehot, mask, wts)
+            support = jnp.sum(onehot * jnp.where(mask, wts, 0.0)[:, None], axis=0)
+        else:
+            per_class = jax.vmap(kernel, in_axes=(1, 1, None))(preds_full, onehot, mask)
+            support = jnp.sum(onehot * mask[:, None].astype(jnp.int32), axis=0)
         return (
             jax.lax.all_gather(per_class, axis, tiled=True),
             jax.lax.all_gather(support, axis, tiled=True),
         )
 
+    extra = (P(axis),) if weighted else ()
     return jax.jit(
         jax.shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(axis), P(axis), P(axis)),
+            in_specs=(P(axis), P(axis), *extra, P(axis)),
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -172,7 +202,7 @@ def _ovr_a2a_program(mesh: Mesh, axis: str, kernel, num_classes: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _ovr_program(mesh: Mesh, axis: str, kernel):
+def _ovr_program(mesh: Mesh, axis: str, kernel, weighted: bool = False):
     """One-vs-rest scores with the **class axis sharded over the mesh**.
 
     The gathered stream is replicated, so resharding its class axis is a
@@ -181,10 +211,12 @@ def _ovr_program(mesh: Mesh, axis: str, kernel):
     where the compute-side scalability comes from (the reference loops over
     classes on every rank, ``functional/classification/auroc.py:79-86``).
     Pad classes carry all-zero onehot columns: their kernel output is NaN
-    (no positives), sliced off by the caller.
+    (no positives), sliced off by the caller. With ``weighted``, the
+    (replicated) per-row weights become the kernel's fourth operand and
+    ``support`` is the weighted class total.
     """
 
-    def _local(preds, target, mask):
+    def _local(preds, target, mask, *rest):
         # class-block slicing happens in-program (preds arrive replicated):
         # no host-side resharding, so the same program runs on multi-host
         # meshes where device_put to non-addressable devices would fail
@@ -193,8 +225,13 @@ def _ovr_program(mesh: Mesh, axis: str, kernel):
         first = jax.lax.axis_index(axis) * n_local
         local = jax.lax.dynamic_slice_in_dim(preds, first, n_local, axis=1)
         onehot = (target[:, None] == (first + jnp.arange(n_local))).astype(jnp.int32)
-        per_class = jax.vmap(kernel, in_axes=(1, 1, None))(local, onehot, mask)
-        support = jnp.sum(onehot * mask[:, None].astype(jnp.int32), axis=0)
+        if weighted:
+            (weights,) = rest
+            per_class = jax.vmap(kernel, in_axes=(1, 1, None, None))(local, onehot, mask, weights)
+            support = jnp.sum(onehot * jnp.where(mask, weights, 0.0)[:, None], axis=0)
+        else:
+            per_class = jax.vmap(kernel, in_axes=(1, 1, None))(local, onehot, mask)
+            support = jnp.sum(onehot * mask[:, None].astype(jnp.int32), axis=0)
         # gather the tiny (C,) results so the outputs come out replicated —
         # host-side slicing/averaging then works on any mesh
         return (
@@ -202,11 +239,12 @@ def _ovr_program(mesh: Mesh, axis: str, kernel):
             jax.lax.all_gather(support, axis, tiled=True),
         )
 
+    extra = (P(),) if weighted else ()
     return jax.jit(
         jax.shard_map(
             _local,
             mesh=mesh,
-            in_specs=(P(), P(), P()),
+            in_specs=(P(), P(), P(), *extra),
             out_specs=(P(), P()),
             check_vma=False,
         )
@@ -299,17 +337,10 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
                     f" got {sample_weights.shape}"
                 )
             # eager value probe (same discipline as the label-range check
-            # below): a negative weight breaks the monotone-cumulant design,
-            # an inf one silently poisons every downstream cumulant
-            if sample_weights.size:
-                if isinstance(sample_weights, np.ndarray):
-                    lo, hi = float(sample_weights.min()), float(sample_weights.max())
-                else:
-                    lo, hi = float(jnp.min(sample_weights)), float(jnp.max(sample_weights))
-                if not (lo >= 0 and np.isfinite(hi)):  # min>=0 catches NaN too
-                    raise ValueError(
-                        f"sample_weights must be non-negative finite, got range [{lo}, {hi}]"
-                    )
+            # below), shared with the binned family
+            from metrics_tpu.utilities.checks import _check_sample_weights_range
+
+            _check_sample_weights_range(sample_weights)
         if target.ndim != 1 or preds.shape != (target.shape[0], *self.preds_suffix):
             shape_desc = "(n" + "".join(f", {d}" for d in self.preds_suffix) + ")"
             raise ValueError(
@@ -381,7 +412,7 @@ class _ShardedOVRMetric(ShardedCurveMetric):
 
     _masked_kernel = None
     _host_kernel = None  # CPU epilogue twin (outside collectives only)
-    _supports_sample_weights = True  # binary-only, enforced in __init__
+    _supports_sample_weights = True  # binary sample-sort + weighted OvR
 
     def __init__(
         self,
@@ -395,12 +426,6 @@ class _ShardedOVRMetric(ShardedCurveMetric):
         if average not in allowed:
             raise ValueError(f"Argument `average` expected to be one of {allowed}, got {average}")
         suffix = () if num_classes in (None, 1) else (num_classes,)
-        if kwargs.get("with_sample_weights") and suffix:
-            raise ValueError(
-                "sample weights are supported on binary score streams only"
-                " (num_classes=None); the one-vs-rest class transpose does"
-                " not carry a weight operand yet"
-            )
         super().__init__(capacity_per_device, preds_suffix=suffix, **kwargs)
         self.pos_label = pos_label
         self.num_classes = num_classes
@@ -432,48 +457,63 @@ class _ShardedOVRMetric(ShardedCurveMetric):
                     self.buf_preds, self.buf_target, self.counts,
                     self.mesh, self.axis_name, self.pos_label,
                 )[self._samplesort_output]
-        if self.preds_suffix and self.world > 1 and not _no_samplesort():
-            # one-vs-rest without replicating the stream: class-transpose
-            # all_to_all straight off the sharded buffers — each device
-            # receives only its C/world class block (O(N·C/world), vs the
-            # gather path's O(N·C) onto every device)
-            num_classes = self.preds_suffix[0]
-            program = _ovr_a2a_program(self.mesh, self.axis_name, self._masked_kernel, num_classes)
-            per_class, support = program(self.buf_preds, self.buf_target, self.counts)
-            per_class = replica0(per_class)[:num_classes]
-            support = replica0(support)[:num_classes]
-            return _average_ovr(per_class, support, self.average, batch_local=self._batch_local_compute)
+        if self.preds_suffix:
+            return self._ovr_compute(self._masked_kernel, weighted=False)
         preds, target, mask = self._gathered()
-        if not self.preds_suffix:
-            # the gathered stream is replicated; run the epilogue kernel on
-            # one local replica (identical wall-clock on a pod, 1/world the
-            # work on a shared-host mesh — see replica0). This is a PLAIN
-            # jit outside any collective, so on CPU backends it can take the
-            # host radix-sort formulation (the shard_map OvR program below
-            # must stay pure XLA)
-            if self._host_kernel is not None and _use_host_sort():
-                return self._host_kernel(replica0(preds), replica0(target), replica0(mask), self.pos_label)
-            return self._masked_kernel(replica0(preds), replica0(target), replica0(mask), self.pos_label)
-        # gather-everything OvR (the METRICS_TPU_NO_SAMPLESORT twin and the
-        # world==1 degenerate case): shard the one-vs-rest class axis over
-        # the mesh on the replicated stream (pad classes give NaN per-class
-        # scores from their all-zero onehot columns and are sliced off)
+        # the gathered stream is replicated; run the epilogue kernel on
+        # one local replica (identical wall-clock on a pod, 1/world the
+        # work on a shared-host mesh — see replica0). This is a PLAIN
+        # jit outside any collective, so on CPU backends it can take the
+        # host radix-sort formulation (the shard_map OvR programs must
+        # stay pure XLA)
+        if self._host_kernel is not None and _use_host_sort():
+            return self._host_kernel(replica0(preds), replica0(target), replica0(mask), self.pos_label)
+        return self._masked_kernel(replica0(preds), replica0(target), replica0(mask), self.pos_label)
+
+    def _ovr_compute(self, kernel, weighted: bool) -> jax.Array:
+        """The one-vs-rest dispatch, shared by the weighted and unweighted
+        paths (they must never diverge structurally): class-transpose
+        all_to_all straight off the sharded buffers on meshes —
+        O(N·C/world) received per device — falling back to the
+        gather-everything class-sharded program (the
+        METRICS_TPU_NO_SAMPLESORT twin and the world==1 degenerate case;
+        pad classes give NaN per-class scores from their all-zero onehot
+        columns and are sliced off)."""
         num_classes = self.preds_suffix[0]
-        padded = -(-num_classes // self.world) * self.world
-        if padded != num_classes:
-            pad = jnp.zeros((preds.shape[0], padded - num_classes), preds.dtype)
-            preds = jnp.concatenate([preds, pad], axis=1)
-        program = _ovr_program(self.mesh, self.axis_name, self._masked_kernel)
-        per_class, support = program(preds, target, mask)
-        per_class, support = replica0(per_class)[:num_classes], replica0(support)[:num_classes]
+        if self.world > 1 and not _no_samplesort():
+            program = _ovr_a2a_program(
+                self.mesh, self.axis_name, kernel, num_classes, weighted=weighted
+            )
+            args = (self.buf_preds, self.buf_target)
+            args += (self.buf_weights,) if weighted else ()
+            per_class, support = program(*args, self.counts)
+        else:
+            if weighted:
+                preds, target, weights, mask = self._gathered()
+            else:
+                preds, target, mask = self._gathered()
+            padded = -(-num_classes // self.world) * self.world
+            if padded != num_classes:
+                pad = jnp.zeros((preds.shape[0], padded - num_classes), preds.dtype)
+                preds = jnp.concatenate([preds, pad], axis=1)
+            program = _ovr_program(self.mesh, self.axis_name, kernel, weighted=weighted)
+            args = (preds, target, mask) + ((weights,) if weighted else ())
+            per_class, support = program(*args)
+        per_class = replica0(per_class)[:num_classes]
+        support = replica0(support)[:num_classes]
         return _average_ovr(per_class, support, self.average, batch_local=self._batch_local_compute)
 
     def _compute_weighted(self) -> jax.Array:
-        """Weighted epilogue dispatch (binary streams only, enforced at
-        construction) — same backend split as the unweighted path: SPMD
-        sample-sort on accelerator meshes, fp64 host twin on single-process
-        CPU, gathered single-replica epilogue otherwise."""
+        """Weighted epilogue dispatch — same backend split as the
+        unweighted path: SPMD sample-sort (binary) / class-transpose
+        all_to_all (one-vs-rest) on meshes, fp64 host twin on
+        single-process CPU binary, gathered single-replica epilogue
+        otherwise; weights ride every program as a passenger operand."""
         out = self._samplesort_output
+        if self.preds_suffix:
+            # per-class weighted kernel keyed by _samplesort_output
+            kernel = (masked_weighted_binary_auroc, masked_weighted_binary_average_precision)[out]
+            return self._ovr_compute(kernel, weighted=True)
         if self.world > 1 and not _no_samplesort():
             if use_host_twin() and self.n_processes == 1:
                 return host_sample_sort_auroc_ap_weighted(self._shard_quads(), self.pos_label)[out]

@@ -694,6 +694,28 @@ def _min_max_jit(x):
     return jnp.min(x), jnp.max(x)
 
 
+def _check_sample_weights_range(sample_weights) -> None:
+    """Eager value probe shared by every weighted state design: reject
+    negative, NaN (via the min>=0 comparison), and infinite weights — a
+    negative weight breaks the monotone-cumulant designs, an infinite one
+    silently poisons histograms/cumulants. Skipped for traced or empty
+    arrays (the empty case fails the non-empty input checks instead)."""
+    import numpy as np
+
+    from metrics_tpu.utilities.data import _is_concrete
+
+    if not (_is_concrete(sample_weights) and sample_weights.size):
+        return
+    if isinstance(sample_weights, np.ndarray):
+        lo, hi = float(sample_weights.min()), float(sample_weights.max())
+    else:
+        lo, hi = (float(v) for v in _min_max_jit(sample_weights))
+    if not (lo >= 0 and np.isfinite(hi)):
+        raise ValueError(
+            f"sample_weights must be non-negative finite, got range [{lo}, {hi}]"
+        )
+
+
 def _check_retrieval_inputs(
     indexes,
     preds,

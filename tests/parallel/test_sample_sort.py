@@ -332,14 +332,66 @@ def test_sample_weights_api_contract():
     with pytest.raises(ValueError, match="with_sample_weights"):
         plain.update(p, t, sample_weights=jnp.ones((8,)))
 
-    with pytest.raises(ValueError, match="binary"):
-        M.ShardedAUROC(capacity_per_device=16, num_classes=4, with_sample_weights=True)
-
     # curve-shaped sharded metrics reject the flag at construction (their
     # compute has no weighted epilogue)
     for cls in (M.ShardedROC, M.ShardedPrecisionRecallCurve):
         with pytest.raises(ValueError, match="does not support sample weights"):
             cls(capacity_per_device=16, with_sample_weights=True)
+
+
+def test_weighted_ovr_multiclass():
+    """Weighted one-vs-rest: the class-transpose all_to_all program carries
+    the weights beside the targets; per-class values match sklearn's
+    weighted oracles, weighted averaging uses weighted supports, and the
+    gather-twin (METRICS_TPU_NO_SAMPLESORT) agrees."""
+    rng = np.random.RandomState(53)
+    n, num_classes = 1024, 11  # non-divisible: exercises class padding
+    probs = rng.rand(n, num_classes).astype(np.float32)
+    labels = rng.randint(num_classes, size=n).astype(np.int32)
+    weights = rng.exponential(size=n).astype(np.float32)
+
+    m = M.ShardedAUROC(
+        capacity_per_device=n // WORLD, num_classes=num_classes, average=None,
+        with_sample_weights=True,
+    )
+    m.update(jnp.asarray(probs), jnp.asarray(labels), sample_weights=jnp.asarray(weights))
+    per_class = np.asarray(m.compute())
+    assert per_class.shape == (num_classes,)
+    for c in range(num_classes):
+        want = roc_auc_score((labels == c).astype(int), probs[:, c], sample_weight=weights)
+        assert abs(per_class[c] - want) < 1e-5, (c, per_class[c], want)
+
+    # weighted averaging over weighted supports
+    mw = M.ShardedAUROC(
+        capacity_per_device=n // WORLD, num_classes=num_classes, average="weighted",
+        with_sample_weights=True,
+    )
+    mw.update(jnp.asarray(probs), jnp.asarray(labels), sample_weights=jnp.asarray(weights))
+    sup = np.array([weights[labels == c].sum() for c in range(num_classes)])
+    oracle = [roc_auc_score((labels == c).astype(int), probs[:, c], sample_weight=weights)
+              for c in range(num_classes)]
+    want_avg = float(np.sum(np.array(oracle) * sup / sup.sum()))
+    assert abs(float(mw.compute()) - want_avg) < 1e-5
+
+    # AP flavor + gather twin
+    ap = M.ShardedAveragePrecision(
+        capacity_per_device=n // WORLD, num_classes=num_classes, average=None,
+        with_sample_weights=True,
+    )
+    ap.update(jnp.asarray(probs), jnp.asarray(labels), sample_weights=jnp.asarray(weights))
+    ap_class = np.asarray(ap.compute())
+    for c in range(num_classes):
+        want = average_precision_score((labels == c).astype(int), probs[:, c], sample_weight=weights)
+        assert abs(ap_class[c] - want) < 1e-5, c
+
+    import os
+    os.environ["METRICS_TPU_NO_SAMPLESORT"] = "1"
+    try:
+        m._computed = None
+        twin = np.asarray(m.compute())
+        assert np.allclose(twin, per_class, atol=1e-6, equal_nan=True)
+    finally:
+        del os.environ["METRICS_TPU_NO_SAMPLESORT"]
 
 
 def test_masked_weighted_xla_epilogue_direct():
