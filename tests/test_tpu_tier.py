@@ -25,6 +25,8 @@ EXPECTED_CHECKS = {
     "samplesort_weighted_auroc",
     "samplesort_weighted_spmd_auroc",
     "samplesort_weighted_spmd_ap",
+    "weighted_ovr_macro",
+    "weighted_binned_histogram",
     "adv_weighted_gather_epilogue",
     "binned_auroc_histogram",
     "roc_curve_len",

@@ -204,6 +204,31 @@ def _child() -> None:
     check("adv_weighted_gather_epilogue", float(mw_a),
           roc_auc_score(bt, scores, sample_weight=sw), 1e-5)
 
+    # weighted one-vs-rest: the class-sharded weighted kernels (vmapped
+    # weighted co-sort + cumulants) on the chip, macro-averaged over
+    # weighted supports
+    ovr_n, ovr_c = sz(100_000), 6
+    ovr_p = rng.rand(ovr_n, ovr_c).astype(np.float32)
+    ovr_t = rng.randint(ovr_c, size=ovr_n).astype(np.int32)
+    ovr_w = rng.exponential(size=ovr_n).astype(np.float32)
+    ovr_m = M.ShardedAUROC(capacity_per_device=ovr_n, num_classes=ovr_c,
+                           average="macro", with_sample_weights=True)
+    ovr_m.update(jnp.asarray(ovr_p), jnp.asarray(ovr_t), sample_weights=jnp.asarray(ovr_w))
+    ovr_want = float(np.mean([
+        roc_auc_score((ovr_t == c).astype(int), ovr_p[:, c], sample_weight=ovr_w)
+        for c in range(ovr_c)
+    ]))
+    check("weighted_ovr_macro", float(ovr_m.compute()), ovr_want, 1e-5)
+
+    # weighted binned histograms via the TPU one-hot contraction path
+    bw_scores = (np.floor(rng.rand(sz(200_000)) * 512) / 512 + 0.5 / 512).astype(np.float32)
+    bw_t = rng.randint(2, size=bw_scores.shape[0])
+    bw_w = rng.rand(bw_scores.shape[0]).astype(np.float32)
+    bw_m = M.BinnedAUROC(num_bins=512)
+    bw_m.update(jnp.asarray(bw_scores), jnp.asarray(bw_t), sample_weights=jnp.asarray(bw_w))
+    check("weighted_binned_histogram", float(bw_m.compute()),
+          roc_auc_score(bw_t, bw_scores, sample_weight=bw_w), 1e-5)
+
     # BinnedAUROC — exercises the TPU-only histogram formulation (chunked
     # one-hot contraction on the MXU; the CPU suite only ever runs the
     # scatter-add branch of ops/histogram.py). Scores quantized to the bin
