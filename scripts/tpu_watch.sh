@@ -25,7 +25,7 @@ for i in $(seq 1 160); do
   fi
   if [ "$BENCH_OK" = 0 ]; then
     log "running bench..."
-    if timeout 2400 python bench.py > bench_watch.out 2>&1; then
+    if timeout 3600 python bench.py > bench_watch.out 2>&1; then
       grep -q '"platform": "tpu"' bench_watch.out && { BENCH_OK=1; log "bench TPU GREEN"; } || log "bench ran but platform != tpu"
     else
       log "bench failed"
