@@ -75,7 +75,18 @@ def _flat_cummax(v):
     return jnp.maximum(v, t)
 
 
-def _tie_scan_kernel(key_ref, pay_ref, offs_ref, out_ref, cnt_ref, carry_ref, lastkey_ref):
+def _tie_scan_kernel(*refs, weighted: bool = False):
+    """One grid step of the segmented scan. With ``weighted``, a third
+    input block carries per-element f32 weights: cumulants become weighted
+    sums (f32 carries — sequential block accumulation, no reassociation
+    dips), the MXU prefix dots pin ``precision=HIGHEST`` (weighted f32
+    operands would otherwise round to bf16 — the 0/1 unweighted operands
+    are bf16-exact so the default path keeps the fast dots), and the AP
+    ratio guard drops to an epsilon (weighted totals can sit below 1)."""
+    if weighted:
+        key_ref, pay_ref, w_ref, offs_ref, out_ref, cnt_ref, carry_ref, lastkey_ref = refs
+    else:
+        key_ref, pay_ref, offs_ref, out_ref, cnt_ref, carry_ref, lastkey_ref = refs
     b = pl.program_id(0)
 
     k = key_ref[...]
@@ -86,22 +97,32 @@ def _tie_scan_kernel(key_ref, pay_ref, offs_ref, out_ref, cnt_ref, carry_ref, la
     # to off_p * n_neg and is corrected by the caller instead.
     off_p = offs_ref[0]
     off_n = offs_ref[1]
-    pos = (pay == 3.0).astype(jnp.float32)  # rel=1, weight=1
-    neg = (pay == 2.0).astype(jnp.float32)  # rel=0, weight=1
+    if weighted:
+        wv = w_ref[...]
+        pos = jnp.where(pay == 3.0, wv, 0.0)  # rel=1, valid: weight
+        neg = jnp.where(pay == 2.0, wv, 0.0)  # rel=0, valid: weight
+        dot_prec = lax.Precision.HIGHEST
+        denom_floor = jnp.float32(1e-30)
+    else:
+        pos = (pay == 3.0).astype(jnp.float32)  # rel=1, weight=1
+        neg = (pay == 2.0).astype(jnp.float32)  # rel=0, weight=1
+        dot_prec = None
+        denom_floor = jnp.float32(1.0)
 
     @pl.when(b == 0)
     def _init():
-        cnt_ref[0] = jnp.int32(0)
-        cnt_ref[1] = jnp.int32(0)
+        cnt_ref[0] = jnp.zeros((), cnt_ref.dtype)
+        cnt_ref[1] = jnp.zeros((), cnt_ref.dtype)
         for i in range(4):
             carry_ref[i] = jnp.float32(0.0)
         # differ from the stream's first key so element 0 opens a group
         lastkey_ref[0] = ~k[0, 0]
 
-    # count carries live in i32: an f32 carry sticks at 2^24 (block sums of
-    # ~32k stay exact, but 16777216.0 + small-block remainders round away
-    # one element at a time once a class crosses 16.7M). The i32→f32
-    # convert below only rounds (≤0.5 ulp), it cannot stick.
+    # unweighted count carries live in i32: an f32 carry sticks at 2^24
+    # (block sums of ~32k stay exact, but 16777216.0 + small-block
+    # remainders round away one element at a time once a class crosses
+    # 16.7M). The i32→f32 convert below only rounds (≤0.5 ulp), it cannot
+    # stick. Weighted carries are f32 sums by nature.
     c_tps = cnt_ref[0].astype(jnp.float32)
     c_fps = cnt_ref[1].astype(jnp.float32)
     c_mt = carry_ref[0]
@@ -117,10 +138,14 @@ def _tie_scan_kernel(key_ref, pay_ref, offs_ref, out_ref, cnt_ref, carry_ref, la
     ri = lax.broadcasted_iota(jnp.int32, (_ROWS, _ROWS), 0)
     rj = lax.broadcasted_iota(jnp.int32, (_ROWS, _ROWS), 1)
     rtri = (ri < rj).astype(jnp.float32)  # (R, R) ones where i < j (exclusive)
-    pos_incl = jnp.dot(pos, tri, preferred_element_type=jnp.float32)
-    neg_incl = jnp.dot(neg, tri, preferred_element_type=jnp.float32)
-    pos_rows = jnp.dot(pos_incl[:, _LANES - 1 :].T, rtri, preferred_element_type=jnp.float32).T
-    neg_rows = jnp.dot(neg_incl[:, _LANES - 1 :].T, rtri, preferred_element_type=jnp.float32).T
+    pos_incl = jnp.dot(pos, tri, preferred_element_type=jnp.float32, precision=dot_prec)
+    neg_incl = jnp.dot(neg, tri, preferred_element_type=jnp.float32, precision=dot_prec)
+    pos_rows = jnp.dot(
+        pos_incl[:, _LANES - 1 :].T, rtri, preferred_element_type=jnp.float32, precision=dot_prec
+    ).T
+    neg_rows = jnp.dot(
+        neg_incl[:, _LANES - 1 :].T, rtri, preferred_element_type=jnp.float32, precision=dot_prec
+    ).T
     # exclusive flattened prefix = inclusive - self + prior-rows + carry
     ctps_prev = c_tps + pos_incl - pos + pos_rows
     cfps_prev = c_fps + neg_incl - neg + neg_rows
@@ -136,21 +161,27 @@ def _tie_scan_kernel(key_ref, pay_ref, offs_ref, out_ref, cnt_ref, carry_ref, la
     mf = jnp.maximum(c_mf, _flat_shift1(_flat_cummax(w), fill=ninf))
 
     chord = jnp.where(is_first, 0.5 * (ctps_prev + mt) * (cfps_prev - mf), 0.0)
-    prec = (ctps_prev + off_p) / jnp.maximum(ctps_prev + cfps_prev + off_p + off_n, 1.0)
+    prec = (ctps_prev + off_p) / jnp.maximum(ctps_prev + cfps_prev + off_p + off_n, denom_floor)
     ap_term = jnp.where(is_first, (ctps_prev - mt) * prec, 0.0)
 
-    # block sums are ≤ 32768 and integer-valued in f32 — the i32 cast is exact
-    new_tps_i = cnt_ref[0] + jnp.sum(pos).astype(jnp.int32)
-    new_fps_i = cnt_ref[1] + jnp.sum(neg).astype(jnp.int32)
-    new_tps = new_tps_i.astype(jnp.float32)
-    new_fps = new_fps_i.astype(jnp.float32)
+    if weighted:
+        new_tps_c = cnt_ref[0] + jnp.sum(pos)
+        new_fps_c = cnt_ref[1] + jnp.sum(neg)
+        new_tps = new_tps_c
+        new_fps = new_fps_c
+    else:
+        # block sums are ≤ 32768 and integer-valued in f32 — i32 cast exact
+        new_tps_c = cnt_ref[0] + jnp.sum(pos).astype(jnp.int32)
+        new_fps_c = cnt_ref[1] + jnp.sum(neg).astype(jnp.int32)
+        new_tps = new_tps_c.astype(jnp.float32)
+        new_fps = new_fps_c.astype(jnp.float32)
     new_mt = jnp.maximum(c_mt, jnp.max(v))
     new_mf = jnp.maximum(c_mf, jnp.max(w))
 
     new_area = carry_ref[2] + jnp.sum(chord)
     new_ap = carry_ref[3] + jnp.sum(ap_term)
-    cnt_ref[0] = new_tps_i
-    cnt_ref[1] = new_fps_i
+    cnt_ref[0] = new_tps_c
+    cnt_ref[1] = new_fps_c
     carry_ref[0] = new_mt
     carry_ref[1] = new_mf
     carry_ref[2] = new_area
@@ -165,7 +196,7 @@ def _tie_scan_kernel(key_ref, pay_ref, offs_ref, out_ref, cnt_ref, carry_ref, la
     mf_f = jnp.maximum(new_mf, 0.0)
     area_f = new_area + 0.5 * (new_tps + mt_f) * (new_fps - mf_f)
     ap_f = new_ap + (new_tps - mt_f) * (
-        (new_tps + off_p) / jnp.maximum(new_tps + new_fps + off_p + off_n, 1.0)
+        (new_tps + off_p) / jnp.maximum(new_tps + new_fps + off_p + off_n, denom_floor)
     )
     orow = lax.broadcasted_iota(jnp.int32, (8, _LANES), 0)
     ocol = lax.broadcasted_iota(jnp.int32, (8, _LANES), 1)
@@ -177,7 +208,11 @@ def _tie_scan_kernel(key_ref, pay_ref, offs_ref, out_ref, cnt_ref, carry_ref, la
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def tie_group_reduce(
-    key_s: jax.Array, payload_s: jax.Array, offsets: jax.Array = None, interpret: bool = False
+    key_s: jax.Array,
+    payload_s: jax.Array,
+    offsets: jax.Array = None,
+    weights_s: jax.Array = None,
+    interpret: bool = False,
 ) -> jax.Array:
     """AUROC area + AP sum + class totals of a key-sorted weighted stream.
 
@@ -193,14 +228,21 @@ def tie_group_reduce(
             sample-sort epilogue). They shift the AP precision ratio
             in-kernel; the area stays LOCAL — its offset term telescopes,
             so the caller adds ``off_p * n_neg`` instead.
+        weights_s: optional ``(N,)`` non-negative f32 per-element weights,
+            co-sorted with the keys. Cumulants become weighted f32 sums
+            (sequential block carries; the MXU prefix dots run at
+            ``precision=HIGHEST`` — bf16-rounded weighted operands would
+            cost ~1e-3 relative). The i32-exactness guarantee is a count
+            property and does not apply to weighted sums.
 
     Returns:
-        ``(4,)`` f32 ``[area, ap_sum, n_pos, n_neg]`` — the sufficient
+        ``(4,)`` f32 ``[area, ap_sum, w_pos, w_neg]`` — the sufficient
         statistics both score formulas normalize from (``area`` local, see
         ``offsets``).
     """
     if offsets is None:
         offsets = jnp.zeros((2,), jnp.float32)
+    weighted = weights_s is not None
     n = key_s.shape[0]
     blk = _ROWS * _LANES
     nb = max(1, -(-n // blk))
@@ -210,29 +252,40 @@ def tie_group_reduce(
     key2 = key_p.reshape(nb * _ROWS, _LANES)
     pay2 = pay_p.reshape(nb * _ROWS, _LANES)
 
+    blockspec = pl.BlockSpec((_ROWS, _LANES), lambda b: (b, 0))
+    operands = [key2, pay2]
+    in_specs = [blockspec, blockspec]
+    if weighted:
+        w_p = jnp.pad(weights_s.astype(jnp.float32), (0, pad))
+        operands.append(w_p.reshape(nb * _ROWS, _LANES))
+        in_specs.append(blockspec)
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    operands.append(offsets.astype(jnp.float32))
+
     out = pl.pallas_call(
-        _tie_scan_kernel,
+        functools.partial(_tie_scan_kernel, weighted=weighted),
         grid=(nb,),
-        in_specs=[
-            pl.BlockSpec((_ROWS, _LANES), lambda b: (b, 0)),
-            pl.BlockSpec((_ROWS, _LANES), lambda b: (b, 0)),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((8, _LANES), lambda b: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((8, _LANES), jnp.float32),
         scratch_shapes=[
-            pltpu.SMEM((2,), jnp.int32),  # exact tps/fps count carries
+            # exact i32 tps/fps count carries; weighted sums carry in f32
+            pltpu.SMEM((2,), jnp.float32 if weighted else jnp.int32),
             pltpu.SMEM((4,), jnp.float32),  # mt, mf, area, ap carries
             pltpu.SMEM((1,), jnp.uint32),
         ],
         interpret=interpret,
-    )(key2, pay2, offsets.astype(jnp.float32))
+    )(*operands)
     return out[0, :4]
 
 
 def auroc_ap_from_stats(stats: jax.Array):
-    """(AUROC, AP) from ``tie_group_reduce`` output, NaN on degenerate."""
+    """(AUROC, AP) from ``tie_group_reduce`` output, NaN on degenerate.
+
+    The epsilon guard (not ``max(·, 1)``) keeps the normalization correct
+    for weighted stats too, whose class totals can legitimately sit below
+    1; the zero case still yields NaN via the ``where``."""
     area, ap_sum, n_pos, n_neg = stats[0], stats[1], stats[2], stats[3]
-    auroc = jnp.where(n_pos * n_neg == 0, jnp.nan, area / jnp.maximum(n_pos * n_neg, 1.0))
-    ap = jnp.where(n_pos == 0, jnp.nan, ap_sum / jnp.maximum(n_pos, 1.0))
+    auroc = jnp.where(n_pos * n_neg == 0, jnp.nan, area / jnp.maximum(n_pos * n_neg, 1e-30))
+    ap = jnp.where(n_pos == 0, jnp.nan, ap_sum / jnp.maximum(n_pos, 1e-30))
     return auroc, ap

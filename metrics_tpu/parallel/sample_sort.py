@@ -135,10 +135,20 @@ def _tie_stats_w(key_s, pay_s, w_s, off_pw, off_nw):
     enforced at update time by the sharded metrics. Invalid/padding slots
     carry payload 0 AND weight 0, so they move nothing.
 
-    No Pallas branch: the weighted epilogue is XLA-only for now (the Pallas
-    tie scan carries i32 count cumulants; a weighted variant would need f32
-    carries — measured unnecessary at current sizes).
+    On TPU the epilogue is the same single-pass Pallas tie scan as the
+    unweighted path, with weights as a third input block and f32 sum
+    carries (``ops/tie_scan_pallas`` ``weights_s=``).
     """
+    from metrics_tpu.ops.auroc_kernel import _use_pallas_epilogue
+
+    if _use_pallas_epilogue():
+        from metrics_tpu.ops.tie_scan_pallas import tie_group_reduce
+
+        stats = tie_group_reduce(
+            key_s, pay_s, offsets=jnp.stack([off_pw, off_nw]), weights_s=w_s
+        )
+        area = stats[0] + off_pw * stats[3]
+        return area, stats[1], stats[2], stats[3]
     pos_w = jnp.where(pay_s == 3.0, w_s, 0.0)
     neg_w = jnp.where(pay_s == 2.0, w_s, 0.0)
     tws = lax.cummax(jnp.cumsum(pos_w))

@@ -195,3 +195,113 @@ def test_offset_aware_ap_matches_xla_tie_stats(monkeypatch):
             assert np.isclose(float(stats[1]), float(want[1]), rtol=1e-5), (
                 off_p, float(stats[1]), float(want[1]))
             assert int(stats[2]) == int(want[2]) and int(stats[3]) == int(want[3])
+
+
+# ----------------------------------------------------------------------
+# weighted kernel (weights_s= third input block, f32 sum carries)
+# ----------------------------------------------------------------------
+
+
+def _pallas_weighted(preds, rel, w, off=(0.0, 0.0)):
+    preds = jnp.asarray(preds, jnp.float32)
+    rel = jnp.asarray(rel, jnp.float32)
+    w = jnp.asarray(w, jnp.float32)
+    key_s, pay_s, w_s = lax.sort(
+        (_descending_key(preds), rel + 2.0, w), num_keys=1, is_stable=False
+    )
+    return tie_group_reduce(
+        key_s, pay_s, offsets=jnp.asarray(off, jnp.float32), weights_s=w_s, interpret=True
+    )
+
+
+def _sk_weighted(preds, rel, w):
+    from sklearn.metrics import average_precision_score, roc_auc_score
+
+    return (
+        roc_auc_score(rel, preds, sample_weight=w),
+        average_precision_score(rel, preds, sample_weight=w),
+    )
+
+
+@pytest.mark.parametrize("n", [64, 1000, 32768, 40000])
+def test_weighted_random_vs_sklearn(n):
+    rng = np.random.RandomState(n)
+    preds = (np.round(rng.rand(n) * 50) / 50).astype(np.float32)  # tie-heavy
+    rel = (rng.rand(n) < preds).astype(np.float32)
+    w = rng.exponential(size=n).astype(np.float32)
+    stats = _pallas_weighted(preds, rel, w)
+    area, ap_sum, w_pos, w_neg = (float(x) for x in stats)
+    want_a, want_ap = _sk_weighted(preds, rel, w)
+    assert abs(area / (w_pos * w_neg) - want_a) < 1e-5
+    assert abs(ap_sum / w_pos - want_ap) < 1e-5
+    assert abs(w_pos - float(w[rel == 1].sum())) < max(1e-3, 1e-6 * n)
+    assert abs(w_neg - float(w[rel == 0].sum())) < max(1e-3, 1e-6 * n)
+
+
+def test_weighted_zero_weights_inert():
+    """Weight-0 elements are excluded exactly, like masked elements in the
+    unweighted kernel."""
+    rng = np.random.RandomState(3)
+    n = 4096
+    preds = rng.rand(n).astype(np.float32)
+    rel = (rng.rand(n) < preds).astype(np.float32)
+    w = (rng.rand(n) < 0.6).astype(np.float32)
+    stats = _pallas_weighted(preds, rel, w)
+    keep = w.astype(bool)
+    from sklearn.metrics import roc_auc_score
+
+    want = roc_auc_score(rel[keep], preds[keep])
+    assert abs(float(stats[0]) / (float(stats[2]) * float(stats[3])) - want) < 1e-5
+
+
+def test_weighted_matches_unweighted_on_unit_weights():
+    """weights_s of all-ones must agree with the unweighted kernel to f32
+    dot noise (the two branches share every structural step)."""
+    rng = np.random.RandomState(7)
+    n = 33000  # spans blocks incl. padding tail
+    preds = (np.round(rng.rand(n) * 20) / 20).astype(np.float32)
+    rel = (rng.rand(n) < 0.4).astype(np.float32)
+    stats_w = _pallas_weighted(preds, rel, np.ones(n, np.float32))
+    key_s, pay_s = lax.sort(
+        (_descending_key(jnp.asarray(preds)), jnp.asarray(rel) + 2.0), num_keys=1, is_stable=False
+    )
+    stats_u = tie_group_reduce(key_s, pay_s, interpret=True)
+    for a, b in zip(stats_w, stats_u):
+        assert abs(float(a) - float(b)) < 2e-2, (float(a), float(b))
+
+
+def test_weighted_offsets_shift_ap_ratio(monkeypatch):
+    """Bucket offsets enter the weighted AP ratio exactly as in the XLA
+    twin (_tie_stats_w), including the telescoped area correction."""
+    import metrics_tpu.ops.auroc_kernel as ak
+    from metrics_tpu.parallel.sample_sort import _tie_stats_w
+
+    # pin the reference to the XLA branch: on a TPU host _tie_stats_w would
+    # itself dispatch to the Pallas kernel and the check would be vacuous
+    monkeypatch.setattr(ak, "_use_pallas_epilogue", lambda: False)
+
+    rng = np.random.RandomState(11)
+    n = 2048
+    preds = (np.round(rng.rand(n) * 10) / 10).astype(np.float32)
+    rel = (rng.rand(n) < 0.5).astype(np.float32)
+    w = rng.rand(n).astype(np.float32)
+    off_p, off_n = 37.5, 52.25
+
+    key_s, pay_s, w_s = lax.sort(
+        (_descending_key(jnp.asarray(preds)), jnp.asarray(rel) + 2.0, jnp.asarray(w)),
+        num_keys=1, is_stable=False,
+    )
+    stats = tie_group_reduce(
+        key_s, pay_s, offsets=jnp.asarray([off_p, off_n], jnp.float32),
+        weights_s=w_s, interpret=True,
+    )
+    pallas_area = float(stats[0]) + off_p * float(stats[3])
+    # XLA twin on the same sorted stream (force the non-Pallas branch: CPU
+    # backend returns False from _use_pallas_epilogue already)
+    xla_area, xla_ap, xla_wp, xla_wn = _tie_stats_w(
+        key_s, pay_s, w_s, jnp.float32(off_p), jnp.float32(off_n)
+    )
+    assert abs(pallas_area - float(xla_area)) < 1e-2
+    assert abs(float(stats[1]) - float(xla_ap)) < 1e-3
+    assert abs(float(stats[2]) - float(xla_wp)) < 1e-2
+    assert abs(float(stats[3]) - float(xla_wn)) < 1e-2
