@@ -264,6 +264,25 @@ for _ in range(3):
     epoch()
     times.append(time.perf_counter() - t0)
 print("COLLECTION_SYNC_MS", min(times) * 1e3)
+
+# the weighted exact epilogue on the same mesh (third co-sorted stream;
+# argsort host twin on CPU meshes — the weighted path gives up the
+# packed-radix trick, which is the honest CPU-mesh cost of weights)
+from sklearn.metrics import roc_auc_score
+
+w = rng.exponential(size=N).astype(np.float32)
+mw = SA(capacity_per_device=N // 8, with_sample_weights=True)
+mw.update(jp, jt, sample_weights=jnp.asarray(w))
+want_w = roc_auc_score(target, preds, sample_weight=w)
+v = float(mw.compute())
+assert abs(v - want_w) < 1e-5, (v, want_w)
+times = []
+for _ in range(3):
+    mw._computed = None
+    t0 = time.perf_counter()
+    float(mw.compute())
+    times.append(time.perf_counter() - t0)
+print("SYNC_WEIGHTED_MS", min(times) * 1e3)
 """
     proc = run_in_virtual_mesh(code, 8, cwd=repo)
     out = _leg_stdout(proc, "sync")
@@ -271,6 +290,7 @@ print("COLLECTION_SYNC_MS", min(times) * 1e3)
         float(_marker_values(out, "SYNC_MS", "sync")[0]),
         float(_marker_values(out, "SYNC_GATHER_MS", "sync")[0]),
         float(_marker_values(out, "COLLECTION_SYNC_MS", "sync")[0]),
+        float(_marker_values(out, "SYNC_WEIGHTED_MS", "sync")[0]),
     )
 
 
@@ -858,13 +878,14 @@ def main() -> None:
         ref_time = None
 
     try:
-        sync_ms, sync_gather_ms, collection_sync_ms = _bench_sync_cpu()
+        sync_ms, sync_gather_ms, collection_sync_ms, sync_weighted_ms = _bench_sync_cpu()
         sync_ms = round(sync_ms, 3)
         sync_gather_ms = round(sync_gather_ms, 3)
         collection_sync_ms = round(collection_sync_ms, 3)
+        sync_weighted_ms = round(sync_weighted_ms, 3)
     except Exception as err:
         print(f"WARNING: 8-device sync leg failed ({err!r})", file=sys.stderr)
-        sync_ms = sync_gather_ms = collection_sync_ms = None
+        sync_ms = sync_gather_ms = collection_sync_ms = sync_weighted_ms = None
 
     try:
         binned = _bench_binned_sync()
@@ -938,6 +959,9 @@ def main() -> None:
         # compute) of MetricCollection[Accuracy,F1] + ShardedAUROC +
         # ShardedRetrievalMAP/MRR at 1M/10k queries on the 8-device mesh
         "collection_sync_8dev_cpu_ms": collection_sync_ms,
+        # the weighted exact epilogue (with_sample_weights=True) on the
+        # same mesh and workload, value-checked vs sklearn in-leg
+        "sync_weighted_8dev_cpu_ms": sync_weighted_ms,
         # the north-star proxy table; see comment at _bench_reference_gloo
         "sync_overhead": sync_overhead,
         # BASELINE.md configs #2/#4/#5 (StatScores/F1, regression pack,
