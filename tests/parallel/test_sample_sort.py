@@ -445,3 +445,21 @@ def test_skew_degenerate_scale_1m():
     a_s, ap_s = sample_sort_auroc_ap(bp, bt, counts, _mesh(), "data")
     assert abs(float(a_s) - want_a) < 1e-5
     assert abs(float(ap_s) - want_ap) < 1e-5
+
+
+def test_weighted_bf16_buffer():
+    """bf16 score buffers compose with sample weights: the result is the
+    exact weighted metric of the bf16-quantized scores (the documented
+    quantize-on-append semantics, unchanged by the weight stream)."""
+    rng = np.random.RandomState(71)
+    n = WORLD * 256
+    p = rng.rand(n).astype(np.float32)
+    t = (rng.rand(n) < p).astype(np.int32)
+    w = rng.exponential(size=n).astype(np.float32)
+
+    m = M.ShardedAUROC(capacity_per_device=n // WORLD, preds_dtype=jnp.bfloat16,
+                       with_sample_weights=True)
+    m.update(jnp.asarray(p), jnp.asarray(t), sample_weights=jnp.asarray(w))
+    p_q = np.asarray(jnp.asarray(p).astype(jnp.bfloat16).astype(jnp.float32))
+    want = roc_auc_score(t, p_q, sample_weight=w)
+    assert abs(float(m.compute()) - want) < 1e-5
