@@ -408,10 +408,14 @@ print("LOCAL_MS", min(times) * 1e3)
     return float(_marker_values(_leg_stdout(proc, "local"), "LOCAL_MS", "local")[0])
 
 
-def _bench_module_forward() -> float:
+def _bench_module_forward() -> dict:
     """Library-level hot loop: a 4-metric MetricCollection forward at 1M×4
-    multiclass preds — the fused one-update forward + single-pass kernels +
-    sibling kernel sharing, end to end through the public API.
+    multiclass preds — eager (fused one-update forward + single-pass kernels
+    + sibling kernel sharing) vs the compiled step engine
+    (``MetricCollection(..., compiled=True)``: ONE donated XLA dispatch per
+    step), end to end through the public API. A second pair runs the
+    5-metric regression family at 1M, whose compiled step reads the input
+    arrays exactly once via the shared sufficient-stats pass.
 
     Runs CPU-forced in a subprocess (the remote-TPU tunnel's ~65ms RTT would
     swamp the eager-validation host reads this path makes by design; on a
@@ -427,30 +431,62 @@ import time
 import jax
 jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp, numpy as np
-from metrics_tpu import Accuracy, F1, MetricCollection, Precision, Recall
+from metrics_tpu import (Accuracy, ExplainedVariance, F1, MeanAbsoluteError,
+                         MeanSquaredError, MetricCollection, PSNR, Precision,
+                         R2Score, Recall)
 
 rng = np.random.RandomState(0)
 probs = jnp.asarray(rng.rand(1_000_000, 4).astype(np.float32))
 probs = probs / probs.sum(1, keepdims=True)
 target = jnp.asarray(rng.randint(4, size=1_000_000))
+reg_t = jnp.asarray((rng.randn(1_000_000) * 3 + 1).astype(np.float32))
+reg_p = reg_t + jnp.asarray(rng.randn(1_000_000).astype(np.float32))
 
-col = MetricCollection([Accuracy(), Precision(num_classes=4, average="macro"),
-                        Recall(num_classes=4, average="macro"), F1(num_classes=4, average="macro")])
-v = col(probs, target)
-jax.block_until_ready(col["Accuracy"].correct); jax.block_until_ready(col["F1"].tp)
-t0 = time.perf_counter()
-for _ in range(10):
-    v = col(probs, target)
-for m in col.values():
-    for name in m._defaults:
-        jax.block_until_ready(getattr(m, name))
-jax.block_until_ready(v["F1"])
-print("FORWARD_MS", (time.perf_counter() - t0) / 10 * 1e3)
+def cls_col(compiled):
+    return MetricCollection([Accuracy(), Precision(num_classes=4, average="macro"),
+                             Recall(num_classes=4, average="macro"),
+                             F1(num_classes=4, average="macro")], compiled=compiled)
+
+def reg_col(compiled):
+    return MetricCollection([MeanSquaredError(), MeanAbsoluteError(), R2Score(),
+                             PSNR(), ExplainedVariance()], compiled=compiled)
+
+def run(col, p, t):
+    v = col(p, t)
+    for m in col.values():
+        for name in m._defaults:
+            jax.block_until_ready(getattr(m, name))
+    jax.block_until_ready(list(v.values())[-1])
+
+def leg(marker, col, p, t):
+    run(col, p, t)  # warm compiles + transfers
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(10):
+            run(col, p, t)
+        best = min(best, (time.perf_counter() - t0) / 10 * 1e3)
+    print(marker, best, flush=True)
+
+leg("FORWARD_MS", cls_col(False), probs, target)
+leg("FORWARD_COMPILED_MS", cls_col(True), probs, target)
+leg("REG_FORWARD_MS", reg_col(False), reg_p, reg_t)
+leg("REG_FORWARD_COMPILED_MS", reg_col(True), reg_p, reg_t)
 """
     proc = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True, timeout=480, cwd=repo
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=900, cwd=repo
     )
-    return round(float(_marker_values(_leg_stdout(proc, "module forward"), "FORWARD_MS", "module forward")[0]), 1)
+    out = _leg_stdout(proc, "module forward")
+    legs = {
+        "collection_forward_1m_cpu_ms": "FORWARD_MS",
+        "collection_forward_compiled_1m_cpu_ms": "FORWARD_COMPILED_MS",
+        "regression_collection_forward_1m_cpu_ms": "REG_FORWARD_MS",
+        "regression_collection_forward_compiled_1m_cpu_ms": "REG_FORWARD_COMPILED_MS",
+    }
+    return {
+        key: round(float(_marker_values(out, marker, "module forward")[0]), 1)
+        for key, marker in legs.items()
+    }
 
 
 def _bench_binned_sync() -> dict:
@@ -894,10 +930,10 @@ def main() -> None:
         binned = {}
 
     try:
-        forward_ms = _bench_module_forward()
+        forward_legs = _bench_module_forward()
     except Exception as err:
         print(f"WARNING: module forward leg failed ({err!r})", file=sys.stderr)
-        forward_ms = None
+        forward_legs = {}
 
     # north-star proxy (BASELINE.md "sync within +5% of NCCL DDP" is
     # unmeasurable without GPUs): like-for-like sync overhead on this host —
@@ -970,9 +1006,12 @@ def main() -> None:
         # the O(bins) scalable sync story: histogram states, one psum,
         # with the measured |binned - exact| cost of the approximation
         **binned,
-        # library-level hot loop: 4-metric collection forward at 1M×4
-        # (fused one-update forward + single-pass kernels + sibling sharing)
-        "collection_forward_1m_cpu_ms": forward_ms,
+        # library-level hot loop: 4-metric collection forward at 1M×4,
+        # eager (fused one-update forward + single-pass kernels + sibling
+        # sharing) next to the compiled step engine (ONE donated XLA
+        # dispatch per step), plus the regression-family pair whose
+        # compiled step reads the inputs once via shared sufficient stats
+        **forward_legs,
         "platform": platform,
     }
 

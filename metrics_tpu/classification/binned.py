@@ -23,7 +23,7 @@ from metrics_tpu.ops.histogram import (
 )
 from metrics_tpu.utilities.checks import (
     _check_retrieval_functional_inputs,
-    _check_sample_weights_range,
+    _guard_sample_weights,
     _min_max_jit,
 )
 from metrics_tpu.utilities.data import _is_concrete
@@ -37,6 +37,8 @@ class _BinnedScoreMetric(Metric):
     score rows with integer labels, per-class one-vs-rest ``(C, num_bins)``
     histograms — still psum-able, still O(state) independent of dataset size.
     """
+
+    _fused_forward = True  # additive histogram states: one-update forward
 
     def __init__(
         self,
@@ -76,7 +78,14 @@ class _BinnedScoreMetric(Metric):
         histograms into weighted sums — the O(bins) analog of the curve
         core's per-call weights; unlike the sharded family no constructor
         flag is needed (histogram state is weight-shape-free), matching the
-        reference's per-call functional contract."""
+        reference's per-call functional contract.
+
+        Weight-range validation is **eager-only**: concrete weights are
+        value-checked and raise on negative/non-finite entries, but under
+        ``jit`` (traced weights) that check cannot run — traced negative
+        weights are instead rewritten to NaN in-graph, so they fail
+        visibly in the computed value rather than silently corrupting the
+        histograms (see ``utilities/checks._guard_sample_weights``)."""
         if sample_weights is not None:
             sample_weights = jnp.asarray(sample_weights, jnp.float32).flatten()
             if sample_weights.shape[0] != jnp.asarray(target).size:
@@ -84,7 +93,7 @@ class _BinnedScoreMetric(Metric):
                     f"expected sample_weights with one weight per target element"
                     f" ({jnp.asarray(target).size}), got {sample_weights.shape[0]}"
                 )
-            _check_sample_weights_range(sample_weights)
+            sample_weights = _guard_sample_weights(sample_weights)
         if self._is_multiclass:
             preds = jnp.asarray(preds)
             target = jnp.asarray(target)

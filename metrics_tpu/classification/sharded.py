@@ -77,7 +77,10 @@ def _masked_weighted_auroc_ap(preds, target, mask, weights, pos_label):
     pay_s = 3.0 - inv_s
     zero = jnp.float32(0.0)
     area, ap, w_pos, w_neg = _tie_stats_w(key_s, pay_s, w_s, zero, zero)
-    auroc = jnp.where(w_pos * w_neg == 0, jnp.nan, area / jnp.maximum(w_pos * w_neg, 1e-30))
+    # degeneracy test on the FACTORS, not the product: w_pos * w_neg can
+    # underflow f32 to 0 for tiny-but-legitimate weights (~1e-20 each side)
+    # and must not fake a NaN-AUROC degeneracy
+    auroc = jnp.where((w_pos == 0) | (w_neg == 0), jnp.nan, area / jnp.maximum(w_pos * w_neg, 1e-30))
     ap_v = jnp.where(w_pos == 0, jnp.nan, ap / jnp.maximum(w_pos, 1e-30))
     return auroc, ap_v
 
@@ -313,7 +316,14 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
         """Append a batch of ``(n, *preds_suffix)`` scores / ``(n,)`` targets,
         ``n`` divisible by the mesh-axis size (the usual SPMD batch
         contract). ``sample_weights`` (``(n,)``, non-negative) is required
-        iff the metric was constructed ``with_sample_weights=True``."""
+        iff the metric was constructed ``with_sample_weights=True``.
+
+        Weight-range validation is **eager-only**: concrete weights are
+        value-checked and raise on negative/non-finite entries, but under
+        ``jit`` (traced weights) that check cannot run — traced negative
+        weights are instead rewritten to NaN in-graph so the corruption
+        fails visibly in the computed value (see
+        ``utilities/checks._guard_sample_weights``)."""
         # keep host inputs on host — _append_streams casts to the stream
         # dtypes and stages exactly once (multi-process staging needs host
         # arrays anyway)
@@ -337,10 +347,11 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
                     f" got {sample_weights.shape}"
                 )
             # eager value probe (same discipline as the label-range check
-            # below), shared with the binned family
-            from metrics_tpu.utilities.checks import _check_sample_weights_range
+            # below), shared with the binned family; traced weights get the
+            # in-graph negative→NaN poison guard instead
+            from metrics_tpu.utilities.checks import _guard_sample_weights
 
-            _check_sample_weights_range(sample_weights)
+            sample_weights = _guard_sample_weights(sample_weights)
         if target.ndim != 1 or preds.shape != (target.shape[0], *self.preds_suffix):
             shape_desc = "(n" + "".join(f", {d}" for d in self.preds_suffix) + ")"
             raise ValueError(

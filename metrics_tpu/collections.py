@@ -10,6 +10,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 
 import jax.numpy as jnp
 
+from metrics_tpu.functional.regression.sufficient_stats import regression_family_sharing
 from metrics_tpu.metric import Metric
 from metrics_tpu.utilities.checks import shared_canonicalization
 
@@ -26,6 +27,14 @@ class MetricCollection:
               same metric class with different parameters.
 
         prefix: a string to append in front of the keys of the output dict
+        compiled: route ``forward`` through the compiled step engine
+            (:class:`~metrics_tpu.engine.CompiledStepEngine`): the whole
+            fan-out — shared canonicalization, every member's update, the
+            batch-local computes, and the state merges — becomes ONE donated
+            XLA dispatch per step, cached per input signature. Metrics whose
+            forward is not trace-pure (list/"cat" states, host-level sync)
+            transparently keep their eager forward. Note compiled steps skip
+            eager-only value validation, exactly as any jitted path does.
 
     Example (input as list):
         >>> import jax.numpy as jnp
@@ -49,8 +58,11 @@ class MetricCollection:
         self,
         metrics: Union[List[Metric], Tuple[Metric, ...], Dict[str, Metric]],
         prefix: Optional[str] = None,
+        compiled: bool = False,
     ):
         self._metrics: "OrderedDict[str, Metric]" = OrderedDict()
+        self.compiled = bool(compiled)
+        self._engine = None
         if isinstance(metrics, dict):
             for name, metric in metrics.items():
                 if not isinstance(metric, Metric):
@@ -79,6 +91,7 @@ class MetricCollection:
 
     def __setitem__(self, key: str, value: Metric) -> None:
         self._metrics[key] = value
+        self._engine = None  # membership changed: stale compiled programs
 
     def __contains__(self, key: str) -> bool:
         return key in self._metrics
@@ -103,8 +116,17 @@ class MetricCollection:
 
         Sibling metrics with identical canonicalization options share one
         input canonicalization (see
-        :func:`~metrics_tpu.utilities.checks.shared_canonicalization`)."""
-        with shared_canonicalization():
+        :func:`~metrics_tpu.utilities.checks.shared_canonicalization`).
+        With ``compiled=True`` the whole fan-out runs as one donated XLA
+        dispatch through the step engine instead."""
+        if self.compiled:
+            if self._engine is None:
+                from metrics_tpu.engine import CompiledStepEngine
+
+                self._engine = CompiledStepEngine(self._metrics)
+            values = self._engine.step(*args, **kwargs)
+            return {self._set_prefix(k): values[k] for k in self._metrics}
+        with shared_canonicalization(), regression_family_sharing():
             return {self._set_prefix(k): m(*args, **m._filter_kwargs(**kwargs)) for k, m in self.items()}
 
     __call__ = forward
@@ -113,7 +135,7 @@ class MetricCollection:
         """Call update for each metric; kwargs are filtered per metric
         signature. Canonicalization is shared across siblings (see
         :meth:`forward`)."""
-        with shared_canonicalization():
+        with shared_canonicalization(), regression_family_sharing():
             for _, m in self.items():
                 m.update(*args, **m._filter_kwargs(**kwargs))
 
@@ -130,6 +152,16 @@ class MetricCollection:
         mc = deepcopy(self)
         mc.prefix = self._check_prefix_arg(prefix)
         return mc
+
+    # compiled programs close over THESE metric instances and hold
+    # unpicklable XLA executables: a copy/pickle drops the engine and lazily
+    # rebuilds it against its own metric objects on the next forward
+    def __getstate__(self) -> dict:
+        return {k: v for k, v in self.__dict__.items() if k != "_engine"}
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        self._engine = None
 
     def persistent(self, mode: bool = True) -> None:
         """Change whether metric states are saved to ``state_dict``."""

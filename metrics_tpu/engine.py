@@ -1,0 +1,331 @@
+"""Compiled step engine: one donated XLA dispatch per forward.
+
+The eager module layer runs each metric's update/compute chain as a string
+of small device programs — at a 4-metric ``MetricCollection`` forward over
+1M×4 preds that dispatch overhead dominates the math by an order of
+magnitude (``collection_forward_1m_cpu_ms`` in ``bench.py``). The same
+lesson the collective-compilation papers draw for communication (EQuARX,
+weight-update sharding) applies to metric plumbing: the win is compiling
+the *whole step* into one XLA program, not making the fragments faster.
+
+:class:`CompiledStepEngine` traces the entire forward of a
+:class:`~metrics_tpu.Metric` or :class:`~metrics_tpu.MetricCollection` —
+shared input canonicalization, every member's ``update`` on fresh state,
+the batch-local ``compute``, and the fused-forward state merge — into a
+single jitted pure function::
+
+    step(states_pytree, args, kwargs) -> (new_states_pytree, batch_values)
+
+with ``donate_argnums`` on the state pytree so accumulators update in
+place in HBM instead of allocating a new buffer per step.
+
+Compiled entries are cached per *call signature* — the
+(shape, dtype, kwargs-structure) tuple of the inputs, so e.g.
+weights-present and weights-absent steps compile separately — in a small
+capped LRU. Metrics whose forward is not trace-pure (list/"cat" states,
+data-dependent output widths, per-step host sync) fall back to the eager
+forward per metric, gracefully and permanently for that engine.
+
+Semantics match the fused one-update forward (``Metric._forward_fused``):
+one ``update`` on fresh default state produces the batch stats, the
+batch-local value is computed from them (``_batch_local_compute`` set), and
+the stats are folded into the accumulated state by each state's registered
+reduction. Value-range validation is skipped under tracing exactly as the
+library's eager-only checks skip it on any traced path.
+
+Caveat (donation): the state buffers passed into the compiled step are
+donated to XLA and **invalidated**. The engine hands back the freshly
+merged buffers, so metric attributes are always valid — but external
+references obtained *before* a compiled step (e.g. a manually captured
+``_snapshot_state``) may become unreadable after it. Buffers that alias a
+registered default are defensively copied so ``reset()`` always works.
+"""
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Mapping, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.functional.regression.sufficient_stats import regression_family_sharing
+from metrics_tpu.metric import Metric
+from metrics_tpu.parallel.backend import is_distributed_initialized
+from metrics_tpu.utilities.checks import shared_canonicalization
+from metrics_tpu.utilities.prints import rank_zero_warn
+
+__all__ = ["CompiledStepEngine"]
+
+# mergeable reductions (same set `Metric._merge_state_value` accepts); a
+# metric with any other reduction or any list ("cat") state cannot be
+# compiled — its state merge is not a pure elementwise fold
+_DEFAULT_CACHE_SIZE = 16
+
+
+def _abstract_leaf(x: Any) -> Any:
+    """Cache-key atom for one input leaf: arrays key on (shape, dtype);
+    everything else (python scalars, strings) keys on its concrete value —
+    scalars become weakly-typed constants under jit, so distinct values
+    must not share a compiled program unless equal."""
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return ("arr", tuple(x.shape), str(x.dtype))
+    return ("val", x)
+
+
+class CompiledStepEngine:
+    """Compile the forward of a metric (or mapping of metrics) into one
+    donated XLA dispatch per step.
+
+    Args:
+        metrics: a single :class:`Metric` or an ordered mapping
+            ``name -> Metric`` (what :class:`MetricCollection` holds).
+        cache_size: max distinct call signatures kept compiled (LRU).
+
+    Usage::
+
+        engine = CompiledStepEngine(metric)
+        value = engine.step(preds, target)          # == metric(preds, target)
+
+    or, through the collection opt-in::
+
+        col = MetricCollection([...], compiled=True)
+        values = col(preds, target)
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Mapping[str, Metric]],
+        cache_size: int = _DEFAULT_CACHE_SIZE,
+    ):
+        if isinstance(metrics, Metric):
+            self._single = True
+            self._metrics: "OrderedDict[str, Metric]" = OrderedDict([("metric", metrics)])
+        else:
+            self._single = False
+            self._metrics = OrderedDict(metrics.items())
+        if not self._metrics:
+            raise ValueError("CompiledStepEngine needs at least one metric")
+        self._cache_size = int(cache_size)
+        if self._cache_size < 1:
+            raise ValueError("cache_size must be >= 1")
+        self._compiled: "OrderedDict[tuple, Callable]" = OrderedDict()
+        # metric names that fell back to eager (trace failure or static
+        # ineligibility); once eager, always eager for this engine
+        self._eager_names: Dict[str, str] = {}
+        for name, m in self._metrics.items():
+            reason = self._static_ineligibility(m)
+            if reason is not None:
+                self._eager_names[name] = reason
+        # trace/compile bookkeeping for tests and for debugging recompiles:
+        # one trace per signature on steady-state shapes
+        self.trace_count = 0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # eligibility
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _static_ineligibility(m: Metric) -> Optional[str]:
+        """Reason this metric can never run compiled, or None if it can."""
+        if not m._defaults:
+            return "no registered state (composition/wrapper metrics sync per-operand)"
+        if not m._fused_forward:
+            # the engine's one-update + reduction-merge step is EXACTLY the
+            # fused-forward contract; a metric that has not opted in may
+            # accumulate non-additively (e.g. a running mean behind a 'sum'
+            # reduction) and must keep its classic double-update forward
+            return "metric does not opt into fused one-update forward semantics"
+        for sname, default in m._defaults.items():
+            if isinstance(default, list) or isinstance(getattr(m, sname), list):
+                return f"list ('cat') state {sname!r} grows per step"
+            if not Metric._merge_reduction_supported(m._reductions.get(sname)):
+                return f"state {sname!r} has a non-mergeable reduction"
+        if m.dist_sync_on_step:
+            return "dist_sync_on_step forwards sync through a host backend"
+        if m.dist_sync_fn is not None:
+            return "custom dist_sync_fn runs at host level"
+        return None
+
+    def _compiled_names(self) -> Tuple[str, ...]:
+        return tuple(n for n in self._metrics if n not in self._eager_names)
+
+    @property
+    def eager_fallbacks(self) -> Dict[str, str]:
+        """``name -> reason`` for every metric running eager (diagnostics)."""
+        return dict(self._eager_names)
+
+    # ------------------------------------------------------------------
+    # the pure step function (closed over the metric objects; all state
+    # flows through the traced pytrees, so it is pure despite the
+    # temporary attribute mutation used to reuse the update/compute code)
+    # ------------------------------------------------------------------
+    def _make_step_fn(self, names: Tuple[str, ...]) -> Callable:
+        metrics = self._metrics
+
+        def step_fn(states, args, kwargs):
+            self.trace_count += 1
+            new_states = {}
+            values = {}
+            with shared_canonicalization(), regression_family_sharing():
+                for name in names:
+                    m = metrics[name]
+                    saved = m._snapshot_state()
+                    try:
+                        m.reset()  # defaults: fresh state for the batch stats
+                        m.update(*args, **m._filter_kwargs(**kwargs))
+                        batch = {s: getattr(m, s) for s in m._defaults}
+                        if m.compute_on_step:
+                            m._batch_local_compute = True
+                            try:
+                                values[name] = m.compute()
+                            finally:
+                                m._batch_local_compute = False
+                        new_states[name] = {
+                            s: Metric._merge_state_value(m._reductions[s], states[name][s], batch[s])
+                            for s in m._defaults
+                        }
+                    finally:
+                        m._restore_state(saved)
+                        m._computed = None
+            return new_states, values
+
+        return step_fn
+
+    # ------------------------------------------------------------------
+    # signature cache
+    # ------------------------------------------------------------------
+    def _signature(self, names: Tuple[str, ...], args: tuple, kwargs: dict) -> tuple:
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        return (names, treedef, tuple(_abstract_leaf(x) for x in leaves))
+
+    def _get_compiled(self, signature: tuple, names: Tuple[str, ...]) -> Callable:
+        hit = self._compiled.get(signature)
+        if hit is not None:
+            self._compiled.move_to_end(signature)
+            return hit
+        fn = jax.jit(self._make_step_fn(names), donate_argnums=(0,))
+        if len(self._compiled) >= self._cache_size:
+            self._compiled.popitem(last=False)  # LRU eviction
+        self._compiled[signature] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # state pytree plumbing
+    # ------------------------------------------------------------------
+    def _donatable_states(self, names: Tuple[str, ...]) -> Dict[str, Dict[str, jax.Array]]:
+        """Current accumulated states as a donation-safe pytree: any buffer
+        that aliases a registered default (always true on the first step
+        after ``reset()``) or appears twice is copied, so donation can never
+        invalidate ``_defaults`` or double-donate one buffer."""
+        seen = set()
+        out: Dict[str, Dict[str, jax.Array]] = {}
+        for name in names:
+            m = self._metrics[name]
+            d = {}
+            for sname in m._defaults:
+                v = getattr(m, sname)
+                if v is m._defaults[sname] or id(v) in seen:
+                    v = jnp.array(v, copy=True)
+                seen.add(id(v))
+                d[sname] = v
+            out[name] = d
+        return out
+
+    def _write_back(self, names: Tuple[str, ...], new_states, values) -> None:
+        for name in names:
+            m = self._metrics[name]
+            for sname, v in new_states[name].items():
+                setattr(m, sname, v)
+            m._forward_cache = values.get(name)
+            m._computed = None
+
+    # ------------------------------------------------------------------
+    # the public step
+    # ------------------------------------------------------------------
+    def step(self, *args: Any, **kwargs: Any):
+        """One forward over the batch: returns what the eager forward would
+        (the per-metric dict for a collection, the bare value for a single
+        metric), having advanced every metric's accumulated state."""
+        # a distributed backend appearing after construction makes the
+        # no-sync trace semantics wrong — run everything eager then
+        if is_distributed_initialized():
+            return self._finish(self._run_eager(tuple(self._metrics), args, kwargs))
+
+        names = self._compiled_names()
+        out: Dict[str, Any] = {}
+        if names:
+            with self._lock:
+                signature = self._signature(names, args, kwargs)
+                fn = self._get_compiled(signature, names)
+                states = self._donatable_states(names)
+                try:
+                    new_states, values = fn(states, args, kwargs)
+                except Exception as err:  # noqa: BLE001 — any trace failure
+                    self._compiled.pop(signature, None)
+                    self._check_states_alive(names, err)
+                    # the donatable pytree was copies/references, the real
+                    # attributes are untouched — safe to rerun eagerly. The
+                    # eager rerun also disambiguates the failure: if it
+                    # raises too, this was a bad INPUT (shape/validation
+                    # error that surfaces at trace time) — propagate it and
+                    # keep the engine compiled for the next, valid batch.
+                    # Only when eager succeeds where tracing failed is the
+                    # forward genuinely trace-impure; then demote the whole
+                    # compiled group for this engine (a per-metric retrace
+                    # bisection would re-run updates against real state).
+                    out_eager = self._run_eager(tuple(self._metrics), args, kwargs)
+                    for n in names:
+                        self._eager_names.setdefault(
+                            n, f"trace failed: {type(err).__name__}: {err}"
+                        )
+                    rank_zero_warn(
+                        f"CompiledStepEngine: falling back to eager forward"
+                        f" ({type(err).__name__}: {err})"
+                    )
+                    return self._finish(out_eager)
+                self._write_back(names, new_states, values)
+                for name in names:
+                    out[name] = values.get(name)
+
+        if self._eager_names:
+            out.update(self._run_eager(tuple(self._eager_names), args, kwargs))
+        # preserve the registration order of the metrics in the output
+        return self._finish({name: out[name] for name in self._metrics})
+
+    __call__ = step
+
+    def _check_states_alive(self, names: Tuple[str, ...], err: Exception) -> None:
+        """Failures normally surface at trace time, before any buffer is
+        donated; if a post-donation execution failure did invalidate live
+        state, refuse to continue on corrupt accumulators."""
+        for name in names:
+            m = self._metrics[name]
+            for sname in m._defaults:
+                v = getattr(m, sname)
+                if hasattr(v, "is_deleted") and v.is_deleted():
+                    raise RuntimeError(
+                        f"compiled step failed after donating state"
+                        f" {name}.{sname}; accumulated state lost —"
+                        f" reset() the metric"
+                    ) from err
+
+    def _run_eager(self, names: Tuple[str, ...], args: tuple, kwargs: dict) -> Dict[str, Any]:
+        with shared_canonicalization(), regression_family_sharing():
+            return {
+                name: self._metrics[name](*args, **self._metrics[name]._filter_kwargs(**kwargs))
+                for name in names
+            }
+
+    def _finish(self, out: Dict[str, Any]):
+        return out["metric"] if self._single else out
+
+    @property
+    def cache_size(self) -> int:
+        return self._cache_size
+
+    def cache_info(self) -> Dict[str, Any]:
+        """Diagnostics: compiled-signature count, trace count, fallbacks."""
+        return {
+            "compiled_signatures": len(self._compiled),
+            "trace_count": self.trace_count,
+            "eager_fallbacks": dict(self._eager_names),
+        }

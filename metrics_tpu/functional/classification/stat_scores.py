@@ -6,6 +6,7 @@ common eager cases skip the one-hot canonicalization entirely via a fused
 probe+count kernel in label space (bincounts), like the accuracy and
 confusion-matrix fast paths.
 """
+import os
 from functools import partial
 from typing import Optional, Tuple
 
@@ -19,15 +20,23 @@ from metrics_tpu.utilities.checks import (
     _fast_path_validate,
     _input_format_classification,
     _fused_probe_preamble,
+    _min_max_jit,
     _prob_sum_atol,
     fast_path_memo,
 )
+from metrics_tpu.utilities.data import _is_concrete
 from metrics_tpu.utilities.enums import DataType
 
 
 def _del_column(x: jax.Array, index: int) -> jax.Array:
     """Delete the column at ``index``."""
     return jnp.concatenate([x[:, :index], x[:, (index + 1):]], axis=1)
+
+
+@jax.jit
+def _all_binary_jit(x: jax.Array) -> jax.Array:
+    """True iff every element is exactly 0 or 1 (debug-mode probe)."""
+    return jnp.all((x == 0) | (x == 1))
 
 
 def _stat_scores(
@@ -40,6 +49,19 @@ def _stat_scores(
     Output shapes (reference ``functional/classification/stat_scores.py:28-74``):
     ``(N,C)`` inputs — micro: scalar, macro: ``(C,)``, samples: ``(N,)``;
     ``(N,C,X)`` inputs — micro: ``(N,)``, macro: ``(N,C)``, samples: ``(N,X)``.
+
+    **Precondition (strict):** ``preds`` and ``target`` must be *canonical
+    0/1 indicator tensors* — the output of
+    :func:`~metrics_tpu.utilities.checks._input_format_classification`.
+    The sufficient-stats identity below (``fp = Σp − Σtp``,
+    ``fn = Σt − Σtp``, ``tn = M − Σt − Σp + Σtp``) replaces the four
+    boolean-mask products with three reductions and is only an identity
+    when every element is exactly 0 or 1; any other value (probabilities
+    that skipped thresholding, label ints ≥ 2) silently corrupts ALL FOUR
+    counts instead of failing loudly. Callers must canonicalize first;
+    set ``METRICS_TPU_DEBUG=1`` to assert the precondition eagerly (the
+    check is value-level, so it is skipped under tracing like every other
+    eager-only probe).
     """
     if reduce == "micro":
         dim = (0, 1) if preds.ndim == 2 else (1, 2)
@@ -47,6 +69,17 @@ def _stat_scores(
         dim = (0,) if preds.ndim == 2 else (2,)
     elif reduce == "samples":
         dim = (1,)
+
+    debug = os.environ.get("METRICS_TPU_DEBUG", "").strip().lower() in ("1", "true")
+    if debug and _is_concrete(preds) and _is_concrete(target):
+        for name, x in (("preds", preds), ("target", target)):
+            if not bool(_all_binary_jit(x)):
+                lo, hi = (float(v) for v in _min_max_jit(x))
+                raise AssertionError(
+                    f"_stat_scores requires canonical 0/1 indicator inputs;"
+                    f" {name} has non-indicator values (range [{lo}, {hi}]) —"
+                    " canonicalize via _input_format_classification first"
+                )
 
     # sufficient-stats identity on 0/1 canonical inputs: three reductions
     # and ONE elementwise temp instead of the four boolean-mask products

@@ -18,6 +18,23 @@ def _explained_variance_update(
     preds: jax.Array, target: jax.Array
 ) -> Tuple[int, jax.Array, jax.Array, jax.Array, jax.Array]:
     _check_same_shape(preds, target)
+    # >2-D inputs keep per-(d1, d2, ...) axis-0 moments the shared pass
+    # does not carry (it collapses image-shaped inputs to full sums) — so
+    # don't even compute/memoize the shared stats for them
+    stats = None
+    if preds.ndim <= 2:
+        from metrics_tpu.functional.regression.sufficient_stats import regression_sufficient_stats
+
+        stats = regression_sufficient_stats(preds, target)
+    if stats is not None:  # collection/engine context: one shared pass
+        return (
+            preds.shape[0],
+            stats["sum_diff"],
+            stats["sum_sq_diff"],
+            stats["sum_target"],
+            stats["sum_sq_target"],
+        )
+
     preds, target = promote_accumulator(preds, target)
 
     n_obs = preds.shape[0]

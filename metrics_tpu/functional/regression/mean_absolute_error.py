@@ -10,6 +10,11 @@ from metrics_tpu.utilities.data import promote_accumulator
 
 def _mean_absolute_error_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, int]:
     _check_same_shape(preds, target)
+    from metrics_tpu.functional.regression.sufficient_stats import full_sum, regression_sufficient_stats
+
+    stats = regression_sufficient_stats(preds, target)
+    if stats is not None:  # collection/engine context: one shared pass
+        return full_sum(stats["sum_abs_diff"]), target.size
     preds, target = promote_accumulator(preds, target)
     sum_abs_error = jnp.sum(jnp.abs(preds - target))
     n_obs = target.size

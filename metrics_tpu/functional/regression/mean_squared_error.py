@@ -10,6 +10,11 @@ from metrics_tpu.utilities.data import promote_accumulator
 
 def _mean_squared_error_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, int]:
     _check_same_shape(preds, target)
+    from metrics_tpu.functional.regression.sufficient_stats import full_sum, regression_sufficient_stats
+
+    stats = regression_sufficient_stats(preds, target)
+    if stats is not None:  # collection/engine context: one shared pass
+        return full_sum(stats["sum_sq_diff"]), target.size
     preds, target = promote_accumulator(preds, target)
     diff = preds - target
     sum_squared_error = jnp.sum(diff * diff)

@@ -27,6 +27,17 @@ def _psnr_update(
     target: jax.Array,
     dim: Optional[Union[int, Tuple[int, ...]]] = None,
 ) -> Tuple[jax.Array, jax.Array]:
+    if dim is None and preds.shape == target.shape:
+        # collection/engine context: one shared pass over the inputs
+        # (shape-equal only — the bespoke path below broadcasts)
+        from metrics_tpu.functional.regression.sufficient_stats import (
+            full_sum,
+            regression_sufficient_stats,
+        )
+
+        stats = regression_sufficient_stats(preds, target)
+        if stats is not None:
+            return full_sum(stats["sum_sq_diff"]), jnp.asarray(target.size)
     preds, target = promote_accumulator(preds, target)
     if dim is None:
         sum_squared_error = jnp.sum((preds - target) ** 2)

@@ -11,7 +11,6 @@ from metrics_tpu.utilities.prints import rank_zero_warn
 
 def _r2score_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, jax.Array, jax.Array, int]:
     _check_same_shape(preds, target)
-    preds, target = promote_accumulator(preds, target)
     if preds.ndim > 2:
         raise ValueError(
             "Expected both prediction and target to be 1D or 2D tensors,"
@@ -20,6 +19,13 @@ def _r2score_update(preds: jax.Array, target: jax.Array) -> Tuple[jax.Array, jax
     if preds.shape[0] < 2:
         raise ValueError("Needs at least two samples to calculate r2 score.")
 
+    from metrics_tpu.functional.regression.sufficient_stats import regression_sufficient_stats
+
+    stats = regression_sufficient_stats(preds, target)
+    if stats is not None:  # collection/engine context: one shared pass
+        return stats["sum_sq_target"], stats["sum_target"], stats["sum_sq_diff"], target.shape[0]
+
+    preds, target = promote_accumulator(preds, target)
     sum_error = jnp.sum(target, axis=0)
     sum_squared_error = jnp.sum(target * target, axis=0)
     diff = target - preds

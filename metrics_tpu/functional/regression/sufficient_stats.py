@@ -1,0 +1,126 @@
+"""Shared single-pass sufficient statistics for the regression family.
+
+Every streaming regression metric in the reference family accumulates some
+subset of the same moments of ``(preds, target)``:
+
+==================  =============================================
+metric              sufficient statistics
+==================  =============================================
+MeanSquaredError    ``Σd²``, ``n``            (``d = target − preds``)
+MeanAbsoluteError   ``Σ|d|``, ``n``
+PSNR (dim=None)     ``Σd²``, ``n``, ``min y``, ``max y``
+R2Score             ``Σy``, ``Σy²``, ``Σd²``, ``n``   (per output)
+ExplainedVariance   ``Σd``, ``Σd²``, ``Σy``, ``Σy²``, ``n``
+==================  =============================================
+
+Run separately, a collection of k regression metrics reads the input
+arrays k times and pays k dispatch chains — and the inputs are the only
+O(N) object in sight, so the whole family is memory-bound duplication.
+:func:`regression_sufficient_stats` computes the union ONCE — per-output
+first moments (``axis=0``) from which the full-stream sums derive by a
+cheap O(C) second reduction, plus the global target min/max — and the
+family's ``_*_update`` helpers all derive their states from it.
+
+Sharing has the same scoping discipline as input canonicalization
+(:func:`~metrics_tpu.utilities.checks.shared_canonicalization`): inside a
+sharing context (a ``MetricCollection`` forward/update — eager or traced
+by the compiled step engine) the stats are memoized by input identity, so
+sibling regression metrics cost ONE pass over the data. Outside a sharing
+context each metric keeps its bespoke minimal update — a lone
+MeanSquaredError never pays for moments it does not use.
+"""
+import threading
+from contextlib import contextmanager
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.utilities.checks import _canon_memo, _check_same_shape, fast_path_memo
+from metrics_tpu.utilities.data import promote_accumulator
+
+__all__ = ["regression_family_sharing", "regression_sufficient_stats"]
+
+
+_sharing = threading.local()
+
+
+@contextmanager
+def regression_family_sharing():
+    """Scope in which the regression family pools its input moments.
+
+    Entered by the multi-metric fan-outs only — ``MetricCollection``'s
+    forward/update and the compiled step engine's traced step. It is a
+    SEPARATE gate from :func:`shared_canonicalization` on purpose: the
+    fused one-update forward opens a canonicalization scope for every
+    *standalone* metric call too, and a lone MeanSquaredError must keep
+    its bespoke single-moment update — eagerly the stats run un-jitted,
+    so the unused moments would cost real O(N) passes, not DCE'd outputs
+    (measured: standalone 1M-row MSE forward 5.4 → 9.3 ms when the full
+    pass fires)."""
+    prev = getattr(_sharing, "active", False)
+    _sharing.active = True
+    try:
+        yield
+    finally:
+        _sharing.active = prev
+
+
+def _compute_stats(preds: jax.Array, target: jax.Array) -> Dict[str, jax.Array]:
+    """The single fused pass. Per-output (``axis=0``) moments when the
+    inputs are ≤2-D (the R2/ExplainedVariance layout); full-stream moments
+    otherwise (image-shaped PSNR/MSE inputs have no output axis)."""
+    preds, target = promote_accumulator(preds, target)
+    diff = target - preds
+    axis = 0 if preds.ndim <= 2 else None
+    stats = {
+        "sum_diff": jnp.sum(diff, axis=axis),
+        "sum_abs_diff": jnp.sum(jnp.abs(diff), axis=axis),
+        "sum_sq_diff": jnp.sum(diff * diff, axis=axis),
+        "sum_target": jnp.sum(target, axis=axis),
+        "sum_sq_target": jnp.sum(target * target, axis=axis),
+        "min_target": jnp.min(target),
+        "max_target": jnp.max(target),
+    }
+    return stats
+
+
+def regression_sufficient_stats(
+    preds: jax.Array, target: jax.Array
+) -> Optional[Dict[str, jax.Array]]:
+    """Shared moments of ``(preds, target)``, or None outside a sharing
+    context.
+
+    Inside :func:`~metrics_tpu.utilities.checks.shared_canonicalization`
+    (every ``MetricCollection`` fan-out, and the compiled step engine's
+    traced step) the returned dict is memoized on input identity: the first
+    regression sibling computes every moment in one fused pass, the rest
+    hit the memo — under tracing that makes the whole family read the
+    input arrays exactly once in the final XLA program. Keys:
+    ``sum_diff``/``sum_abs_diff``/``sum_sq_diff`` (``d = target − preds``),
+    ``sum_target``/``sum_sq_target`` — per-output for ≤2-D inputs,
+    full-stream otherwise — plus scalar ``min_target``/``max_target``.
+    Derive full sums with :func:`full_sum`. (Only moments with a consumer
+    are computed: eagerly the stats run un-jitted, so a dead moment would
+    cost a real O(N) pass per batch, not a DCE'd output.)
+    """
+    if not getattr(_sharing, "active", False):
+        return None
+    if getattr(_canon_memo, "store", None) is None:
+        return None
+    _check_same_shape(preds, target)
+    key = (
+        "regression_sufficient_stats",
+        id(preds),
+        id(target),
+        tuple(preds.shape),
+        str(preds.dtype),
+        str(target.dtype),
+    )
+    return fast_path_memo(key, (preds, target), lambda: _compute_stats(preds, target))
+
+
+def full_sum(stat: jax.Array) -> jax.Array:
+    """Collapse a per-output moment to the full-stream sum (identity for
+    the already-scalar >2-D layout); O(C), fused into the same program."""
+    return jnp.sum(stat)

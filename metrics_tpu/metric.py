@@ -247,28 +247,44 @@ class Metric(ABC):
                 self._computed = None
             return self._forward_cache
 
+    @staticmethod
+    def _merge_reduction_supported(reduction: Optional[Callable]) -> bool:
+        """True iff a registered reduction folds (accumulated, batch) pairs
+        purely — the invariant both the fused forward and the compiled step
+        engine (:mod:`metrics_tpu.engine`) rely on."""
+        return reduction in (dim_zero_sum, dim_zero_min, dim_zero_max)
+
+    @staticmethod
+    def _merge_state_value(reduction: Optional[Callable], prior: Any, batch: Any) -> Any:
+        """Pure (accumulated, batch) → merged fold for one state, by its
+        registered reduction: sum → add, min/max → elementwise min/max,
+        list states → rank-order concat. Shared by the in-place fused
+        forward (:meth:`_merge_states`) and the compiled step engine, so
+        the two paths cannot drift."""
+        if isinstance(batch, list):
+            return prior + batch
+        if reduction is dim_zero_sum:
+            return prior + batch
+        if reduction is dim_zero_min:
+            return jnp.minimum(prior, batch)
+        if reduction is dim_zero_max:
+            return jnp.maximum(prior, batch)
+        raise TypeError(
+            "state reduction does not support a pure (accumulated, batch) merge"
+        )
+
     def _merge_states(self, accumulated: Dict[str, Any]) -> None:
         """Fold the current (batch-only) states into ``accumulated`` in
         place of sequential accumulation, combining each state by its
-        registered reduction: sum → add, min/max → elementwise min/max,
-        list states → rank-order concat."""
+        registered reduction (see :meth:`_merge_state_value`)."""
         for name, reduction in self._reductions.items():
             batch = getattr(self, name)
-            prior = accumulated[name]
-            if isinstance(batch, list):
-                merged = prior + batch
-            elif reduction is dim_zero_sum:
-                merged = prior + batch
-            elif reduction is dim_zero_min:
-                merged = jnp.minimum(prior, batch)
-            elif reduction is dim_zero_max:
-                merged = jnp.maximum(prior, batch)
-            else:
+            if not isinstance(batch, list) and not self._merge_reduction_supported(reduction):
                 raise TypeError(
                     f"state {name!r} of {type(self).__name__} has a reduction that"
                     " does not support fused forward; unset `_fused_forward`"
                 )
-            setattr(self, name, merged)
+            setattr(self, name, self._merge_state_value(reduction, accumulated[name], batch))
 
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_tensors) -> None:
         """All-gather every registered state and apply its reduction

@@ -29,6 +29,19 @@ import jax.numpy as jnp
 # K <= 1024, 5.1 ms at K=4096 vs scatter's flat ~8.8 ms — crossover ~4k;
 # 2048 keeps a safety margin)
 _CONTRACTION_MAX_LENGTH = 2048
+# XLA:CPU lowers scatter-add just as serially (~100-130 ms flat at 1M on a
+# 2-core host, any length) — the contraction wins there too, but only while
+# the (chunk, K) one-hot temp stays cache-friendly: measured crossover at
+# 1M is K≈32 (contraction 9 ms at K=4, 24 ms at K=10, 120 ms ≈ scatter at
+# K=32), so CPU routes the label-space counts (C, small C²) through the
+# contraction and leaves larger lengths on scatter
+_CONTRACTION_MAX_LENGTH_CPU = 32
+# tiny label spaces on CPU skip the chunked scan entirely: an unchunked
+# (N, K) compare-and-sum is faster still (16.6 vs ~25 ms at 1M, K=4) and —
+# because it is plain eq/reduce with no scan carry — XLA CSEs the one-hot
+# masks across the several counts of one fused program (support and tp
+# share the target mask), which the scan formulation hides
+_COMPARE_MAX_LENGTH_CPU = 8
 _CONTRACTION_CHUNK = 262144
 
 
@@ -44,28 +57,44 @@ def label_bincount(indices: jax.Array, length: int, weights: jax.Array = None) -
     so nothing saturates the way a single f32 scatter-add would. The
     contraction therefore requires ``weights`` to be None or boolean —
     general integer weights could exceed f32 exactness within a chunk and
-    fall back to ``jnp.bincount``, as do CPU backends (scatter lowers fine
-    there) and large lengths (MDMC-samplewise group counts).
+    fall back to ``jnp.bincount``, as do large lengths (MDMC-samplewise
+    group counts). XLA:CPU scatter is serial too, so CPU also takes the
+    contraction — but only for the small label-space lengths where the
+    one-hot temp stays cache-resident (see ``_CONTRACTION_MAX_LENGTH_CPU``).
 
     Out-of-range behavior matches ``jnp.bincount(..., length=...)`` on both
     paths — negatives clamp to bucket 0, ``>= length`` drops — because
     under tracing the eager range validation is skipped and the two paths
     must not diverge across backends on invalid labels.
     """
+    backend = jax.default_backend()
+    max_length = (
+        _CONTRACTION_MAX_LENGTH if backend == "tpu"
+        else _CONTRACTION_MAX_LENGTH_CPU if backend == "cpu"
+        else 0
+    )
     bool_weights = weights is None or weights.dtype == jnp.bool_
-    if (
-        jax.default_backend() != "tpu"
-        or length > _CONTRACTION_MAX_LENGTH
-        or not bool_weights
-    ):
+    if length > max_length or not bool_weights:
         if weights is not None and weights.dtype == jnp.bool_:
             # int scatter-add: a float one saturates at 2^24 contributions
             weights = weights.astype(jnp.int32)
         return jnp.bincount(indices, weights=weights, length=length)
+    if backend == "cpu" and length <= _COMPARE_MAX_LENGTH_CPU:
+        return _compare_bincount(indices, length, weights)
     out = _contraction_bincount(indices, length, weights)
     if weights is not None and weights.dtype != jnp.bool_:
         return out.astype(weights.dtype)
     return out
+
+
+def _compare_bincount(indices: jax.Array, length: int, weights: jax.Array = None) -> jax.Array:
+    """Unchunked compare-and-sum count for tiny label spaces (bool/None
+    weights). Same out-of-range contract as the other paths: negatives
+    clamp to bucket 0, ``>= length`` drops."""
+    onehot = jnp.maximum(indices.astype(jnp.int32), 0)[:, None] == jnp.arange(length)
+    if weights is not None:
+        onehot = onehot & weights[:, None]
+    return jnp.sum(onehot, axis=0, dtype=jnp.int32)
 
 
 def _contraction_bincount(indices: jax.Array, length: int, weights: jax.Array = None) -> jax.Array:

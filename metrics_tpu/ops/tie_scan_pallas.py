@@ -286,6 +286,9 @@ def auroc_ap_from_stats(stats: jax.Array):
     for weighted stats too, whose class totals can legitimately sit below
     1; the zero case still yields NaN via the ``where``."""
     area, ap_sum, n_pos, n_neg = stats[0], stats[1], stats[2], stats[3]
-    auroc = jnp.where(n_pos * n_neg == 0, jnp.nan, area / jnp.maximum(n_pos * n_neg, 1e-30))
+    # factor-wise degeneracy test: for weighted stats the f32 product
+    # n_pos * n_neg underflows to 0 at tiny-but-legitimate weights
+    # (~1e-20 per side) and must not fake a NaN degeneracy
+    auroc = jnp.where((n_pos == 0) | (n_neg == 0), jnp.nan, area / jnp.maximum(n_pos * n_neg, 1e-30))
     ap = jnp.where(n_pos == 0, jnp.nan, ap_sum / jnp.maximum(n_pos, 1e-30))
     return auroc, ap

@@ -294,7 +294,9 @@ def _program_b(mesh: Mesh, axis: str, slot: int, weighted: bool = False):
             ap_sum = lax.psum(ap, axis)
             w_pos = jnp.sum(totals[:, 0])
             w_neg = jnp.sum(totals[:, 1])
-            auroc = jnp.where(w_pos * w_neg == 0, jnp.nan, area / jnp.maximum(w_pos * w_neg, 1e-30))
+            # factor-wise degeneracy test: the f32 product underflows to 0
+            # for tiny-but-legitimate weights (~1e-20 per side)
+            auroc = jnp.where((w_pos == 0) | (w_neg == 0), jnp.nan, area / jnp.maximum(w_pos * w_neg, 1e-30))
             ap_v = jnp.where(w_pos == 0, jnp.nan, ap_sum / jnp.maximum(w_pos, 1e-30))
             return auroc, ap_v
 

@@ -88,9 +88,24 @@ class PSNR(Metric):
         sum_squared_error, n_obs = _psnr_update(preds, target, dim=self.dim)
         if self.dim is None:
             if self.data_range is None:
-                # keep track of min and max target values
-                self.min_target = jnp.minimum(jnp.min(target), self.min_target)
-                self.max_target = jnp.maximum(jnp.max(target), self.max_target)
+                # keep track of min and max target values; inside a sharing
+                # context the extremes ride the family's single shared pass
+                from metrics_tpu.functional.regression.sufficient_stats import (
+                    regression_sufficient_stats,
+                )
+
+                stats = (
+                    regression_sufficient_stats(preds, target)
+                    if preds.shape == target.shape
+                    else None
+                )
+                tmin, tmax = (
+                    (stats["min_target"], stats["max_target"])
+                    if stats is not None
+                    else (jnp.min(target), jnp.max(target))
+                )
+                self.min_target = jnp.minimum(tmin, self.min_target)
+                self.max_target = jnp.maximum(tmax, self.max_target)
 
             self.sum_squared_error = self.sum_squared_error + sum_squared_error
             self.total = self.total + n_obs

@@ -699,7 +699,9 @@ def _check_sample_weights_range(sample_weights) -> None:
     negative, NaN (via the min>=0 comparison), and infinite weights — a
     negative weight breaks the monotone-cumulant designs, an infinite one
     silently poisons histograms/cumulants. Skipped for traced or empty
-    arrays (the empty case fails the non-empty input checks instead)."""
+    arrays (the empty case fails the non-empty input checks instead);
+    traced callers get the in-graph poison guard of
+    :func:`_guard_sample_weights` instead."""
     import numpy as np
 
     from metrics_tpu.utilities.data import _is_concrete
@@ -714,6 +716,31 @@ def _check_sample_weights_range(sample_weights) -> None:
         raise ValueError(
             f"sample_weights must be non-negative finite, got range [{lo}, {hi}]"
         )
+
+
+def _guard_sample_weights(sample_weights):
+    """Validate sample weights on every path; returns the (possibly
+    guarded) weights.
+
+    Concrete weights take the eager range check
+    (:func:`_check_sample_weights_range`), which raises. A traced array
+    cannot be value-checked at trace time — the reference behavior there
+    used to be *silently skipping* validation, letting a negative weight
+    corrupt monotone cumulants into a plausible-but-wrong value. Instead,
+    traced weights get an in-graph poison guard: negative entries are
+    rewritten to NaN, so the corruption surfaces as NaN in the metric
+    value rather than as a silently wrong number. (Infinite weights
+    already propagate to inf/NaN on their own; NaN weights propagate
+    unchanged.)
+    """
+    from metrics_tpu.utilities.data import _is_concrete
+
+    if _is_concrete(sample_weights):
+        _check_sample_weights_range(sample_weights)
+        return sample_weights
+    import jax.numpy as _jnp
+
+    return _jnp.where(sample_weights < 0, _jnp.nan, sample_weights)
 
 
 def _check_retrieval_inputs(

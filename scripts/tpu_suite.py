@@ -35,6 +35,33 @@ from bench import _probe_backend  # noqa: E402
 
 ARTIFACT = os.path.join(HERE, "TPU_SUITE.json")
 LAST_GOOD = os.path.join(HERE, "TPU_SUITE_last_good.json")
+
+
+def _git_head() -> str:
+    """Current HEAD SHA (empty string when git is unavailable): recorded in
+    the artifact so resume can tell a same-code rerun from a stale one. A
+    dirty working tree returns "" — uncommitted edits mean no two runs are
+    provably the same code, so cached chunks are never reused. The suite's
+    own outputs are excluded from the dirty check (the run itself rewrites
+    the git-tracked last-good mirror and bench state, which must not block
+    the very resume this feature exists for), as are untracked files
+    (artifacts; TPU_SUITE.json is gitignored but belt-and-braces)."""
+    try:
+        dirty = subprocess.run(
+            [
+                "git", "status", "--porcelain", "--untracked-files=no", "--",
+                ".", ":(exclude)TPU_SUITE_last_good.json", ":(exclude).bench_last_good.json",
+            ],
+            capture_output=True, text=True, timeout=30, cwd=HERE,
+        )
+        if dirty.returncode != 0 or dirty.stdout.strip():
+            return ""
+        proc = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True, timeout=30, cwd=HERE,
+        )
+        return proc.stdout.strip() if proc.returncode == 0 else ""
+    except Exception:
+        return ""
 # per-chunk ceilings, not a whole-run budget: first-compile on the chip is
 # slow (~20-40s/program) but cached afterwards (.jax_cache), so early chunks
 # pay most of the cost
@@ -133,6 +160,7 @@ def _write(result: dict) -> None:
 def main() -> int:
     result = {
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_head": _git_head(),
         "platform": None,
         "ok": False,
         "complete": False,
@@ -152,12 +180,17 @@ def main() -> int:
 
     # resume: a tunnel flap (or the watcher's outer timeout) kills the run
     # mid-suite; green chunks from a prior same-platform run are carried so
-    # repeated invocations converge instead of restarting from chunk 1
+    # repeated invocations converge instead of restarting from chunk 1.
+    # Staleness-safe: cached chunks are only reused when the prior artifact
+    # was measured at the SAME git HEAD — a green chunk from old code must
+    # not masquerade as evidence for the current tree (and an unknown HEAD,
+    # here or in the prior run, never matches)
     done = {}
     try:
         with open(ARTIFACT) as f:
             prior = json.load(f)
-        if prior.get("platform") == want:
+        same_code = bool(result["git_head"]) and prior.get("git_head") == result["git_head"]
+        if prior.get("platform") == want and same_code:
             done = {
                 c["chunk"]: dict(c, cached=True)
                 for c in prior.get("chunks", [])

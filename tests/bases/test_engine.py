@@ -1,0 +1,328 @@
+"""Differential tests for the compiled step engine (`metrics_tpu/engine.py`).
+
+The contract under test: for every engine-eligible configuration,
+``compiled step == eager forward`` — the batch values AND the state
+pytrees — with zero steady-state recompilations (one trace per input
+signature), graceful eager fallback for non-trace-pure metrics, and
+donation that never invalidates the registered defaults.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import (
+    Accuracy,
+    AUROC,
+    CompiledStepEngine,
+    ExplainedVariance,
+    F1,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    MetricCollection,
+    Precision,
+    PSNR,
+    R2Score,
+    Recall,
+)
+from tests.helpers import seed_all
+
+seed_all(42)
+
+_RNG = np.random.RandomState(7)
+
+
+def _cls_batch(n=512, c=4, seed=0):
+    rng = np.random.RandomState(seed)
+    probs = rng.rand(n, c).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    return jnp.asarray(probs), jnp.asarray(rng.randint(c, size=n))
+
+
+def _reg_batch(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    t = (rng.randn(n) * 3 + 1).astype(np.float32)
+    p = (t + rng.randn(n)).astype(np.float32)
+    return jnp.asarray(p), jnp.asarray(t)
+
+
+def _cls_collection(compiled):
+    return MetricCollection(
+        [
+            Accuracy(),
+            Precision(num_classes=4, average="macro"),
+            Recall(num_classes=4, average="macro"),
+            F1(num_classes=4, average="macro"),
+        ],
+        compiled=compiled,
+    )
+
+
+def _reg_collection(compiled):
+    return MetricCollection(
+        [MeanSquaredError(), MeanAbsoluteError(), R2Score(), PSNR(), ExplainedVariance()],
+        compiled=compiled,
+    )
+
+
+def _assert_tree_close(a, b, rtol=1e-5, atol=1e-6, msg=""):
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol, err_msg=msg)
+
+
+def _assert_state_parity(col_a, col_b, rtol=1e-5):
+    for key in col_a.keys():
+        for sname in col_a[key]._defaults:
+            _assert_tree_close(
+                getattr(col_a[key], sname),
+                getattr(col_b[key], sname),
+                rtol=rtol,
+                msg=f"state {key}.{sname}",
+            )
+
+
+@pytest.mark.parametrize("family", ["classification", "regression"])
+def test_compiled_matches_eager_collection(family):
+    """Batch values and state pytrees agree step-by-step, and the epoch-end
+    compute agrees after several batches."""
+    mk = _cls_collection if family == "classification" else _reg_collection
+    batch = _cls_batch if family == "classification" else _reg_batch
+    eager, compiled = mk(False), mk(True)
+
+    for step in range(4):
+        preds, target = batch(seed=step)
+        ve = eager(preds, target)
+        vc = compiled(preds, target)
+        assert set(ve) == set(vc)
+        for k in ve:
+            _assert_tree_close(ve[k], vc[k], msg=f"step {step} value {k}")
+        _assert_state_parity(eager, compiled)
+
+    ee, ec = eager.compute(), compiled.compute()
+    for k in ee:
+        _assert_tree_close(ee[k], ec[k], msg=f"epoch value {k}")
+
+    # every metric ran compiled — nothing silently fell back
+    assert compiled._engine.eager_fallbacks == {}
+
+
+def test_zero_steadystate_recompilation():
+    """One trace per input signature: steady-state same-shape steps must
+    hit the compiled cache, a new shape adds exactly one trace."""
+    col = _cls_collection(True)
+    p, t = _cls_batch(n=256)
+    for _ in range(5):
+        col(p, t)
+    engine = col._engine
+    assert engine.trace_count == 1, engine.cache_info()
+    assert len(engine._compiled) == 1
+
+    # a new batch shape is a new signature: exactly one more trace...
+    p2, t2 = _cls_batch(n=128)
+    col(p2, t2)
+    col(p2, t2)
+    assert engine.trace_count == 2, engine.cache_info()
+    # ...and flipping back to the first shape costs nothing
+    col(p, t)
+    assert engine.trace_count == 2, engine.cache_info()
+
+
+def test_single_metric_engine():
+    p, t = _reg_batch()
+    m_eager, m_comp = MeanSquaredError(), MeanSquaredError()
+    engine = CompiledStepEngine(m_comp)
+    for _ in range(3):
+        ve = m_eager(p, t)
+        vc = engine(p, t)
+        _assert_tree_close(ve, vc)
+    _assert_tree_close(m_eager.compute(), m_comp.compute())
+    assert engine.trace_count == 1
+
+
+def test_cat_state_metric_falls_back_eager():
+    """AUROC keeps unbounded list ('cat') states — it must run eager inside
+    a compiled collection, with values identical to a fully eager run."""
+    rng = np.random.RandomState(3)
+    p = jnp.asarray(rng.rand(256).astype(np.float32))
+    t = jnp.asarray(rng.randint(2, size=256))
+
+    eager = MetricCollection([Accuracy(), AUROC()])
+    compiled = MetricCollection([Accuracy(), AUROC()], compiled=True)
+    for _ in range(2):
+        ve, vc = eager(p, t), compiled(p, t)
+        for k in ve:
+            _assert_tree_close(ve[k], vc[k], msg=k)
+    assert "AUROC" in compiled._engine.eager_fallbacks
+    assert "Accuracy" not in compiled._engine.eager_fallbacks
+    _assert_tree_close(eager.compute()["AUROC"], compiled.compute()["AUROC"])
+
+
+def test_donation_never_invalidates_defaults():
+    """The first compiled step donates buffers that may alias the
+    registered defaults; reset() must keep returning readable arrays."""
+    col = _cls_collection(True)
+    p, t = _cls_batch()
+    col(p, t)
+    col.reset()
+    for m in col.values():
+        for sname in m._defaults:
+            np.asarray(getattr(m, sname))  # raises if donated/invalidated
+    # and the engine keeps working after reset
+    v = col(p, t)
+    assert 0.0 <= float(v["Accuracy"]) <= 1.0
+
+
+def test_compiled_collection_clone_and_pickle():
+    import pickle
+
+    col = _cls_collection(True)
+    p, t = _cls_batch()
+    col(p, t)
+    clone = col.clone(prefix="c_")
+    assert clone._engine is None  # engine must not be copied
+    vc = clone(p, t)
+    assert "c_Accuracy" in vc
+    rt = pickle.loads(pickle.dumps(_cls_collection(True)))
+    assert rt._engine is None
+    assert "Accuracy" in rt(p, t)
+
+
+def test_compute_on_step_false_returns_none_and_accumulates():
+    p, t = _reg_batch()
+    m_eager = MeanSquaredError(compute_on_step=False)
+    m_comp = MeanSquaredError(compute_on_step=False)
+    engine = CompiledStepEngine(m_comp)
+    assert m_eager(p, t) is None
+    assert engine(p, t) is None
+    _assert_tree_close(m_eager.compute(), m_comp.compute())
+
+
+def test_signature_includes_kwargs_structure():
+    """weights-present and weights-absent steps must compile separately
+    (different kwargs structure), both with parity vs eager."""
+    from metrics_tpu import BinnedAUROC
+
+    rng = np.random.RandomState(11)
+    p = jnp.asarray(rng.rand(256).astype(np.float32))
+    t = jnp.asarray(rng.randint(2, size=256))
+    w = jnp.asarray(rng.rand(256).astype(np.float32))
+
+    m_eager, m_comp = BinnedAUROC(num_bins=32), BinnedAUROC(num_bins=32)
+    engine = CompiledStepEngine(m_comp)
+    _assert_tree_close(m_eager(p, t), engine(p, t))
+    _assert_tree_close(m_eager(p, t, sample_weights=w), engine(p, t, sample_weights=w))
+    assert engine.trace_count == 2  # two signatures, one trace each
+    _assert_tree_close(m_eager(p, t, sample_weights=w), engine(p, t, sample_weights=w))
+    assert engine.trace_count == 2  # steady state: cache hit
+    _assert_tree_close(m_eager.compute(), m_comp.compute())
+
+
+def test_engine_cache_is_capped():
+    col = MetricCollection([MeanSquaredError()], compiled=True)
+    col.forward(*_reg_batch(n=8))
+    engine = col._engine
+    engine._cache_size = 2
+    for n in (16, 32, 64):
+        col(*_reg_batch(n=n))
+    assert len(engine._compiled) <= 2
+
+
+def test_regression_family_shares_one_pass_in_trace():
+    """Inside one compiled program the five regression metrics must share
+    the sufficient-stats pass: the traced program contains ONE reduction
+    set over the inputs. Proxy assertion: parity plus a single trace, and
+    the shared-stats helper memoizes per identity under the context."""
+    from metrics_tpu.functional.regression.sufficient_stats import (
+        regression_family_sharing,
+        regression_sufficient_stats,
+    )
+    from metrics_tpu.utilities.checks import shared_canonicalization
+
+    p, t = _reg_batch()
+    assert regression_sufficient_stats(p, t) is None  # no context: bespoke paths
+    with shared_canonicalization():
+        # a canonicalization scope alone (what every standalone fused
+        # forward opens) must NOT fire the full multi-moment pass
+        assert regression_sufficient_stats(p, t) is None
+    with shared_canonicalization(), regression_family_sharing():
+        s1 = regression_sufficient_stats(p, t)
+        s2 = regression_sufficient_stats(p, t)
+    assert s1 is s2  # memoized: ONE pass for the whole family
+    _assert_tree_close(s1["sum_sq_diff"], jnp.sum((t - p) ** 2))
+    _assert_tree_close(s1["min_target"], jnp.min(t))
+
+
+def test_standalone_metric_keeps_bespoke_update(monkeypatch):
+    """A lone MeanSquaredError forward must never pay for the full
+    shared-stats pass (its fused forward opens shared_canonicalization,
+    which must not be mistaken for a family-sharing context)."""
+    import metrics_tpu.functional.regression.sufficient_stats as ss
+
+    calls = []
+    real = ss._compute_stats
+    monkeypatch.setattr(ss, "_compute_stats", lambda p, t: calls.append(1) or real(p, t))
+    p, t = _reg_batch()
+    m = MeanSquaredError()
+    m(p, t)
+    assert calls == []  # bespoke path: shared pass never fired
+    col = _reg_collection(False)
+    col(p, t)
+    assert len(calls) == 1  # collection: exactly ONE shared pass
+
+
+def test_regression_stats_parity_standalone_vs_shared():
+    """The bespoke single-metric updates and the shared-stats collection
+    path accumulate the same states (up to reduction-order float error)."""
+    p, t = _reg_batch(n=1024)
+    singles = [MeanSquaredError(), MeanAbsoluteError(), R2Score(), PSNR(), ExplainedVariance()]
+    for m in singles:
+        m(p, t)
+    col = _reg_collection(False)
+    col(p, t)
+    for m in singles:
+        name = type(m).__name__
+        for sname in m._defaults:
+            _assert_tree_close(
+                getattr(m, sname), getattr(col[name], sname), msg=f"{name}.{sname}"
+            )
+
+
+def test_bad_input_does_not_demote_the_engine():
+    """A validation error surfacing at trace time is a BAD BATCH, not a
+    trace-impure metric: it must propagate, and the next valid batch must
+    still run compiled."""
+    col = _cls_collection(True)
+    p, t = _cls_batch()
+    col(p, t)
+    with pytest.raises(ValueError):
+        col(p, t[:100])  # mismatched first dims
+    assert col._engine.eager_fallbacks == {}  # not demoted
+    col(p, t)
+    assert len(col._engine._compiled) >= 1  # still compiled
+
+
+def test_non_fused_metric_falls_back_eager():
+    """A metric that never opted into fused one-update forward semantics
+    (even with sum-reducible states) must keep its classic eager forward."""
+    from metrics_tpu.metric import Metric
+    import jax.numpy as jnp
+
+    class RunningMax(Metric):
+        # deliberately NOT _fused_forward: 'sum'-registered state updated
+        # non-additively — merge semantics would corrupt it
+        def __init__(self):
+            super().__init__()
+            self.add_state("seen", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        def update(self, preds, target):
+            self.seen = jnp.maximum(self.seen, jnp.max(preds))
+
+        def compute(self):
+            return self.seen
+
+    eager, comp = RunningMax(), RunningMax()
+    engine = CompiledStepEngine(comp)
+    assert "metric" in engine.eager_fallbacks
+    p, t = _reg_batch()
+    for _ in range(2):
+        _assert_tree_close(eager(p, t), engine(p, t))
+    _assert_tree_close(eager.compute(), comp.compute())

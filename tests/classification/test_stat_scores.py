@@ -284,3 +284,24 @@ def test_fast_update_keeps_validation_errors():
         stat_scores(probs, labels, reduce="micro", num_classes=3, ignore_index=7)
     with pytest.raises(ValueError, match="same first dimension"):
         stat_scores(probs, labels[:4], num_classes=3)
+
+
+def test_stat_scores_debug_mode_asserts_binary_precondition(monkeypatch):
+    """The sufficient-stats identity in `_stat_scores` is only valid on
+    canonical 0/1 indicator inputs; METRICS_TPU_DEBUG=1 must surface a
+    violation eagerly instead of silently corrupting all four counts."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.functional.classification.stat_scores import _stat_scores
+
+    monkeypatch.setenv("METRICS_TPU_DEBUG", "1")
+    ok = jnp.asarray([[1, 0], [0, 1]])
+    _stat_scores(ok, ok, reduce="micro")  # canonical inputs pass
+
+    probs = jnp.asarray([[0.3, 0.7], [0.6, 0.4]])  # skipped thresholding
+    with pytest.raises(AssertionError, match="0/1 indicator"):
+        _stat_scores(probs, ok.astype(jnp.float32), reduce="micro")
+
+    # debug off (default): no value probe, identical fast behavior
+    monkeypatch.delenv("METRICS_TPU_DEBUG")
+    _stat_scores(probs, ok.astype(jnp.float32), reduce="micro")

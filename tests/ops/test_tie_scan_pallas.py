@@ -305,3 +305,20 @@ def test_weighted_offsets_shift_ap_ratio(monkeypatch):
     assert abs(float(stats[1]) - float(xla_ap)) < 1e-3
     assert abs(float(stats[2]) - float(xla_wp)) < 1e-2
     assert abs(float(stats[3]) - float(xla_wn)) < 1e-2
+
+
+def test_tiny_weight_totals_are_not_degenerate():
+    """ADVICE round 5: the degeneracy test must check the FACTORS, not the
+    product — w_pos * w_neg underflows f32 to 0 at ~1e-20 per side, which
+    must not fake a NaN-AUROC degeneracy for legitimate tiny weights."""
+    import numpy as np
+
+    tiny_pos, tiny_neg = np.float32(1e-23), np.float32(1e-23)
+    assert tiny_pos * tiny_neg == 0.0  # the underflow premise (below subnormal range)
+    stats = jnp.asarray([0.0, 0.0, tiny_pos, tiny_neg])
+    auroc, ap = auroc_ap_from_stats(stats)
+    assert not np.isnan(float(auroc))
+    # genuinely one-class streams still report NaN
+    for w_pos, w_neg in ((0.0, 1e-20), (1e-20, 0.0), (0.0, 0.0)):
+        auroc, _ = auroc_ap_from_stats(jnp.asarray([0.0, 0.0, w_pos, w_neg]))
+        assert np.isnan(float(auroc))

@@ -111,3 +111,24 @@ def test_get_group_indexes():
     groups = get_group_indexes(jnp.asarray([5, 5, 2, 2]))
     np.testing.assert_array_equal(np.asarray(groups[0]), [0, 1])
     np.testing.assert_array_equal(np.asarray(groups[1]), [2, 3])
+
+
+def test_guard_sample_weights_eager_raises_traced_poisons():
+    """ADVICE round 5: weight-range validation is eager-only — traced
+    negative weights must fail VISIBLY (negative → NaN poison in-graph)
+    instead of silently corrupting monotone cumulants."""
+    import jax
+
+    from metrics_tpu.utilities.checks import _guard_sample_weights
+
+    # concrete weights: the eager range check raises
+    with pytest.raises(ValueError, match="non-negative finite"):
+        _guard_sample_weights(jnp.asarray([1.0, -2.0]))
+    # valid concrete weights pass through untouched
+    w = jnp.asarray([0.5, 2.0])
+    assert _guard_sample_weights(w) is w
+
+    # traced weights: negatives poison to NaN, non-negatives unchanged
+    out = jax.jit(_guard_sample_weights)(jnp.asarray([1.0, -2.0, 0.0]))
+    out = np.asarray(out)
+    assert np.isnan(out[1]) and out[0] == 1.0 and out[2] == 0.0
