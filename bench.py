@@ -183,6 +183,15 @@ def _marker_values(stdout: str, marker: str, leg: str) -> list:
     raise RuntimeError(f"{leg} leg produced no {marker} line: {stdout[-400:]}")
 
 
+def _marker_rest(stdout: str, marker: str, leg: str) -> str:
+    """The raw remainder after ``marker`` (for payloads containing spaces,
+    e.g. the per-leg telemetry JSON blocks)."""
+    for line in stdout.splitlines():
+        if line.startswith(marker + " "):
+            return line[len(marker) + 1:]
+    raise RuntimeError(f"{leg} leg produced no {marker} line: {stdout[-400:]}")
+
+
 def _bench_sync_cpu() -> tuple:
     """Distributed sync+compute leg: 8-virtual-device CPU mesh, so the step
     contains a real collective crossing. Returns ``(sample_sort_ms,
@@ -408,73 +417,128 @@ print("LOCAL_MS", min(times) * 1e3)
     return float(_marker_values(_leg_stdout(proc, "local"), "LOCAL_MS", "local")[0])
 
 
-def _bench_module_forward() -> dict:
-    """Library-level hot loop: a 4-metric MetricCollection forward at 1M×4
-    multiclass preds — eager (fused one-update forward + single-pass kernels
-    + sibling kernel sharing) vs the compiled step engine
-    (``MetricCollection(..., compiled=True)``: ONE donated XLA dispatch per
-    step), end to end through the public API. A second pair runs the
-    5-metric regression family at 1M, whose compiled step reads the input
-    arrays exactly once via the shared sufficient-stats pass.
+def _forward_leg() -> None:
+    """``--leg-forward`` child: library-level hot loop — a 4-metric
+    MetricCollection forward at N×4 multiclass preds, eager (fused
+    one-update forward + single-pass kernels + sibling kernel sharing) vs
+    the compiled step engine (ONE donated XLA dispatch per step), plus the
+    5-metric regression family whose compiled step reads the input arrays
+    exactly once via the shared sufficient-stats pass. N defaults to 1M;
+    ``BENCH_FORWARD_N`` overrides (the telemetry-schema tier-1 test runs
+    this leg tiny).
 
-    Runs CPU-forced in a subprocess (the remote-TPU tunnel's ~65ms RTT would
-    swamp the eager-validation host reads this path makes by design; on a
-    local accelerator host those are microseconds). Fully blocked: the timed
-    quantity includes the merged STATE chain, not just the step values.
+    Alongside each ``<MARKER> <ms>`` timing line the leg prints
+    ``TELEMETRY <MARKER> <json>``: ``null`` when observability is disabled
+    (the guarantee that the timed path carries zero instrumentation —
+    pinned by ``tests/test_bench.py``), else a per-leg block with dispatch
+    and retrace counts from a fresh telemetry window per leg.
     """
+    import json as _json
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from metrics_tpu import (
+        Accuracy,
+        ExplainedVariance,
+        F1,
+        MeanAbsoluteError,
+        MeanSquaredError,
+        MetricCollection,
+        PSNR,
+        Precision,
+        R2Score,
+        Recall,
+    )
+    from metrics_tpu import observability as obs
+
+    n = int(os.environ.get("BENCH_FORWARD_N", N))
+    rng = np.random.RandomState(0)
+    probs = jnp.asarray(rng.rand(n, 4).astype(np.float32))
+    probs = probs / probs.sum(1, keepdims=True)
+    target = jnp.asarray(rng.randint(4, size=n))
+    reg_t = jnp.asarray((rng.randn(n) * 3 + 1).astype(np.float32))
+    reg_p = reg_t + jnp.asarray(rng.randn(n).astype(np.float32))
+
+    def cls_col(compiled):
+        return MetricCollection(
+            [
+                Accuracy(),
+                Precision(num_classes=4, average="macro"),
+                Recall(num_classes=4, average="macro"),
+                F1(num_classes=4, average="macro"),
+            ],
+            compiled=compiled,
+        )
+
+    def reg_col(compiled):
+        return MetricCollection(
+            [MeanSquaredError(), MeanAbsoluteError(), R2Score(), PSNR(), ExplainedVariance()],
+            compiled=compiled,
+        )
+
+    def run(col, p, t):
+        v = col(p, t)
+        for m in col.values():
+            for name in m._defaults:
+                jax.block_until_ready(getattr(m, name))
+        jax.block_until_ready(list(v.values())[-1])
+
+    def telemetry_block(col):
+        """Per-leg dispatch/retrace block, or None with telemetry off."""
+        if not obs.enabled():
+            return None
+        tel = obs.get()
+        counters = tel.snapshot()["counters"]
+        return {
+            "dispatches": int(counters.get("engine.dispatches", 0)),
+            "traces": int(sum(v for k, v in counters.items() if k.startswith("trace."))),
+            "retraces": int(tel.watchdog.retrace_count()),
+            "cache_hits": int(counters.get("engine.cache_hits", 0)),
+            "cache_misses": int(counters.get("engine.cache_misses", 0)),
+        }
+
+    def leg(marker, col, p, t):
+        if obs.enabled():
+            obs.get().reset()  # fresh telemetry window per leg
+        run(col, p, t)  # warm compiles + transfers
+        best = 1e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                run(col, p, t)
+            best = min(best, (time.perf_counter() - t0) / 10 * 1e3)
+        print(marker, best, flush=True)
+        print("TELEMETRY", marker, _json.dumps(telemetry_block(col)), flush=True)
+
+    leg("FORWARD_MS", cls_col(False), probs, target)
+    leg("FORWARD_COMPILED_MS", cls_col(True), probs, target)
+    leg("REG_FORWARD_MS", reg_col(False), reg_p, reg_t)
+    leg("REG_FORWARD_COMPILED_MS", reg_col(True), reg_p, reg_t)
+
+
+def _bench_module_forward() -> dict:
+    """Library-level hot-loop legs (see :func:`_forward_leg`), run
+    CPU-forced in a subprocess (the remote-TPU tunnel's ~65ms RTT would
+    swamp the eager-validation host reads this path makes by design; on a
+    local accelerator host those are microseconds). Fully blocked: the
+    timed quantity includes the merged STATE chain, not just the step
+    values. The returned dict carries a ``telemetry`` key: ``null`` when
+    the bench ran with observability disabled (the default — guarding
+    against accidental always-on overhead), else one
+    dispatch/retrace-count block per leg.
+    """
+    import json as _json
     import os
     import subprocess
 
-    repo = os.path.dirname(os.path.abspath(__file__))
-    code = """
-import time
-import jax
-jax.config.update("jax_platforms", "cpu")
-import jax.numpy as jnp, numpy as np
-from metrics_tpu import (Accuracy, ExplainedVariance, F1, MeanAbsoluteError,
-                         MeanSquaredError, MetricCollection, PSNR, Precision,
-                         R2Score, Recall)
-
-rng = np.random.RandomState(0)
-probs = jnp.asarray(rng.rand(1_000_000, 4).astype(np.float32))
-probs = probs / probs.sum(1, keepdims=True)
-target = jnp.asarray(rng.randint(4, size=1_000_000))
-reg_t = jnp.asarray((rng.randn(1_000_000) * 3 + 1).astype(np.float32))
-reg_p = reg_t + jnp.asarray(rng.randn(1_000_000).astype(np.float32))
-
-def cls_col(compiled):
-    return MetricCollection([Accuracy(), Precision(num_classes=4, average="macro"),
-                             Recall(num_classes=4, average="macro"),
-                             F1(num_classes=4, average="macro")], compiled=compiled)
-
-def reg_col(compiled):
-    return MetricCollection([MeanSquaredError(), MeanAbsoluteError(), R2Score(),
-                             PSNR(), ExplainedVariance()], compiled=compiled)
-
-def run(col, p, t):
-    v = col(p, t)
-    for m in col.values():
-        for name in m._defaults:
-            jax.block_until_ready(getattr(m, name))
-    jax.block_until_ready(list(v.values())[-1])
-
-def leg(marker, col, p, t):
-    run(col, p, t)  # warm compiles + transfers
-    best = 1e9
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(10):
-            run(col, p, t)
-        best = min(best, (time.perf_counter() - t0) / 10 * 1e3)
-    print(marker, best, flush=True)
-
-leg("FORWARD_MS", cls_col(False), probs, target)
-leg("FORWARD_COMPILED_MS", cls_col(True), probs, target)
-leg("REG_FORWARD_MS", reg_col(False), reg_p, reg_t)
-leg("REG_FORWARD_COMPILED_MS", reg_col(True), reg_p, reg_t)
-"""
+    here = os.path.abspath(__file__)
     proc = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True, timeout=900, cwd=repo
+        [sys.executable, here, "--leg-forward"],
+        capture_output=True, text=True, timeout=900, cwd=os.path.dirname(here),
     )
     out = _leg_stdout(proc, "module forward")
     legs = {
@@ -483,10 +547,17 @@ leg("REG_FORWARD_COMPILED_MS", reg_col(True), reg_p, reg_t)
         "regression_collection_forward_1m_cpu_ms": "REG_FORWARD_MS",
         "regression_collection_forward_compiled_1m_cpu_ms": "REG_FORWARD_COMPILED_MS",
     }
-    return {
+    result = {
         key: round(float(_marker_values(out, marker, "module forward")[0]), 1)
         for key, marker in legs.items()
     }
+    telemetry = {}
+    for key, marker in legs.items():
+        blob = _json.loads(_marker_rest(out, "TELEMETRY " + marker, "module forward"))
+        if blob is not None:
+            telemetry[key] = blob
+    result["telemetry"] = telemetry or None
+    return result
 
 
 def _bench_binned_sync() -> dict:
@@ -903,6 +974,9 @@ def main() -> None:
         return
     if "--leg-matrix" in sys.argv:
         _matrix_leg()
+        return
+    if "--leg-forward" in sys.argv:
+        _forward_leg()
         return
 
     jax_time, jax_acc, jax_auroc, platform = _run_jax_leg_isolated()

@@ -11,21 +11,31 @@ jax.config.update("jax_platforms", "cpu")
 
 import metrics_tpu
 import metrics_tpu.functional as F
+import metrics_tpu.observability as O
 import metrics_tpu.parallel as P
+
+
+def _summary(obj) -> str:
+    """First docstring *paragraph* collapsed to one line — first-line-only
+    extraction shipped truncated entries whenever a summary sentence
+    wrapped."""
+    doc = inspect.getdoc(obj) or ""
+    para = doc.split("\n\n")[0]
+    return " ".join(line.strip() for line in para.splitlines())
 
 
 def _classes(module):
     for name in sorted(dir(module)):
         obj = getattr(module, name)
         if inspect.isclass(obj) and not name.startswith("_"):
-            yield name, (inspect.getdoc(obj) or "").split("\n")[0]
+            yield name, _summary(obj)
 
 
 def _functions(module):
     for name in sorted(dir(module)):
         obj = getattr(module, name)
         if inspect.isfunction(obj) and not name.startswith("_"):
-            yield name, (inspect.getdoc(obj) or "").split("\n")[0]
+            yield name, _summary(obj)
 
 
 def main() -> None:
@@ -37,6 +47,10 @@ def main() -> None:
     lines += ["", "## Distributed primitives (`metrics_tpu.parallel`)", ""]
     lines += [f"- **`{n}`** — {d}" for n, d in _classes(P)]
     lines += [f"- **`{n}`** — {d}" for n, d in _functions(P)]
+    lines += ["", "## Observability (`metrics_tpu.observability`)", ""]
+    lines += ["See `docs/observability.md` for the counter glossary and usage.", ""]
+    lines += [f"- **`{n}`** — {d}" for n, d in _classes(O)]
+    lines += [f"- **`{n}`** — {d}" for n, d in _functions(O)]
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "api.md")
     with open(out, "w") as f:

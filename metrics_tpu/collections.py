@@ -131,6 +131,31 @@ class MetricCollection:
 
     __call__ = forward
 
+    @property
+    def eager_fallbacks(self) -> Dict[str, str]:
+        """``name -> reason`` for members the compiled step engine demoted
+        to their eager forward (empty when nothing is demoted, when
+        ``compiled=False``, or before the first compiled forward builds the
+        engine). The public face of ``CompiledStepEngine.eager_fallbacks``
+        — users should not need to reach into ``_engine``."""
+        if self._engine is None:
+            return {}
+        return self._engine.eager_fallbacks
+
+    def __repr__(self) -> str:
+        body = "\n".join(f"  ({k}): {m!r}" for k, m in self.items())
+        header = "MetricCollection("
+        if self.prefix is not None:
+            header = f"MetricCollection(prefix={self.prefix!r},"
+        fallbacks = self.eager_fallbacks
+        note = ""
+        if fallbacks:
+            note = (
+                f"\n  # {len(fallbacks)}/{len(self)} metric(s) demoted to eager"
+                f" forward under compiled=True: {sorted(fallbacks)}"
+            )
+        return f"{header}\n{body}{note}\n)"
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Call update for each metric; kwargs are filtered per metric
         signature. Canonicalization is shared across siblings (see

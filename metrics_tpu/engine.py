@@ -41,6 +41,7 @@ references obtained *before* a compiled step (e.g. a manually captured
 registered default are defensively copied so ``reset()`` always works.
 """
 import threading
+import time as _time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Mapping, Optional, Tuple, Union
 
@@ -49,9 +50,10 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.regression.sufficient_stats import regression_family_sharing
 from metrics_tpu.metric import Metric
+from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.parallel.backend import is_distributed_initialized
 from metrics_tpu.utilities.checks import shared_canonicalization
-from metrics_tpu.utilities.prints import rank_zero_warn
+from metrics_tpu.utilities.prints import warn_once
 
 __all__ = ["CompiledStepEngine"]
 
@@ -119,6 +121,15 @@ class CompiledStepEngine:
         # one trace per signature on steady-state shapes
         self.trace_count = 0
         self._lock = threading.Lock()
+        # telemetry: signatures ever compiled (distinguishes a NEW signature
+        # from LRU-eviction thrash for the recompilation watchdog) and the
+        # human-readable key telemetry counters/warnings use for this engine
+        self._seen_signatures = set()
+        self._watch_key = "engine[" + ",".join(self._metrics) + "]"
+        if _obs.enabled() and self._eager_names:
+            tel = _obs.get()
+            for name, reason in self._eager_names.items():
+                tel.event("eager_fallback", engine=self._watch_key, metric=name, reason=reason)
 
     # ------------------------------------------------------------------
     # eligibility
@@ -162,7 +173,13 @@ class CompiledStepEngine:
         metrics = self._metrics
 
         def step_fn(states, args, kwargs):
+            # host side effects here run at TRACE time only — this line IS
+            # the tracer-side retrace counter the watchdog listens to. The
+            # budget tracks the LRU capacity: up to cache_size distinct
+            # signatures is a legitimately warm engine, beyond it eviction
+            # thrash gives the exact note_compile signal anyway
             self.trace_count += 1
+            _obs.note_trace(self._watch_key, budget=max(8, self._cache_size))
             new_states = {}
             values = {}
             with shared_canonicalization(), regression_family_sharing():
@@ -197,16 +214,34 @@ class CompiledStepEngine:
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
         return (names, treedef, tuple(_abstract_leaf(x) for x in leaves))
 
-    def _get_compiled(self, signature: tuple, names: Tuple[str, ...]) -> Callable:
+    def _get_compiled(self, signature: tuple, names: Tuple[str, ...]) -> Tuple[Callable, bool]:
+        """Returns ``(step_fn, cache_hit)`` for the signature."""
         hit = self._compiled.get(signature)
         if hit is not None:
             self._compiled.move_to_end(signature)
-            return hit
+            if _obs.enabled():
+                tel = _obs.get()
+                tel.count("engine.cache_hits")
+                tel.watchdog.note_steady(self._watch_key)
+            return hit, True
+        if _obs.enabled():
+            tel = _obs.get()
+            tel.count("engine.cache_misses")
+            # full signature knowledge lives here: a miss for a signature
+            # compiled before is LRU thrash, which the watchdog flags
+            # immediately; a genuinely new signature is a legitimate compile
+            tel.watchdog.note_compile(self._watch_key, signature not in self._seen_signatures)
+        if len(self._seen_signatures) >= 4096:
+            self._seen_signatures.clear()  # polymorphic caller: stay bounded
+        self._seen_signatures.add(signature)
         fn = jax.jit(self._make_step_fn(names), donate_argnums=(0,))
         if len(self._compiled) >= self._cache_size:
             self._compiled.popitem(last=False)  # LRU eviction
+            if _obs.enabled():
+                _obs.get().count("engine.cache_evictions")
+                _obs.get().event("cache_eviction", engine=self._watch_key)
         self._compiled[signature] = fn
-        return fn
+        return fn, False
 
     # ------------------------------------------------------------------
     # state pytree plumbing
@@ -255,8 +290,12 @@ class CompiledStepEngine:
         if names:
             with self._lock:
                 signature = self._signature(names, args, kwargs)
-                fn = self._get_compiled(signature, names)
+                fn, cache_hit = self._get_compiled(signature, names)
                 states = self._donatable_states(names)
+                telemetry_on = _obs.enabled()
+                if telemetry_on:
+                    _obs.get().count("engine.dispatches")
+                    t0 = _time.perf_counter()
                 try:
                     new_states, values = fn(states, args, kwargs)
                 except Exception as err:  # noqa: BLE001 — any trace failure
@@ -277,16 +316,32 @@ class CompiledStepEngine:
                         self._eager_names.setdefault(
                             n, f"trace failed: {type(err).__name__}: {err}"
                         )
-                    rank_zero_warn(
+                    if telemetry_on:
+                        _obs.get().count("engine.trace_failures")
+                        _obs.get().event(
+                            "eager_fallback",
+                            engine=self._watch_key,
+                            metrics=list(names),
+                            reason=f"trace failed: {type(err).__name__}: {err}",
+                        )
+                    # rate-limited: a demotion warns once per engine, not
+                    # once per training-loop step
+                    warn_once(
                         f"CompiledStepEngine: falling back to eager forward"
-                        f" ({type(err).__name__}: {err})"
+                        f" ({type(err).__name__}: {err})",
+                        key=f"engine-demoted:{id(self)}",
                     )
                     return self._finish(out_eager)
+                if telemetry_on and not cache_hit:
+                    # miss executions carry the trace + compile cost
+                    _obs.get().observe("engine.trace_s", _time.perf_counter() - t0)
                 self._write_back(names, new_states, values)
                 for name in names:
                     out[name] = values.get(name)
 
         if self._eager_names:
+            if _obs.enabled():
+                _obs.get().count("engine.eager_steps", len(self._eager_names))
             out.update(self._run_eager(tuple(self._eager_names), args, kwargs))
         # preserve the registration order of the metrics in the output
         return self._finish({name: out[name] for name in self._metrics})
@@ -327,5 +382,6 @@ class CompiledStepEngine:
         return {
             "compiled_signatures": len(self._compiled),
             "trace_count": self.trace_count,
+            "seen_signatures": len(self._seen_signatures),
             "eager_fallbacks": dict(self._eager_names),
         }

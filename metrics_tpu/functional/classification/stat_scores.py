@@ -6,7 +6,6 @@ common eager cases skip the one-hot canonicalization entirely via a fused
 probe+count kernel in label space (bincounts), like the accuracy and
 confusion-matrix fast paths.
 """
-import os
 from functools import partial
 from typing import Optional, Tuple
 
@@ -15,6 +14,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from metrics_tpu.ops.histogram import label_bincount
+from metrics_tpu.utilities.env import debug_enabled
 from metrics_tpu.utilities.checks import (
     _fast_path_inputs,
     _fast_path_validate,
@@ -61,7 +61,8 @@ def _stat_scores(
     counts instead of failing loudly. Callers must canonicalize first;
     set ``METRICS_TPU_DEBUG=1`` to assert the precondition eagerly (the
     check is value-level, so it is skipped under tracing like every other
-    eager-only probe).
+    eager-only probe; the flag is parsed once at import —
+    ``utilities.env.refresh()`` re-reads a mutated environment).
     """
     if reduce == "micro":
         dim = (0, 1) if preds.ndim == 2 else (1, 2)
@@ -70,8 +71,7 @@ def _stat_scores(
     elif reduce == "samples":
         dim = (1,)
 
-    debug = os.environ.get("METRICS_TPU_DEBUG", "").strip().lower() in ("1", "true")
-    if debug and _is_concrete(preds) and _is_concrete(target):
+    if debug_enabled() and _is_concrete(preds) and _is_concrete(target):
         for name, x in (("preds", preds), ("target", target)):
             if not bool(_all_binary_jit(x)):
                 lo, hi = (float(v) for v in _min_max_jit(x))

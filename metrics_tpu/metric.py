@@ -31,6 +31,7 @@ from typing import Any, Callable, Dict, Optional, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.parallel.backend import is_distributed_initialized
 from metrics_tpu.utilities.checks import shared_canonicalization
 from metrics_tpu.utilities.data import (
@@ -179,7 +180,7 @@ class Metric(ABC):
         a state merge, see :meth:`_forward_fused`)."""
         if self._fused_forward and self.compute_on_step:
             return self._forward_fused(*args, **kwargs)
-        with shared_canonicalization():
+        with _obs.metric_scope(self, "forward"), shared_canonicalization():
             self.update(*args, **kwargs)
             self._forward_cache = None
 
@@ -221,7 +222,7 @@ class Metric(ABC):
         :meth:`_merge_states`. Numerically identical to the classic path for
         reduction-mergeable states (``accum + (default ⊕ batch)`` is the very
         operation ``update`` performs on the accumulated state)."""
-        with shared_canonicalization():
+        with _obs.metric_scope(self, "forward"), shared_canonicalization():
             accumulated = self._snapshot_state()
             self.reset()
             try:
@@ -290,6 +291,16 @@ class Metric(ABC):
         """All-gather every registered state and apply its reduction
         (reference ``metric.py:176-194``)."""
         input_dict = {attr: getattr(self, attr) for attr in self._reductions}
+        if _obs.enabled():
+            tel = _obs.get()
+            payload = sum(
+                _obs.array_nbytes(v)
+                for state in input_dict.values()
+                for v in (state if isinstance(state, list) else [state])
+            )
+            tel.count("sync.calls")
+            tel.count("sync.payload_bytes", payload)
+            tel.event("sync", metric=type(self).__name__, payload_bytes=payload)
         output_dict = apply_to_collection(
             input_dict,
             (Array, jnp.ndarray),
@@ -312,13 +323,22 @@ class Metric(ABC):
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any):
             self._computed = None
-            return update(*args, **kwargs)
+            # telemetry lifecycle hook: wall time + call count + a profiler
+            # span (`metrics_tpu.<Name>.update`) so device profiles
+            # attribute compiled time to metric names; a shared null
+            # context (one branch) when disabled
+            with _obs.metric_scope(self, "update"):
+                return update(*args, **kwargs)
 
         return wrapped_func
 
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any):
+            with _obs.metric_scope(self, "compute"):
+                return _inner(*args, **kwargs)
+
+        def _inner(*args: Any, **kwargs: Any):
             # the cache carries its provenance: a value computed under
             # batch-local (forward) semantics must never serve an epoch-end
             # compute, or vice versa — e.g. a tolerant batch-local OvR

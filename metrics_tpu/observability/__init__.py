@@ -1,0 +1,58 @@
+"""Runtime telemetry for the metric pipeline.
+
+Zero-overhead-when-disabled counters, timers, profiler spans, a bounded
+structured event log, and a recompilation watchdog — wired through the
+``Metric`` lifecycle choke points, the compiled step engine, and the
+collective sync layer. See ``docs/observability.md`` for the counter
+glossary and usage.
+
+Quick start::
+
+    import metrics_tpu.observability as obs
+
+    obs.enable()                 # or METRICS_TPU_TELEMETRY=1 in the env
+    ... run the eval loop ...
+    print(obs.report())          # human-readable summary
+    blob = obs.to_json()         # machine-readable, json.loads-able
+
+    with obs.telemetry_scope() as tel:   # scoped alternative
+        ... one eval pass ...
+        assert tel.watchdog.retrace_count() == 0
+"""
+from metrics_tpu.observability.telemetry import (  # noqa: F401
+    Telemetry,
+    disable,
+    enable,
+    enabled,
+    get,
+    metric_scope,
+    note_trace,
+    profile_span,
+    telemetry_scope,
+)
+from metrics_tpu.observability.watchdog import RecompilationWatchdog  # noqa: F401
+
+__all__ = [
+    "Telemetry",
+    "RecompilationWatchdog",
+    "enable",
+    "disable",
+    "enabled",
+    "get",
+    "telemetry_scope",
+    "note_trace",
+    "metric_scope",
+    "profile_span",
+    "report",
+    "to_json",
+]
+
+
+def report() -> str:
+    """Shorthand for ``get().report()``."""
+    return get().report()
+
+
+def to_json(indent=None) -> str:
+    """Shorthand for ``get().to_json()``."""
+    return get().to_json(indent=indent)

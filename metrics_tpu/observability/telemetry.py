@@ -1,0 +1,358 @@
+"""Process-local telemetry registry: counters, timers, bounded event log.
+
+The instrumentation core of the observability subsystem (see
+``docs/observability.md``). Design constraints, in order:
+
+1. **Zero overhead when disabled.** Every hook in the metric runtime is
+   guarded by :func:`enabled` — one module-global read + branch — and the
+   traced/compiled paths are untouched: a disabled hook contributes no ops
+   to any XLA program and no host work beyond the branch. The bench guards
+   this with the ``telemetry: null`` contract
+   (``tests/test_bench.py::test_forward_leg_telemetry_schema``).
+2. **Trace-time semantics are explicit.** Hooks that live *inside* jitted
+   functions (``note_trace``, the engine's ``step_fn`` bookkeeping, the
+   collective counters under ``shard_map``) execute as host side effects
+   at trace time only — which is exactly what makes them recompilation
+   detectors: a steady-state loop stops producing them.
+3. **Bounded memory.** Events live in a ``deque(maxlen=...)``; counters and
+   timers are flat dicts keyed by dotted names.
+
+Enable via ``metrics_tpu.observability.enable()``, the
+:func:`telemetry_scope` context manager, or ``METRICS_TPU_TELEMETRY=1`` in
+the environment (parsed once at import by ``utilities/env.py``).
+"""
+import atexit
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager, nullcontext
+from typing import Any, Dict, Iterator, Optional
+
+from metrics_tpu.observability.watchdog import RecompilationWatchdog
+from metrics_tpu.utilities.env import telemetry_requested
+
+__all__ = [
+    "Telemetry",
+    "enable",
+    "disable",
+    "enabled",
+    "get",
+    "telemetry_scope",
+    "note_trace",
+    "metric_scope",
+    "profile_span",
+]
+
+_DEFAULT_MAX_EVENTS = 1024
+
+
+class Telemetry:
+    """Registry of counters, timers, and a bounded structured event log.
+
+    Thread-safe; all mutation goes through a reentrant lock (hooks fire
+    from trace-time callbacks which may nest).
+    """
+
+    def __init__(self, max_events: int = _DEFAULT_MAX_EVENTS):
+        self._lock = threading.RLock()
+        self.max_events = int(max_events)
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        # name -> [total_seconds, count]
+        self._timers: Dict[str, list] = {}
+        self.events: "deque[Dict[str, Any]]" = deque(maxlen=self.max_events)
+        self.watchdog = RecompilationWatchdog(telemetry=self)
+
+    # ------------------------------------------------------------------
+    # recording
+    # ------------------------------------------------------------------
+    def count(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        with self._lock:
+            self.gauges[name] = float(value)
+
+    def observe(self, name: str, seconds: float) -> None:
+        with self._lock:
+            slot = self._timers.setdefault(name, [0.0, 0])
+            slot[0] += float(seconds)
+            slot[1] += 1
+
+    def event(self, kind: str, **fields: Any) -> None:
+        with self._lock:
+            self.events.append({"kind": kind, **fields})
+
+    @contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(name, time.perf_counter() - t0)
+
+    # ------------------------------------------------------------------
+    # reading / export
+    # ------------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable view of everything recorded so far."""
+        with self._lock:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "timers": {
+                    name: {"total_s": total, "count": count}
+                    for name, (total, count) in self._timers.items()
+                },
+                "events": list(self.events),
+                "watchdog": self.watchdog.snapshot(),
+            }
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def to_jsonl(self) -> str:
+        """The bounded event log as JSON-lines (one event per line)."""
+        with self._lock:
+            return "\n".join(json.dumps(e, sort_keys=True) for e in self.events)
+
+    def report(self) -> str:
+        """Human-readable summary (counters, timers, watchdog verdicts)."""
+        snap = self.snapshot()
+        lines = ["metrics_tpu telemetry report", "=" * 28]
+        lines.append("counters:")
+        for name in sorted(snap["counters"]):
+            lines.append(f"  {name:<48} {snap['counters'][name]:>12g}")
+        if not snap["counters"]:
+            lines.append("  (none)")
+        if snap["gauges"]:
+            lines.append("gauges:")
+            for name in sorted(snap["gauges"]):
+                lines.append(f"  {name:<48} {snap['gauges'][name]:>12g}")
+        lines.append("timers (total ms / calls):")
+        for name in sorted(snap["timers"]):
+            t = snap["timers"][name]
+            lines.append(f"  {name:<48} {t['total_s'] * 1e3:>10.3f} / {t['count']}")
+        if not snap["timers"]:
+            lines.append("  (none)")
+        wd = snap["watchdog"]
+        lines.append("recompilation watchdog:")
+        if not wd["keys"]:
+            lines.append("  (no traced functions observed)")
+        for key, entry in sorted(wd["keys"].items()):
+            verdict = "RETRACING" if entry["retraces"] else "steady"
+            lines.append(
+                f"  {key:<48} traces={entry['traces']}"
+                f" retraces={entry['retraces']} [{verdict}]"
+            )
+        lines.append(f"events recorded: {len(snap['events'])} (cap {self.max_events})")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self.counters.clear()
+            self.gauges.clear()
+            self._timers.clear()
+            self.events.clear()
+            self.watchdog.reset()
+
+
+# ----------------------------------------------------------------------
+# module-level singleton + enable/disable switch
+# ----------------------------------------------------------------------
+_telemetry = Telemetry()
+_enabled = False
+
+
+def get() -> Telemetry:
+    """The process-local registry (valid whether or not recording is on)."""
+    return _telemetry
+
+
+def enabled() -> bool:
+    """The ONE check every hook makes; keep it a plain global read."""
+    return _enabled
+
+
+def enable(max_events: Optional[int] = None) -> Telemetry:
+    """Turn recording on (idempotent). ``max_events`` resizes the event
+    log cap, preserving the newest events."""
+    global _enabled, _telemetry
+    if max_events is not None and max_events != _telemetry.max_events:
+        with _telemetry._lock:
+            _telemetry.max_events = int(max_events)
+            _telemetry.events = deque(_telemetry.events, maxlen=_telemetry.max_events)
+    _enabled = True
+    return _telemetry
+
+
+def disable() -> None:
+    """Turn recording off. Recorded data stays readable via :func:`get`."""
+    global _enabled
+    _enabled = False
+
+
+@contextmanager
+def telemetry_scope(max_events: Optional[int] = None) -> Iterator[Telemetry]:
+    """Enable telemetry for the duration of a ``with`` block::
+
+        with metrics_tpu.observability.telemetry_scope() as tel:
+            run_eval()
+        print(tel.report())
+
+    Restores the prior enabled/disabled state on exit; recorded data is
+    NOT cleared (read it from the yielded registry).
+    """
+    global _enabled
+    prior = _enabled
+    enable(max_events)
+    try:
+        yield _telemetry
+    finally:
+        _enabled = prior
+
+
+# ----------------------------------------------------------------------
+# hook helpers (cheap no-ops when disabled)
+# ----------------------------------------------------------------------
+def note_trace(key: str, budget: Optional[int] = None) -> None:
+    """Tracer-side retrace counter: call from INSIDE a jitted function.
+
+    Executes as a host side effect at trace time only — a steady-state
+    loop stops producing calls, so the per-key count IS the trace count.
+    Feeds the recompilation watchdog (churn beyond the trace budget fires
+    one rate-limited verdict per key). Pass ``budget`` for keys that
+    legitimately aggregate many distinct signatures (e.g. a process-wide
+    functional shared by every metric configuration).
+    """
+    if not _enabled:
+        return
+    _telemetry.count(f"trace.{key}")
+    _telemetry.watchdog.note_trace(key, budget=budget)
+
+
+_NULL_CM = nullcontext()
+
+
+class _Span:
+    """``jax.named_scope`` (names XLA ops under tracing, so device profiles
+    attribute compiled time to metric names) stacked with
+    ``jax.profiler.TraceAnnotation`` (host-timeline span for eager
+    execution)."""
+
+    __slots__ = ("name", "_scope", "_annot")
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        import jax
+
+        self._scope = jax.named_scope(self.name)
+        self._annot = jax.profiler.TraceAnnotation(self.name)
+        self._scope.__enter__()
+        self._annot.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._annot.__exit__(*exc)
+        self._scope.__exit__(*exc)
+        return False
+
+
+def profile_span(name: str):
+    """Device-profile attribution span; no-op when telemetry is disabled.
+
+    Span naming convention: ``metrics_tpu.<MetricName>.<update|compute>``.
+    """
+    if not _enabled:
+        return _NULL_CM
+    return _Span(name)
+
+
+@contextmanager
+def _metric_scope_impl(metric: Any, phase: str) -> Iterator[None]:
+    name = type(metric).__name__
+    t0 = time.perf_counter()
+    with profile_span(f"metrics_tpu.{name}.{phase}"):
+        try:
+            yield
+        finally:
+            _telemetry.count(f"metric.{name}.{phase}_calls")
+            _telemetry.observe(f"metric.{name}.{phase}_s", time.perf_counter() - t0)
+            if phase == "forward":
+                nbytes = _state_nbytes(metric)
+                if nbytes is not None:
+                    _telemetry.gauge(f"metric.{name}.state_nbytes", nbytes)
+
+
+def metric_scope(metric: Any, phase: str):
+    """Lifecycle hook for ``Metric`` update/compute/forward: wall time,
+    call count, and (on forward) accumulated-state nbytes. Returns a
+    shared null context when disabled — the hot path pays one branch."""
+    if not _enabled:
+        return _NULL_CM
+    return _metric_scope_impl(metric, phase)
+
+
+def _state_nbytes(metric: Any) -> Optional[int]:
+    """Total bytes of the metric's registered states (list states sum
+    elementwise; tracer-valued states size via shape × itemsize through
+    :func:`array_nbytes`); None when sizing fails entirely."""
+    total = 0
+    try:
+        for name in metric._defaults:
+            val = getattr(metric, name)
+            vals = val if isinstance(val, list) else [val]
+            for v in vals:
+                total += array_nbytes(v)
+    except Exception:
+        return None
+    return total
+
+
+def array_nbytes(x: Any) -> int:
+    """Best-effort payload size for arrays AND tracers (shape × itemsize,
+    so collective counters work at trace time inside ``shard_map``)."""
+    nbytes = getattr(x, "nbytes", None)
+    if isinstance(nbytes, int):
+        return nbytes
+    try:
+        import numpy as np
+
+        size = 1
+        for dim in x.shape:
+            size *= int(dim)
+        return size * np.dtype(x.dtype).itemsize
+    except Exception:
+        return 0
+
+
+# ----------------------------------------------------------------------
+# env-driven startup + failure-dump hook
+# ----------------------------------------------------------------------
+if telemetry_requested():
+    enable()
+
+_DUMP_ENV = "METRICS_TPU_TELEMETRY_DUMP"
+
+
+def _dump_at_exit() -> None:
+    """When ``METRICS_TPU_TELEMETRY_DUMP=<path>`` is set and telemetry ran,
+    write the final registry snapshot there at interpreter exit — the
+    mechanism ``scripts/tpu_suite.py`` uses to collect per-chunk telemetry
+    from its pytest subprocesses on failure."""
+    path = os.environ.get(_DUMP_ENV)
+    if not path or not (_enabled or _telemetry.counters or _telemetry.events):
+        return
+    try:
+        with open(path, "w") as f:
+            f.write(_telemetry.to_json(indent=1))
+    except OSError:
+        pass
+
+
+atexit.register(_dump_at_exit)

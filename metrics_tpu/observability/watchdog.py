@@ -1,0 +1,127 @@
+"""Recompilation watchdog: detect silent steady-state retracing.
+
+With the compiled step engine (PR 1) the dominant production failure modes
+are invisible ones: a shape-polymorphic input pipeline retraces every step,
+signature-cache thrash recompiles evicted programs, and nothing in the loop
+output changes — only the wall clock. The watchdog turns both into counters
+and one rate-limited warning.
+
+Two signals, two detection rules:
+
+* :meth:`note_trace` — tracer-side, called from INSIDE a jitted function
+  (so it fires at trace time only). More traces of one key than the
+  ``trace_budget`` means the jit cache is not converging: shape
+  polymorphism. A steady-state loop traces once per signature and stays
+  far under budget.
+* :meth:`note_compile` — engine-side, called at the compile decision with
+  full signature knowledge. ``new_signature=False`` means a previously
+  compiled signature is being compiled AGAIN (LRU eviction thrash) — a
+  retrace immediately, no budget needed.
+
+The watchdog is owned by the :class:`~metrics_tpu.observability.telemetry.
+Telemetry` registry and only hears anything while telemetry is enabled.
+"""
+from typing import Any, Dict, Optional
+
+from metrics_tpu.utilities.prints import warn_once
+
+__all__ = ["RecompilationWatchdog"]
+
+_DEFAULT_TRACE_BUDGET = 8
+_MAX_KEYS = 256
+
+
+class RecompilationWatchdog:
+    """Per-key trace/retrace bookkeeping (keys are engine labels or jitted
+    functional names)."""
+
+    def __init__(self, telemetry: Optional[Any] = None, trace_budget: int = _DEFAULT_TRACE_BUDGET):
+        self.trace_budget = int(trace_budget)
+        self._telemetry = telemetry
+        # key -> {"traces": n, "retraces": n}
+        self._keys: Dict[str, Dict[str, int]] = {}
+
+    def _entry(self, key: str) -> Dict[str, int]:
+        entry = self._keys.get(key)
+        if entry is None:
+            if len(self._keys) >= _MAX_KEYS:
+                # bounded: collapse the overflow into one bucket rather
+                # than growing without limit (a key that embeds shapes is
+                # itself a polymorphism bug this makes visible)
+                key = "<overflow>"
+                if key in self._keys:
+                    return self._keys[key]
+            entry = self._keys[key] = {"traces": 0, "retraces": 0, "flagged": 0}
+        return entry
+
+    def note_steady(self, key: str) -> None:
+        """Register ``key`` without counting anything — a cache hit on an
+        engine compiled before telemetry was enabled still deserves a
+        ``traces=0 retraces=0 [steady]`` row in the report instead of
+        "(no traced functions observed)"."""
+        self._entry(key)
+
+    def note_trace(self, key: str, budget: Optional[int] = None) -> None:
+        """A jitted function keyed ``key`` is being traced (again).
+
+        The trace-budget verdict is **one-shot per key**: crossing the
+        budget fires one retrace verdict (one event, one rate-limited
+        warning); further traces only raise the ``traces`` tally in the
+        report. Keys that legitimately aggregate many distinct signatures
+        (the per-functional hooks) pass a larger per-call ``budget``.
+        """
+        entry = self._entry(key)
+        entry["traces"] += 1
+        limit = self.trace_budget if budget is None else budget
+        if entry["traces"] > limit and not entry["flagged"]:
+            entry["flagged"] = 1
+            self._fire(
+                key,
+                entry,
+                f"traced {entry['traces']}x (budget {limit}) —"
+                " input signatures are not converging (shape-polymorphic"
+                " loop?)",
+            )
+
+    def note_compile(self, key: str, new_signature: bool) -> None:
+        """The step engine decided to compile. A compile for a signature it
+        has already compiled before is cache thrash — retrace immediately
+        (an exact signal, so every occurrence counts; compiles are slow
+        enough that this cannot flood the event log)."""
+        entry = self._entry(key)
+        if not new_signature:
+            self._fire(
+                key,
+                entry,
+                "recompiled a previously compiled signature — the compiled"
+                " cache is thrashing (too many live signatures for its"
+                " LRU capacity?)",
+            )
+
+    def _fire(self, key: str, entry: Dict[str, int], reason: str) -> None:
+        entry["retraces"] += 1
+        if self._telemetry is not None:
+            self._telemetry.count("watchdog.retraces")
+            self._telemetry.event("retrace", key=key, reason=reason)
+        warn_once(
+            f"metrics_tpu recompilation watchdog: {key}: {reason}"
+            " (warning once; see observability report for counts)",
+            key=f"watchdog:{key}",
+        )
+
+    def retrace_count(self, key: Optional[str] = None) -> int:
+        """Total retraces (for one key, or across all keys)."""
+        if key is not None:
+            entry = self._keys.get(key)
+            return entry["retraces"] if entry else 0
+        return sum(e["retraces"] for e in self._keys.values())
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "trace_budget": self.trace_budget,
+            "retraces": self.retrace_count(),
+            "keys": {k: dict(v) for k, v in self._keys.items()},
+        }
+
+    def reset(self) -> None:
+        self._keys.clear()

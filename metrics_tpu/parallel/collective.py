@@ -28,13 +28,29 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from metrics_tpu.observability import telemetry as _obs
+
 Reduction = Union[str, None]
 
 _VALID = ("sum", "mean", "min", "max", "cat", None)
 
 
 def sync_array(x: jax.Array, reduction: Reduction, axis_name: str) -> jax.Array:
-    """Synchronize one array across a named mesh axis per the reduction spec."""
+    """Synchronize one array across a named mesh axis per the reduction spec.
+
+    Telemetry: when observability is enabled, each call counts one
+    ``collective.<reduction>`` op and its per-device payload bytes. These
+    fire at *trace* time when used inside ``shard_map``/``jit`` (the usual
+    deployment), so steady-state counts stay flat — a growing
+    ``collective.payload_bytes`` across a supposedly steady loop is itself
+    a retrace signal.
+    """
+    if _obs.enabled():
+        tel = _obs.get()
+        payload = _obs.array_nbytes(x)
+        tel.count(f"collective.{reduction if reduction is not None else 'gather'}")
+        tel.count("collective.ops")
+        tel.count("collective.payload_bytes", payload)
     if reduction == "sum":
         return lax.psum(x, axis_name)
     if reduction == "mean":
@@ -76,6 +92,11 @@ def masked_cat_sync(buffer: jax.Array, count: jax.Array, axis_name: str):
     gathered per-device counts ``(world,)``, and a validity mask aligned with
     the gathered buffer.
     """
+    if _obs.enabled():
+        tel = _obs.get()
+        tel.count("collective.cat")
+        tel.count("collective.ops", 2)
+        tel.count("collective.payload_bytes", _obs.array_nbytes(buffer) + _obs.array_nbytes(count))
     gathered = lax.all_gather(buffer, axis_name, tiled=True)
     counts = lax.all_gather(count, axis_name)
     capacity = buffer.shape[0]

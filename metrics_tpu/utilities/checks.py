@@ -351,6 +351,16 @@ def _check_classification_inputs(
 )
 def _canonicalize_jit(preds, target, p_shape, t_shape, case, threshold, top_k, num_classes, is_multiclass):
     """Fused canonicalizing transform (reference ``checks.py:394-445``), one XLA program."""
+    # tracer-side retrace counter (runs at trace time only): every new
+    # static configuration of the canonicalizer is one compile; a loop
+    # that keeps producing new ones is shape-polymorphic, which the
+    # observability watchdog surfaces (no-op when telemetry is disabled).
+    # The budget is generous: this ONE key aggregates every metric
+    # configuration in the process, and config-diverse workloads (test
+    # suites) legitimately trace it dozens of times
+    from metrics_tpu.observability.telemetry import note_trace
+
+    note_trace("checks._canonicalize_jit", budget=64)
     case = DataType(case) if isinstance(case, str) else case
     preds = preds.reshape(p_shape)
     target = target.reshape(t_shape)

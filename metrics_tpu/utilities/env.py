@@ -1,0 +1,56 @@
+"""Single point of truth for ``METRICS_TPU_*`` debug/telemetry env flags.
+
+The library used to parse ``os.environ`` ad hoc at every flag site
+(``functional/classification/stat_scores.py``'s debug assert being the
+hot-path offender: a dict lookup + ``.strip().lower()`` per call). Flags
+that gate *process-wide* behavior are parsed ONCE at import and cached
+here; call :func:`refresh` after mutating the environment (tests do this
+via ``monkeypatch`` + ``refresh()``).
+
+Deliberately NOT cached here: flags that existing tooling toggles
+mid-process for measurement twins (``METRICS_TPU_NO_SAMPLESORT`` in the
+bench sync leg, ``METRICS_TPU_NO_PALLAS``) keep their live reads at their
+dispatch sites — caching them would silently freeze the first value into
+subsequent legs.
+"""
+import os
+from typing import Dict, Optional
+
+__all__ = ["parse_flag", "debug_enabled", "telemetry_requested", "refresh"]
+
+_TRUTHY = frozenset(("1", "true", "yes", "on"))
+
+
+def parse_flag(value: Optional[str]) -> bool:
+    """Canonical truthiness rule for every METRICS_TPU_* boolean flag."""
+    return value is not None and value.strip().lower() in _TRUTHY
+
+
+def _read() -> Dict[str, bool]:
+    return {
+        "debug": parse_flag(os.environ.get("METRICS_TPU_DEBUG")),
+        "telemetry": parse_flag(os.environ.get("METRICS_TPU_TELEMETRY")),
+    }
+
+
+_flags = _read()
+
+
+def debug_enabled() -> bool:
+    """``METRICS_TPU_DEBUG``: eager value-level precondition asserts
+    (e.g. the 0/1-indicator check in ``_stat_scores``)."""
+    return _flags["debug"]
+
+
+def telemetry_requested() -> bool:
+    """``METRICS_TPU_TELEMETRY``: enable the observability subsystem at
+    import (equivalent to calling ``metrics_tpu.observability.enable()``)."""
+    return _flags["telemetry"]
+
+
+def refresh() -> Dict[str, bool]:
+    """Re-read the environment (for tests that monkeypatch flags after
+    import). Returns the new flag values."""
+    global _flags
+    _flags = _read()
+    return dict(_flags)

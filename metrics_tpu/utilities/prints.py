@@ -44,6 +44,32 @@ def _warn(*args, **kwargs):
     warnings.warn(*args, **kwargs)
 
 
+# warn_once dedup registry; bounded so a pathological caller generating
+# unbounded distinct keys (e.g. a key accidentally containing a batch id)
+# cannot grow memory — past the cap new keys are silently dropped, which
+# is the right failure mode for a rate limiter.
+_WARN_ONCE_SEEN = set()
+_WARN_ONCE_CAP = 4096
+
+
+def warn_once(message: str, *args, key: str = None, **kwargs) -> bool:
+    """Rank-zero warning emitted at most once per ``key`` per process.
+
+    The spam-safe channel for warnings that can fire every step of a
+    training loop (recompilation watchdog, engine eager demotion): the
+    first occurrence warns through :func:`rank_zero_warn`, repeats are
+    dropped. ``key`` defaults to the message itself; pass an explicit key
+    when the message embeds variable detail (counts, shapes) that should
+    not defeat deduplication. Returns True iff the warning was emitted.
+    """
+    k = key if key is not None else str(message)
+    if k in _WARN_ONCE_SEEN or len(_WARN_ONCE_SEEN) >= _WARN_ONCE_CAP:
+        return False
+    _WARN_ONCE_SEEN.add(k)
+    rank_zero_warn(message, *args, **kwargs)
+    return True
+
+
 def _info(*args, **kwargs):
     log.info(*args, **kwargs)
 

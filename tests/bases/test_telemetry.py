@@ -1,0 +1,350 @@
+"""Tests for the observability subsystem (`metrics_tpu/observability/`).
+
+The contract under test, in priority order:
+
+1. **Disabled is invisible**: no counters, no events, and forward results
+   bit-identical to an instrumented run — the hooks must not perturb the
+   math or record anything when off (they are also required to stay off
+   the traced path; the bench's ``telemetry: null`` schema test guards
+   the perf side).
+2. **Engine counters are exact**: cache hit/miss counts match the
+   signature arithmetic the engine parity tests already pin.
+3. **The recompilation watchdog** fires on a shape-polymorphic loop,
+   flags LRU thrash immediately, and stays silent at steady state.
+4. **Export round-trips**: ``to_json()`` is ``json.loads``-able back into
+   the exact snapshot; the event log is bounded and JSON-lines exportable.
+"""
+import json
+import warnings
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import (
+    Accuracy,
+    AUROC,
+    F1,
+    MeanSquaredError,
+    MetricCollection,
+    Precision,
+)
+from metrics_tpu.observability.watchdog import RecompilationWatchdog
+from tests.helpers import seed_all
+
+seed_all(42)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_telemetry():
+    """Every test starts and ends disabled with an empty registry (the
+    module switch is process-global)."""
+    obs.disable()
+    obs.get().reset()
+    yield
+    obs.disable()
+    obs.get().reset()
+
+
+def _cls_batch(n=256, c=4, seed=0):
+    rng = np.random.RandomState(seed)
+    probs = rng.rand(n, c).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    return jnp.asarray(probs), jnp.asarray(rng.randint(c, size=n))
+
+
+def _collection(compiled=False):
+    return MetricCollection(
+        [Accuracy(), Precision(num_classes=4, average="macro"), F1(num_classes=4, average="macro")],
+        compiled=compiled,
+    )
+
+
+# ----------------------------------------------------------------------
+# 1. disabled-by-default invariant
+# ----------------------------------------------------------------------
+def test_disabled_by_default_records_nothing():
+    assert not obs.enabled()
+    col = _collection(compiled=True)
+    p, t = _cls_batch()
+    for _ in range(3):
+        col(p, t)
+    col.compute()
+    snap = obs.get().snapshot()
+    assert snap["counters"] == {}
+    assert snap["events"] == []
+    assert snap["timers"] == {}
+    assert snap["watchdog"]["keys"] == {}
+
+
+@pytest.mark.parametrize("compiled", [False, True])
+def test_forward_results_bit_identical_enabled_vs_disabled(compiled):
+    """Instrumentation must not change the math: same batches, same seeds,
+    bitwise-equal step values, epoch values, and state pytrees."""
+    p, t = _cls_batch()
+
+    plain = _collection(compiled)
+    v_plain = [plain(p, t) for _ in range(3)]
+    e_plain = plain.compute()
+
+    with obs.telemetry_scope():
+        instrumented = _collection(compiled)
+        v_inst = [instrumented(p, t) for _ in range(3)]
+        e_inst = instrumented.compute()
+
+    for step, (va, vb) in enumerate(zip(v_plain, v_inst)):
+        for k in va:
+            np.testing.assert_array_equal(
+                np.asarray(va[k]), np.asarray(vb[k]), err_msg=f"step {step} {k}"
+            )
+    for k in e_plain:
+        np.testing.assert_array_equal(np.asarray(e_plain[k]), np.asarray(e_inst[k]), err_msg=k)
+    for key in plain.keys():
+        for sname in plain[key]._defaults:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(plain[key], sname)),
+                np.asarray(getattr(instrumented[key], sname)),
+                err_msg=f"state {key}.{sname}",
+            )
+
+
+# ----------------------------------------------------------------------
+# 2. engine counter correctness
+# ----------------------------------------------------------------------
+def test_engine_cache_hit_miss_counters_across_two_signatures():
+    obs.enable()
+    col = MetricCollection([MeanSquaredError()], compiled=True)
+    a = jnp.asarray(np.random.RandomState(0).rand(64).astype(np.float32))
+    b = jnp.asarray(np.random.RandomState(1).rand(96).astype(np.float32))
+
+    col(a, a)  # sig A: miss
+    col(a, a)  # hit
+    col(b, b)  # sig B: miss
+    col(b, b)  # hit
+    col(a, a)  # hit
+
+    c = obs.get().counters
+    assert c["engine.cache_misses"] == 2, c
+    assert c["engine.cache_hits"] == 3, c
+    assert c["engine.dispatches"] == 5, c
+    # counters agree with the engine's own bookkeeping
+    assert col._engine.trace_count == 2
+    assert obs.get().watchdog.retrace_count() == 0
+
+
+def test_per_metric_lifecycle_counters_and_state_nbytes():
+    obs.enable()
+    m = MeanSquaredError()
+    p = jnp.asarray(np.random.RandomState(0).rand(64).astype(np.float32))
+    m(p, p)
+    m.compute()
+    c = obs.get().counters
+    assert c["metric.MeanSquaredError.forward_calls"] == 1
+    assert c["metric.MeanSquaredError.update_calls"] >= 1
+    assert c["metric.MeanSquaredError.compute_calls"] >= 1
+    snap = obs.get().snapshot()
+    assert snap["gauges"]["metric.MeanSquaredError.state_nbytes"] > 0
+    assert snap["timers"]["metric.MeanSquaredError.forward_s"]["count"] == 1
+
+
+def test_sync_payload_counters():
+    obs.enable()
+    m = Accuracy()
+    m.dist_sync_fn = lambda x, group=None: [x]  # 1-process gather stand-in
+    p, t = _cls_batch(n=32)
+    m.update(p, t)
+    m.compute()
+    c = obs.get().counters
+    assert c["sync.calls"] == 1
+    assert c["sync.payload_bytes"] > 0
+    events = [e for e in obs.get().events if e["kind"] == "sync"]
+    assert events and events[0]["metric"] == "Accuracy"
+
+
+def test_collective_counters_record_at_trace_time():
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from metrics_tpu.parallel.collective import sync_state
+
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:  # pre-0.4.35 spelling
+        from jax.experimental.shard_map import shard_map
+
+    obs.enable()
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    def step(x):
+        return sync_state({"total": x}, {"total": "sum"}, "dp")["total"]
+
+    fn = jax.jit(shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P()))
+    x = jnp.arange(16, dtype=jnp.float32)
+    fn(x)
+    fn(x)  # steady state: no second trace, no second count
+    c = obs.get().counters
+    assert c["collective.sum"] == 1, c
+    assert c["collective.payload_bytes"] > 0
+
+
+# ----------------------------------------------------------------------
+# 3. recompilation watchdog
+# ----------------------------------------------------------------------
+def test_watchdog_fires_on_shape_polymorphic_loop():
+    obs.enable()
+    # small LRU so the trace budget (max(8, cache_size)) stays at 8 and the
+    # loop needs ~12 distinct shapes, not cache_size+4
+    col = MetricCollection([MeanSquaredError()], compiled=True)
+    p0 = jnp.asarray(np.random.RandomState(0).rand(4).astype(np.float32))
+    col(p0, p0)  # build the engine
+    col._engine._cache_size = 4
+    budget = max(8, col._engine.cache_size)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for n in range(8, 8 + 2 * (budget + 4), 2):  # every step a new shape
+            p = jnp.asarray(np.random.RandomState(n).rand(n).astype(np.float32))
+            col(p, p)
+    assert obs.get().watchdog.retrace_count() > 0
+    fired = [w for w in caught if "recompilation watchdog" in str(w.message)]
+    assert len(fired) == 1  # rate-limited: warn_once per key
+    assert obs.get().counters["watchdog.retraces"] == obs.get().watchdog.retrace_count()
+    assert any(e["kind"] == "retrace" for e in obs.get().events)
+    # one-shot verdict: the tally keeps climbing, the event log does not
+    retrace_events = [e for e in obs.get().events if e["kind"] == "retrace"]
+    assert len(retrace_events) == 1
+
+
+def test_watchdog_silent_at_steady_state():
+    obs.enable()
+    col = _collection(compiled=True)
+    p, t = _cls_batch()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        for _ in range(12):
+            col(p, t)
+    assert obs.get().watchdog.retrace_count() == 0
+    assert not [w for w in caught if "recompilation watchdog" in str(w.message)]
+
+
+def test_watchdog_flags_cache_thrash_immediately():
+    wd = RecompilationWatchdog()
+    wd.note_compile("engine[x]", new_signature=True)  # legit compile
+    assert wd.retrace_count() == 0
+    wd.note_compile("engine[x]", new_signature=False)  # evicted + recompiled
+    assert wd.retrace_count("engine[x]") == 1
+
+
+def test_jitted_functional_trace_counter():
+    """The tracer-side hook inside `_canonicalize_jit` counts traces, not
+    calls: two identical canonicalizations cost at most one trace."""
+    from metrics_tpu.utilities.checks import _input_format_classification
+
+    obs.enable()
+    rng = np.random.RandomState(3)
+    p = jnp.asarray(rng.rand(37, 5).astype(np.float32))
+    p = p / p.sum(1, keepdims=True)
+    t = jnp.asarray(rng.randint(5, size=37))
+    _input_format_classification(p, t)
+    first = obs.get().counters.get("trace.checks._canonicalize_jit", 0)
+    _input_format_classification(p, t)
+    assert obs.get().counters.get("trace.checks._canonicalize_jit", 0) == first
+    assert first <= 1  # 0 iff a prior test already traced this config
+
+
+# ----------------------------------------------------------------------
+# 4. export round-trips + bounded log
+# ----------------------------------------------------------------------
+def test_to_json_round_trips():
+    tel = obs.enable()
+    tel.count("a.b", 3)
+    tel.gauge("g", 2.5)
+    tel.observe("t.x", 0.25)
+    tel.event("custom", detail="v", n=1)
+    blob = json.loads(obs.to_json())
+    assert blob == tel.snapshot()
+    assert blob["counters"]["a.b"] == 3
+    assert blob["timers"]["t.x"]["count"] == 1
+    assert blob["events"] == [{"kind": "custom", "detail": "v", "n": 1}]
+    # and the JSON-lines export carries one event per line
+    lines = tel.to_jsonl().splitlines()
+    assert [json.loads(l) for l in lines] == blob["events"]
+
+
+def test_event_log_is_bounded():
+    tel = obs.enable(max_events=16)
+    try:
+        for i in range(64):
+            tel.event("e", i=i)
+        assert len(tel.events) == 16
+        assert list(tel.events)[-1]["i"] == 63
+    finally:
+        obs.enable(max_events=1024)  # restore the default cap
+
+
+def test_report_is_human_readable():
+    tel = obs.enable()
+    m = MeanSquaredError()
+    p = jnp.asarray(np.random.RandomState(0).rand(32).astype(np.float32))
+    m(p, p)
+    text = obs.report()
+    assert "metrics_tpu telemetry report" in text
+    assert "metric.MeanSquaredError.update_calls" in text
+    assert "recompilation watchdog" in text
+
+
+def test_telemetry_scope_restores_prior_state():
+    assert not obs.enabled()
+    with obs.telemetry_scope() as tel:
+        assert obs.enabled()
+        tel.count("inside", 1)
+    assert not obs.enabled()
+    assert obs.get().counters["inside"] == 1  # data survives the scope
+
+
+# ----------------------------------------------------------------------
+# satellites: public fallback surface, env cache, warn_once
+# ----------------------------------------------------------------------
+def test_collection_eager_fallbacks_public_surface():
+    col = MetricCollection([Accuracy(), AUROC()], compiled=True)
+    assert col.eager_fallbacks == {}  # engine not built yet
+    p = jnp.asarray(np.random.RandomState(0).rand(64).astype(np.float32))
+    t = jnp.asarray(np.random.RandomState(1).randint(2, size=64))
+    col(p, t)
+    assert "AUROC" in col.eager_fallbacks
+    assert "Accuracy" not in col.eager_fallbacks
+    assert col.eager_fallbacks == col._engine.eager_fallbacks
+    r = repr(col)
+    assert "demoted to eager" in r and "AUROC" in r
+    # a fully-compiled collection carries no demotion note
+    clean = MetricCollection([Accuracy()], compiled=True)
+    clean(p, t)
+    assert "demoted" not in repr(clean)
+
+
+def test_env_flags_cached_and_refreshable(monkeypatch):
+    from metrics_tpu.utilities import env
+
+    try:
+        monkeypatch.setenv("METRICS_TPU_TELEMETRY", "1")
+        assert not env.telemetry_requested()  # cached at import
+        env.refresh()
+        assert env.telemetry_requested()
+    finally:
+        monkeypatch.undo()
+        env.refresh()
+    assert env.parse_flag("TRUE") and env.parse_flag(" on ")
+    assert not env.parse_flag("0") and not env.parse_flag(None) and not env.parse_flag("no")
+
+
+def test_warn_once_rate_limits_per_key():
+    from metrics_tpu.utilities.prints import warn_once
+
+    key = "test-warn-once-unique-key"
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert warn_once("first", key=key) is True
+        assert warn_once("second (dropped)", key=key) is False
+        assert warn_once("different message, default key") is True
+    messages = [str(w.message) for w in caught]
+    assert messages == ["first", "different message, default key"]

@@ -293,15 +293,25 @@ def test_stat_scores_debug_mode_asserts_binary_precondition(monkeypatch):
     import jax.numpy as jnp
 
     from metrics_tpu.functional.classification.stat_scores import _stat_scores
+    from metrics_tpu.utilities import env
 
+    # the flag is parsed once at import (utilities/env.py); monkeypatched
+    # environments must refresh the cache — and restore it on exit even if
+    # an assertion in between fails
     monkeypatch.setenv("METRICS_TPU_DEBUG", "1")
-    ok = jnp.asarray([[1, 0], [0, 1]])
-    _stat_scores(ok, ok, reduce="micro")  # canonical inputs pass
+    env.refresh()
+    try:
+        ok = jnp.asarray([[1, 0], [0, 1]])
+        _stat_scores(ok, ok, reduce="micro")  # canonical inputs pass
 
-    probs = jnp.asarray([[0.3, 0.7], [0.6, 0.4]])  # skipped thresholding
-    with pytest.raises(AssertionError, match="0/1 indicator"):
+        probs = jnp.asarray([[0.3, 0.7], [0.6, 0.4]])  # skipped thresholding
+        with pytest.raises(AssertionError, match="0/1 indicator"):
+            _stat_scores(probs, ok.astype(jnp.float32), reduce="micro")
+
+        # debug off (default): no value probe, identical fast behavior
+        monkeypatch.delenv("METRICS_TPU_DEBUG")
+        env.refresh()
         _stat_scores(probs, ok.astype(jnp.float32), reduce="micro")
-
-    # debug off (default): no value probe, identical fast behavior
-    monkeypatch.delenv("METRICS_TPU_DEBUG")
-    _stat_scores(probs, ok.astype(jnp.float32), reduce="micro")
+    finally:
+        monkeypatch.undo()
+        env.refresh()

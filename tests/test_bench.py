@@ -4,11 +4,56 @@ The accelerator leg runs via ``bench.py --leg-jax`` in a subprocess; when the
 remote-accelerator tunnel is unreachable the CPU-forced fallback must still
 produce a parseable, plausible measurement.
 """
+import json
 import os
 import subprocess
 import sys
 
 import pytest
+
+
+def _run_forward_leg(extra_env):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, BENCH_FORWARD_N="2000")
+    env.pop("METRICS_TPU_TELEMETRY", None)  # the leg must see OUR setting only
+    env.update(extra_env)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), "--leg-forward"],
+        capture_output=True,
+        text=True,
+        timeout=400,
+        env=env,
+        cwd=repo,
+    )
+    assert proc.returncode == 0, proc.stderr[-800:]
+    blocks = {}
+    for line in proc.stdout.splitlines():
+        if line.startswith("TELEMETRY "):
+            _, marker, rest = line.split(" ", 2)
+            blocks[marker] = json.loads(rest)
+    return blocks
+
+
+@pytest.mark.timeout(500)
+def test_forward_leg_telemetry_schema():
+    """The bench's module-forward leg must emit ``telemetry: null`` when
+    observability is disabled (the default) — the guard against the hooks
+    silently becoming always-on overhead — and real per-leg
+    dispatch/retrace blocks when ``METRICS_TPU_TELEMETRY=1``, with the
+    compiled legs showing the steady-state contract: one trace, zero
+    retraces, every post-warmup step a cache hit."""
+    disabled = _run_forward_leg({})
+    assert len(disabled) == 4
+    assert all(blob is None for blob in disabled.values()), disabled
+
+    enabled = _run_forward_leg({"METRICS_TPU_TELEMETRY": "1"})
+    assert len(enabled) == 4
+    for marker in ("FORWARD_COMPILED_MS", "REG_FORWARD_COMPILED_MS"):
+        blob = enabled[marker]
+        assert blob["dispatches"] > 0, (marker, blob)
+        assert blob["retraces"] == 0, (marker, blob)
+        assert blob["cache_misses"] == 1, (marker, blob)
+        assert blob["cache_hits"] == blob["dispatches"] - 1, (marker, blob)
 
 
 @pytest.mark.slow
