@@ -1,4 +1,4 @@
-.PHONY: ci test test-tpu test-tpu-suite doctest bench dryrun fuzz fuzz-sharded clean
+.PHONY: ci test test-tpu test-tpu-suite doctest bench dryrun fuzz fuzz-sharded chaos clean
 
 ci:
 	# the full CI gate as one machine-runnable target (mirrors
@@ -48,6 +48,12 @@ fuzz-sharded:
 	# randomized self-consistency of the TPU-native Sharded*/Binned* state
 	# designs vs the exact replicated metrics, on an 8-virtual-device mesh
 	python scripts/fuzz_sharded.py --trials 200
+
+chaos:
+	# fault-injection recovery drills (metrics_tpu/reliability/): NaN
+	# quarantine, flaky/hung sync, corrupted checkpoints, engine compile
+	# failures. Fast; also included in the default tier-1 run.
+	python -m pytest tests/reliability -q -m chaos
 
 dryrun:
 	# multi-chip sharded eval step on an 8-device mesh (self-provisions a

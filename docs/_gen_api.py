@@ -13,6 +13,7 @@ import metrics_tpu
 import metrics_tpu.functional as F
 import metrics_tpu.observability as O
 import metrics_tpu.parallel as P
+import metrics_tpu.reliability as R
 
 
 def _summary(obj) -> str:
@@ -51,6 +52,15 @@ def main() -> None:
     lines += ["See `docs/observability.md` for the counter glossary and usage.", ""]
     lines += [f"- **`{n}`** — {d}" for n, d in _classes(O)]
     lines += [f"- **`{n}`** — {d}" for n, d in _functions(O)]
+    lines += ["", "## Reliability (`metrics_tpu.reliability`)", ""]
+    lines += [
+        "See `docs/reliability.md` for guard policies, degraded-sync"
+        " semantics, the checkpoint-envelope format, and the"
+        " fault-injection cookbook.",
+        "",
+    ]
+    lines += [f"- **`{n}`** — {d}" for n, d in _classes(R)]
+    lines += [f"- **`{n}`** — {d}" for n, d in _functions(R)]
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "api.md")
     with open(out, "w") as f:

@@ -82,6 +82,34 @@ class AUROC(Metric):
             )
         self.mode = mode
 
+    def load_state_dict(
+        self,
+        state_dict: dict,
+        prefix: str = "",
+        strict: bool = False,
+        _warn_on_zero_match: bool = True,
+    ) -> None:
+        # `mode` is host-side bookkeeping derived from the first batch; a
+        # checkpoint restore bypasses update(), so re-derive it from the
+        # canonical shapes the stored states are guaranteed to be in
+        # (update appends post-`_auroc_update` arrays: binary -> 1-d preds,
+        # multiclass -> (N, C) preds + (N,) target, multilabel -> both 2-d).
+        # Without this, a restored AUROC computed with mode=None and died
+        # with an unrelated IndexError (tests/reliability/test_roundtrips.py).
+        super().load_state_dict(
+            state_dict, prefix, strict=strict, _warn_on_zero_match=_warn_on_zero_match
+        )
+        if self.mode is None and self.preds:
+            from metrics_tpu.utilities.enums import DataType
+
+            p0, t0 = self.preds[0], self.target[0]
+            if p0.ndim == 1:
+                self.mode = DataType.BINARY
+            elif t0.ndim == p0.ndim:
+                self.mode = DataType.MULTILABEL
+            else:
+                self.mode = DataType.MULTICLASS
+
     def compute(self) -> jax.Array:
         """AUROC over all seen batches."""
         preds = dim_zero_cat(self.preds)

@@ -59,6 +59,24 @@ class AveragePrecision(Metric):
         self.num_classes = num_classes
         self.pos_label = pos_label
 
+    def load_state_dict(
+        self,
+        state_dict: dict,
+        prefix: str = "",
+        strict: bool = False,
+        _warn_on_zero_match: bool = True,
+    ) -> None:
+        # `num_classes`/`pos_label` are update-derived host bookkeeping; a
+        # checkpoint restore bypasses update(), so re-derive them from the
+        # canonical stored batch (see PrecisionRecallCurve.load_state_dict)
+        super().load_state_dict(
+            state_dict, prefix, strict=strict, _warn_on_zero_match=_warn_on_zero_match
+        )
+        if self.num_classes is None and self.preds:
+            _, _, self.num_classes, self.pos_label = _average_precision_update(
+                self.preds[0], self.target[0], self.num_classes, self.pos_label
+            )
+
     def compute(self) -> Union[jax.Array, List[jax.Array]]:
         """Average precision over all seen batches (per-class list for multiclass)."""
         preds = dim_zero_cat(self.preds)

@@ -60,6 +60,26 @@ class PrecisionRecallCurve(Metric):
         self.num_classes = num_classes
         self.pos_label = pos_label
 
+    def load_state_dict(
+        self,
+        state_dict: dict,
+        prefix: str = "",
+        strict: bool = False,
+        _warn_on_zero_match: bool = True,
+    ) -> None:
+        # `num_classes`/`pos_label` are derived from the first batch by
+        # update(); a checkpoint restore bypasses update, so re-derive them
+        # by re-running the canonicalizer on the (already-canonical) stored
+        # batch — otherwise a restored curve metric computes with
+        # num_classes=None and dies obscurely (tests/reliability/).
+        super().load_state_dict(
+            state_dict, prefix, strict=strict, _warn_on_zero_match=_warn_on_zero_match
+        )
+        if self.num_classes is None and self.preds:
+            _, _, self.num_classes, self.pos_label = _precision_recall_curve_update(
+                self.preds[0], self.target[0], self.num_classes, self.pos_label
+            )
+
     def compute(self) -> Union[Tuple[jax.Array, ...], Tuple[List[jax.Array], ...]]:
         """``(precision, recall, thresholds)`` over all seen batches."""
         preds = dim_zero_cat(self.preds)

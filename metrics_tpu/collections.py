@@ -199,9 +199,59 @@ class MetricCollection:
             m.state_dict(destination, prefix=f"{prefix}{k}.")
         return destination
 
-    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+    def load_state_dict(self, state_dict: dict, prefix: str = "", strict: bool = False) -> None:
+        """Restore member states saved by :meth:`state_dict`.
+
+        ``strict=True`` additionally rejects *unexpected* keys — entries in
+        ``state_dict`` that belong to no member — and requires every member
+        state to be present (each member's own strict check). For checksum/
+        schema-validated restores see
+        :func:`metrics_tpu.reliability.load_envelope`.
+        """
+        if strict:
+            # only keys under OUR prefix can be "unexpected": a shared flat
+            # dict legitimately carries other objects' entries (that is what
+            # the prefix parameter exists for)
+            expected = {key for key, _ in self._named_states(prefix)}
+            unexpected = sorted(
+                k for k in set(state_dict) - expected if k.startswith(prefix)
+            )
+            if unexpected:
+                raise KeyError(
+                    f"strict load_state_dict: state_dict carries keys under"
+                    f" prefix {prefix!r} that no member of this"
+                    f" MetricCollection registers: {unexpected}"
+                )
         for k, m in self.items():
-            m.load_state_dict(state_dict, prefix=f"{prefix}{k}.")
+            m.load_state_dict(
+                state_dict, prefix=f"{prefix}{k}.", strict=strict, _warn_on_zero_match=False
+            )
+        # the zero-match hazard check runs over the WHOLE collection: one
+        # member matching nothing is legitimate (it had no persistent
+        # states at save time), but NO member matching a non-empty dict is
+        # the silent mistyped-prefix load the warning exists for
+        if state_dict and self._metrics and not any(
+            key in state_dict for key, _ in self._named_states(prefix)
+        ):
+            from metrics_tpu.utilities.prints import warn_once
+
+            warn_once(
+                f"load_state_dict: no member state of this MetricCollection"
+                f" (prefix={prefix!r}) matched the non-empty state_dict"
+                f" ({len(state_dict)} entries); nothing was loaded. Check the"
+                " prefix used at save time, pass strict=True to make this an"
+                " error, or use metrics_tpu.reliability.load_envelope for"
+                " validated restores.",
+                key=f"load-zero-match:MetricCollection:{prefix}",
+            )
+
+    def _named_states(self, prefix: str = "") -> list:
+        """Member-prefixed ``(key, value)`` pairs across the collection (see
+        :meth:`Metric._named_states`)."""
+        pairs = []
+        for k, m in self.items():
+            pairs += m._named_states(f"{prefix}{k}.")
+        return pairs
 
     def to_device(self, device) -> "MetricCollection":
         for _, m in self.items():

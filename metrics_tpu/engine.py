@@ -40,6 +40,7 @@ references obtained *before* a compiled step (e.g. a manually captured
 ``_snapshot_state``) may become unreadable after it. Buffers that alias a
 registered default are defensively copied so ``reset()`` always works.
 """
+import functools
 import threading
 import time as _time
 from collections import OrderedDict
@@ -52,6 +53,7 @@ from metrics_tpu.functional.regression.sufficient_stats import regression_family
 from metrics_tpu.metric import Metric
 from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.parallel.backend import is_distributed_initialized
+from metrics_tpu.reliability import guard as _rguard
 from metrics_tpu.utilities.checks import shared_canonicalization
 from metrics_tpu.utilities.prints import warn_once
 
@@ -169,7 +171,7 @@ class CompiledStepEngine:
     # flows through the traced pytrees, so it is pure despite the
     # temporary attribute mutation used to reuse the update/compute code)
     # ------------------------------------------------------------------
-    def _make_step_fn(self, names: Tuple[str, ...]) -> Callable:
+    def _make_step_fn(self, names: Tuple[str, ...], guard_token: Optional[str] = None) -> Callable:
         metrics = self._metrics
 
         def step_fn(states, args, kwargs):
@@ -182,6 +184,7 @@ class CompiledStepEngine:
             _obs.note_trace(self._watch_key, budget=max(8, self._cache_size))
             new_states = {}
             values = {}
+            finites = {}
             with shared_canonicalization(), regression_family_sharing():
                 for name in names:
                     m = metrics[name]
@@ -196,13 +199,38 @@ class CompiledStepEngine:
                                 values[name] = m.compute()
                             finally:
                                 m._batch_local_compute = False
-                        new_states[name] = {
+                        merged = {
                             s: Metric._merge_state_value(m._reductions[s], states[name][s], batch[s])
                             for s in m._defaults
                         }
+                        if guard_token is not None:
+                            # reliability: fused all-finite scalar over the
+                            # MERGED float states (catches NaN batches and
+                            # accumulator overflow alike), riding the same
+                            # dispatch. "select" folds the rollback in too:
+                            # a poisoned merge yields the prior state.
+                            flags = [
+                                jnp.all(jnp.isfinite(v))
+                                for v in merged.values()
+                                if jnp.issubdtype(v.dtype, jnp.floating)
+                            ]
+                            finite = flags[0] if len(flags) == 1 else (
+                                functools.reduce(jnp.logical_and, flags)
+                                if flags
+                                else jnp.asarray(True)
+                            )
+                            if guard_token == "select":
+                                merged = {
+                                    s: jnp.where(finite, v, states[name][s])
+                                    for s, v in merged.items()
+                                }
+                            finites[name] = finite
+                        new_states[name] = merged
                     finally:
                         m._restore_state(saved)
                         m._computed = None
+            if guard_token is not None:
+                return new_states, values, finites
             return new_states, values
 
         return step_fn
@@ -210,11 +238,30 @@ class CompiledStepEngine:
     # ------------------------------------------------------------------
     # signature cache
     # ------------------------------------------------------------------
-    def _signature(self, names: Tuple[str, ...], args: tuple, kwargs: dict) -> tuple:
+    def _signature(
+        self,
+        names: Tuple[str, ...],
+        args: tuple,
+        kwargs: dict,
+        guard_token: Optional[str] = None,
+    ) -> tuple:
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
-        return (names, treedef, tuple(_abstract_leaf(x) for x in leaves))
+        return (names, guard_token, treedef, tuple(_abstract_leaf(x) for x in leaves))
 
-    def _get_compiled(self, signature: tuple, names: Tuple[str, ...]) -> Tuple[Callable, bool]:
+    @staticmethod
+    def _guard_token(guard) -> Optional[str]:
+        """Program-shape token for the active guard: None (no guard — the
+        pristine pre-reliability program, bit-identical by construction),
+        "select" (raise/quarantine: in-program last-good rollback), or
+        "flag" (warn: finite flags only, state kept). raise and quarantine
+        share one compiled program; only the host-side verdict differs."""
+        if guard is None:
+            return None
+        return "select" if guard.policy in ("raise", "quarantine") else "flag"
+
+    def _get_compiled(
+        self, signature: tuple, names: Tuple[str, ...], guard_token: Optional[str] = None
+    ) -> Tuple[Callable, bool]:
         """Returns ``(step_fn, cache_hit)`` for the signature."""
         hit = self._compiled.get(signature)
         if hit is not None:
@@ -234,7 +281,7 @@ class CompiledStepEngine:
         if len(self._seen_signatures) >= 4096:
             self._seen_signatures.clear()  # polymorphic caller: stay bounded
         self._seen_signatures.add(signature)
-        fn = jax.jit(self._make_step_fn(names), donate_argnums=(0,))
+        fn = jax.jit(self._make_step_fn(names, guard_token), donate_argnums=(0,))
         if len(self._compiled) >= self._cache_size:
             self._compiled.popitem(last=False)  # LRU eviction
             if _obs.enabled():
@@ -246,11 +293,17 @@ class CompiledStepEngine:
     # ------------------------------------------------------------------
     # state pytree plumbing
     # ------------------------------------------------------------------
-    def _donatable_states(self, names: Tuple[str, ...]) -> Dict[str, Dict[str, jax.Array]]:
+    def _donatable_states(
+        self, names: Tuple[str, ...], copy_all: bool = False
+    ) -> Dict[str, Dict[str, jax.Array]]:
         """Current accumulated states as a donation-safe pytree: any buffer
         that aliases a registered default (always true on the first step
         after ``reset()``) or appears twice is copied, so donation can never
-        invalidate ``_defaults`` or double-donate one buffer."""
+        invalidate ``_defaults`` or double-donate one buffer.
+
+        ``copy_all`` (guard-active steps) copies EVERY buffer, so the live
+        metric attributes survive donation as a last-good snapshot the
+        engine can restore if the dispatch dies after donating."""
         seen = set()
         out: Dict[str, Dict[str, jax.Array]] = {}
         for name in names:
@@ -258,7 +311,7 @@ class CompiledStepEngine:
             d = {}
             for sname in m._defaults:
                 v = getattr(m, sname)
-                if v is m._defaults[sname] or id(v) in seen:
+                if copy_all or v is m._defaults[sname] or id(v) in seen:
                     v = jnp.array(v, copy=True)
                 seen.add(id(v))
                 d[sname] = v
@@ -289,18 +342,37 @@ class CompiledStepEngine:
         out: Dict[str, Any] = {}
         if names:
             with self._lock:
-                signature = self._signature(names, args, kwargs)
-                fn, cache_hit = self._get_compiled(signature, names)
-                states = self._donatable_states(names)
+                guard = _rguard.active()
+                guard_token = self._guard_token(guard)
+                signature = self._signature(names, args, kwargs, guard_token)
+                fn, cache_hit = self._get_compiled(signature, names, guard_token)
+                # guard-active steps donate COPIES so the live attributes
+                # double as a last-good snapshot (restorable if the dispatch
+                # fails after donation); unguarded steps keep the pristine
+                # zero-copy donation
+                states = self._donatable_states(names, copy_all=guard is not None)
                 telemetry_on = _obs.enabled()
                 if telemetry_on:
                     _obs.get().count("engine.dispatches")
                     t0 = _time.perf_counter()
                 try:
-                    new_states, values = fn(states, args, kwargs)
+                    if guard_token is None:
+                        new_states, values = fn(states, args, kwargs)
+                        finites = None
+                    else:
+                        new_states, values, finites = fn(states, args, kwargs)
                 except Exception as err:  # noqa: BLE001 — any trace failure
                     self._compiled.pop(signature, None)
-                    self._check_states_alive(names, err)
+                    if guard is None:
+                        self._check_states_alive(names, err)
+                    # guard active: copy_all donation means the live
+                    # attributes were never donated — accumulated state
+                    # survived the failed dispatch by construction, and the
+                    # eager rerun below proceeds on intact state instead of
+                    # raising. (The recovery counter is bumped only AFTER
+                    # the rerun succeeds: a bad-input error that the rerun
+                    # re-raises is not a recovery event, and the counter is
+                    # documented as zero-on-healthy/alertable.)
                     # the donatable pytree was copies/references, the real
                     # attributes are untouched — safe to rerun eagerly. The
                     # eager rerun also disambiguates the failure: if it
@@ -312,6 +384,10 @@ class CompiledStepEngine:
                     # compiled group for this engine (a per-metric retrace
                     # bisection would re-run updates against real state).
                     out_eager = self._run_eager(tuple(self._metrics), args, kwargs)
+                    if guard is not None and telemetry_on:
+                        # the eager rerun succeeded where the dispatch died:
+                        # THIS is the recovery event
+                        _obs.get().count("reliability.engine_dispatch_recoveries")
                     for n in names:
                         self._eager_names.setdefault(
                             n, f"trace failed: {type(err).__name__}: {err}"
@@ -336,6 +412,8 @@ class CompiledStepEngine:
                     # miss executions carry the trace + compile cost
                     _obs.get().observe("engine.trace_s", _time.perf_counter() - t0)
                 self._write_back(names, new_states, values)
+                if finites is not None:
+                    self._apply_guard_verdicts(guard, names, finites)
                 for name in names:
                     out[name] = values.get(name)
 
@@ -347,6 +425,29 @@ class CompiledStepEngine:
         return self._finish({name: out[name] for name in self._metrics})
 
     __call__ = step
+
+    def _apply_guard_verdicts(self, guard, names: Tuple[str, ...], finites: Dict[str, Any]) -> None:
+        """Host-side epilogue of the in-program finite check: read each
+        metric's all-finite flag (one scalar device fetch per metric) and
+        apply the guard policy. Under "raise"/"quarantine" the compiled
+        step already selected the last-good state, so the rollback is done
+        by the time this runs; "warn" keeps the poisoned state."""
+        rolled_back = guard.policy in ("raise", "quarantine")
+        # ONE host transfer for all flags, not one blocking bool() per
+        # metric — N round-trips per step would serialize the very dispatch
+        # the engine exists to keep async
+        host_flags = jax.device_get(finites)
+        for name in names:
+            flag = host_flags.get(name)
+            guard.stats["checks"] += 1
+            if flag is None or bool(flag):
+                continue
+            guard.handle_violation(
+                self._metrics[name],
+                None,
+                context=f"compiled step ({name})",
+                already_rolled_back=rolled_back,
+            )
 
     def _check_states_alive(self, names: Tuple[str, ...], err: Exception) -> None:
         """Failures normally surface at trace time, before any buffer is

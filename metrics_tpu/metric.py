@@ -33,7 +33,10 @@ import jax.numpy as jnp
 
 from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.parallel.backend import is_distributed_initialized
+from metrics_tpu.reliability import guard as _rguard
+from metrics_tpu.reliability import sync as _rsync
 from metrics_tpu.utilities.checks import shared_canonicalization
+from metrics_tpu.utilities.prints import warn_once
 from metrics_tpu.utilities.data import (
     _flatten,
     apply_to_collection,
@@ -80,6 +83,13 @@ class Metric(ABC):
 
     # provenance of the `_computed` cache (see `_wrap_compute`)
     _computed_batch_local = False
+
+    # True only while forward()'s classic path re-runs update on throwaway
+    # post-reset state for the batch-local value; the reliability guard
+    # skips this pass (the state is discarded by the snapshot/restore cycle
+    # anyway, and quarantining it would roll back to EMPTY state — crashing
+    # cat-state computes — and double-count the poisoned batch)
+    _batch_local_pass = False
 
     # Opt-in fused forward (SURVEY §7 hard-part 3): when every state merge
     # commutes with its registered reduction — sum/min/max counters, list
@@ -192,7 +202,11 @@ class Metric(ABC):
 
                 self.reset()
                 try:
-                    self.update(*args, **kwargs)
+                    self._batch_local_pass = True
+                    try:
+                        self.update(*args, **kwargs)
+                    finally:
+                        self._batch_local_pass = False
                     # flag the batch-local compute: a mini-batch is allowed
                     # to be partial (e.g. miss classes) in ways the epoch-end
                     # compute treats as errors; state-dependent computes can
@@ -246,6 +260,12 @@ class Metric(ABC):
                 self._merge_states(accumulated)
                 self._to_sync = True
                 self._computed = None
+            # reliability hook: the MERGE can go non-finite even when the
+            # batch stats were healthy (accumulator overflow); the guard
+            # rolls back to the pre-batch snapshot per its policy
+            guard = _rguard.active()
+            if guard is not None:
+                guard.check_states(self, accumulated, context="merge")
             return self._forward_cache
 
     @staticmethod
@@ -301,12 +321,31 @@ class Metric(ABC):
             tel.count("sync.calls")
             tel.count("sync.payload_bytes", payload)
             tel.event("sync", metric=type(self).__name__, payload_bytes=payload)
-        output_dict = apply_to_collection(
-            input_dict,
-            (Array, jnp.ndarray),
-            dist_sync_fn,
-            group=self.process_group,
-        )
+        # reliability hook: an installed SyncPolicy adds timeout + bounded
+        # retry around every gather; a plain passthrough (one global read)
+        # when no policy is installed. Degradation is handled HERE, not per
+        # gather, so it is atomic across the whole state dict — a per-leaf
+        # fallback could mix world-aggregated and local-only states in one
+        # metric (globally-summed `total` with local `correct`), which is
+        # silently wrong rather than degraded.
+        guarded_sync_fn = _rsync.apply_sync_policy(dist_sync_fn)
+        try:
+            output_dict = apply_to_collection(
+                input_dict,
+                (Array, jnp.ndarray),
+                guarded_sync_fn,
+                group=self.process_group,
+            )
+        except _rsync.SyncFailedError as err:
+            local_only = _rsync.degraded_local_fallback(err)
+            if local_only is None:
+                raise
+            output_dict = apply_to_collection(
+                input_dict,
+                (Array, jnp.ndarray),
+                local_only,
+                group=self.process_group,
+            )
 
         for attr, reduction_fn in self._reductions.items():
             # array states stack to (world, ...); list states flatten in rank order
@@ -328,7 +367,13 @@ class Metric(ABC):
             # attribute compiled time to metric names; a shared null
             # context (one branch) when disabled
             with _obs.metric_scope(self, "update"):
-                return update(*args, **kwargs)
+                # reliability hook: with a StateGuard installed the update
+                # runs snapshot -> update -> fused isfinite check -> policy;
+                # without one (default) the cost is this one global read
+                guard = _rguard.active()
+                if guard is None:
+                    return update(*args, **kwargs)
+                return guard.run_update(self, update, args, kwargs)
 
         return wrapped_func
 
@@ -487,8 +532,34 @@ class Metric(ABC):
                 destination[prefix + key] = getattr(self, key)
         return destination
 
-    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
-        """Restore states saved by :meth:`state_dict`."""
+    def load_state_dict(
+        self,
+        state_dict: dict,
+        prefix: str = "",
+        strict: bool = False,
+        _warn_on_zero_match: bool = True,
+    ) -> None:
+        """Restore states saved by :meth:`state_dict`.
+
+        Args:
+            strict: require every registered state (at ``prefix + name``)
+                to be present in ``state_dict``; raises ``KeyError`` listing
+                the missing keys otherwise. For checkpoint validation beyond
+                key presence (schema version, payload checksum, dtype/shape
+                specs) use :func:`metrics_tpu.reliability.load_envelope`.
+            _warn_on_zero_match: internal — containers (collection,
+                composition) pass False and run the zero-match check over
+                ALL their members instead: one member legitimately matching
+                nothing (partial persistence at save time) is not the
+                mistyped-prefix hazard the warning exists for.
+        """
+        if strict:
+            missing = [prefix + key for key in self._defaults if prefix + key not in state_dict]
+            if missing:
+                raise KeyError(
+                    f"strict load_state_dict: {type(self).__name__} is missing"
+                    f" state keys {missing}"
+                )
         loaded = False
         for key in self._defaults:
             name = prefix + key
@@ -502,6 +573,27 @@ class Metric(ABC):
         if loaded:
             # a cached pre-load result no longer describes the state
             self._computed = None
+        elif _warn_on_zero_match and state_dict and self._defaults:
+            # silent-partial-load hazard: a mistyped prefix (or a checkpoint
+            # from a renamed metric) matches ZERO keys and historically
+            # returned without a sound — the state silently kept its priors
+            warn_once(
+                f"load_state_dict: none of {type(self).__name__}'s"
+                f" {len(self._defaults)} state keys (prefix={prefix!r}) matched"
+                f" the non-empty state_dict ({len(state_dict)} entries); nothing"
+                " was loaded. Check the prefix used at save time, pass"
+                " strict=True to make this an error, or use"
+                " metrics_tpu.reliability.load_envelope for validated restores.",
+                key=f"load-zero-match:{type(self).__name__}:{prefix}",
+            )
+
+    def _named_states(self, prefix: str = "") -> list:
+        """Every loadable ``(key, value)`` pair, prefixed exactly as
+        :meth:`state_dict` prefixes it — the key universe strict checkpoint
+        validation checks against (``metrics_tpu/reliability/checkpoint.py``).
+        Unlike ``state_dict()`` this ignores ``persistent`` flags: it
+        describes what *could* be restored, not what was saved."""
+        return [(prefix + key, getattr(self, key)) for key in self._defaults]
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         """Filter kwargs to those accepted by this metric's ``update`` signature."""
@@ -809,12 +901,47 @@ class CompositionalMetric(Metric):
             self.metric_b.state_dict(destination, prefix + "metric_b.")
         return destination
 
-    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+    def load_state_dict(
+        self,
+        state_dict: dict,
+        prefix: str = "",
+        strict: bool = False,
+        _warn_on_zero_match: bool = True,
+    ) -> None:
         if isinstance(self.metric_a, Metric):
-            self.metric_a.load_state_dict(state_dict, prefix + "metric_a.")
+            self.metric_a.load_state_dict(
+                state_dict, prefix + "metric_a.", strict=strict, _warn_on_zero_match=False
+            )
         if isinstance(self.metric_b, Metric):
-            self.metric_b.load_state_dict(state_dict, prefix + "metric_b.")
+            self.metric_b.load_state_dict(
+                state_dict, prefix + "metric_b.", strict=strict, _warn_on_zero_match=False
+            )
+        # zero-match hazard check over the WHOLE composition: one operand
+        # matching nothing is legitimate partial persistence, but nothing
+        # matching anywhere means a mistyped prefix / renamed metrics
+        # (suppressed when an enclosing container runs its own check)
+        if _warn_on_zero_match and state_dict and not any(
+            key in state_dict for key, _ in self._named_states(prefix)
+        ):
+            if self._named_states(prefix):
+                warn_once(
+                    f"load_state_dict: no operand state of this"
+                    f" {type(self).__name__} (prefix={prefix!r}) matched the"
+                    f" non-empty state_dict ({len(state_dict)} entries);"
+                    " nothing was loaded. Check the prefix used at save time"
+                    " or pass strict=True to make this an error.",
+                    key=f"load-zero-match:{type(self).__name__}:{prefix}",
+                )
         self._computed = None
+
+    def _named_states(self, prefix: str = "") -> list:
+        # operand-prefixed, mirroring state_dict's child recursion
+        pairs = super()._named_states(prefix)
+        if isinstance(self.metric_a, Metric):
+            pairs += self.metric_a._named_states(prefix + "metric_a.")
+        if isinstance(self.metric_b, Metric):
+            pairs += self.metric_b._named_states(prefix + "metric_b.")
+        return pairs
 
     def to_device(self, device) -> "CompositionalMetric":
         if isinstance(self.metric_a, Metric):

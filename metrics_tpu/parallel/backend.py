@@ -70,10 +70,16 @@ class MultiHostBackend(SyncBackend):
 _BACKEND: Optional[SyncBackend] = None
 
 
-def set_sync_backend(backend: Optional[SyncBackend]) -> None:
-    """Install a process-global sync backend (None restores auto-detection)."""
+def set_sync_backend(backend: Optional[SyncBackend]) -> Optional[SyncBackend]:
+    """Install a process-global sync backend (None restores auto-detection).
+
+    Returns the previously-installed backend so callers that wrap or
+    temporarily replace the backend (tests, fault injection) can restore it
+    exactly instead of clobbering someone else's installation."""
     global _BACKEND
+    prev = _BACKEND
     _BACKEND = backend
+    return prev
 
 
 def get_sync_backend() -> SyncBackend:

@@ -265,7 +265,13 @@ class ShardedStreamsMixin:
             setattr(self, key, _put_sharded(getattr(self, key), sharding))
         self._defaults = {k: _put_sharded(v, sharding) for k, v in self._defaults.items()}
 
-    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+    def load_state_dict(
+        self,
+        state_dict: dict,
+        prefix: str = "",
+        strict: bool = False,
+        _warn_on_zero_match: bool = True,
+    ) -> None:
         # a checkpoint from a different mesh size cannot be resharded blindly:
         # counts are per-device and the mask logic depends on world/capacity
         if prefix + "counts" in state_dict:
@@ -284,7 +290,9 @@ class ShardedStreamsMixin:
                     f"checkpoint capacity {saved_cap} != this metric's capacity"
                     f" {self.capacity} ({self.capacity_per_device}/device)"
                 )
-        super().load_state_dict(state_dict, prefix)
+        super().load_state_dict(
+            state_dict, prefix, strict=strict, _warn_on_zero_match=_warn_on_zero_match
+        )
         # restore the mesh sharding (checkpoint restore yields single-device
         # arrays) and the host-side fill level; _put_sharded keeps this
         # working on multi-host meshes, where every process loads the same

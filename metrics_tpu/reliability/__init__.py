@@ -1,0 +1,75 @@
+"""Reliability subsystem: survive the failure, don't just observe it.
+
+The observability layer (PR 2) makes runtime pathologies *visible*; this
+package makes the library *survive* them — the metric-layer analog of
+fault-tolerant collective libraries. Four pieces, each off-by-default and
+zero-overhead until enabled:
+
+* **Validated checkpointing** (:mod:`.checkpoint`) — a versioned,
+  checksummed state envelope around ``state_dict``/``load_state_dict``;
+  ``strict`` loads reject schema drift, corruption, and partial matches
+  with typed errors instead of today's silent partial load.
+* **Non-finite state guard** (:mod:`.guard`) — ``raise``/``warn``/
+  ``quarantine`` policies applied after every update/merge; quarantine
+  rolls a poisoned batch back to the last-good state (in-program, under
+  the compiled engine).
+* **Guarded sync** (:mod:`.sync`) — timeout + bounded exponential-backoff
+  retry for host-level state gathers, with a ``degraded_ok`` local-only
+  fallback instead of a crashed eval.
+* **Fault injection** (:mod:`.faultinject`) — scoped context managers that
+  create each failure on demand, so every recovery path above is
+  exercised by the chaos suite (``tests/reliability/``) on every PR.
+
+Telemetry counters (all under ``reliability.*``; see
+``docs/reliability.md`` and the glossary in ``docs/observability.md``):
+``quarantined``, ``sync_retries``, ``degraded_syncs``,
+``checkpoint_rejects``, ``engine_dispatch_recoveries`` — a healthy run
+keeps every one of them at zero.
+"""
+from metrics_tpu.reliability.checkpoint import (  # noqa: F401
+    CheckpointCorruptionError,
+    CheckpointError,
+    CheckpointMismatchError,
+    CheckpointSchemaError,
+    load_envelope,
+    read_envelope,
+    save_envelope,
+    write_envelope,
+)
+from metrics_tpu.reliability.guard import (  # noqa: F401
+    NonFiniteStateError,
+    StateGuard,
+    guard_scope,
+    install_guard,
+    uninstall_guard,
+)
+from metrics_tpu.reliability.sync import (  # noqa: F401
+    SyncFailedError,
+    SyncPolicy,
+    SyncTimeoutError,
+    set_sync_policy,
+    sync_policy_scope,
+)
+from metrics_tpu.reliability import faultinject  # noqa: F401
+
+__all__ = [
+    "CheckpointCorruptionError",
+    "CheckpointError",
+    "CheckpointMismatchError",
+    "CheckpointSchemaError",
+    "NonFiniteStateError",
+    "StateGuard",
+    "SyncFailedError",
+    "SyncPolicy",
+    "SyncTimeoutError",
+    "faultinject",
+    "guard_scope",
+    "install_guard",
+    "load_envelope",
+    "read_envelope",
+    "save_envelope",
+    "set_sync_policy",
+    "sync_policy_scope",
+    "uninstall_guard",
+    "write_envelope",
+]

@@ -1,0 +1,371 @@
+"""Validated checkpointing: a versioned, checksummed state envelope.
+
+``Metric.state_dict()`` / ``load_state_dict()`` move raw arrays with no
+provenance: a checkpoint written by a differently-configured metric (other
+``num_classes``, other dtype policy, renamed states after a refactor) loads
+*silently partially* — whatever keys happen to match are restored and the
+rest keep their defaults, which surfaces days later as a subtly wrong
+metric, not an error. The envelope closes that hole:
+
+.. code-block:: python
+
+    env = {
+        "format":         "metrics_tpu.state_envelope",
+        "schema_version": 1,
+        "metric_type":    "MetricCollection",       # informational
+        "complete":       True,                      # covers every state?
+        "spec":  {key: {"kind": "array", "dtype": "float32", "shape": [3]},
+                  key2: {"kind": "list", "len": 2, "dtype": "float32"}},
+        "payload": {key: <array>, key2: [<array>, <array>]},
+        "checksum": "crc32:xxxxxxxx",                # over payload bytes
+    }
+
+:func:`load_envelope` verifies, in order: the format marker, the schema
+version, the payload checksum (bit-rot / truncation), and — under
+``strict=True`` — that the envelope's keys and per-state dtype/shape specs
+exactly match the receiving metric's registered states. Any rejection
+raises a typed :class:`CheckpointError` subclass and counts
+``reliability.checkpoint_rejects`` in telemetry. Non-strict mode loads the
+valid intersection and warns (rate-limited) about everything it skipped —
+strictly more visible than the raw ``load_state_dict``.
+
+Works uniformly on a :class:`~metrics_tpu.Metric`, a
+:class:`~metrics_tpu.CompositionalMetric`, and a
+:class:`~metrics_tpu.MetricCollection` (state keys are member-prefixed, as
+in ``MetricCollection.state_dict``). :func:`write_envelope` /
+:func:`read_envelope` serialize to a single ``.npz`` whose payload survives
+any dtype JAX produces (bfloat16 included — arrays travel as raw bytes and
+are rebuilt from the spec).
+"""
+import json
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.utilities.prints import warn_once
+
+__all__ = [
+    "ENVELOPE_FORMAT",
+    "SCHEMA_VERSION",
+    "CheckpointError",
+    "CheckpointSchemaError",
+    "CheckpointCorruptionError",
+    "CheckpointMismatchError",
+    "save_envelope",
+    "load_envelope",
+    "write_envelope",
+    "read_envelope",
+]
+
+ENVELOPE_FORMAT = "metrics_tpu.state_envelope"
+SCHEMA_VERSION = 1
+
+
+class CheckpointError(RuntimeError):
+    """Base of every envelope rejection."""
+
+
+class CheckpointSchemaError(CheckpointError):
+    """Not an envelope, or written by an incompatible schema version."""
+
+
+class CheckpointCorruptionError(CheckpointError):
+    """The payload checksum does not match (bit rot, truncation, tamper)."""
+
+
+class CheckpointMismatchError(CheckpointError):
+    """Strict load: envelope keys/dtypes/shapes do not match the metric."""
+
+
+def _reject(exc: CheckpointError) -> CheckpointError:
+    if _obs.enabled():
+        _obs.get().count("reliability.checkpoint_rejects")
+        _obs.get().event("checkpoint_reject", error=f"{type(exc).__name__}: {exc}")
+    return exc
+
+
+# ----------------------------------------------------------------------
+# payload plumbing
+# ----------------------------------------------------------------------
+def _np(v: Any) -> np.ndarray:
+    arr = np.asarray(v)
+    # ascontiguousarray alone promotes 0-d to 1-d; keep the true shape
+    return np.ascontiguousarray(arr).reshape(arr.shape)
+
+
+def _dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)  # ml_dtypes registers "bfloat16" etc.
+    except TypeError:
+        return np.dtype(getattr(jnp, name))
+
+
+def _spec_of(value: Any) -> Dict[str, Any]:
+    if isinstance(value, list):
+        return {
+            "kind": "list",
+            "len": len(value),
+            "dtype": [str(_np(v).dtype) for v in value],
+            "shape": [list(_np(v).shape) for v in value],
+        }
+    arr = _np(value)
+    return {"kind": "array", "dtype": str(arr.dtype), "shape": list(arr.shape)}
+
+
+def _checksum(payload: Dict[str, Any]) -> str:
+    crc = 0
+    for key in sorted(payload):
+        crc = zlib.crc32(key.encode(), crc)
+        val = payload[key]
+        for v in val if isinstance(val, list) else [val]:
+            arr = _np(v)
+            crc = zlib.crc32(f"{arr.dtype}{arr.shape}".encode(), crc)
+            crc = zlib.crc32(arr.tobytes(), crc)
+    return f"crc32:{crc:08x}"
+
+
+def _named_states(obj: Any) -> List[Tuple[str, Any]]:
+    """Every loadable (key, current value) pair of a metric or collection,
+    member-/operand-prefixed exactly as ``state_dict`` prefixes them."""
+    pairs = obj._named_states()
+    return list(pairs)
+
+
+# ----------------------------------------------------------------------
+# save
+# ----------------------------------------------------------------------
+def save_envelope(obj: Any, persistent_only: bool = False) -> Dict[str, Any]:
+    """Capture ``obj``'s state into a validated envelope.
+
+    By default every registered state is captured (a reliability checkpoint
+    should be complete); ``persistent_only=True`` honors the metric's
+    ``persistent()`` flags instead, i.e. wraps exactly what
+    ``obj.state_dict()`` would return.
+    """
+    # Materialize to host numpy ONCE here. This simultaneously (a) breaks
+    # aliasing with live list ("cat") states, which a later update() would
+    # append into in place, mutating the payload under its own checksum,
+    # and (b) keeps spec/checksum/file-write from re-fetching every device
+    # array (their separate _np() passes would otherwise mean three
+    # device-to-host transfers of the full state per checkpoint).
+    source = obj.state_dict() if persistent_only else dict(_named_states(obj))
+    payload = {
+        k: ([_np(x) for x in v] if isinstance(v, list) else _np(v))
+        for k, v in source.items()
+    }
+    complete = set(payload) == {k for k, _ in _named_states(obj)}
+    return {
+        "format": ENVELOPE_FORMAT,
+        "schema_version": SCHEMA_VERSION,
+        "metric_type": type(obj).__name__,
+        "complete": complete,
+        "spec": {k: _spec_of(v) for k, v in payload.items()},
+        "payload": payload,
+        "checksum": _checksum(payload),
+    }
+
+
+# ----------------------------------------------------------------------
+# load
+# ----------------------------------------------------------------------
+def _validate_envelope(envelope: Any) -> None:
+    if not isinstance(envelope, dict) or envelope.get("format") != ENVELOPE_FORMAT:
+        raise _reject(
+            CheckpointSchemaError(
+                "not a metrics_tpu state envelope (missing/unknown 'format'"
+                " marker); raw state dicts load via load_state_dict()"
+            )
+        )
+    version = envelope.get("schema_version")
+    if not isinstance(version, int) or version < 1 or version > SCHEMA_VERSION:
+        raise _reject(
+            CheckpointSchemaError(
+                f"envelope schema_version {version!r} is not supported by this"
+                f" library build (supports 1..{SCHEMA_VERSION}); refusing to"
+                " guess at its layout"
+            )
+        )
+    for field in ("spec", "payload", "checksum"):
+        if field not in envelope:
+            raise _reject(
+                CheckpointSchemaError(f"envelope is missing required field {field!r}")
+            )
+    got = _checksum(envelope["payload"])
+    if got != envelope["checksum"]:
+        raise _reject(
+            CheckpointCorruptionError(
+                f"envelope payload checksum mismatch: stored"
+                f" {envelope['checksum']}, recomputed {got} — the checkpoint"
+                " is corrupted (bit rot, truncation, or tampering)"
+            )
+        )
+
+
+def _shape_dtype_problems(
+    envelope: Dict[str, Any], current: Dict[str, Any]
+) -> List[str]:
+    problems = []
+    for key, spec in envelope["spec"].items():
+        if key not in current:
+            continue
+        cur = current[key]
+        if spec["kind"] == "list":
+            if not isinstance(cur, list):
+                problems.append(f"{key}: envelope has a list state, metric an array")
+            continue  # list lengths grow with batches; no shape pin
+        if isinstance(cur, list):
+            problems.append(f"{key}: envelope has an array state, metric a list")
+            continue
+        cur_arr = _np(cur)
+        if list(cur_arr.shape) != list(spec["shape"]):
+            problems.append(
+                f"{key}: shape {list(spec['shape'])} != metric state shape"
+                f" {list(cur_arr.shape)}"
+            )
+        elif str(cur_arr.dtype) != spec["dtype"]:
+            problems.append(
+                f"{key}: dtype {spec['dtype']} != metric state dtype {cur_arr.dtype}"
+            )
+    return problems
+
+
+def load_envelope(obj: Any, envelope: Dict[str, Any], strict: bool = True) -> None:
+    """Validate ``envelope`` and restore it into ``obj``.
+
+    ``strict=True`` (default): the envelope must carry exactly the metric's
+    registered state keys, each with matching dtype and shape — missing
+    keys, unexpected keys, or spec mismatches raise
+    :class:`CheckpointMismatchError` *before any state is touched*.
+    ``strict=False``: the valid intersection is loaded; everything skipped
+    is reported through one rate-limited warning.
+    """
+    _validate_envelope(envelope)
+    current = dict(_named_states(obj))
+    have = set(envelope["payload"])
+    want = set(current)
+    missing = sorted(want - have)
+    unexpected = sorted(have - want)
+    problems = _shape_dtype_problems(envelope, current)
+
+    if strict:
+        if missing or unexpected or problems:
+            detail = []
+            if missing:
+                detail.append(f"missing keys: {missing}")
+            if unexpected:
+                detail.append(f"unexpected keys: {unexpected}")
+            if problems:
+                detail.append(f"spec mismatches: {problems}")
+            raise _reject(
+                CheckpointMismatchError(
+                    "strict envelope load rejected — " + "; ".join(detail)
+                    + ". The checkpoint was written by a differently-configured"
+                    " metric (or a different library version); load with"
+                    " strict=False to restore the matching subset."
+                )
+            )
+        loadable = dict(envelope["payload"])
+    else:
+        bad_keys = {p.split(":", 1)[0] for p in problems}
+        loadable = {
+            k: v
+            for k, v in envelope["payload"].items()
+            if k in want and k not in bad_keys
+        }
+        skipped = sorted((have - set(loadable)) | set(missing))
+        if missing or unexpected or problems:
+            warn_once(
+                "non-strict envelope load skipped incompatible entries"
+                f" (missing={missing}, unexpected={unexpected},"
+                f" mismatched={sorted(bad_keys)}); loaded"
+                f" {len(loadable)}/{len(have)} states, skipped {skipped}",
+                key=f"envelope-partial:{type(obj).__name__}",
+            )
+    obj.load_state_dict(loadable)
+
+
+# ----------------------------------------------------------------------
+# file round-trip (single .npz; dtype-agnostic raw-byte payload)
+# ----------------------------------------------------------------------
+def write_envelope(path: Any, envelope: Dict[str, Any]) -> None:
+    """Serialize an envelope to one ``.npz`` file. Arrays are stored as raw
+    bytes and rebuilt from the spec, so every JAX dtype (bfloat16 included)
+    survives the trip without pickling."""
+    header = {k: envelope[k] for k in envelope if k != "payload"}
+    arrays = {"__header__": np.frombuffer(json.dumps(header).encode(), dtype=np.uint8)}
+    for key, val in envelope["payload"].items():
+        if isinstance(val, list):
+            for i, v in enumerate(val):
+                arrays[f"l::{key}::{i}"] = np.frombuffer(_np(v).tobytes(), dtype=np.uint8)
+        else:
+            arrays[f"a::{key}"] = np.frombuffer(_np(val).tobytes(), dtype=np.uint8)
+    with open(path, "wb") as f:
+        np.savez(f, **arrays)
+
+
+def read_envelope(path: Any) -> Dict[str, Any]:
+    """Read an envelope written by :func:`write_envelope`. Performs only
+    structural decoding; validation happens in :func:`load_envelope`."""
+    with np.load(path) as data:
+        if "__header__" not in data:
+            raise _reject(
+                CheckpointSchemaError(f"{path!r} is not a metrics_tpu envelope file")
+            )
+        try:
+            header = json.loads(bytes(data["__header__"]).decode())
+        except (UnicodeDecodeError, json.JSONDecodeError) as err:
+            raise _reject(
+                CheckpointCorruptionError(f"envelope header is unreadable: {err}")
+            ) from err
+        spec = header.get("spec", {})
+        payload: Dict[str, Any] = {}
+        for name in data.files:
+            if name == "__header__":
+                continue
+            kind, _, rest = name.partition("::")
+            if kind == "a":
+                s = spec.get(rest)
+                if s is None:
+                    raise _reject(
+                        CheckpointCorruptionError(f"payload entry {rest!r} has no spec")
+                    )
+                payload[rest] = _decode(data[name], s["dtype"], s["shape"])
+            elif kind == "l":
+                key, _, idx = rest.rpartition("::")
+                s = spec.get(key)
+                if s is None:
+                    raise _reject(
+                        CheckpointCorruptionError(f"payload entry {key!r} has no spec")
+                    )
+                i = int(idx)
+                payload.setdefault(key, {})[i] = _decode(
+                    data[name], s["dtype"][i], s["shape"][i]
+                )
+    for key, val in list(payload.items()):
+        if isinstance(val, dict):  # reassemble list states in index order
+            payload[key] = [val[i] for i in sorted(val)]
+    # empty list states write zero npz entries; rebuild them from the spec
+    # (only for len == 0 — a len > 0 list with missing entries is genuine
+    # truncation and must keep failing the checksum)
+    for key, s in spec.items():
+        if s.get("kind") == "list" and s.get("len") == 0 and key not in payload:
+            payload[key] = []
+    header["payload"] = payload
+    return header
+
+
+def _decode(raw: np.ndarray, dtype: str, shape: List[int]) -> np.ndarray:
+    dt = _dtype(dtype)
+    expected = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+    buf = raw.tobytes()
+    if len(buf) != expected:
+        raise _reject(
+            CheckpointCorruptionError(
+                f"payload byte length {len(buf)} does not match spec"
+                f" {dtype}{shape} ({expected} bytes) — truncated checkpoint"
+            )
+        )
+    return np.frombuffer(buf, dtype=dt).reshape(shape)

@@ -1,0 +1,240 @@
+"""Fault-injection harness: make the failure paths testable on demand.
+
+Recovery code that only runs during real outages is recovery code that has
+never run. Each helper here injects one production failure mode — NaN/Inf
+in update inputs, a corrupted checkpoint envelope, a sync backend that
+fails or hangs, a compiled step that will not trace — as a scoped context
+manager that restores the pristine world on exit. The chaos suite
+(``tests/reliability/``) drives every reliability recovery path through
+these; they are also safe to use in a staging eval loop as a live drill.
+
+Nothing here is imported by the runtime hot paths; injecting a fault costs
+nothing until you ask for it.
+"""
+import time
+from contextlib import contextmanager
+from copy import deepcopy
+from typing import Any, Dict, Iterator, List, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.parallel.backend import (
+    SyncBackend,
+    get_sync_backend,
+    set_sync_backend,
+)
+
+__all__ = [
+    "FaultInjected",
+    "poison",
+    "nonfinite_updates",
+    "flaky_sync_backend",
+    "failing_engine_compile",
+    "corrupt_envelope",
+]
+
+
+class FaultInjected(RuntimeError):
+    """Marker exception raised by injected faults (distinguishable from
+    organic failures in assertions and logs)."""
+
+
+# ----------------------------------------------------------------------
+# 1. non-finite inputs
+# ----------------------------------------------------------------------
+def poison(x: jax.Array, mode: str = "nan", index: Any = 0) -> jax.Array:
+    """Return ``x`` with ``x[index]`` replaced by NaN (``mode="nan"``) or
+    +Inf (``mode="inf"``). For crafting poisoned batches fed to *compiled*
+    paths, where wrapping ``update`` would bake the fault into a cached XLA
+    program instead of into one batch's data."""
+    if mode not in ("nan", "inf"):
+        raise ValueError(f"mode must be 'nan' or 'inf', got {mode!r}")
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating):
+        raise ValueError("poison() needs a floating-point array")
+    bad = jnp.nan if mode == "nan" else jnp.inf
+    return jnp.asarray(x).at[index].set(bad)
+
+
+def _target_metrics(obj: Any) -> List[Any]:
+    values = getattr(obj, "values", None)
+    if values is not None and not hasattr(obj, "_defaults"):
+        return list(obj.values())  # MetricCollection
+    return [obj]
+
+
+@contextmanager
+def nonfinite_updates(
+    obj: Any, mode: str = "nan", times: int = 1, arg_index: int = 0
+) -> Iterator[Dict[str, int]]:
+    """Poison the first ``times`` ``update()`` calls of a metric (or of
+    every member of a collection): positional argument ``arg_index`` gets
+    one element overwritten with NaN/Inf before the real update runs.
+
+    Eager-path injection only — under the compiled engine, ``update`` runs
+    at trace time and a wrapper would poison the cached *program*; feed
+    :func:`poison`-ed batch data instead.
+    """
+    metrics = _target_metrics(obj)
+    injected = {"count": 0}
+    originals = [(m, m.update) for m in metrics]
+
+    def _wrap(metric, orig):
+        def poisoned_update(*args, **kwargs):
+            if injected["count"] < times and len(args) > arg_index:
+                injected["count"] += 1
+                args = (
+                    *args[:arg_index],
+                    poison(args[arg_index], mode),
+                    *args[arg_index + 1 :],
+                )
+            return orig(*args, **kwargs)
+
+        return poisoned_update
+
+    try:
+        for m, orig in originals:
+            m.update = _wrap(m, orig)
+        yield injected
+    finally:
+        for m, orig in originals:
+            m.update = orig
+
+
+# ----------------------------------------------------------------------
+# 2. flaky / hung sync backend
+# ----------------------------------------------------------------------
+class _FlakyBackend(SyncBackend):
+    """Delegates to ``inner`` after misbehaving: the first ``fails`` gather
+    calls raise ``exc_type`` (after an optional delay — set ``fails=0`` and
+    ``delay_s>0`` for a slow-but-successful backend, the timeout drill)."""
+
+    def __init__(
+        self,
+        inner: SyncBackend,
+        fails: int,
+        delay_s: float = 0.0,
+        exc_type: Type[BaseException] = FaultInjected,
+        slow_calls: int = 0,
+    ):
+        self.inner = inner
+        self.remaining_failures = fails
+        self.delay_s = delay_s
+        self.exc_type = exc_type
+        self.remaining_slow = slow_calls
+        self.calls = 0
+
+    @property
+    def world_size(self) -> int:
+        return self.inner.world_size
+
+    def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
+        self.calls += 1
+        if self.remaining_failures > 0:
+            self.remaining_failures -= 1
+            if self.delay_s:
+                time.sleep(self.delay_s)
+            raise self.exc_type(
+                f"injected sync failure ({self.remaining_failures} more to come)"
+            )
+        if self.remaining_slow > 0:
+            self.remaining_slow -= 1
+            time.sleep(self.delay_s)
+        return self.inner.gather(x, group=group)
+
+
+@contextmanager
+def flaky_sync_backend(
+    fails: int = 1,
+    delay_s: float = 0.0,
+    exc_type: Type[BaseException] = FaultInjected,
+    slow_calls: int = 0,
+) -> Iterator[_FlakyBackend]:
+    """Install a sync backend that fails the first ``fails`` gathers (then
+    delegates to the previously-active backend). With ``fails=0`` and
+    ``slow_calls > 0``, the first ``slow_calls`` gathers instead *succeed
+    slowly* (sleep ``delay_s``) — the drill for ``SyncPolicy.timeout_s``."""
+    backend = _FlakyBackend(get_sync_backend(), fails, delay_s, exc_type, slow_calls)
+    prev = set_sync_backend(backend)
+    try:
+        yield backend
+    finally:
+        set_sync_backend(prev)
+
+
+# ----------------------------------------------------------------------
+# 3. engine compile failure
+# ----------------------------------------------------------------------
+@contextmanager
+def failing_engine_compile(times: int = 1) -> Iterator[Dict[str, int]]:
+    """Make the next ``times`` compiled-step traces raise
+    :class:`FaultInjected` at trace time — the exact failure shape of an
+    XLA lowering bug or an unjittable update sneaking into the engine.
+    Exercises the engine's rerun-eager-then-demote recovery path."""
+    from metrics_tpu.engine import CompiledStepEngine  # lazy: avoid import cycle
+
+    orig = CompiledStepEngine._make_step_fn
+    injected = {"count": 0}
+
+    def patched(self, names, *fn_args, **fn_kwargs):
+        real = orig(self, names, *fn_args, **fn_kwargs)
+
+        def step_fn(states, args, kwargs):
+            if injected["count"] < times:
+                injected["count"] += 1
+                raise FaultInjected("injected engine compile failure")
+            return real(states, args, kwargs)
+
+        return step_fn
+
+    CompiledStepEngine._make_step_fn = patched
+    try:
+        yield injected
+    finally:
+        CompiledStepEngine._make_step_fn = orig
+
+
+# ----------------------------------------------------------------------
+# 4. checkpoint corruption
+# ----------------------------------------------------------------------
+def corrupt_envelope(envelope: Dict[str, Any], mode: str = "payload") -> Dict[str, Any]:
+    """Return a corrupted copy of a state envelope (the original is left
+    intact). Modes mirror real checkpoint damage:
+
+    * ``"payload"``  — flip bits in one payload array, checksum untouched
+      (bit rot in storage; must be caught by checksum verification).
+    * ``"checksum"`` — clobber the stored checksum (truncated/partial
+      write of the header).
+    * ``"schema"``   — bump ``schema_version`` past what this build knows
+      (checkpoint from a future library version).
+    * ``"truncate"`` — drop one payload entry AND its spec, recomputing the
+      checksum (a consistent-but-incomplete envelope; must be caught by
+      strict key matching, not the checksum).
+    """
+    from metrics_tpu.reliability.checkpoint import _checksum  # lazy: cycle-free
+
+    env = deepcopy({k: v for k, v in envelope.items() if k != "payload"})
+    env["payload"] = dict(envelope["payload"])
+    if mode == "payload":
+        key = sorted(env["payload"])[0]
+        val = env["payload"][key]
+        first = val[0] if isinstance(val, list) else val
+        arr = np.array(np.asarray(first), copy=True)
+        raw = np.atleast_1d(arr).view(np.uint8)  # view: mutates arr in place
+        raw.reshape(-1)[0] ^= 0xFF
+        env["payload"][key] = [arr, *val[1:]] if isinstance(val, list) else arr
+    elif mode == "checksum":
+        env["checksum"] = "crc32:00000000"
+    elif mode == "schema":
+        env["schema_version"] = envelope["schema_version"] + 999
+    elif mode == "truncate":
+        key = sorted(env["payload"])[-1]
+        del env["payload"][key]
+        env["spec"] = {k: v for k, v in env["spec"].items() if k != key}
+        env["checksum"] = _checksum(env["payload"])
+    else:
+        raise ValueError(
+            f"mode must be one of 'payload'|'checksum'|'schema'|'truncate', got {mode!r}"
+        )
+    return env

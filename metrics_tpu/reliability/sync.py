@@ -1,0 +1,237 @@
+"""Guarded distributed sync: timeout, bounded retry, degraded-mode fallback.
+
+The host-level sync path (``Metric._sync_dist`` → ``gather_all_tensors`` →
+the active :class:`~metrics_tpu.parallel.backend.SyncBackend`) is the one
+place a metric blocks on OTHER machines: a flaky DCN link, a preempted
+peer, or a wedged collective turns ``compute()`` into either an exception
+that kills the eval or a hang that never returns. A :class:`SyncPolicy`
+bounds both failure modes, in the spirit of fault-tolerant collective
+libraries (Prime PCCL): each gather gets
+
+* an optional **timeout** (``timeout_s``) — the gather runs in a worker
+  thread and is abandoned if it does not return in time (the thread itself
+  cannot be killed; it is left to finish in the background, which is the
+  best any host-level wrapper can do against a wedged collective). A
+  timed-out attempt is TERMINAL, never retried: the abandoned worker may
+  still be consuming the peers' collective round, and a concurrent retry
+  would pair this rank's gathers with the wrong rounds;
+* **bounded retries** with exponential backoff (``max_retries``,
+  ``backoff_s`` doubling per attempt) for cleanly-failing gathers —
+  counted as ``reliability.sync_retries`` in telemetry;
+* a **degraded mode** (``degraded_ok=True``): when a gather fails
+  terminally, the WHOLE sync falls back to LOCAL-ONLY state — every state
+  gathers as ``[x]``, exactly as the single-process backend would — with
+  one rate-limited warning and a ``reliability.degraded_syncs`` count,
+  rather than crashing the eval. Degradation is atomic per sync (applied
+  by ``Metric._sync_dist`` across the full state dict): mixing
+  world-aggregated and local-only states within one metric would be
+  silently wrong, not degraded. The resulting value is this rank's
+  contribution only; callers opting in accept
+  locally-correct-but-globally-partial results over no results.
+
+Like every reliability feature, the default is OFF and zero-overhead: with
+no policy installed, :func:`apply_sync_policy` returns its argument
+untouched after one module-global read.
+
+Scope: host-level backends only. In-program XLA collectives
+(``parallel/collective.py``) execute inside a compiled program where a
+Python wrapper cannot intercede; hangs there are the runtime's to handle.
+"""
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Callable, Iterator, Optional
+
+import jax.numpy as jnp
+
+from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.utilities.prints import warn_once
+
+__all__ = [
+    "SyncPolicy",
+    "SyncFailedError",
+    "SyncTimeoutError",
+    "set_sync_policy",
+    "active_policy",
+    "sync_policy_scope",
+    "apply_sync_policy",
+    "degraded_local_fallback",
+]
+
+
+class SyncFailedError(RuntimeError):
+    """Every attempt of a guarded gather failed (and ``degraded_ok`` is off)."""
+
+
+class SyncTimeoutError(SyncFailedError):
+    """A single gather attempt exceeded ``SyncPolicy.timeout_s``."""
+
+
+@dataclass
+class SyncPolicy:
+    """Retry/timeout/degradation contract for host-level state sync.
+
+    Attributes:
+        max_retries: additional attempts after the first failure (total
+            attempts = ``max_retries + 1``).
+        backoff_s: sleep before the first retry; doubles per retry.
+        timeout_s: per-attempt wall-clock bound; None = wait forever.
+        degraded_ok: after the final failure, fall back to local-only
+            state (one warning + ``reliability.degraded_syncs``) instead
+            of raising :class:`SyncFailedError`.
+    """
+
+    max_retries: int = 2
+    backoff_s: float = 0.05
+    timeout_s: Optional[float] = None
+    degraded_ok: bool = False
+
+    # host-side tally, useful when telemetry is disabled
+    def __post_init__(self):
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
+        self.stats = {"retries": 0, "degraded": 0, "timeouts": 0}
+
+
+_active: Optional[SyncPolicy] = None
+
+
+def set_sync_policy(policy: Optional[SyncPolicy]) -> Optional[SyncPolicy]:
+    """Install a process-global sync policy (None removes it). Returns the
+    previously-installed policy so callers can restore it."""
+    global _active
+    prev = _active
+    _active = policy
+    return prev
+
+
+def active_policy() -> Optional[SyncPolicy]:
+    return _active
+
+
+@contextmanager
+def sync_policy_scope(policy: Optional[SyncPolicy] = None, **kwargs: Any) -> Iterator[SyncPolicy]:
+    """Install a policy for a ``with`` block (``SyncPolicy(**kwargs)`` when
+    no policy object is given), restoring the prior one on exit."""
+    p = policy if policy is not None else SyncPolicy(**kwargs)
+    prev = set_sync_policy(p)
+    try:
+        yield p
+    finally:
+        set_sync_policy(prev)
+
+
+def _attempt(fn: Callable, args: tuple, kwargs: dict, timeout_s: Optional[float]):
+    if timeout_s is None:
+        return fn(*args, **kwargs)
+    # A fresh DAEMON thread per timed attempt — not a ThreadPoolExecutor,
+    # whose non-daemon workers are joined by concurrent.futures' atexit
+    # hook: a wedged gather would then convert "eval hangs" into "process
+    # never terminates". A daemon thread is genuinely abandonable.
+    result: dict = {}
+    done = threading.Event()
+
+    def _run():
+        try:
+            result["value"] = fn(*args, **kwargs)
+        except BaseException as err:  # noqa: BLE001 — ferried to the caller
+            result["error"] = err
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=_run, name="metrics_tpu-sync", daemon=True)
+    worker.start()
+    if not done.wait(timeout_s):
+        raise SyncTimeoutError(
+            f"sync gather exceeded timeout_s={timeout_s}; the attempt was"
+            " abandoned (its daemon worker may still be running)"
+        )
+    if "error" in result:
+        raise result["error"]
+    return result["value"]
+
+
+def apply_sync_policy(fn: Callable) -> Callable:
+    """Wrap a gather callable (``fn(x, group=None) -> [x_rank0, ...]``) with
+    the active policy's retry/backoff/timeout; returns ``fn`` untouched when
+    no policy is installed (the zero-overhead default).
+
+    On exhaustion the wrapper ALWAYS raises :class:`SyncFailedError` — it
+    never degrades a single gather. Degradation must be atomic across a
+    whole sync (one metric's state dict): a per-leaf fallback could mix
+    world-aggregated and local-only states in one metric (e.g. global
+    ``total`` with local ``correct``), which is silently wrong rather than
+    degraded. The caller (``Metric._sync_dist``) catches the error and
+    applies :func:`degraded_local_fallback` to every state at once.
+
+    A TIMED-OUT attempt is terminal, not retried: the abandoned worker may
+    still be executing the gather, and on backends that match collectives
+    by call order a concurrent retry would pair this rank's gathers with
+    the wrong rounds on its peers. Only clean failures retry.
+    """
+    policy = _active
+    if policy is None:
+        return fn
+
+    def guarded(x, *args: Any, **kwargs: Any):
+        delay = policy.backoff_s
+        last_err: Optional[BaseException] = None
+        for attempt in range(policy.max_retries + 1):
+            try:
+                return _attempt(fn, (x, *args), kwargs, policy.timeout_s)
+            except Exception as err:  # noqa: BLE001 — any backend failure
+                last_err = err
+                if isinstance(err, SyncTimeoutError):
+                    # the abandoned attempt may still be consuming the
+                    # peers' collective round — retrying would race it
+                    policy.stats["timeouts"] += 1
+                    break
+                if attempt < policy.max_retries:
+                    policy.stats["retries"] += 1
+                    if _obs.enabled():
+                        _obs.get().count("reliability.sync_retries")
+                        _obs.get().event(
+                            "sync_retry",
+                            attempt=attempt + 1,
+                            error=f"{type(err).__name__}: {err}",
+                        )
+                    time.sleep(delay)
+                    delay *= 2.0
+        if isinstance(last_err, SyncFailedError):
+            # keep the subtype catchable: a terminal timeout surfaces as
+            # SyncTimeoutError (which IS-A SyncFailedError), not re-wrapped
+            raise last_err
+        raise SyncFailedError(
+            f"sync gather failed ({type(last_err).__name__}: {last_err})"
+        ) from last_err
+
+    return guarded
+
+
+def degraded_local_fallback(err: BaseException) -> Optional[Callable]:
+    """When the active policy allows degradation, record one degraded sync
+    (stats + telemetry + one rate-limited warning) and return the
+    local-only gather (``x -> [x]``, the single-process contract) to be
+    applied to EVERY state of the failed sync — atomic local-only
+    degradation. Returns None when no policy is active or ``degraded_ok``
+    is off (the caller should re-raise)."""
+    policy = _active
+    if policy is None or not policy.degraded_ok:
+        return None
+    policy.stats["degraded"] += 1
+    if _obs.enabled():
+        _obs.get().count("reliability.degraded_syncs")
+        _obs.get().event("degraded_sync", error=f"{type(err).__name__}: {err}")
+    warn_once(
+        "guarded sync: gather failed terminally"
+        f" ({type(err).__name__}: {err}); continuing with LOCAL-ONLY state"
+        " for the whole sync (degraded_ok=True). Synced results now reflect"
+        " this process alone; telemetry counter: reliability.degraded_syncs.",
+        key="reliability-degraded-sync",
+    )
+
+    def local_only(x, *args: Any, **kwargs: Any):
+        return [jnp.asarray(x)]
+
+    return local_only

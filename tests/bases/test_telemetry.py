@@ -337,6 +337,30 @@ def test_env_flags_cached_and_refreshable(monkeypatch):
     assert not env.parse_flag("0") and not env.parse_flag(None) and not env.parse_flag("no")
 
 
+def test_reliability_counters_zero_on_healthy_run():
+    """The `reliability.*` counter family (quarantined / sync_retries /
+    degraded_syncs / checkpoint_rejects / engine_dispatch_recoveries —
+    see the docs/observability.md glossary) must stay entirely absent on a
+    healthy run, even with every reliability feature switched ON."""
+    from metrics_tpu import reliability
+
+    obs.enable()
+    p, t = _cls_batch()
+    with reliability.guard_scope("quarantine"):
+        with reliability.sync_policy_scope(max_retries=2, degraded_ok=True):
+            col = _collection(compiled=True)
+            for _ in range(3):
+                col(p, t)
+            col.compute()
+            m = Accuracy()
+            m.update(p, t)
+            env = reliability.save_envelope(m)
+            m2 = Accuracy()
+            reliability.load_envelope(m2, env, strict=True)
+    rel = {k: v for k, v in obs.get().counters.items() if k.startswith("reliability.")}
+    assert rel == {}, rel
+
+
 def test_warn_once_rate_limits_per_key():
     from metrics_tpu.utilities.prints import warn_once
 

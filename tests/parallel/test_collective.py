@@ -106,6 +106,51 @@ def test_sync_array_invalid_reduction():
         sync_array(jnp.ones(()), "bogus", "data")
 
 
+def test_masked_cat_sync_clamps_overrun_counts():
+    """Direct coverage for the overflow-clamp branch (collective.py:104-109):
+    a per-device count that ran PAST capacity must validate exactly
+    ``capacity`` slots, never slots that were never written. (Writers drop
+    out-of-bounds updates, so any count > capacity means dropped samples —
+    the mask must not resurrect them as garbage reads.)"""
+    try:
+        shard_map = jax.shard_map
+        smap_kw = {"check_vma": False}
+    except AttributeError:  # pre-0.4.35 spelling (and its check_rep arg)
+        from jax.experimental.shard_map import shard_map
+
+        smap_kw = {"check_rep": False}
+
+    mesh = _mesh()
+    capacity = 4
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P("data"), P("data")),
+        out_specs=(P(), P(), P()),
+        **smap_kw,
+    )
+    def gather(buf, count):
+        return masked_cat_sync(buf, count[0], "data")
+
+    buf = jnp.arange(8 * capacity, dtype=jnp.float32).reshape(8 * capacity)
+    # devices 0..7 claim fill levels 0..7; capacity is 4, so devices 5..7
+    # have overrun counts that MUST clamp to 4 valid slots
+    counts = jnp.arange(8, dtype=jnp.int32)
+    gathered, out_counts, mask = jax.jit(gather)(buf, counts)
+
+    assert gathered.shape == (8 * capacity,)
+    np.testing.assert_array_equal(np.asarray(out_counts), np.arange(8))
+    mask = np.asarray(mask)
+    for dev in range(8):
+        seg = mask[dev * capacity : (dev + 1) * capacity]
+        valid = min(dev, capacity)  # the clamp under test
+        assert seg[:valid].all(), f"device {dev}: valid slots masked out"
+        assert not seg[valid:].any(), f"device {dev}: unwritten slots validated"
+    # total valid entries = sum of clamped counts
+    assert mask.sum() == sum(min(c, capacity) for c in range(8))
+
+
 def test_distributed_auroc_equals_single_device():
     """Sharded cat-state AUROC (per-device buffers + all_gather + exact kernel)
     equals the single-device value — the SURVEY §5.7 sharded-buffer design."""

@@ -1,0 +1,25 @@
+"""Shared hygiene for the reliability/chaos suite: every test starts and
+ends with NO guard, NO sync policy, an auto-detected sync backend, and a
+disabled, empty telemetry registry — the module-global switches must never
+leak between tests (or into the rest of the suite)."""
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu.parallel.backend import set_sync_backend
+from metrics_tpu.reliability import guard as _guard
+from metrics_tpu.reliability import sync as _sync
+
+
+@pytest.fixture(autouse=True)
+def _pristine_reliability():
+    _guard.uninstall_guard()
+    _sync.set_sync_policy(None)
+    set_sync_backend(None)
+    obs.disable()
+    obs.get().reset()
+    yield
+    _guard.uninstall_guard()
+    _sync.set_sync_policy(None)
+    set_sync_backend(None)
+    obs.disable()
+    obs.get().reset()

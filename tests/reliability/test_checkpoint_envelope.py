@@ -1,0 +1,295 @@
+"""Validated checkpoint envelope: schema/checksum/strict-spec rejection,
+metric- and collection-level round-trips, file serialization.
+
+Chaos contract (ISSUE 3): corrupted/mismatched checkpoints are rejected
+with a clear typed error in strict mode, and every rejection counts
+``reliability.checkpoint_rejects``.
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import (
+    Accuracy,
+    AUROC,
+    BinnedAUROC,
+    MeanSquaredError,
+    MetricCollection,
+    reliability,
+)
+from metrics_tpu.reliability import (
+    CheckpointCorruptionError,
+    CheckpointMismatchError,
+    CheckpointSchemaError,
+    faultinject as fi,
+    load_envelope,
+    read_envelope,
+    save_envelope,
+    write_envelope,
+)
+from metrics_tpu.reliability.checkpoint import ENVELOPE_FORMAT, SCHEMA_VERSION
+
+pytestmark = pytest.mark.chaos
+
+
+def _acc(seed=0):
+    rng = np.random.RandomState(seed)
+    probs = rng.rand(48, 4).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    m = Accuracy()
+    m.update(jnp.asarray(probs), jnp.asarray(rng.randint(4, size=48)))
+    return m
+
+
+def test_envelope_structure_and_roundtrip():
+    m = _acc()
+    env = save_envelope(m)
+    assert env["format"] == ENVELOPE_FORMAT
+    assert env["schema_version"] == SCHEMA_VERSION
+    assert env["metric_type"] == "Accuracy"
+    assert env["complete"] is True
+    assert set(env["spec"]) == set(env["payload"]) == {"correct", "total"}
+    assert env["checksum"].startswith("crc32:")
+
+    m2 = Accuracy()
+    load_envelope(m2, env, strict=True)
+    assert float(m2.compute()) == float(m.compute())
+
+
+def test_persistent_only_envelope_wraps_state_dict():
+    m = _acc()
+    m.persistent(True)
+    env = save_envelope(m, persistent_only=True)
+    assert set(env["payload"]) == set(m.state_dict())
+    m.persistent(False)
+    env_empty = save_envelope(m, persistent_only=True)
+    assert env_empty["payload"] == {} and env_empty["complete"] is False
+
+
+@pytest.mark.parametrize(
+    "mode,exc",
+    [
+        ("payload", CheckpointCorruptionError),
+        ("checksum", CheckpointCorruptionError),
+        ("schema", CheckpointSchemaError),
+        ("truncate", CheckpointMismatchError),
+    ],
+)
+def test_corrupted_envelopes_rejected_with_typed_errors(mode, exc):
+    env = save_envelope(_acc())
+    bad = fi.corrupt_envelope(env, mode)
+    with obs.telemetry_scope():
+        with pytest.raises(exc):
+            load_envelope(Accuracy(), bad, strict=True)
+        assert obs.get().counters["reliability.checkpoint_rejects"] == 1
+        assert any(e["kind"] == "checkpoint_reject" for e in obs.get().events)
+    # the pristine original still loads
+    load_envelope(Accuracy(), env, strict=True)
+
+
+def test_rejection_leaves_state_untouched():
+    donor = save_envelope(_acc(seed=1))
+    m = _acc(seed=2)
+    before = float(m.compute())
+    with pytest.raises(CheckpointCorruptionError):
+        load_envelope(m, fi.corrupt_envelope(donor, "payload"), strict=True)
+    assert float(m.compute()) == before
+
+
+def test_not_an_envelope_and_future_schema_rejected():
+    with pytest.raises(CheckpointSchemaError, match="not a metrics_tpu"):
+        load_envelope(Accuracy(), {"some": "dict"}, strict=True)
+    env = save_envelope(_acc())
+    env2 = dict(env, schema_version=SCHEMA_VERSION + 1)
+    with pytest.raises(CheckpointSchemaError, match="schema_version"):
+        load_envelope(Accuracy(), env2, strict=True)
+
+
+def test_strict_rejects_differently_configured_metric():
+    env = save_envelope(_acc())
+    with pytest.raises(CheckpointMismatchError, match="missing|unexpected"):
+        load_envelope(MeanSquaredError(), env, strict=True)
+
+
+def test_strict_rejects_shape_drift():
+    """Same metric class, different config -> different state shapes."""
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.rand(64).astype(np.float32))
+    t = jnp.asarray(rng.randint(2, size=64))
+    m = BinnedAUROC(num_bins=32)
+    m.update(p, t)
+    env = save_envelope(m)
+    other = BinnedAUROC(num_bins=16)
+    with pytest.raises(CheckpointMismatchError, match="shape"):
+        load_envelope(other, env, strict=True)
+    # non-strict: skips the mismatched states, warns once
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        load_envelope(other, env, strict=False)
+    assert any("skipped" in str(w.message) for w in caught)
+
+
+def test_nonstrict_loads_valid_intersection():
+    m = _acc(seed=3)
+    env = fi.corrupt_envelope(save_envelope(m), "truncate")  # one state dropped
+    m2 = Accuracy()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        load_envelope(m2, env, strict=False)
+    kept = sorted(env["payload"])
+    assert kept  # something survived the truncation
+    for key in kept:
+        np.testing.assert_array_equal(np.asarray(getattr(m2, key)), np.asarray(getattr(m, key)))
+
+
+def test_collection_envelope_roundtrip_with_list_states(tmp_path):
+    rng = np.random.RandomState(4)
+    p = jnp.asarray(rng.rand(64).astype(np.float32))
+    t = jnp.asarray(rng.randint(2, size=64))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        col = MetricCollection([Accuracy(), AUROC()])
+        col.update(p, t)
+        env = save_envelope(col)
+        assert env["metric_type"] == "MetricCollection"
+        assert any(k.startswith("AUROC.") for k in env["payload"])
+
+        path = tmp_path / "collection.npz"
+        write_envelope(path, env)
+        col2 = MetricCollection([Accuracy(), AUROC()])
+        load_envelope(col2, read_envelope(path), strict=True)
+    a, b = col.compute(), col2.compute()
+    for k in a:
+        assert float(a[k]) == float(b[k])
+
+
+def test_file_roundtrip_preserves_bf16_and_scalars(tmp_path):
+    rng = np.random.RandomState(5)
+    m = BinnedAUROC(num_bins=16)
+    m.update(jnp.asarray(rng.rand(64).astype(np.float32)), jnp.asarray(rng.randint(2, size=64)))
+    m.astype(jnp.bfloat16)
+    path = tmp_path / "bf16.npz"
+    write_envelope(path, save_envelope(m))
+    env = read_envelope(path)
+    m2 = BinnedAUROC(num_bins=16).astype(jnp.bfloat16)
+    load_envelope(m2, env, strict=True)
+    assert m2.hist_pos.dtype == jnp.bfloat16
+    assert float(m2.compute()) == float(m.compute())
+
+    # scalar (0-d) states keep their exact shape through the file
+    acc = _acc()
+    p2 = tmp_path / "acc.npz"
+    write_envelope(p2, save_envelope(acc))
+    restored = read_envelope(p2)
+    assert restored["spec"]["correct"]["shape"] == []
+
+
+def test_envelope_is_isolated_from_later_updates(tmp_path):
+    """Regression: the payload must not alias live list states — an
+    update() after save_envelope() appended into the envelope in place,
+    breaking its own checksum (and the file writer's spec)."""
+    rng = np.random.RandomState(11)
+    p = jnp.asarray(rng.rand(32).astype(np.float32))
+    t = jnp.asarray(rng.randint(2, size=32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = AUROC()
+        m.update(p, t)
+        env = rel_save = save_envelope(m)
+        want = float(m.compute())
+        m.update(jnp.flip(p), t)  # mutates the live lists AFTER the save
+        m2 = AUROC()
+        load_envelope(m2, env, strict=True)  # no checksum error
+        assert len(m2.preds) == 1
+        assert float(m2.compute()) == want
+        path = tmp_path / "iso.npz"
+        write_envelope(path, rel_save)  # spec len still matches payload
+        m3 = AUROC()
+        load_envelope(m3, read_envelope(path), strict=True)
+        assert float(m3.compute()) == want
+
+
+def test_empty_list_state_envelope_file_roundtrip(tmp_path):
+    """Regression: an empty list state writes zero npz entries; the reader
+    must rebuild it from the spec (len == 0) instead of reporting a
+    checksum mismatch on a perfectly healthy just-reset checkpoint."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = AUROC()  # fresh: preds/target are empty lists
+        env = save_envelope(m)
+        path = tmp_path / "fresh.npz"
+        write_envelope(path, env)
+        restored = read_envelope(path)
+        assert restored["payload"]["preds"] == []
+        m2 = AUROC()
+        load_envelope(m2, restored, strict=True)  # no corruption error
+        assert m2.preds == [] and m2.target == []
+
+
+def test_collection_strict_load_tolerates_sibling_prefixes():
+    """Regression: strict collection loads must ignore OTHER objects'
+    entries in a shared flat dict — that is what the prefix is for."""
+    rng = np.random.RandomState(9)
+    probs = rng.rand(16, 4).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    p, t = jnp.asarray(probs), jnp.asarray(rng.randint(4, size=16))
+
+    col_a = MetricCollection([Accuracy()])
+    col_b = MetricCollection([Accuracy()])
+    col_a.update(p, t)
+    col_b.update(p, t)
+    col_a.persistent(True)
+    col_b.persistent(True)
+    shared = {}
+    col_a.state_dict(shared, prefix="a.")
+    col_b.state_dict(shared, prefix="b.")
+
+    fresh = MetricCollection([Accuracy()])
+    fresh.load_state_dict(shared, prefix="a.", strict=True)  # b.* tolerated
+    assert float(fresh.compute()["Accuracy"]) == float(col_a.compute()["Accuracy"])
+    # but junk under OUR prefix still rejects
+    with pytest.raises(KeyError, match="no member"):
+        fresh.load_state_dict({**shared, "a.Ghost.x": jnp.asarray(0)}, prefix="a.", strict=True)
+
+
+def test_file_corruption_detected(tmp_path):
+    path = tmp_path / "ckpt.npz"
+    write_envelope(path, save_envelope(_acc()))
+    blob = bytearray(path.read_bytes())
+    blob[-20] ^= 0xFF  # flip one payload byte on disk
+    path.write_bytes(bytes(blob))
+    with pytest.raises(
+        (CheckpointCorruptionError, CheckpointSchemaError, Exception)
+    ):
+        load_envelope(Accuracy(), read_envelope(path), strict=True)
+
+
+def test_compositional_metric_envelope_roundtrip():
+    m1, m2 = _acc(seed=6), _acc(seed=7)
+    comp = m1 + m2
+    env = save_envelope(comp)
+    assert any(k.startswith("metric_a.") for k in env["payload"])
+    comp2 = Accuracy() + Accuracy()
+    load_envelope(comp2, env, strict=True)
+    assert float(comp.compute()) == float(comp2.compute())
+
+
+def test_load_state_dict_strict_and_zero_match_warn():
+    """Satellite: the raw (non-envelope) loader's silent-partial-load fix."""
+    m = _acc(seed=8)
+    m.persistent(True)
+    sd = m.state_dict()
+    fresh = Accuracy()
+    with pytest.raises(KeyError, match="missing"):
+        fresh.load_state_dict(sd, prefix="typo.", strict=True)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        fresh.load_state_dict(sd, prefix="typo.")  # zero keys match
+    assert any("matched" in str(w.message) for w in caught)
+    # collection-level strict: unexpected keys rejected
+    col = MetricCollection([Accuracy()])
+    with pytest.raises(KeyError, match="no member"):
+        col.load_state_dict({"NotAMember.correct": jnp.asarray(0)}, strict=True)

@@ -1,0 +1,196 @@
+"""Guarded host-level sync: bounded retry with backoff, per-attempt
+timeout, degraded local-only fallback.
+
+Chaos contract (ISSUE 3): a sync backend that fails twice then succeeds
+yields the correct synced result; a dead/hung backend under
+``degraded_ok`` degrades to local state with one warning instead of
+crashing; every path emits its telemetry counters.
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import Accuracy, MeanSquaredError, reliability
+from metrics_tpu.reliability import SyncFailedError, SyncPolicy, faultinject as fi
+from metrics_tpu.reliability.sync import active_policy, apply_sync_policy, set_sync_policy
+from metrics_tpu.utilities.distributed import gather_all_tensors
+
+pytestmark = pytest.mark.chaos
+
+
+def _filled_accuracy(seed=0):
+    rng = np.random.RandomState(seed)
+    probs = rng.rand(48, 4).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    m = Accuracy()
+    m.update(jnp.asarray(probs), jnp.asarray(rng.randint(4, size=48)))
+    return m
+
+
+def test_policy_install_scope_and_validation():
+    assert active_policy() is None
+    with pytest.raises(ValueError, match="max_retries"):
+        SyncPolicy(max_retries=-1)
+    with reliability.sync_policy_scope(max_retries=5) as p:
+        assert active_policy() is p and p.max_retries == 5
+    assert active_policy() is None
+    # no policy installed -> the gather fn passes through IDENTICALLY
+    fn = lambda x, group=None: [x]  # noqa: E731
+    assert apply_sync_policy(fn) is fn
+
+
+def test_fails_twice_then_succeeds_yields_correct_synced_result():
+    m = _filled_accuracy()
+    want = float(m.compute())
+    m2 = _filled_accuracy()
+    m2.dist_sync_fn = gather_all_tensors  # force the host sync path
+    with obs.telemetry_scope(), fi.flaky_sync_backend(fails=2):
+        with reliability.sync_policy_scope(max_retries=2, backoff_s=0.001) as pol:
+            got = float(m2.compute())
+    assert got == want
+    assert pol.stats["retries"] == 2 and pol.stats["degraded"] == 0
+    assert obs.get().counters["reliability.sync_retries"] == 2
+    assert "reliability.degraded_syncs" not in obs.get().counters
+    # sync went through: state was gathered and reduced exactly once
+    assert int(m2.total) == 48  # accumulation itself unsynced (cache/restore)
+
+
+def test_exhausted_retries_raise_without_degraded_ok():
+    m = _filled_accuracy()
+    m.dist_sync_fn = gather_all_tensors
+    with fi.flaky_sync_backend(fails=99):
+        with reliability.sync_policy_scope(max_retries=1, backoff_s=0.001) as pol:
+            with pytest.raises(SyncFailedError, match="injected sync failure"):
+                m.compute()
+    assert pol.stats["retries"] >= 1
+
+
+def test_dead_backend_degrades_to_local_state_with_one_warning():
+    m = _filled_accuracy()
+    want = float(m.compute())  # single-process: local == global
+    m2 = _filled_accuracy()
+    m2.dist_sync_fn = gather_all_tensors
+    with obs.telemetry_scope(), fi.flaky_sync_backend(fails=10**6):
+        with reliability.sync_policy_scope(
+            max_retries=1, backoff_s=0.001, degraded_ok=True
+        ) as pol:
+            with warnings.catch_warnings(record=True) as caught:
+                warnings.simplefilter("always")
+                got = float(m2.compute())
+    assert got == want  # local-only fallback still produces the local truth
+    assert pol.stats["degraded"] >= 1
+    assert obs.get().counters["reliability.degraded_syncs"] >= 1
+    assert any(e["kind"] == "degraded_sync" for e in obs.get().events)
+    fired = [w for w in caught if "LOCAL-ONLY" in str(w.message)]
+    assert len(fired) <= 1  # warn_once across the per-state gathers
+
+
+def test_hung_backend_times_out_then_degrades():
+    m = _filled_accuracy()
+    want = float(m.compute())
+    m2 = _filled_accuracy()
+    m2.dist_sync_fn = gather_all_tensors
+    with fi.flaky_sync_backend(fails=0, delay_s=5.0, slow_calls=10**6):
+        with reliability.sync_policy_scope(
+            max_retries=0, timeout_s=0.05, degraded_ok=True
+        ) as pol:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                got = float(m2.compute())
+    assert got == want
+    assert pol.stats["timeouts"] >= 1 and pol.stats["degraded"] >= 1
+
+
+def test_backoff_sleeps_between_retries_and_wrapper_always_raises():
+    """The per-gather wrapper retries with doubling backoff and raises on
+    exhaustion EVEN under degraded_ok — degradation is applied atomically
+    by _sync_dist across the whole state dict, never per leaf (a per-leaf
+    fallback could mix world-aggregated and local-only states)."""
+    import time
+
+    calls = []
+
+    def failing(x, group=None):
+        calls.append(time.perf_counter())
+        raise RuntimeError("down")
+
+    with reliability.sync_policy_scope(max_retries=2, backoff_s=0.05, degraded_ok=True):
+        with pytest.raises(SyncFailedError):
+            apply_sync_policy(failing)(jnp.asarray(1.0))
+    assert len(calls) == 3
+    assert calls[1] - calls[0] >= 0.04  # first backoff
+    assert calls[2] - calls[1] >= 0.08  # doubled
+
+
+def test_timeout_is_terminal_not_retried():
+    """A timed-out gather must NOT be retried: the abandoned worker may
+    still be consuming the peers' collective round, and a concurrent retry
+    would pair gathers with the wrong rounds."""
+    import time
+
+    calls = []
+
+    def slow(x, group=None):
+        calls.append(time.perf_counter())
+        time.sleep(0.5)
+        return [x]
+
+    from metrics_tpu.reliability import SyncTimeoutError
+
+    with reliability.sync_policy_scope(max_retries=5, backoff_s=0.001, timeout_s=0.05) as pol:
+        # the subtype stays catchable (SyncTimeoutError IS-A SyncFailedError)
+        with pytest.raises(SyncTimeoutError):
+            apply_sync_policy(slow)(jnp.asarray(1.0))
+    assert len(calls) == 1  # no retry after the timeout
+    assert pol.stats["timeouts"] == 1 and pol.stats["retries"] == 0
+
+
+def test_degradation_is_atomic_across_states():
+    """A backend that recovers mid-sync must not produce a metric with
+    some states world-gathered and others local: once one state's gather
+    fails terminally, the WHOLE sync is local-only."""
+    m = _filled_accuracy()
+    want = float(m.compute())
+    m2 = _filled_accuracy()
+    m2.dist_sync_fn = gather_all_tensors
+    # fails exactly max_retries+1 times: the FIRST state's gather exhausts
+    # its attempts, then the backend would succeed — the second state must
+    # NOT gather globally anyway
+    with fi.flaky_sync_backend(fails=2):
+        with reliability.sync_policy_scope(max_retries=1, backoff_s=0.001, degraded_ok=True) as pol:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                got = float(m2.compute())
+    assert got == want  # all-local on 1 process == the local truth
+    assert pol.stats["degraded"] == 1  # one degraded SYNC, not per leaf
+
+
+def test_set_sync_policy_returns_previous():
+    a, b = SyncPolicy(), SyncPolicy(max_retries=7)
+    assert set_sync_policy(a) is None
+    assert set_sync_policy(b) is a
+    assert set_sync_policy(None) is b
+
+
+def test_flaky_backend_restores_previous_backend():
+    from metrics_tpu.parallel.backend import get_sync_backend
+
+    before = get_sync_backend()
+    with fi.flaky_sync_backend(fails=1) as flaky:
+        assert get_sync_backend() is flaky
+    assert type(get_sync_backend()) is type(before)
+
+
+def test_compiled_engine_runs_eager_under_distributed_backend():
+    """Engine + installed backend: the whole collection must take the eager
+    path (sync semantics), where the guarded gather still applies."""
+    from metrics_tpu import MetricCollection
+
+    p = jnp.asarray(np.random.RandomState(0).rand(64).astype(np.float32))
+    col = MetricCollection([MeanSquaredError()], compiled=True)
+    with fi.flaky_sync_backend(fails=0):  # a live (delegating) backend
+        col(p, p)  # distributed-initialized -> eager route
+    assert int(col["MeanSquaredError"].total) == 64

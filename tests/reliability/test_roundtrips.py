@@ -1,0 +1,211 @@
+"""Satellite 3: persistent()/state_dict/load_state_dict round-trips across
+every metric family — array states, list ("cat") states, scalar states,
+bfloat16-cast states, compositions, and collections. This is the
+regression bed the checkpoint-envelope work builds on: every entry also
+round-trips through a validated envelope (in-memory AND through a file).
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import (
+    AUC,
+    AUROC,
+    Accuracy,
+    AveragePrecision,
+    BinnedAUROC,
+    BinnedAveragePrecision,
+    CohenKappa,
+    ConfusionMatrix,
+    ExplainedVariance,
+    F1,
+    FBeta,
+    HammingDistance,
+    Hinge,
+    IoU,
+    MatthewsCorrcoef,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MetricCollection,
+    PSNR,
+    Precision,
+    PrecisionRecallCurve,
+    R2Score,
+    ROC,
+    Recall,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalPrecision,
+    RetrievalRecall,
+    StatScores,
+    reliability,
+)
+
+pytestmark = pytest.mark.chaos
+
+_RNG = np.random.RandomState(1234)
+_N = 48
+_C = 4
+
+_PROBS = _RNG.rand(_N, _C).astype(np.float32)
+_PROBS /= _PROBS.sum(1, keepdims=True)
+_MC = (jnp.asarray(_PROBS), jnp.asarray(_RNG.randint(_C, size=_N)))
+_BIN = (jnp.asarray(_PROBS[:, 1]), jnp.asarray(_RNG.randint(2, size=_N)))
+_REG = (
+    jnp.asarray(_RNG.rand(_N).astype(np.float32)),
+    jnp.asarray(_RNG.rand(_N).astype(np.float32)),
+)
+_RET = (
+    jnp.asarray(_RNG.randint(6, size=_N)),
+    jnp.asarray(_RNG.rand(_N).astype(np.float32)),
+    jnp.asarray(_RNG.randint(2, size=_N)),
+)
+
+# (metric factory, update args) — one representative config per class
+CASES = [
+    ("Accuracy", lambda: Accuracy(), _MC),
+    ("Precision", lambda: Precision(num_classes=_C, average="macro"), _MC),
+    ("Recall", lambda: Recall(num_classes=_C, average="macro"), _MC),
+    ("F1", lambda: F1(num_classes=_C, average="macro"), _MC),
+    ("FBeta", lambda: FBeta(num_classes=_C, beta=0.5, average="macro"), _MC),
+    ("StatScores", lambda: StatScores(reduce="micro"), _MC),
+    ("ConfusionMatrix", lambda: ConfusionMatrix(num_classes=_C), _MC),
+    ("IoU", lambda: IoU(num_classes=_C), _MC),
+    ("MatthewsCorrcoef", lambda: MatthewsCorrcoef(num_classes=_C), _MC),
+    ("CohenKappa", lambda: CohenKappa(num_classes=_C), _MC),
+    ("HammingDistance", lambda: HammingDistance(), _BIN),
+    ("Hinge", lambda: Hinge(), (jnp.asarray(_RNG.randn(_N).astype(np.float32)), _BIN[1])),
+    ("AUROC", lambda: AUROC(), _BIN),  # list states
+    ("AveragePrecision", lambda: AveragePrecision(), _BIN),  # list states
+    ("PrecisionRecallCurve", lambda: PrecisionRecallCurve(), _BIN),  # list states
+    ("ROC", lambda: ROC(), _BIN),  # list states
+    # reorder: two appended identical sweeps are non-monotonic when concatenated
+    ("AUC", lambda: AUC(reorder=True), (jnp.linspace(0, 1, 16), jnp.linspace(0, 1, 16))),
+    ("BinnedAUROC", lambda: BinnedAUROC(num_bins=16), _BIN),
+    ("BinnedAveragePrecision", lambda: BinnedAveragePrecision(num_bins=16), _BIN),
+    ("MeanSquaredError", lambda: MeanSquaredError(), _REG),
+    ("MeanAbsoluteError", lambda: MeanAbsoluteError(), _REG),
+    ("MeanSquaredLogError", lambda: MeanSquaredLogError(), _REG),
+    ("R2Score", lambda: R2Score(), _REG),
+    ("ExplainedVariance", lambda: ExplainedVariance(), _REG),
+    ("PSNR", lambda: PSNR(data_range=1.0), _REG),
+    ("RetrievalMAP", lambda: RetrievalMAP(), _RET),  # list states, 3-arg update
+    ("RetrievalMRR", lambda: RetrievalMRR(), _RET),
+    ("RetrievalPrecision", lambda: RetrievalPrecision(k=2), _RET),
+    ("RetrievalRecall", lambda: RetrievalRecall(k=2), _RET),
+]
+
+
+def _values_equal(a, b, name):
+    flat_a = a if isinstance(a, (tuple, list)) else [a]
+    flat_b = b if isinstance(b, (tuple, list)) else [b]
+    assert len(flat_a) == len(flat_b), name
+    for x, y in zip(flat_a, flat_b):
+        if isinstance(x, (tuple, list)):
+            _values_equal(x, y, name)
+        else:
+            np.testing.assert_array_equal(np.asarray(x), np.asarray(y), err_msg=name)
+
+
+@pytest.mark.parametrize("name,factory,args", [(n, f, a) for n, f, a in CASES], ids=[c[0] for c in CASES])
+def test_state_dict_roundtrip_every_family(name, factory, args):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = factory()
+        m.update(*args)
+        m.update(*args)  # two batches: list states get len-2 lists
+        m.persistent(True)
+        saved = m.state_dict()
+        assert saved, f"{name}: persistent(True) produced an empty state_dict"
+
+        m2 = factory()
+        m2.persistent(True)
+        m2.load_state_dict(saved, strict=True)
+        _values_equal(m.compute(), m2.compute(), name)
+
+
+@pytest.mark.parametrize("name,factory,args", [(n, f, a) for n, f, a in CASES], ids=[c[0] for c in CASES])
+def test_envelope_roundtrip_every_family(name, factory, args, tmp_path):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = factory()
+        m.update(*args)
+        m.update(*args)
+        env = reliability.save_envelope(m)
+        assert env["complete"], name
+
+        m2 = factory()
+        reliability.load_envelope(m2, env, strict=True)
+        _values_equal(m.compute(), m2.compute(), name)
+
+        path = tmp_path / f"{name}.npz"
+        reliability.write_envelope(path, env)
+        m3 = factory()
+        reliability.load_envelope(m3, reliability.read_envelope(path), strict=True)
+        _values_equal(m.compute(), m3.compute(), name)
+
+
+def test_persistent_toggle_controls_state_dict():
+    m = Accuracy()
+    m.update(*_MC)
+    assert m.state_dict() == {}  # default: nothing persistent
+    m.persistent(True)
+    assert set(m.state_dict()) == {"correct", "total"}
+    m.persistent(False)
+    assert m.state_dict() == {}
+
+
+def test_bf16_cast_roundtrip_through_plain_and_envelope(tmp_path):
+    m = BinnedAUROC(num_bins=16)
+    m.update(*_BIN)
+    m.astype(jnp.bfloat16)
+    m.persistent(True)
+    want = float(m.compute())
+
+    m2 = BinnedAUROC(num_bins=16).astype(jnp.bfloat16)
+    m2.load_state_dict(m.state_dict(), strict=True)
+    assert float(m2.compute()) == want
+
+    path = tmp_path / "bf16.npz"
+    reliability.write_envelope(path, reliability.save_envelope(m))
+    m3 = BinnedAUROC(num_bins=16).astype(jnp.bfloat16)
+    reliability.load_envelope(m3, reliability.read_envelope(path), strict=True)
+    assert m3.hist_pos.dtype == jnp.bfloat16
+    assert float(m3.compute()) == want
+
+
+def test_collection_roundtrip_mixed_state_kinds(tmp_path):
+    """A collection mixing scalar counters, matrices, and list states."""
+    def build():
+        return MetricCollection(
+            {
+                "acc": Accuracy(),
+                "cm": ConfusionMatrix(num_classes=2),
+                "auroc": AUROC(),
+            }
+        )
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        col = build()
+        col.update(*_BIN)
+        col.persistent(True)
+        saved = col.state_dict()
+        assert any(k.startswith("auroc.") for k in saved)
+
+        col2 = build()
+        col2.load_state_dict(saved, strict=True)
+        a, b = col.compute(), col2.compute()
+        for k in a:
+            _values_equal(a[k], b[k], k)
+
+        path = tmp_path / "col.npz"
+        reliability.write_envelope(path, reliability.save_envelope(col))
+        col3 = build()
+        reliability.load_envelope(col3, reliability.read_envelope(path), strict=True)
+        c = col3.compute()
+        for k in a:
+            _values_equal(a[k], c[k], k)

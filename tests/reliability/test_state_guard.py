@@ -1,0 +1,253 @@
+"""StateGuard: non-finite detection + policy handling, eager and compiled.
+
+Chaos contract (ISSUE 3): NaN injection under ``quarantine`` recovers the
+last-good state and the final metric matches the value computed WITHOUT
+the poisoned batch; ``raise`` fails fast with usable state; ``warn`` is
+visibility-only. Each path emits its telemetry.
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import (
+    Accuracy,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    MetricCollection,
+    reliability,
+)
+from metrics_tpu.reliability import NonFiniteStateError, faultinject as fi
+from metrics_tpu.reliability.guard import StateGuard, active, install_guard, uninstall_guard
+
+pytestmark = pytest.mark.chaos
+
+
+def _batches(n=4, size=64, seed=0):
+    rng = np.random.RandomState(seed)
+    return [
+        (
+            jnp.asarray(rng.rand(size).astype(np.float32)),
+            jnp.asarray(rng.rand(size).astype(np.float32)),
+        )
+        for _ in range(n)
+    ]
+
+
+def test_policy_validation_and_install_cycle():
+    with pytest.raises(ValueError, match="policy"):
+        StateGuard("explode")
+    assert active() is None
+    g = install_guard("warn")
+    assert active() is g and g.policy == "warn"
+    uninstall_guard()
+    assert active() is None
+    with reliability.guard_scope("quarantine") as g2:
+        assert active() is g2
+    assert active() is None
+
+
+@pytest.mark.parametrize("mode", ["nan", "inf"])
+@pytest.mark.parametrize("compiled", [False, True])
+def test_quarantine_recovers_last_good_state(mode, compiled):
+    """THE headline chaos scenario: final value with a quarantined poisoned
+    batch == value computed without that batch ever happening."""
+    batches = _batches()
+    clean = MetricCollection([MeanSquaredError(), MeanAbsoluteError()], compiled=compiled)
+    for p, t in batches:
+        clean(p, t)
+    want = {k: float(v) for k, v in clean.compute().items()}
+
+    chaotic = MetricCollection([MeanSquaredError(), MeanAbsoluteError()], compiled=compiled)
+    with obs.telemetry_scope(), reliability.guard_scope("quarantine") as guard:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for i, (p, t) in enumerate(batches):
+                chaotic(p, t)
+                if i == 1:  # a poisoned batch mid-stream
+                    chaotic(fi.poison(p, mode), t)
+        got = {k: float(v) for k, v in chaotic.compute().items()}
+    assert got == want
+    assert guard.stats["quarantined"] == 2  # both members rolled back
+    assert obs.get().counters["reliability.quarantined"] == 2
+    assert any(e["kind"] == "nonfinite_state" for e in obs.get().events)
+
+
+@pytest.mark.parametrize("compiled", [False, True])
+def test_raise_policy_fails_fast_with_usable_state(compiled):
+    batches = _batches(2)
+    col = MetricCollection([MeanSquaredError()], compiled=compiled)
+    col(*batches[0])
+    before = float(col.compute()["MeanSquaredError"])
+    with reliability.guard_scope("raise"):
+        with pytest.raises(NonFiniteStateError):
+            col(fi.poison(batches[1][0], "nan"), batches[1][1])
+    # the poisoned batch was rolled back: state is still the first batch's
+    assert float(col.compute()["MeanSquaredError"]) == before
+    col(*batches[1])  # and accumulation continues normally
+    assert int(col["MeanSquaredError"].total) == 128
+
+
+@pytest.mark.parametrize("compiled", [False, True])
+def test_warn_policy_keeps_poisoned_state_but_warns_once(compiled):
+    batches = _batches(2)
+    col = MetricCollection([MeanSquaredError()], compiled=compiled)
+    col(*batches[0])
+    with reliability.guard_scope("warn") as guard:
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            col(fi.poison(batches[1][0], "nan"), batches[1][1])
+            col(fi.poison(batches[1][0], "nan"), batches[1][1])
+    assert bool(jnp.isnan(col.compute()["MeanSquaredError"]))
+    # >= : the eager fused path re-flags the kept-poisoned state at its
+    # post-merge check too (warn never rolls back, so the NaN stays visible)
+    assert guard.stats["violations"] >= 2
+    assert guard.stats["quarantined"] == 0
+    fired = [w for w in caught if "StateGuard" in str(w.message)]
+    assert len(fired) <= 1  # warn_once per metric class
+
+
+def test_direct_update_path_is_guarded():
+    """update() without forward() (the MetricCollection.update loop) hits
+    the same guard hook."""
+    m = MeanSquaredError()
+    p = jnp.asarray(np.random.RandomState(0).rand(32).astype(np.float32))
+    m.update(p, p)
+    with reliability.guard_scope("quarantine") as guard:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.update(fi.poison(p, "inf"), p)
+    assert guard.stats["quarantined"] == 1
+    assert int(m.total) == 32  # poisoned update rolled back
+
+
+def test_nonfinite_updates_injector_restores_update():
+    m = MeanSquaredError()
+    orig_update = m.update
+    p = jnp.asarray(np.random.RandomState(0).rand(16).astype(np.float32))
+    with fi.nonfinite_updates(m, times=1) as injected:
+        m.update(p, p)
+    assert injected["count"] == 1
+    assert m.update is orig_update
+    assert bool(jnp.isnan(m.sum_squared_error))  # unguarded: poison landed
+
+
+def test_integer_state_metrics_pass_the_guard():
+    """Metrics with no float states (pure counters) are never flagged."""
+    rng = np.random.RandomState(0)
+    probs = rng.rand(32, 4).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    p, t = jnp.asarray(probs), jnp.asarray(rng.randint(4, size=32))
+    with reliability.guard_scope("raise") as guard:
+        m = Accuracy()
+        m(p, t)
+    assert guard.stats["violations"] == 0
+
+
+def test_engine_guard_toggle_does_not_corrupt_cache():
+    """Guard on -> off -> on compiles distinct signatures and never serves
+    a guarded program to an unguarded step (or vice versa)."""
+    p = jnp.asarray(np.random.RandomState(0).rand(64).astype(np.float32))
+    col = MetricCollection([MeanSquaredError()], compiled=True)
+    col(p, p)  # unguarded signature
+    with reliability.guard_scope("quarantine"):
+        col(p, p)  # guarded signature (select variant)
+    col(p, p)  # unguarded again: cache hit, no new trace
+    info = col._engine.cache_info()
+    assert info["compiled_signatures"] == 2
+    assert info["trace_count"] == 2
+    assert int(col["MeanSquaredError"].total) == 3 * 64
+
+
+def test_engine_dispatch_failure_with_guard_demotes_and_preserves_state():
+    """A compiled step that dies mid-flight under a guard must neither
+    crash the eval nor lose accumulated state: the engine reruns eagerly,
+    demotes the group, and counts the recovery."""
+    p = jnp.asarray(np.random.RandomState(0).rand(32).astype(np.float32))
+    col = MetricCollection([MeanSquaredError()], compiled=True)
+    col(p, p)
+    with obs.telemetry_scope(), reliability.guard_scope("quarantine"):
+        with fi.failing_engine_compile(times=1):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                col(p, p)  # injected trace failure -> eager rerun
+    assert col.eager_fallbacks  # demoted, not raising every step
+    assert int(col["MeanSquaredError"].total) == 64  # both batches counted
+    assert obs.get().counters.get("reliability.engine_dispatch_recoveries") == 1
+    col(p, p)  # subsequent steps keep working (eager)
+    assert int(col["MeanSquaredError"].total) == 96
+
+
+def test_fused_forward_merge_overflow_is_quarantined():
+    """float32 accumulator overflow: each batch's stats are finite but the
+    MERGE overflows to Inf — the post-merge check on the fused eager path
+    must catch what the post-update check cannot."""
+    m = MeanSquaredError()  # _fused_forward metric
+    # per-batch sum_squared_error ~ 3.0e38 (finite); two merged -> Inf
+    a = jnp.asarray([np.float32(np.sqrt(3.0e38))], dtype=jnp.float32)
+    zero = jnp.zeros((1,), jnp.float32)
+    m(a, zero)
+    assert bool(jnp.isfinite(m.sum_squared_error))
+    before = float(m.sum_squared_error)
+    with reliability.guard_scope("quarantine") as guard:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m(a, zero)  # merge overflows
+    assert guard.stats["quarantined"] >= 1
+    assert float(m.sum_squared_error) == before  # rolled back to last-good
+
+
+def test_quarantine_rolls_back_list_state_metrics():
+    """Regression: ``_snapshot_state`` returns list ("cat") states by
+    reference and update appends IN PLACE — a reference snapshot aliases
+    the poisoned list and turns the rollback into a silent no-op. The
+    guard must shallow-copy list leaves."""
+    from metrics_tpu import AUROC
+
+    rng = np.random.RandomState(7)
+    p = jnp.asarray(rng.rand(32).astype(np.float32))
+    t = jnp.asarray(rng.randint(2, size=32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = AUROC()
+        m.update(p, t)
+        want = float(m.compute())
+        with reliability.guard_scope("quarantine") as guard:
+            m.update(fi.poison(p, "nan"), t)
+    assert guard.stats["quarantined"] == 1
+    assert len(m.preds) == 1  # the poisoned append was really rolled back
+    assert float(m.compute()) == want
+
+
+def test_quarantine_forward_on_cat_state_metric_survives():
+    """Regression: forward()'s classic path re-runs update on throwaway
+    post-reset state; quarantining THAT pass rolled back to empty lists
+    and crashed compute ('need at least one array to concatenate'), and
+    double-counted the batch. The guard must skip the batch-local pass:
+    one count per poisoned batch, no crash, epoch state protected."""
+    from metrics_tpu import AUROC
+
+    rng = np.random.RandomState(13)
+    p = jnp.asarray(rng.rand(32).astype(np.float32))
+    t = jnp.asarray(rng.randint(2, size=32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = AUROC()
+        m(p, t)
+        want = float(m.compute())
+        with reliability.guard_scope("quarantine") as guard:
+            m(fi.poison(p, "nan"), t)  # forward, not bare update
+    assert guard.stats["quarantined"] == 1  # once per batch, not per pass
+    assert len(m.preds) == 1
+    assert float(m.compute()) == want
+
+
+def test_poison_helper_validates():
+    with pytest.raises(ValueError, match="mode"):
+        fi.poison(jnp.zeros(3), "bad")
+    with pytest.raises(ValueError, match="floating"):
+        fi.poison(jnp.zeros(3, jnp.int32))
+    out = fi.poison(jnp.zeros(3), "inf", index=2)
+    assert bool(jnp.isinf(out[2])) and bool(jnp.isfinite(out[0]))

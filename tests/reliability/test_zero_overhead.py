@@ -1,0 +1,147 @@
+"""The zero-overhead invariant (ISSUE 3 acceptance): with reliability
+features disabled — the default — results are bit-identical to the
+pre-reliability runtime, no reliability counters appear, and the engine
+compiles the exact same (guard-free) programs. And on HEALTHY data,
+enabling the features must not perturb the math either.
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import (
+    Accuracy,
+    F1,
+    MeanSquaredError,
+    MetricCollection,
+    Precision,
+    reliability,
+)
+from metrics_tpu.reliability.guard import active
+from metrics_tpu.reliability.sync import active_policy, apply_sync_policy
+
+pytestmark = pytest.mark.chaos
+
+
+def _cls_batches(n=3, seed=0):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        probs = rng.rand(256, 4).astype(np.float32)
+        probs /= probs.sum(1, keepdims=True)
+        out.append((jnp.asarray(probs), jnp.asarray(rng.randint(4, size=256))))
+    return out
+
+
+def _collection(compiled):
+    return MetricCollection(
+        [Accuracy(), Precision(num_classes=4, average="macro"), F1(num_classes=4, average="macro")],
+        compiled=compiled,
+    )
+
+
+def test_defaults_are_off():
+    assert active() is None
+    assert active_policy() is None
+    fn = lambda x, group=None: [x]  # noqa: E731
+    assert apply_sync_policy(fn) is fn  # literally the same object
+
+
+@pytest.mark.parametrize("compiled", [False, True])
+def test_guard_scope_on_healthy_data_is_bit_identical(compiled):
+    """Install-quarantine vs never-installed on clean batches: step values,
+    epoch values, and state pytrees must match BITWISE."""
+    batches = _cls_batches()
+
+    plain = _collection(compiled)
+    v_plain = [plain(p, t) for p, t in batches]
+    e_plain = plain.compute()
+
+    with reliability.guard_scope("quarantine") as guard:
+        guarded = _collection(compiled)
+        v_guard = [guarded(p, t) for p, t in batches]
+        e_guard = guarded.compute()
+
+    for step, (va, vb) in enumerate(zip(v_plain, v_guard)):
+        for k in va:
+            np.testing.assert_array_equal(
+                np.asarray(va[k]), np.asarray(vb[k]), err_msg=f"step {step} {k}"
+            )
+    for k in e_plain:
+        np.testing.assert_array_equal(np.asarray(e_plain[k]), np.asarray(e_guard[k]), err_msg=k)
+    for key in plain.keys():
+        for sname in plain[key]._defaults:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(plain[key], sname)),
+                np.asarray(getattr(guarded[key], sname)),
+                err_msg=f"state {key}.{sname}",
+            )
+    assert guard.stats["violations"] == 0
+
+
+def test_unguarded_engine_programs_carry_no_guard_token():
+    """The compiled-program cache key for a default step is the guard-free
+    one: uninstalling reliability can never leave guarded programs serving
+    default traffic."""
+    p, t = _cls_batches(1)[0]
+    col = _collection(compiled=True)
+    col(p, t)
+    (signature,) = list(col._engine._compiled)
+    names, guard_token, _, _ = signature
+    assert guard_token is None
+    assert col._engine.trace_count == 1
+
+
+def test_healthy_run_keeps_every_reliability_counter_at_zero():
+    """Satellite 6: telemetry ON, reliability features ON, clean data —
+    all reliability.* counters stay absent/zero."""
+    batches = _cls_batches()
+    with obs.telemetry_scope():
+        with reliability.guard_scope("quarantine"):
+            with reliability.sync_policy_scope(max_retries=2, degraded_ok=True):
+                col = _collection(compiled=True)
+                for p, t in batches:
+                    col(p, t)
+                col.compute()
+                m = Accuracy()
+                m.update(*batches[0])
+                env = reliability.save_envelope(m)
+                reliability.load_envelope(Accuracy(), env, strict=True)
+        rel_counters = {
+            k: v for k, v in obs.get().counters.items() if k.startswith("reliability.")
+        }
+    assert rel_counters == {}, rel_counters
+
+
+def test_sync_policy_scope_without_failures_is_transparent():
+    m = Accuracy()
+    p, t = _cls_batches(1)[0]
+    m.update(p, t)
+    want = float(m.compute())
+    m2 = Accuracy()
+    m2.update(p, t)
+    from metrics_tpu.utilities.distributed import gather_all_tensors
+
+    m2.dist_sync_fn = gather_all_tensors
+    with reliability.sync_policy_scope(max_retries=3, timeout_s=5.0, degraded_ok=True) as pol:
+        got = float(m2.compute())
+    assert got == want
+    assert pol.stats == {"retries": 0, "degraded": 0, "timeouts": 0}
+
+
+def test_reliability_warnings_key_per_feature():
+    """Reliability warnings register per-feature warn_once keys, so one
+    feature's rate limit can never swallow another's first warning."""
+    from metrics_tpu.utilities.prints import _WARN_ONCE_SEEN
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with reliability.guard_scope("quarantine"):
+            m = MeanSquaredError()
+            x = jnp.asarray(np.random.RandomState(0).rand(8).astype(np.float32))
+            m.update(x.at[0].set(jnp.nan), x)
+    # membership in the process-wide registry (not set difference): an
+    # earlier chaos test may already have burned this key
+    assert "guard-quarantine:MeanSquaredError" in _WARN_ONCE_SEEN
