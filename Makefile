@@ -52,7 +52,9 @@ fuzz-sharded:
 chaos:
 	# fault-injection recovery drills (metrics_tpu/reliability/): NaN
 	# quarantine, flaky/hung sync, corrupted checkpoints, engine compile
-	# failures. Fast; also included in the default tier-1 run.
+	# failures, and the durable-session suite (preempt/resume exactly-once,
+	# torn-write fallback, multi-host cursor agreement, step deadlines).
+	# Fast; also included in the default tier-1 run.
 	python -m pytest tests/reliability -q -m chaos
 
 dryrun:

@@ -11,7 +11,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import jax.numpy as jnp
 
 from metrics_tpu.functional.regression.sufficient_stats import regression_family_sharing
-from metrics_tpu.metric import Metric
+from metrics_tpu.metric import Metric, _decode_session_cursor, _encode_session_cursor
 from metrics_tpu.utilities.checks import shared_canonicalization
 
 
@@ -193,10 +193,19 @@ class MetricCollection:
         for _, m in self.items():
             m.persistent(mode)
 
+    # Durable-session step cursor (reliability/session.py): collection-level
+    # (one cursor for the whole fan-out — members advance in lockstep under
+    # one forward), riding state_dict/_named_states exactly as Metric's does
+    _session_cursor: Optional[int] = None
+
     def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
         destination = {} if destination is None else destination
         for k, m in self.items():
             m.state_dict(destination, prefix=f"{prefix}{k}.")
+        if self._session_cursor is not None:
+            destination[prefix + Metric._SESSION_CURSOR_KEY] = _encode_session_cursor(
+                self._session_cursor
+            )
         return destination
 
     def load_state_dict(self, state_dict: dict, prefix: str = "", strict: bool = False) -> None:
@@ -222,6 +231,9 @@ class MetricCollection:
                     f" prefix {prefix!r} that no member of this"
                     f" MetricCollection registers: {unexpected}"
                 )
+        cursor_key = prefix + Metric._SESSION_CURSOR_KEY
+        if cursor_key in state_dict:
+            self._session_cursor = _decode_session_cursor(state_dict[cursor_key])
         for k, m in self.items():
             m.load_state_dict(
                 state_dict, prefix=f"{prefix}{k}.", strict=strict, _warn_on_zero_match=False
@@ -247,10 +259,16 @@ class MetricCollection:
 
     def _named_states(self, prefix: str = "") -> list:
         """Member-prefixed ``(key, value)`` pairs across the collection (see
-        :meth:`Metric._named_states`)."""
+        :meth:`Metric._named_states`), plus the collection-level session
+        cursor when enrolled — envelopes then checksum the cursor together
+        with the state it describes."""
         pairs = []
         for k, m in self.items():
             pairs += m._named_states(f"{prefix}{k}.")
+        if self._session_cursor is not None:
+            pairs.append(
+                (prefix + Metric._SESSION_CURSOR_KEY, _encode_session_cursor(self._session_cursor))
+            )
         return pairs
 
     def to_device(self, device) -> "MetricCollection":

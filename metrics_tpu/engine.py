@@ -400,6 +400,7 @@ class CompiledStepEngine:
                             metrics=list(names),
                             reason=f"trace failed: {type(err).__name__}: {err}",
                         )
+
                     # rate-limited: a demotion warns once per engine, not
                     # once per training-loop step
                     warn_once(
@@ -407,6 +408,18 @@ class CompiledStepEngine:
                         f" ({type(err).__name__}: {err})",
                         key=f"engine-demoted:{id(self)}",
                     )
+                    # a durable EvalSession wrapping these metrics gets to
+                    # checkpoint the surviving state NOW, while it provably
+                    # exists — an engine unstable enough to kill a dispatch
+                    # is unstable enough to kill the next one too. Cold
+                    # path only (lazy import, no-op without sessions), and
+                    # never allowed to turn the recovery into a crash.
+                    try:
+                        from metrics_tpu.reliability import session as _rsession
+
+                        _rsession.notify_dispatch_failure(self._metrics.values())
+                    except Exception:  # noqa: BLE001 — best-effort hook
+                        pass
                     return self._finish(out_eager)
                 if telemetry_on and not cache_hit:
                     # miss executions carry the trace + compile cost

@@ -51,6 +51,31 @@ from metrics_tpu.utilities.distributed import gather_all_tensors
 Array = jax.Array
 
 
+def _encode_session_cursor(cursor: int) -> Array:
+    """The durable-session step cursor as a checkpointable scalar. int32 —
+    JAX's x64-off default — so the spec a save writes and the spec a load
+    validates against agree bit-for-bit. The ONE encoding, shared by
+    Metric, CompositionalMetric and MetricCollection."""
+    return jnp.asarray(int(cursor), dtype=jnp.int32)
+
+
+def _decode_session_cursor(value: Any) -> int:
+    return int(jnp.asarray(value))
+
+
+def _device_owned(v: Any) -> Array:
+    """Import a checkpoint value as state the device OWNS outright.
+
+    ``jnp.asarray(numpy)`` can import the host buffer zero-copy (CPU), and
+    plain ``device_put`` buffers interact badly with the compiled step
+    engine's donation when executables come from the persistent
+    compilation cache — both observed as bit-garbled state and GC
+    segfaults after a resume. The explicit ``.copy()`` runs as an XLA
+    computation, so the state buffer is XLA-allocated like any step
+    output: safe to donate, aliasing nothing on the host."""
+    return jnp.asarray(v).copy()
+
+
 class Metric(ABC):
     """Base class for all metrics.
 
@@ -80,6 +105,17 @@ class Metric(ABC):
     # every class present). Class-level default so pre-existing pickles
     # (which bypass __init__) keep working.
     _batch_local_compute = False
+
+    # Durable-session step cursor (reliability/session.py): the index of
+    # the last batch folded into the accumulated state, or None when the
+    # metric is not enrolled in an EvalSession. When set, it travels WITH
+    # the state — state_dict()/_named_states() emit it under
+    # _SESSION_CURSOR_KEY so a checkpoint of the state and the cursor that
+    # describes it are one atomic artifact (the exactly-once invariant is
+    # unenforceable if they can diverge). reset() deliberately keeps it:
+    # the session, not the state, owns batch accounting.
+    _session_cursor: Optional[int] = None
+    _SESSION_CURSOR_KEY = "__session_cursor__"
 
     # provenance of the `_computed` cache (see `_wrap_compute`)
     _computed_batch_local = False
@@ -524,12 +560,31 @@ class Metric(ABC):
         for key in self._persistent:
             self._persistent[key] = mode
 
+    def _cursor_state(self) -> Array:
+        """The session cursor as a checkpointable scalar (see
+        :func:`_encode_session_cursor`)."""
+        return _encode_session_cursor(self._session_cursor)
+
+    def _route_cursor(self, state_dict: dict, prefix: str) -> bool:
+        """Restore a session cursor riding in ``state_dict`` (if any);
+        returns True when one was found. Shared by metric, composition and
+        collection loaders so the cursor follows the state everywhere."""
+        key = prefix + self._SESSION_CURSOR_KEY
+        if key in state_dict:
+            self._session_cursor = _decode_session_cursor(state_dict[key])
+            return True
+        return False
+
     def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
-        """Collect persistent states into a checkpointable dict."""
+        """Collect persistent states into a checkpointable dict. A metric
+        enrolled in an :class:`~metrics_tpu.reliability.EvalSession`
+        additionally emits its step cursor (see ``_session_cursor``)."""
         destination = {} if destination is None else destination
         for key in self._defaults:
             if self._persistent[key]:
                 destination[prefix + key] = getattr(self, key)
+        if self._session_cursor is not None:
+            destination[prefix + self._SESSION_CURSOR_KEY] = self._cursor_state()
         return destination
 
     def load_state_dict(
@@ -560,15 +615,15 @@ class Metric(ABC):
                     f"strict load_state_dict: {type(self).__name__} is missing"
                     f" state keys {missing}"
                 )
-        loaded = False
+        loaded = self._route_cursor(state_dict, prefix)
         for key in self._defaults:
             name = prefix + key
             if name in state_dict:
                 val = state_dict[name]
                 if isinstance(val, list):
-                    setattr(self, key, [jnp.asarray(v) for v in val])
+                    setattr(self, key, [_device_owned(v) for v in val])
                 else:
-                    setattr(self, key, jnp.asarray(val))
+                    setattr(self, key, _device_owned(val))
                 loaded = True
         if loaded:
             # a cached pre-load result no longer describes the state
@@ -592,8 +647,14 @@ class Metric(ABC):
         :meth:`state_dict` prefixes it — the key universe strict checkpoint
         validation checks against (``metrics_tpu/reliability/checkpoint.py``).
         Unlike ``state_dict()`` this ignores ``persistent`` flags: it
-        describes what *could* be restored, not what was saved."""
-        return [(prefix + key, getattr(self, key)) for key in self._defaults]
+        describes what *could* be restored, not what was saved. A
+        session-enrolled metric includes its step cursor: checkpoint
+        envelopes built from these pairs then carry the cursor under the
+        same checksum as the state it describes."""
+        pairs = [(prefix + key, getattr(self, key)) for key in self._defaults]
+        if self._session_cursor is not None:
+            pairs.append((prefix + self._SESSION_CURSOR_KEY, self._cursor_state()))
+        return pairs
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         """Filter kwargs to those accepted by this metric's ``update`` signature."""
@@ -899,6 +960,8 @@ class CompositionalMetric(Metric):
             self.metric_a.state_dict(destination, prefix + "metric_a.")
         if isinstance(self.metric_b, Metric):
             self.metric_b.state_dict(destination, prefix + "metric_b.")
+        if self._session_cursor is not None:
+            destination[prefix + self._SESSION_CURSOR_KEY] = self._cursor_state()
         return destination
 
     def load_state_dict(
@@ -908,6 +971,7 @@ class CompositionalMetric(Metric):
         strict: bool = False,
         _warn_on_zero_match: bool = True,
     ) -> None:
+        self._route_cursor(state_dict, prefix)
         if isinstance(self.metric_a, Metric):
             self.metric_a.load_state_dict(
                 state_dict, prefix + "metric_a.", strict=strict, _warn_on_zero_match=False

@@ -19,22 +19,41 @@ zero-overhead until enabled:
 * **Fault injection** (:mod:`.faultinject`) — scoped context managers that
   create each failure on demand, so every recovery path above is
   exercised by the chaos suite (``tests/reliability/``) on every PR.
+* **Durable eval sessions** (:mod:`.session` + :mod:`.journal`) — the
+  composition: an :class:`EvalSession` wraps a metric stream with
+  crash-consistent checkpoint rotation (:class:`CheckpointJournal`),
+  exactly-once batch accounting (a step cursor checksummed into the same
+  envelope as the state, with a replay guard on resume), multi-host
+  resume agreement, and an optional hung-step deadline.
 
 Telemetry counters (all under ``reliability.*``; see
 ``docs/reliability.md`` and the glossary in ``docs/observability.md``):
 ``quarantined``, ``sync_retries``, ``degraded_syncs``,
-``checkpoint_rejects``, ``engine_dispatch_recoveries`` — a healthy run
-keeps every one of them at zero.
+``checkpoint_rejects``, ``engine_dispatch_recoveries``, and the
+``session_*`` family — a healthy run keeps every failure counter at zero
+(``session_checkpoints``/``session_resumes`` count normal durable
+activity and are zero only for code that never constructs a session).
 """
 from metrics_tpu.reliability.checkpoint import (  # noqa: F401
     CheckpointCorruptionError,
     CheckpointError,
     CheckpointMismatchError,
     CheckpointSchemaError,
+    atomic_file,
     load_envelope,
     read_envelope,
     save_envelope,
     write_envelope,
+)
+from metrics_tpu.reliability.journal import (  # noqa: F401
+    CheckpointJournal,
+    atomic_write_json,
+)
+from metrics_tpu.reliability.session import (  # noqa: F401
+    EvalSession,
+    SessionError,
+    SessionResumeError,
+    SessionStepTimeoutError,
 )
 from metrics_tpu.reliability.guard import (  # noqa: F401
     NonFiniteStateError,
@@ -55,13 +74,20 @@ from metrics_tpu.reliability import faultinject  # noqa: F401
 __all__ = [
     "CheckpointCorruptionError",
     "CheckpointError",
+    "CheckpointJournal",
     "CheckpointMismatchError",
     "CheckpointSchemaError",
+    "EvalSession",
     "NonFiniteStateError",
+    "SessionError",
+    "SessionResumeError",
+    "SessionStepTimeoutError",
     "StateGuard",
     "SyncFailedError",
     "SyncPolicy",
     "SyncTimeoutError",
+    "atomic_file",
+    "atomic_write_json",
     "faultinject",
     "guard_scope",
     "install_guard",

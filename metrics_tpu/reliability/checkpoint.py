@@ -38,8 +38,10 @@ any dtype JAX produces (bfloat16 included — arrays travel as raw bytes and
 are rebuilt from the spec).
 """
 import json
+import os
 import zlib
-from typing import Any, Dict, List, Optional, Tuple
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -54,6 +56,7 @@ __all__ = [
     "CheckpointSchemaError",
     "CheckpointCorruptionError",
     "CheckpointMismatchError",
+    "atomic_file",
     "save_envelope",
     "load_envelope",
     "write_envelope",
@@ -92,6 +95,14 @@ def _reject(exc: CheckpointError) -> CheckpointError:
 # ----------------------------------------------------------------------
 def _np(v: Any) -> np.ndarray:
     arr = np.asarray(v)
+    if not isinstance(v, np.ndarray):
+        # a device array: np.asarray() can be a ZERO-COPY view of the live
+        # XLA buffer (jax caches `_npy_value` that way on CPU). An
+        # envelope must own its payload — the compiled step engine DONATES
+        # state buffers, and XLA rewriting a donated buffer under a view
+        # the envelope still holds corrupts the checkpoint (and, once the
+        # view's memory is recycled, the heap)
+        return np.array(arr)
     # ascontiguousarray alone promotes 0-d to 1-d; keep the true shape
     return np.ascontiguousarray(arr).reshape(arr.shape)
 
@@ -290,9 +301,47 @@ def load_envelope(obj: Any, envelope: Dict[str, Any], strict: bool = True) -> No
 # ----------------------------------------------------------------------
 # file round-trip (single .npz; dtype-agnostic raw-byte payload)
 # ----------------------------------------------------------------------
+@contextmanager
+def atomic_file(path: Any) -> Iterator[Any]:
+    """Open ``<path>.tmp`` for writing; on clean exit flush + fsync it and
+    ``os.replace`` it over ``path`` (fsyncing the directory best-effort), so
+    a crash at ANY point leaves either the old file or the new one at
+    ``path`` — never a half-written hybrid. On error the temp file is
+    removed and ``path`` is untouched."""
+    path = os.fspath(path)
+    tmp = path + ".tmp"
+    f = open(tmp, "wb")
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+        # the rename itself must survive a power cut: fsync the directory
+        # entry (best-effort; not every filesystem supports dir fds)
+        try:
+            dfd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass
+    except BaseException:
+        f.close()
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 def write_envelope(path: Any, envelope: Dict[str, Any]) -> None:
-    """Serialize an envelope to one ``.npz`` file. Arrays are stored as raw
-    bytes and rebuilt from the spec, so every JAX dtype (bfloat16 included)
+    """Serialize an envelope to one ``.npz`` file, **atomically**: the bytes
+    go to ``<path>.tmp`` and are fsync'd before an ``os.replace`` over
+    ``path``, so a crash mid-write can never leave a torn envelope at the
+    target path (see :func:`atomic_file`). Arrays are stored as raw bytes
+    and rebuilt from the spec, so every JAX dtype (bfloat16 included)
     survives the trip without pickling."""
     header = {k: envelope[k] for k in envelope if k != "payload"}
     arrays = {"__header__": np.frombuffer(json.dumps(header).encode(), dtype=np.uint8)}
@@ -302,14 +351,34 @@ def write_envelope(path: Any, envelope: Dict[str, Any]) -> None:
                 arrays[f"l::{key}::{i}"] = np.frombuffer(_np(v).tobytes(), dtype=np.uint8)
         else:
             arrays[f"a::{key}"] = np.frombuffer(_np(val).tobytes(), dtype=np.uint8)
-    with open(path, "wb") as f:
+    with atomic_file(path) as f:
         np.savez(f, **arrays)
 
 
 def read_envelope(path: Any) -> Dict[str, Any]:
     """Read an envelope written by :func:`write_envelope`. Performs only
-    structural decoding; validation happens in :func:`load_envelope`."""
-    with np.load(path) as data:
+    structural decoding; validation happens in :func:`load_envelope`.
+    A file that cannot even be decoded — a torn write from a crashed
+    process, a truncated download — raises
+    :class:`CheckpointCorruptionError` rather than leaking zipfile/zlib
+    internals (a missing file stays ``FileNotFoundError``)."""
+    try:
+        return _read_envelope(path)
+    except (CheckpointError, FileNotFoundError):
+        raise
+    except Exception as err:  # zipfile.BadZipFile, zlib.error, ValueError...
+        raise _reject(
+            CheckpointCorruptionError(
+                f"envelope file {path!r} is unreadable (torn write or"
+                f" truncation): {type(err).__name__}: {err}"
+            )
+        ) from err
+
+
+def _read_envelope(path: Any) -> Dict[str, Any]:
+    # own the fd: np.load(path) leaks its file object when zipfile decoding
+    # raises mid-construction (torn files), tripping ResourceWarnings
+    with open(path, "rb") as fobj, np.load(fobj) as data:
         if "__header__" not in data:
             raise _reject(
                 CheckpointSchemaError(f"{path!r} is not a metrics_tpu envelope file")
@@ -368,4 +437,10 @@ def _decode(raw: np.ndarray, dtype: str, shape: List[int]) -> np.ndarray:
                 f" {dtype}{shape} ({expected} bytes) — truncated checkpoint"
             )
         )
-    return np.frombuffer(buf, dtype=dt).reshape(shape)
+    # .copy(): the payload must be OWNED, WRITABLE memory. A bare
+    # frombuffer view over the bytes object is read-only and borrowed —
+    # jax's CPU device_put can import such a host buffer zero-copy, and if
+    # the resulting state array is later DONATED (the compiled step
+    # engine), XLA writes outputs into memory the bytes object owns: heap
+    # corruption that surfaces as garbage metric values or a GC segfault.
+    return np.frombuffer(buf, dtype=dt).reshape(shape).copy()

@@ -11,6 +11,7 @@ these; they are also safe to use in a staging eval loop as a live drill.
 Nothing here is imported by the runtime hot paths; injecting a fault costs
 nothing until you ask for it.
 """
+import os
 import time
 from contextlib import contextmanager
 from copy import deepcopy
@@ -28,17 +29,27 @@ from metrics_tpu.parallel.backend import (
 
 __all__ = [
     "FaultInjected",
+    "Preempted",
     "poison",
     "nonfinite_updates",
     "flaky_sync_backend",
     "failing_engine_compile",
     "corrupt_envelope",
+    "preempt_at_step",
+    "torn_write",
+    "cursor_skew",
 ]
 
 
 class FaultInjected(RuntimeError):
     """Marker exception raised by injected faults (distinguishable from
     organic failures in assertions and logs)."""
+
+
+class Preempted(FaultInjected):
+    """Raised by :func:`preempt_at_step`: the process "died" here. A test
+    catches it, abandons the session object, and drives recovery purely
+    from what reached disk — the same evidence a real SIGKILL leaves."""
 
 
 # ----------------------------------------------------------------------
@@ -167,11 +178,17 @@ def flaky_sync_backend(
 # 3. engine compile failure
 # ----------------------------------------------------------------------
 @contextmanager
-def failing_engine_compile(times: int = 1) -> Iterator[Dict[str, int]]:
-    """Make the next ``times`` compiled-step traces raise
-    :class:`FaultInjected` at trace time — the exact failure shape of an
-    XLA lowering bug or an unjittable update sneaking into the engine.
-    Exercises the engine's rerun-eager-then-demote recovery path."""
+def failing_engine_compile(
+    times: int = 1, exc_type: Type[BaseException] = FaultInjected
+) -> Iterator[Dict[str, int]]:
+    """Make the next ``times`` compiled-step traces raise ``exc_type`` at
+    trace time — by default :class:`FaultInjected`, the exact failure
+    shape of an XLA lowering bug or an unjittable update sneaking into the
+    engine (exercises the rerun-eager-then-demote recovery path). Pass
+    ``exc_type=KeyboardInterrupt`` to drill an operator ^C landing inside
+    a dispatch: a BaseException the engine must let escape while the
+    donated-copy guarantee keeps accumulated state at the last-good
+    snapshot."""
     from metrics_tpu.engine import CompiledStepEngine  # lazy: avoid import cycle
 
     orig = CompiledStepEngine._make_step_fn
@@ -183,7 +200,7 @@ def failing_engine_compile(times: int = 1) -> Iterator[Dict[str, int]]:
         def step_fn(states, args, kwargs):
             if injected["count"] < times:
                 injected["count"] += 1
-                raise FaultInjected("injected engine compile failure")
+                raise exc_type("injected engine compile failure")
             return real(states, args, kwargs)
 
         return step_fn
@@ -238,3 +255,71 @@ def corrupt_envelope(envelope: Dict[str, Any], mode: str = "payload") -> Dict[st
             f"mode must be one of 'payload'|'checksum'|'schema'|'truncate', got {mode!r}"
         )
     return env
+
+
+# ----------------------------------------------------------------------
+# 5. durable-session faults (preemption, torn files, cursor skew)
+# ----------------------------------------------------------------------
+@contextmanager
+def preempt_at_step(session: Any, step: int) -> Iterator[Dict[str, int]]:
+    """SIGKILL-simulate a preemption: while active, the session "dies" —
+    raises :class:`Preempted` — the moment it is fed ``step_index >=
+    step``, before that batch touches any state. Everything the session
+    durably checkpointed before that instant is exactly what a real
+    preemption leaves behind; drive recovery by building a FRESH metric +
+    session over the same journal directory and calling ``resume()``."""
+    orig = session.step
+    info = {"preempted_at": -1}
+
+    def dying(step_index, *args: Any, **kwargs: Any):
+        if int(step_index) >= step:
+            info["preempted_at"] = int(step_index)
+            raise Preempted(f"injected preemption at step {step_index}")
+        return orig(step_index, *args, **kwargs)
+
+    session.step = dying
+    try:
+        yield info
+    finally:
+        del session.step  # uncover the bound method
+
+
+def torn_write(path: Any, keep_fraction: float = 0.5) -> int:
+    """Truncate a checkpoint file in place to ``keep_fraction`` of its
+    bytes — the on-disk carcass of a process killed mid-write (only
+    possible for files written WITHOUT the atomic tmp+rename path, which
+    is exactly why the journal uses it; injecting it against a finished
+    generation drills the resume-time fallback). Returns the new size."""
+    if not 0.0 <= keep_fraction < 1.0:
+        raise ValueError(f"keep_fraction must be in [0, 1), got {keep_fraction}")
+    size = os.path.getsize(path)
+    new_size = int(size * keep_fraction)
+    os.truncate(path, new_size)
+    return new_size
+
+
+@contextmanager
+def cursor_skew(session: Any, delta: int) -> Iterator[None]:
+    """While active, every checkpoint the session commits records a step
+    cursor offset by ``delta`` (state untouched) — the accounting drift of
+    a replica that counted batches its peers did not (a rank that died
+    between its own checkpoint and the others'). Drives the multi-host
+    resume-agreement path: skewed ranks must roll back to a common
+    generation or raise ``SessionResumeError``."""
+    orig = session.checkpoint
+
+    def skewed(*args: Any, **kwargs: Any):
+        real_cursor = session.cursor
+        session.cursor = real_cursor + delta
+        session.metric._session_cursor = session.cursor
+        try:
+            return orig(*args, **kwargs)
+        finally:
+            session.cursor = real_cursor
+            session.metric._session_cursor = real_cursor
+
+    session.checkpoint = skewed
+    try:
+        yield
+    finally:
+        del session.checkpoint

@@ -15,9 +15,12 @@ libraries (Prime PCCL): each gather gets
   timed-out attempt is TERMINAL, never retried: the abandoned worker may
   still be consuming the peers' collective round, and a concurrent retry
   would pair this rank's gathers with the wrong rounds;
-* **bounded retries** with exponential backoff (``max_retries``,
-  ``backoff_s`` doubling per attempt) for cleanly-failing gathers —
-  counted as ``reliability.sync_retries`` in telemetry;
+* **bounded retries** with decorrelated-jitter backoff (``max_retries``,
+  base ``backoff_s``, ceiling ``max_backoff_s``; ``jitter=False`` restores
+  plain doubling) for cleanly-failing gathers — counted as
+  ``reliability.sync_retries`` in telemetry. Jitter is the default because
+  a pod's ranks fail a collective *together*, and deterministic backoff
+  retries them together too — a thundering herd re-colliding every round;
 * a **degraded mode** (``degraded_ok=True``): when a gather fails
   terminally, the WHOLE sync falls back to LOCAL-ONLY state — every state
   gathers as ``[x]``, exactly as the single-process backend would — with
@@ -37,6 +40,7 @@ Scope: host-level backends only. In-program XLA collectives
 (``parallel/collective.py``) execute inside a compiled program where a
 Python wrapper cannot intercede; hangs there are the runtime's to handle.
 """
+import random
 import threading
 import time
 from contextlib import contextmanager
@@ -75,23 +79,62 @@ class SyncPolicy:
     Attributes:
         max_retries: additional attempts after the first failure (total
             attempts = ``max_retries + 1``).
-        backoff_s: sleep before the first retry; doubles per retry.
+        backoff_s: base sleep before the first retry; with ``jitter`` off
+            it doubles per retry, with ``jitter`` on (the default) it is
+            the floor of the decorrelated-jitter draw.
         timeout_s: per-attempt wall-clock bound; None = wait forever.
         degraded_ok: after the final failure, fall back to local-only
             state (one warning + ``reliability.degraded_syncs``) instead
             of raising :class:`SyncFailedError`.
+        jitter: decorrelate retry sleeps across hosts (default ON). A pod
+            whose ranks all fail a collective at the same instant and all
+            back off deterministically retries in LOCKSTEP — a thundering
+            herd that re-collides every round. Each retry instead sleeps
+            ``min(max_backoff_s, uniform(backoff_s, 3 * prev))`` (the
+            decorrelated-jitter recipe), drawn from a per-policy RNG
+            seeded from OS entropy, so no two hosts share a schedule.
+        max_backoff_s: hard ceiling on any single retry sleep. Default
+            (None) resolves to ``max(2.0, 8 * backoff_s)`` — scaled with
+            the base so a large ``backoff_s`` is never silently clamped
+            into a constant, jitter-free sleep. An explicit ceiling below
+            ``backoff_s`` is rejected.
     """
 
     max_retries: int = 2
     backoff_s: float = 0.05
     timeout_s: Optional[float] = None
     degraded_ok: bool = False
+    jitter: bool = True
+    max_backoff_s: Optional[float] = None
 
     # host-side tally, useful when telemetry is disabled
     def __post_init__(self):
         if self.max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        if self.backoff_s < 0:
+            raise ValueError("backoff_s must be >= 0")
+        if self.max_backoff_s is None:
+            self.max_backoff_s = max(2.0, 8.0 * self.backoff_s)
+        if self.max_backoff_s < self.backoff_s or self.max_backoff_s <= 0:
+            raise ValueError(
+                f"max_backoff_s ({self.max_backoff_s}) must be > 0 and >="
+                f" backoff_s ({self.backoff_s}) — a ceiling below the base"
+                " degenerates every retry into the same clamped sleep"
+            )
         self.stats = {"retries": 0, "degraded": 0, "timeouts": 0}
+        # fresh OS-entropy seed per policy object: two policies built from
+        # the same (seed-free) config MUST NOT produce identical schedules
+        self._rng = random.Random()
+
+    def next_backoff(self, prev: Optional[float]) -> float:
+        """The sleep before the next retry, given the previous sleep (None
+        before the first retry). Deterministic doubling under
+        ``jitter=False``; decorrelated jitter otherwise. Always within
+        ``[min(backoff_s, max_backoff_s), max_backoff_s]``."""
+        if not self.jitter:
+            return min(self.max_backoff_s, self.backoff_s if prev is None else prev * 2.0)
+        hi = 3.0 * (self.backoff_s if prev is None else prev)
+        return min(self.max_backoff_s, self._rng.uniform(self.backoff_s, max(self.backoff_s, hi)))
 
 
 _active: Optional[SyncPolicy] = None
@@ -175,7 +218,7 @@ def apply_sync_policy(fn: Callable) -> Callable:
         return fn
 
     def guarded(x, *args: Any, **kwargs: Any):
-        delay = policy.backoff_s
+        delay: Optional[float] = None
         last_err: Optional[BaseException] = None
         for attempt in range(policy.max_retries + 1):
             try:
@@ -196,8 +239,8 @@ def apply_sync_policy(fn: Callable) -> Callable:
                             attempt=attempt + 1,
                             error=f"{type(err).__name__}: {err}",
                         )
+                    delay = policy.next_backoff(delay)
                     time.sleep(delay)
-                    delay *= 2.0
         if isinstance(last_err, SyncFailedError):
             # keep the subtype catchable: a terminal timeout surfaces as
             # SyncTimeoutError (which IS-A SyncFailedError), not re-wrapped

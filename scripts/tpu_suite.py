@@ -13,7 +13,11 @@ Tunnel-hardened like everything else on this host: the remote-TPU tunnel
 flaps, so the run is CHUNKED (one pytest invocation per directory, per-file
 for the big classification tree), each chunk under its own timeout, and the
 artifact (`TPU_SUITE.json`) is rewritten after every chunk — a mid-run
-tunnel death keeps every chunk that finished. Green runs mirror to the
+tunnel death keeps every chunk that finished. The rewrite follows the
+durable-session discipline (`metrics_tpu/reliability/journal.py`): the
+chunk list is this runner's step cursor, and the artifact is replaced
+atomically (tmp + fsync + rename), so a kill landing INSIDE the rewrite
+can no longer tear the resume state and restart the suite from chunk 1. Green runs mirror to the
 git-tracked `TPU_SUITE_last_good.json`; a failed artifact carries the last
 good one (same contract as TPU_TEST.json / .bench_last_good.json).
 
@@ -32,6 +36,7 @@ HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, HERE)
 
 from bench import _probe_backend  # noqa: E402
+from metrics_tpu.reliability.journal import atomic_write_json  # noqa: E402
 
 ARTIFACT = os.path.join(HERE, "TPU_SUITE.json")
 LAST_GOOD = os.path.join(HERE, "TPU_SUITE_last_good.json")
@@ -178,18 +183,22 @@ def _attach_telemetry(entry: dict, dump_path: str) -> None:
 
 
 def _write(result: dict) -> None:
+    # atomic (tmp + fsync + os.replace, via the reliability journal's
+    # helper): the artifact IS the resume state — chunk resume reads it on
+    # the next invocation — and this very function runs between chunks,
+    # exactly where the watcher's outer timeout (or a tunnel-death kill)
+    # lands. A torn TPU_SUITE.json used to fail json.load on resume and
+    # silently restart the whole suite from chunk 1.
     if result.get("ok"):
         result.pop("last_good", None)  # never nest prior artifacts into a green one
-        with open(LAST_GOOD, "w") as f:
-            json.dump(result, f, indent=1)
+        atomic_write_json(LAST_GOOD, result)
     else:
         try:
             with open(LAST_GOOD) as f:
                 result["last_good"] = json.load(f)
         except Exception:
             result.pop("last_good", None)
-    with open(ARTIFACT, "w") as f:
-        json.dump(result, f, indent=1)
+    atomic_write_json(ARTIFACT, result)
 
 
 def main() -> int:

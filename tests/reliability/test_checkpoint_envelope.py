@@ -293,3 +293,98 @@ def test_load_state_dict_strict_and_zero_match_warn():
     col = MetricCollection([Accuracy()])
     with pytest.raises(KeyError, match="no member"):
         col.load_state_dict({"NotAMember.correct": jnp.asarray(0)}, strict=True)
+
+
+# ----------------------------------------------------------------------
+# ISSUE 4 satellites: atomic file writes + torn-write regression
+# ----------------------------------------------------------------------
+def test_write_envelope_is_atomic_on_crash(tmp_path, monkeypatch):
+    """A crash mid-write must never leave a half-written envelope at the
+    target path: the old file survives untouched, the temp file is
+    removed."""
+    path = tmp_path / "ckpt.npz"
+    good = save_envelope(_acc(seed=1))
+    write_envelope(path, good)
+    before = path.read_bytes()
+    real_savez = np.savez
+
+    def dying_savez(f, **arrays):
+        # write half the real bytes, then "lose power"
+        import io
+
+        buf = io.BytesIO()
+        real_savez(buf, **arrays)
+        f.write(buf.getvalue()[: buf.tell() // 2])
+        raise OSError("simulated crash mid-write")
+
+    monkeypatch.setattr(np, "savez", dying_savez)
+    with pytest.raises(OSError, match="mid-write"):
+        write_envelope(path, save_envelope(_acc(seed=2)))
+    monkeypatch.undo()
+
+    assert path.read_bytes() == before  # old envelope intact, bit for bit
+    assert not (tmp_path / "ckpt.npz.tmp").exists()
+    load_envelope(Accuracy(), read_envelope(path), strict=True)  # still loads
+
+
+def test_atomic_file_fresh_target_crash_leaves_nothing(tmp_path):
+    from metrics_tpu.reliability import atomic_file
+
+    path = tmp_path / "new.bin"
+    with pytest.raises(RuntimeError):
+        with atomic_file(path) as f:
+            f.write(b"partial")
+            raise RuntimeError("boom")
+    assert not path.exists() and not (tmp_path / "new.bin.tmp").exists()
+
+
+def test_truncate_injector_against_a_real_file(tmp_path):
+    """Satellite regression: a corrupt_envelope(truncate) envelope — a
+    consistent-but-incomplete checkpoint — written to a REAL file is
+    rejected by the strict load after the round-trip (key matching, not
+    checksum, catches it: the checksum was recomputed by the injector)."""
+    path = tmp_path / "trunc.npz"
+    env = save_envelope(_acc(seed=3))
+    write_envelope(path, fi.corrupt_envelope(env, "truncate"))
+    back = read_envelope(path)  # structurally fine: the file is coherent
+    with pytest.raises(CheckpointMismatchError, match="missing keys"):
+        load_envelope(Accuracy(), back, strict=True)
+
+
+def test_torn_file_raises_typed_corruption_error(tmp_path):
+    """A file truncated at the byte level (the torn write the atomic path
+    prevents, injected via faultinject.torn_write) must surface as
+    CheckpointCorruptionError — never a bare zipfile/zlib internal."""
+    path = tmp_path / "torn.npz"
+    write_envelope(path, save_envelope(_acc(seed=4)))
+    fi.torn_write(path, keep_fraction=0.4)
+    with obs.telemetry_scope():
+        with pytest.raises(CheckpointCorruptionError, match="unreadable|truncat"):
+            read_envelope(path)
+        assert obs.get().counters["reliability.checkpoint_rejects"] == 1
+    with pytest.raises(ValueError, match="keep_fraction"):
+        fi.torn_write(path, keep_fraction=1.5)
+
+
+def test_missing_file_stays_file_not_found(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        read_envelope(tmp_path / "never_written.npz")
+
+
+def test_loaded_states_are_device_owned(tmp_path):
+    """Resume-hazard regression: states loaded from an envelope file must
+    be XLA-owned buffers — donation-safe under the compiled engine — not
+    zero-copy views of the (soon-freed) decoded payload."""
+    path = tmp_path / "ckpt.npz"
+    m = MeanSquaredError()
+    x = jnp.asarray(np.random.RandomState(0).rand(64).astype(np.float32))
+    m.update(x, x * 0.5)
+    write_envelope(path, save_envelope(m))
+    env = read_envelope(path)
+    fresh = MeanSquaredError()
+    load_envelope(fresh, env, strict=True)
+    for sname in fresh._defaults:
+        state = getattr(fresh, sname)
+        for host in (v for v in env["payload"].values() if isinstance(v, np.ndarray)):
+            if host.size and state.size:
+                assert state.unsafe_buffer_pointer() != host.ctypes.data

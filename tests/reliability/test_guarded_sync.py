@@ -105,7 +105,8 @@ def test_hung_backend_times_out_then_degrades():
 
 
 def test_backoff_sleeps_between_retries_and_wrapper_always_raises():
-    """The per-gather wrapper retries with doubling backoff and raises on
+    """The per-gather wrapper retries with doubling backoff
+    (``jitter=False``: the deterministic schedule) and raises on
     exhaustion EVEN under degraded_ok — degradation is applied atomically
     by _sync_dist across the whole state dict, never per leaf (a per-leaf
     fallback could mix world-aggregated and local-only states)."""
@@ -117,12 +118,65 @@ def test_backoff_sleeps_between_retries_and_wrapper_always_raises():
         calls.append(time.perf_counter())
         raise RuntimeError("down")
 
-    with reliability.sync_policy_scope(max_retries=2, backoff_s=0.05, degraded_ok=True):
+    with reliability.sync_policy_scope(
+        max_retries=2, backoff_s=0.05, degraded_ok=True, jitter=False
+    ):
         with pytest.raises(SyncFailedError):
             apply_sync_policy(failing)(jnp.asarray(1.0))
     assert len(calls) == 3
     assert calls[1] - calls[0] >= 0.04  # first backoff
     assert calls[2] - calls[1] >= 0.08  # doubled
+
+
+def test_jittered_policies_decorrelate_and_respect_the_bound():
+    """ISSUE 4 satellite: two policies built from the same (seed-free)
+    config must NOT produce identical sleep schedules — synchronized
+    multi-host retries are a thundering herd — while every sleep stays
+    within [backoff_s, max_backoff_s]."""
+    a = SyncPolicy(backoff_s=0.01, max_backoff_s=0.5)
+    b = SyncPolicy(backoff_s=0.01, max_backoff_s=0.5)
+
+    def schedule(policy, n=24):
+        out, prev = [], None
+        for _ in range(n):
+            prev = policy.next_backoff(prev)
+            out.append(prev)
+        return out
+
+    sched_a, sched_b = schedule(a), schedule(b)
+    assert sched_a != sched_b  # decorrelated (seed-free per-policy RNG)
+    for sched in (sched_a, sched_b):
+        assert all(0.01 <= s <= 0.5 for s in sched)
+    # the decorrelated walk actually explores above the base, i.e. it is
+    # a backoff, not a constant retry
+    assert max(sched_a) > 0.01
+
+
+def test_jitter_is_on_by_default_and_sleeps_at_least_base():
+    import time
+
+    calls = []
+
+    def failing(x, group=None):
+        calls.append(time.perf_counter())
+        raise RuntimeError("down")
+
+    with reliability.sync_policy_scope(max_retries=1, backoff_s=0.03) as pol:
+        assert pol.jitter is True
+        with pytest.raises(SyncFailedError):
+            apply_sync_policy(failing)(jnp.asarray(1.0))
+    assert len(calls) == 2
+    assert calls[1] - calls[0] >= 0.02  # jittered, but never below ~base
+
+
+def test_backoff_validation():
+    with pytest.raises(ValueError, match="backoff"):
+        SyncPolicy(backoff_s=-1.0)
+    with pytest.raises(ValueError, match="backoff"):
+        SyncPolicy(max_backoff_s=0.0)
+    # the deterministic schedule also honors the ceiling
+    p = SyncPolicy(backoff_s=1.0, max_backoff_s=1.5, jitter=False)
+    assert p.next_backoff(p.next_backoff(None)) == 1.5
 
 
 def test_timeout_is_terminal_not_retried():

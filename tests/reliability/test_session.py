@@ -1,0 +1,492 @@
+"""Durable eval sessions (ISSUE 4 acceptance): an eval killed mid-epoch by
+``faultinject.preempt_at_step`` resumes from the rotated checkpoint, skips
+replayed batches exactly once, and the final ``compute()`` is bit-identical
+to an uninterrupted run — for a plain metric, a compiled collection, and a
+multi-process (virtual-DDP) collection. Plus: torn-write resume fallback,
+multi-host cursor agreement (rollback / typed failure / degraded warn),
+the hung-step deadline, the engine-demotion protective checkpoint, and the
+git-SHA drift warning.
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import (
+    Accuracy,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    MetricCollection,
+    Precision,
+    reliability,
+)
+from metrics_tpu.reliability import (
+    EvalSession,
+    SessionResumeError,
+    SessionStepTimeoutError,
+    faultinject as fi,
+)
+from tests.helpers.testers import run_virtual_ddp
+
+pytestmark = pytest.mark.chaos
+
+N_BATCHES = 8
+KILL_AT = 5
+
+
+def _reg_batches(n=N_BATCHES, size=64, seed=7):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        t = rng.rand(size).astype(np.float32)
+        p = t + 0.1 * rng.randn(size).astype(np.float32)
+        out.append((jnp.asarray(p), jnp.asarray(t)))
+    return out
+
+
+def _cls_batches(n=N_BATCHES, size=48, seed=3):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        probs = rng.rand(size, 4).astype(np.float32)
+        probs /= probs.sum(1, keepdims=True)
+        out.append((jnp.asarray(probs), jnp.asarray(rng.randint(4, size=size))))
+    return out
+
+
+def _reg_collection(compiled=False):
+    return MetricCollection([MeanSquaredError(), MeanAbsoluteError()], compiled=compiled)
+
+
+def _assert_bit_identical(got, want):
+    if isinstance(want, dict):
+        assert set(got) == set(want)
+        for k in want:
+            np.testing.assert_array_equal(np.asarray(got[k]), np.asarray(want[k]), err_msg=k)
+    else:
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def _run_preempt_resume(make_metric, batches, tmp_path, checkpoint_every=2):
+    """Kill a session mid-epoch, resume a FRESH metric+session from disk,
+    replay the whole stream; returns (final_value, resumed_session)."""
+    first = EvalSession(
+        make_metric(), tmp_path / "j", checkpoint_every=checkpoint_every
+    )
+    with pytest.raises(fi.Preempted):
+        with fi.preempt_at_step(first, KILL_AT):
+            for i, batch in enumerate(batches):
+                first.step(i, *batch)
+
+    resumed = EvalSession(
+        make_metric(), tmp_path / "j", checkpoint_every=checkpoint_every
+    )
+    cursor = resumed.resume()
+    assert 0 <= cursor < KILL_AT  # something was durably checkpointed
+    for i, batch in enumerate(batches):  # naive full replay of the stream
+        resumed.step(i, *batch)
+    # exactly-once: every batch at-or-below the cursor skipped, once each
+    assert resumed.stats["replays_skipped"] == cursor + 1
+    assert resumed.stats["steps"] == len(batches) - cursor - 1
+    return resumed.compute(), resumed
+
+
+def test_preempted_plain_metric_resumes_bit_identical(tmp_path):
+    batches = _reg_batches()
+    clean = MeanSquaredError()
+    for p, t in batches:
+        clean(p, t)
+    with obs.telemetry_scope():
+        got, session = _run_preempt_resume(MeanSquaredError, batches, tmp_path)
+        assert obs.get().counters["reliability.session_replays_skipped"] > 0
+    _assert_bit_identical(got, clean.compute())
+
+
+def test_preempted_compiled_collection_resumes_bit_identical(tmp_path):
+    batches = _reg_batches()
+    clean = _reg_collection(compiled=True)
+    for p, t in batches:
+        clean(p, t)
+    got, _ = _run_preempt_resume(
+        lambda: _reg_collection(compiled=True), batches, tmp_path
+    )
+    _assert_bit_identical(got, clean.compute())
+
+
+def test_preempted_multiprocess_collection_resumes_bit_identical(tmp_path):
+    """SPMD-style sharded eval: every rank steps every global batch index
+    on ITS shard of the batch (rank r takes samples r::world). Both ranks
+    die mid-epoch, both resume and agree on the cursor; the synced final
+    values are bit-identical to an uninterrupted 2-rank run."""
+    world = 2
+    batches = _cls_batches()
+
+    def _shard(batch, rank):
+        probs, target = batch
+        return probs[rank::world], target[rank::world]
+
+    def _col():
+        return MetricCollection([Accuracy(), Precision(num_classes=4, average="macro")])
+
+    want = {}
+
+    def uninterrupted(rank, world_size):
+        col = _col()
+        for i, batch in enumerate(batches):
+            col.update(*_shard(batch, rank))
+        values = col.compute()  # every rank joins the gather
+        if rank == 0:
+            want.update(values)
+
+    run_virtual_ddp(world, uninterrupted)
+
+    def killed(rank, world_size):
+        session = EvalSession(_col(), tmp_path / f"rank{rank}", checkpoint_every=1)
+        try:
+            with fi.preempt_at_step(session, KILL_AT):
+                for i, batch in enumerate(batches):
+                    session.step(i, *_shard(batch, rank))
+        except fi.Preempted:
+            pass
+
+    run_virtual_ddp(world, killed)
+
+    got = {}
+
+    def resumed(rank, world_size):
+        session = EvalSession(_col(), tmp_path / f"rank{rank}", checkpoint_every=1)
+        cursor = session.resume()
+        assert cursor == KILL_AT - 1  # both ranks checkpointed every step
+        for i, batch in enumerate(batches):  # naive full-stream replay
+            session.step(i, *_shard(batch, rank))
+        assert session.stats["replays_skipped"] == KILL_AT
+        values = session.compute()  # syncs through the virtual backend
+        if rank == 0:
+            got.update(values)
+
+    run_virtual_ddp(world, resumed)
+    _assert_bit_identical(got, want)
+
+
+def test_resume_falls_back_over_torn_newest_generation(tmp_path):
+    """Acceptance: truncating the newest generation makes resume() restore
+    generation N-1 with a typed warning — never a crash, never a silent
+    partial load — and the replay guard still makes the rerun exact."""
+    batches = _reg_batches()
+    clean = MeanSquaredError()
+    for p, t in batches:
+        clean(p, t)
+
+    session = EvalSession(MeanSquaredError(), tmp_path / "j", checkpoint_every=1)
+    for i, b in enumerate(batches[:KILL_AT]):
+        session.step(i, *b)
+    newest = session.journal.records()[-1]
+    fi.torn_write(session.journal._gen_path(int(newest["generation"])))
+
+    fresh = EvalSession(MeanSquaredError(), tmp_path / "j", checkpoint_every=1)
+    with pytest.warns(UserWarning, match="falling back"):
+        cursor = fresh.resume()
+    assert cursor == KILL_AT - 2  # generation N-1's cursor
+    for i, b in enumerate(batches):
+        fresh.step(i, *b)
+    _assert_bit_identical(fresh.compute(), clean.compute())
+
+
+def test_replay_guard_is_exactly_once_without_any_crash(tmp_path):
+    """Replays are no-ops even in a healthy loop: feeding the same prefix
+    twice counts it once."""
+    batches = _reg_batches(4)
+    clean = MeanSquaredError()
+    for p, t in batches:
+        clean(p, t)
+    session = EvalSession(MeanSquaredError(), tmp_path / "j", checkpoint_every=None)
+    for i, b in enumerate(batches[:2]):
+        session.step(i, *b)
+    for i, b in enumerate(batches):  # re-feeds 0 and 1
+        assert (session.step(i, *b) is None) == (i < 2)
+    assert session.stats["replays_skipped"] == 2
+    _assert_bit_identical(session.compute(), clean.compute())
+
+
+def test_cursor_rides_inside_the_checksummed_envelope(tmp_path):
+    session = EvalSession(MeanSquaredError(), tmp_path / "j", checkpoint_every=1)
+    p, t = _reg_batches(1)[0]
+    session.step(0, p, t)
+    envelope, record, _ = session.journal.load_latest_good()
+    from metrics_tpu.metric import Metric
+
+    assert Metric._SESSION_CURSOR_KEY in envelope["payload"]
+    assert int(np.asarray(envelope["payload"][Metric._SESSION_CURSOR_KEY])) == 0
+    assert record["cursor"] == 0
+    # ... and under the checksum: corrupting the payload is detected
+    bad = fi.corrupt_envelope(envelope, "payload")
+    with pytest.raises(reliability.CheckpointError):
+        reliability.load_envelope(
+            EvalSession(MeanSquaredError(), tmp_path / "j2").metric, bad, strict=True
+        )
+
+
+def test_multihost_skew_rolls_back_to_common_generation(tmp_path):
+    """Ranks resuming with different cursors roll back to the newest
+    generation BOTH still hold, so batch accounting re-agrees."""
+    batches = _cls_batches()
+
+    def phase1(rank, world_size):
+        session = EvalSession(
+            Accuracy(), tmp_path / f"rank{rank}", checkpoint_every=1, keep_last=3
+        )
+        for i in range(4):
+            if i == 3 and rank == 1:
+                with fi.cursor_skew(session, +2):
+                    session.step(i, *batches[i])
+            else:
+                session.step(i, *batches[i])
+
+    run_virtual_ddp(2, phase1)
+
+    cursors = {}
+
+    def phase2(rank, world_size):
+        session = EvalSession(Accuracy(), tmp_path / f"rank{rank}", keep_last=3)
+        cursors[rank] = (session.resume(), session.stats["resume_rollbacks"])
+
+    with obs.telemetry_scope():
+        # filters toggled in the MAIN thread only: the warnings module's
+        # filter stack is process-global and worker threads would race it
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            run_virtual_ddp(2, phase2)
+        assert obs.get().counters["reliability.session_resume_rollbacks"] >= 1
+    # both ranks land on the same cursor, below the skewed one
+    assert cursors[0][0] == cursors[1][0] < 4
+    assert cursors[0][1] + cursors[1][1] >= 1  # at least one rank rolled back
+
+
+def test_multihost_skew_without_common_generation_raises_typed(tmp_path):
+    """keep_last=1 + a skewed cursor leaves NO generation both ranks hold:
+    resume must fail with SessionResumeError (degraded_ok demotes to one
+    warning and continues on local accounting)."""
+    batches = _cls_batches()
+
+    def phase1(rank, world_size):
+        session = EvalSession(
+            Accuracy(), tmp_path / f"rank{rank}", checkpoint_every=1, keep_last=1
+        )
+        with fi.cursor_skew(session, +2 if rank == 1 else 0):
+            for i in range(3):
+                session.step(i, *batches[i])
+
+    run_virtual_ddp(2, phase1)
+
+    def phase2_strict(rank, world_size):
+        session = EvalSession(Accuracy(), tmp_path / f"rank{rank}", keep_last=1)
+        with pytest.raises(SessionResumeError, match="skewed step cursors"):
+            session.resume()
+
+    run_virtual_ddp(2, phase2_strict)
+
+    def phase2_degraded(rank, world_size):
+        session = EvalSession(
+            Accuracy(), tmp_path / f"rank{rank}", keep_last=1, degraded_ok=True
+        )
+        assert session.resume() >= 0  # local cursor kept
+
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        run_virtual_ddp(2, phase2_degraded)
+    assert any("LOCAL accounting" in str(w.message) for w in caught)
+
+
+def test_step_deadline_checkpoints_last_good_then_raises(tmp_path):
+    """A wedged step: the watchdog restores the pre-step snapshot, writes
+    a protective checkpoint of it, and raises the typed error."""
+    import time
+
+    class WedgedMSE(MeanSquaredError):
+        wedge = False
+
+        def update(self, preds, target):
+            if WedgedMSE.wedge:
+                time.sleep(2.0)
+            return super().update(preds, target)
+
+    batches = _reg_batches(3)
+    session = EvalSession(
+        WedgedMSE(), tmp_path / "j", checkpoint_every=None, step_deadline_s=0.2
+    )
+    session.step(0, *batches[0])
+    good_total = int(np.asarray(session.metric.total))
+    WedgedMSE.wedge = True
+    try:
+        with obs.telemetry_scope():
+            with pytest.raises(SessionStepTimeoutError, match="deadline"):
+                session.step(1, *batches[1])
+            assert obs.get().counters["reliability.session_deadline_exceeded"] == 1
+            assert obs.get().counters["reliability.session_protective_checkpoints"] == 1
+    finally:
+        WedgedMSE.wedge = False
+    assert session.cursor == 0  # the wedged batch never counted
+    envelope, record, _ = session.journal.load_latest_good()
+    assert record["cursor"] == 0 and "protective" in record["note"]
+    # the persisted state is the pre-step snapshot
+    fresh = EvalSession(MeanSquaredError(), tmp_path / "j")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert fresh.resume() == 0
+    assert int(np.asarray(fresh.metric.total)) == good_total
+
+
+def test_engine_demotion_triggers_protective_checkpoint(tmp_path):
+    """ISSUE tentpole (4): the compiled engine's dispatch-failure path
+    notifies the session, so demote-to-eager leaves a durable recovery
+    point even between cadence checkpoints."""
+    batches = _reg_batches(2)
+    col = _reg_collection(compiled=True)
+    session = EvalSession(col, tmp_path / "j", checkpoint_every=1000)
+    session.step(0, *batches[0])
+    assert session.journal.records() == []  # cadence never fired
+    p, t = batches[1]
+    doubled = (jnp.concatenate([p, p]), jnp.concatenate([t, t]))  # fresh trace
+    with obs.telemetry_scope():
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with fi.failing_engine_compile(times=1):
+                session.step(1, *doubled)
+        assert obs.get().counters["reliability.session_protective_checkpoints"] == 1
+    records = session.journal.records()
+    assert len(records) == 1 and "engine dispatch failure" in records[0]["note"]
+    # the protective checkpoint covers the in-flight batch (it landed via
+    # the eager rerun), so a resume from it replays nothing twice
+    assert records[0]["cursor"] == 1
+    clean = _reg_collection(compiled=False)
+    clean(*batches[0])
+    clean(*doubled)
+    resumed = EvalSession(_reg_collection(), tmp_path / "j")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        assert resumed.resume() == 1
+    _assert_bit_identical(resumed.compute(), clean.compute())
+
+
+def test_resume_warns_on_git_sha_drift(tmp_path, monkeypatch):
+    """Satellite: an envelope recorded at another git SHA resumes with a
+    warn_once, mirroring tpu_suite's SHA-keyed resume convention."""
+    import metrics_tpu.reliability.journal as journal_mod
+
+    batches = _reg_batches(2)
+    monkeypatch.setattr(journal_mod, "_GIT_SHA", "a" * 40)
+    session = EvalSession(MeanSquaredError(), tmp_path / "j", checkpoint_every=1)
+    session.step(0, *batches[0])
+    monkeypatch.setattr(journal_mod, "_GIT_SHA", "b" * 40)
+    fresh = EvalSession(MeanSquaredError(), tmp_path / "j")
+    with pytest.warns(UserWarning, match="git SHA"):
+        assert fresh.resume() == 0
+
+
+def test_session_validates_inputs(tmp_path):
+    with pytest.raises(TypeError, match="EvalSession wraps"):
+        EvalSession(object(), tmp_path)
+    with pytest.raises(ValueError, match="checkpoint_every"):
+        EvalSession(MeanSquaredError(), tmp_path, checkpoint_every=0)
+    session = EvalSession(MeanSquaredError(), tmp_path)
+    with pytest.raises(ValueError, match="step_index"):
+        session.step(-1, jnp.zeros(3), jnp.zeros(3))
+
+
+def test_state_dict_carries_cursor_for_enrolled_metrics_only(tmp_path):
+    plain = MeanSquaredError()
+    assert "__session_cursor__" not in plain.state_dict()
+    session = EvalSession(MeanSquaredError(), tmp_path)
+    p, t = _reg_batches(1)[0]
+    session.step(0, p, t)
+    sd = session.metric.state_dict()
+    assert int(np.asarray(sd["__session_cursor__"])) == 0
+    other = MeanSquaredError()
+    other.load_state_dict(sd)
+    assert other._session_cursor == 0
+
+
+def test_skew_agreement_never_advertises_torn_generations(tmp_path):
+    """Review fix: a rank whose newest generation is torn must not offer
+    its cursor to peers as a rollback target — the agreement vector only
+    carries generations that actually load, so the negotiated target is
+    always honorable (no SessionResumeError in the documented torn-write
+    fallback path)."""
+    batches = _cls_batches()
+
+    def phase1(rank, world_size):
+        session = EvalSession(
+            Accuracy(), tmp_path / f"rank{rank}", checkpoint_every=1, keep_last=3
+        )
+        for i in range(4):
+            session.step(i, *batches[i])
+        if rank == 1:
+            newest = session.journal.records()[-1]
+            fi.torn_write(session.journal._gen_path(int(newest["generation"])))
+
+    run_virtual_ddp(2, phase1)
+
+    cursors = {}
+
+    def phase2(rank, world_size):
+        session = EvalSession(Accuracy(), tmp_path / f"rank{rank}", keep_last=3)
+        cursors[rank] = session.resume()
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        run_virtual_ddp(2, phase2)
+    # rank 1 fell back to cursor 2; rank 0 rolled back to match; agreement
+    # settles on a generation BOTH can load
+    assert cursors[0] == cursors[1] == 2
+
+
+def test_skew_agreement_survives_manifest_loss(tmp_path):
+    """Review fix: a rank that lost its manifest still advertises its
+    generations (cursors recovered from the envelope payloads), so
+    agreement resolves instead of raising."""
+    import os
+
+    batches = _cls_batches()
+
+    def phase1(rank, world_size):
+        session = EvalSession(
+            Accuracy(), tmp_path / f"rank{rank}", checkpoint_every=1, keep_last=3
+        )
+        for i in range(4 if rank == 0 else 3):  # rank 1 died one step early
+            session.step(i, *batches[i])
+        if rank == 1:
+            os.remove(session.journal.manifest_path)
+
+    run_virtual_ddp(2, phase1)
+
+    cursors = {}
+
+    def phase2(rank, world_size):
+        session = EvalSession(Accuracy(), tmp_path / f"rank{rank}", keep_last=3)
+        cursors[rank] = session.resume()
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        run_virtual_ddp(2, phase2)
+    assert cursors[0] == cursors[1] == 2  # newest cursor both ranks hold
+
+
+def test_resume_accepts_pre_session_envelopes(tmp_path):
+    """Review fix: a journal seeded with plain save_envelope envelopes (no
+    embedded cursor) resumes via the manifest's cursor instead of failing
+    the strict key match on __session_cursor__."""
+    m = MeanSquaredError()
+    p, t = _reg_batches(1)[0]
+    m.update(p, t)
+    journal = reliability.CheckpointJournal(tmp_path / "j")
+    journal.commit(reliability.save_envelope(m), cursor=6)  # no cursor in payload
+
+    session = EvalSession(MeanSquaredError(), tmp_path / "j")
+    assert session.resume() == 6  # manifest cursor
+    np.testing.assert_array_equal(
+        np.asarray(session.metric.sum_squared_error), np.asarray(m.sum_squared_error)
+    )
+    assert session.step(6, p, t) is None  # replay guard honors it
+    assert session.step(7, p, t) is not None

@@ -251,3 +251,34 @@ def test_poison_helper_validates():
         fi.poison(jnp.zeros(3, jnp.int32))
     out = fi.poison(jnp.zeros(3), "inf", index=2)
     assert bool(jnp.isinf(out[2])) and bool(jnp.isfinite(out[0]))
+
+
+def test_keyboard_interrupt_inside_guarded_compiled_step_keeps_last_good_state():
+    """ISSUE 4 satellite: an operator ^C (KeyboardInterrupt — a
+    BaseException the engine must NOT swallow) landing inside a guarded
+    compiled step propagates, and the donated-copy guarantee keeps the
+    accumulated state at the last-good snapshot — the interrupted batch
+    simply never happened."""
+    batches = _batches(2)
+    col = MetricCollection([MeanSquaredError(), MeanAbsoluteError()], compiled=True)
+    col(*batches[0])  # warm step: real accumulated state
+    before = {
+        (k, s): np.array(np.asarray(getattr(m, s)))
+        for k, m in col.items()
+        for s in m._defaults
+    }
+    p, t = batches[1]
+    doubled = (jnp.concatenate([p, p]), jnp.concatenate([t, t]))  # new shape -> trace
+    with reliability.guard_scope("quarantine"):
+        with pytest.raises(KeyboardInterrupt):
+            with fi.failing_engine_compile(times=1, exc_type=KeyboardInterrupt):
+                col(*doubled)
+        # accumulated state is bit-identical to the pre-interrupt snapshot
+        for (k, s), want in before.items():
+            np.testing.assert_array_equal(
+                np.asarray(getattr(col[k], s)), want, err_msg=f"{k}.{s}"
+            )
+        # and the collection still works: the same batch replays cleanly
+        col(*doubled)
+    total = int(np.asarray(col["MeanSquaredError"].total))
+    assert total == batches[0][0].size + doubled[0].size

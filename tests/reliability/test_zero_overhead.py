@@ -145,3 +145,50 @@ def test_reliability_warnings_key_per_feature():
     # membership in the process-wide registry (not set difference): an
     # earlier chaos test may already have burned this key
     assert "guard-quarantine:MeanSquaredError" in _WARN_ONCE_SEEN
+
+
+def test_collection_outside_any_session_is_bit_identical_with_zero_session_counters():
+    """ISSUE 4 satellite (tier-1): a collection never constructed inside
+    an EvalSession runs bit-identically whether or not sessions exist in
+    the process, leaves its state_dict cursor-free, and generates ZERO
+    reliability.session_* counter activity."""
+    batches = _cls_batches()
+
+    control = _collection(compiled=True)
+    v_control = [control(p, t) for p, t in batches]
+    e_control = control.compute()
+
+    with obs.telemetry_scope():
+        # a live session elsewhere in the process must not perturb
+        # non-session collections (the hooks are object-scoped)
+        import tempfile
+
+        from metrics_tpu.reliability import EvalSession
+
+        with tempfile.TemporaryDirectory() as d:
+            unrelated = EvalSession(MeanSquaredError(), d, checkpoint_every=None)
+            bystander = _collection(compiled=True)
+            v_by = [bystander(p, t) for p, t in batches]
+            e_by = bystander.compute()
+        del unrelated
+
+        session_counters = {
+            k: v
+            for k, v in obs.get().counters.items()
+            if k.startswith("reliability.session_")
+        }
+    assert session_counters == {}, session_counters
+
+    for step, (va, vb) in enumerate(zip(v_control, v_by)):
+        for k in va:
+            np.testing.assert_array_equal(
+                np.asarray(va[k]), np.asarray(vb[k]), err_msg=f"step {step} {k}"
+            )
+    for k in e_control:
+        np.testing.assert_array_equal(
+            np.asarray(e_control[k]), np.asarray(e_by[k]), err_msg=k
+        )
+    # no cursor rides along for non-enrolled metrics
+    assert "__session_cursor__" not in bystander.state_dict()
+    for key in bystander.keys():
+        assert bystander[key]._session_cursor is None
