@@ -1,14 +1,26 @@
-.PHONY: ci test test-tpu test-tpu-suite doctest bench dryrun fuzz fuzz-sharded chaos clean
+.PHONY: ci lint test test-tpu test-tpu-suite doctest bench dryrun fuzz fuzz-sharded chaos clean
 
 ci:
 	# the full CI gate as one machine-runnable target (mirrors
 	# .github/workflows/ci.yml): lint -> suite (incl. doctests + api-surface
 	# guard) -> fuzz smoke -> multi-chip dryrun
 	python -m compileall -q metrics_tpu tests scripts bench.py tpu_correctness.py __graft_entry__.py
+	# lint-only: the suite runs the full program audit in-process
+	# (tests/analysis/test_lint_clean.py); `make lint` runs both passes
+	python scripts/lint_metrics.py --strict --skip-audit
 	python -m pytest tests/ -q
 	python scripts/fuzz_parity.py --trials 50
 	python scripts/fuzz_sharded.py --trials 25
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+lint:
+	# static analysis gate: pass 1 traces every metric family's program
+	# (accumulator dtypes, host sync, donation aliasing, reduction
+	# soundness), pass 2 lints the source tree for repo invariants;
+	# writes ANALYSIS.json atomically. Also pinned in tier-1 via
+	# tests/analysis/test_lint_clean.py. Rule catalog:
+	# docs/static_analysis.md
+	python scripts/lint_metrics.py --strict
 
 test:
 	# full suite: sklearn/scipy oracles + package doctests + 8-virtual-device

@@ -10,6 +10,7 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 import metrics_tpu
+import metrics_tpu.analysis as A
 import metrics_tpu.functional as F
 import metrics_tpu.observability as O
 import metrics_tpu.parallel as P
@@ -61,6 +62,14 @@ def main() -> None:
     ]
     lines += [f"- **`{n}`** — {d}" for n, d in _classes(R)]
     lines += [f"- **`{n}`** — {d}" for n, d in _functions(R)]
+    lines += ["", "## Static analysis (`metrics_tpu.analysis`)", ""]
+    lines += [
+        "See `docs/static_analysis.md` for the rule catalog (MTA001-MTA004,"
+        " MTL101-MTL104), suppression syntax, and the `make lint` gate.",
+        "",
+    ]
+    lines += [f"- **`{n}`** — {d}" for n, d in _classes(A)]
+    lines += [f"- **`{n}`** — {d}" for n, d in _functions(A)]
 
     out = os.path.join(os.path.dirname(os.path.abspath(__file__)), "api.md")
     with open(out, "w") as f:

@@ -1,0 +1,46 @@
+"""Static analysis for metric programs: catch the bad program before it
+dispatches, not after it corrupts an epoch.
+
+Two passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
+
+* **Pass 1 — program audit** (:mod:`metrics_tpu.analysis.program`):
+  abstractly traces each metric's ``update`` and, for engine-eligible
+  metrics, the actual donated step program, then walks the jaxpr for
+  accumulator dtype drift (MTA001), host synchronization (MTA002),
+  donated-buffer aliasing (MTA003), and unsound cross-replica reductions
+  (MTA004). ``audit_registry()`` runs it over every metric family.
+* **Pass 2 — repo-invariant lint** (:mod:`metrics_tpu.analysis.lint`):
+  AST checks over the ``metrics_tpu`` source tree — host ops in traced
+  paths (MTL101), bare ``jax.jit`` outside ``utilities/jit.py`` (MTL102),
+  step-rate warnings that bypass ``warn_once`` (MTL103), and array states
+  registered without a ``dist_reduce_fx`` (MTL104).
+
+Suppress a rule at a site with ``# metrics-tpu: allow(<RULE-ID>)``.
+``scripts/lint_metrics.py`` (and ``make lint``) run both passes and write
+``ANALYSIS.json``; a tier-1 test pins the zero-unsuppressed-findings
+baseline. Rule catalog and usage: ``docs/static_analysis.md``.
+"""
+from metrics_tpu.analysis.rules import RULES, Finding, Rule  # noqa: F401
+from metrics_tpu.analysis.program import (  # noqa: F401
+    AuditResult,
+    audit_collection,
+    audit_metric,
+    audit_registry,
+    hint_for_watch_key,
+    iter_eqns,
+)
+from metrics_tpu.analysis.lint import lint_file, lint_paths  # noqa: F401
+
+__all__ = [
+    "AuditResult",
+    "Finding",
+    "Rule",
+    "RULES",
+    "audit_collection",
+    "audit_metric",
+    "audit_registry",
+    "hint_for_watch_key",
+    "iter_eqns",
+    "lint_file",
+    "lint_paths",
+]

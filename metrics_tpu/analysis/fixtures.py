@@ -1,0 +1,152 @@
+"""Deliberately broken metrics: negative tests proving each analyzer rule
+fires.
+
+The mirror of :mod:`metrics_tpu.reliability.faultinject` for the static
+analyzer: faultinject injects runtime faults to prove the *dynamic*
+defenses catch them; these fixtures encode program-level defects to prove
+the *static* passes catch them before anything runs. Each fixture is
+surgical — it violates exactly one rule and is otherwise clean, so
+``tests/analysis`` can pin "this fixture trips this rule and nothing
+else".
+
+Never export these from the package root; they exist for the analyzer's
+test bed and for documentation of what each rule means in code.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.metric import Metric
+
+__all__ = [
+    "CallbackInJit",
+    "DonatedAlias",
+    "HostSyncUpdate",
+    "MeanWithoutCount",
+    "NarrowAccumulator",
+    "NonCommutativeMerge",
+    "SuppressedNarrowAccumulator",
+]
+
+
+class NarrowAccumulator(Metric):
+    """MTA001: a float16 accumulator fed float32 batches. One update
+    promotes the state to f32 (signature churn: every later step
+    recompiles) and the declared accumulator is narrower than its input
+    (precision loss)."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.zeros((), jnp.float16), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.acc = self.acc + jnp.sum(x)
+
+    def compute(self) -> jax.Array:
+        return self.acc
+
+
+class SuppressedNarrowAccumulator(NarrowAccumulator):
+    """The same defect with the rule suppressed — the suppression-syntax
+    fixture."""
+
+    # metrics-tpu: allow(MTA001) — deliberate: proves class-body
+    # suppression routes findings to the `suppressed` bucket
+
+
+class CallbackInJit(Metric):
+    """MTA002: a ``pure_callback`` in the update program. It traces fine —
+    and serializes every compiled dispatch on a host round-trip."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        total = jax.pure_callback(
+            lambda v: np.asarray(v, np.float32),
+            jax.ShapeDtypeStruct((), jnp.float32),
+            jnp.sum(x),
+        )
+        self.acc = self.acc + total
+
+    def compute(self) -> jax.Array:
+        return self.acc
+
+
+class HostSyncUpdate(Metric):
+    """MTA002 (concretization flavor): ``float()`` of a traced value in an
+    engine-eligible update. The first compiled step raises a tracer error
+    and silently demotes the metric to eager."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.acc = self.acc + float(jnp.sum(x))  # metrics-tpu: allow(MTL101)
+
+    def compute(self) -> jax.Array:
+        return self.acc
+
+
+class DonatedAlias(Metric):
+    """MTA003: one traced value assigned to two states. Under the engine's
+    donated dispatch the two outputs share one buffer — double-donation or
+    two live states aliasing the same storage."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("sum_a", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_b", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        total = jnp.sum(x)
+        self.sum_a = total
+        self.sum_b = total  # the alias: same jaxpr var as sum_a
+
+    def compute(self) -> jax.Array:
+        return self.sum_a
+
+
+class NonCommutativeMerge(Metric):
+    """MTA004: a custom ``dist_reduce_fx`` whose fold is order-dependent —
+    every replica layout merges to a different value."""
+
+    @staticmethod
+    def _subtract_reduce(stacked: jax.Array) -> jax.Array:
+        return stacked[0] - stacked[1:].sum(axis=0)
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx=self._subtract_reduce)
+
+    def update(self, x: jax.Array) -> None:
+        self.acc = self.acc + jnp.sum(x)
+
+    def compute(self) -> jax.Array:
+        return self.acc
+
+
+class MeanWithoutCount(Metric):
+    """MTA004 (mean flavor): a 'mean'-reduced state with no paired
+    sum-reduced count — mean-of-means is wrong whenever replicas see
+    different batch counts."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("avg", default=jnp.zeros(()), dist_reduce_fx="mean")
+
+    def update(self, x: jax.Array) -> None:
+        self.avg = jnp.mean(x)
+
+    def compute(self) -> jax.Array:
+        return self.avg

@@ -1,0 +1,476 @@
+"""Pass 2 — repo-invariant lint over the ``metrics_tpu`` source tree.
+
+Where the program audit (:mod:`metrics_tpu.analysis.program`) reasons
+about one traced program at a time, this pass enforces the *architectural*
+invariants that keep every future program auditable — shallow, syntactic,
+and designed for a zero-false-positive baseline:
+
+* **MTL101** — host ops (``np.*``, ``.item()``, ``float()/int()/bool()``
+  of traced values) inside jit-compiled functions or ``update`` methods.
+  The repo's eager-only value probes are exempt when guarded by
+  ``_is_concrete``/``debug_enabled`` (the established idiom), as are
+  reads of jit-static parameters (``static_argnames``) and of ``self``
+  configuration attributes.
+* **MTL102** — bare ``jax.jit`` anywhere outside ``utilities/jit.py``;
+  hot paths compile through :func:`metrics_tpu.utilities.jit.tpu_jit` so
+  compilation policy has one home.
+* **MTL103** — ``warnings.warn``/``rank_zero_warn`` inside update paths
+  (``update``/``forward`` methods, ``_*_update`` functionals); step-rate
+  warnings must rate-limit through ``warn_once``.
+* **MTL104** — ``add_state`` registering an array state without a
+  ``dist_reduce_fx`` (list states may omit it: rank-order concat is their
+  implied reduction).
+
+Suppression: ``# metrics-tpu: allow(MTL104)`` on the flagged line or the
+line directly above it.
+"""
+import ast
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
+
+from metrics_tpu.analysis.rules import (
+    CALLBACK_PRIMITIVES,
+    Finding,
+    parse_allow_comments,
+)
+
+__all__ = ["lint_file", "lint_paths", "lint_source", "default_lint_root"]
+
+_UPDATE_FUNCTIONAL_RE = re.compile(r"^_\w*_update$")
+_JIT_HOME = os.path.join("utilities", "jit.py")
+_CAST_BUILTINS = {"float", "int", "bool"}
+_CONCRETE_GUARDS = {"_is_concrete", "debug_enabled"}
+
+
+def default_lint_root() -> str:
+    """The package directory the repo gate lints."""
+    import metrics_tpu
+
+    return os.path.dirname(os.path.abspath(metrics_tpu.__file__))
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _guard_polarity(test: ast.AST) -> Optional[bool]:
+    """Which branch of ``if test:`` can only run on concrete values?
+
+    ``True``  — the test being true implies concreteness (guard the body):
+    a bare ``_is_concrete(...)``/``debug_enabled(...)`` call, or an ``and``
+    with such a conjunct. ``False`` — the test being *false* implies
+    concreteness (guard the orelse): ``not _is_concrete(...)``, or an
+    ``or`` with such a disjunct. ``None`` — neither branch is guarded
+    (e.g. ``_is_concrete(x) or flag``: the body still runs on tracers
+    whenever ``flag`` is true)."""
+    if isinstance(test, ast.Call) and _names_in(test.func) & _CONCRETE_GUARDS:
+        return True
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        inner = _guard_polarity(test.operand)
+        return None if inner is None else not inner
+    if isinstance(test, ast.BoolOp):
+        polarities = [_guard_polarity(v) for v in test.values]
+        if isinstance(test.op, ast.And) and True in polarities:
+            return True  # whole test true => the guarding conjunct held
+        if isinstance(test.op, ast.Or) and False in polarities:
+            return False  # whole test false => the guarding disjunct's
+            # operand held, so the orelse only runs concrete
+    return None
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and node.attr == "jit"
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "jax"
+    )
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """Does this expression name a jit entry point (``tpu_jit`` or
+    ``jax.jit``)?"""
+    if _is_jax_jit(node):
+        return True
+    return isinstance(node, ast.Name) and node.id == "tpu_jit"
+
+
+def _jit_decorator(dec: ast.AST) -> Optional[Tuple[Set[str], Set[int]]]:
+    """If ``dec`` jit-compiles the function, the static arguments it
+    declares as ``(names, positions)`` (either possibly empty); else None.
+    Covers ``@tpu_jit``, ``@tpu_jit(...)``, ``@partial(tpu_jit, ...)`` and
+    the bare ``jax.jit`` spellings of each; positions come from
+    ``static_argnums`` and are resolved against the decorated function's
+    own positional parameters by the caller."""
+    if _is_jit_expr(dec):
+        return set(), set()
+    if not isinstance(dec, ast.Call):
+        return None
+    target: Optional[ast.Call] = None
+    if _is_jit_expr(dec.func):
+        target = dec
+    elif (
+        isinstance(dec.func, ast.Name)
+        and dec.func.id in ("partial", "_partial")
+        and dec.args
+        and _is_jit_expr(dec.args[0])
+    ):
+        target = dec
+    if target is None:
+        return None
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in target.keywords:
+        if kw.arg not in ("static_argnames", "static_argnums"):
+            continue
+        values = (
+            kw.value.elts if isinstance(kw.value, (ast.Tuple, ast.List)) else [kw.value]
+        )
+        for elt in values:
+            if not isinstance(elt, ast.Constant):
+                continue
+            if isinstance(elt.value, str):
+                names.add(elt.value)
+            elif isinstance(elt.value, int):
+                nums.add(elt.value)
+    return names, nums
+
+
+class _Scope:
+    """One traced-path scope (a jitted function or an ``update`` method)."""
+
+    def __init__(self, kind: str, name: str, static_args: Set[str]):
+        self.kind = kind
+        self.name = name
+        self.static_args = static_args
+
+
+class _Linter(ast.NodeVisitor):
+    def __init__(self, rel_path: str, source: str):
+        self.rel_path = rel_path
+        self.findings: List[Finding] = []
+        self.numpy_aliases: Set[str] = set()
+        self.numpy_from_names: Set[str] = set()
+        self.warn_names: Set[str] = {"rank_zero_warn", "_warn"}
+        self._class_stack: List[str] = []
+        self._traced_stack: List[_Scope] = []
+        self._warnscope_stack: List[str] = []
+        self._guard_depth = 0
+
+    # -- bookkeeping ----------------------------------------------------
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        self.findings.append(Finding(
+            rule,
+            f"{self.rel_path}:{getattr(node, 'lineno', 0)}",
+            message,
+            detail={"line": getattr(node, "lineno", 0)},
+        ))
+
+    @property
+    def _traced(self) -> Optional[_Scope]:
+        return self._traced_stack[-1] if self._traced_stack else None
+
+    # -- imports: learn this module's numpy spelling --------------------
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name == "numpy":
+                self.numpy_aliases.add(alias.asname or "numpy")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "numpy" or (node.module or "").startswith("numpy."):
+            for alias in node.names:
+                self.numpy_from_names.add(alias.asname or alias.name)
+        self.generic_visit(node)
+
+    # -- scopes ---------------------------------------------------------
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _function_scopes(self, node: ast.FunctionDef) -> (Optional[_Scope], bool):
+        static: Optional[Set[str]] = None
+        for dec in node.decorator_list:
+            s = _jit_decorator(dec)
+            if s is not None:
+                names, nums = s
+                pos = [a.arg for a in node.args.posonlyargs + node.args.args]
+                names = names | {pos[i] for i in nums if 0 <= i < len(pos)}
+                static = names if static is None else static | names
+        traced: Optional[_Scope] = None
+        if static is not None:
+            traced = _Scope("jit", node.name, static)
+        elif self._class_stack and node.name == "update":
+            traced = _Scope("update-method", node.name, set())
+        hot_warn = (
+            (self._class_stack and node.name in ("update", "forward"))
+            or (not self._class_stack and _UPDATE_FUNCTIONAL_RE.match(node.name) is not None)
+        )
+        return traced, hot_warn
+
+    def _visit_function(self, node) -> None:
+        traced, hot_warn = self._function_scopes(node)
+        if traced is not None:
+            self._traced_stack.append(traced)
+        if hot_warn:
+            self._warnscope_stack.append(node.name)
+        guard_depth = self._guard_depth
+        self._guard_depth = 0  # guards don't cross function boundaries
+        self.generic_visit(node)
+        self._guard_depth = guard_depth
+        if hot_warn:
+            self._warnscope_stack.pop()
+        if traced is not None:
+            self._traced_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    # -- eager-only guard regions ---------------------------------------
+    def visit_If(self, node: ast.If) -> None:
+        polarity = _guard_polarity(node.test)
+        self.visit(node.test)
+        if polarity is True:
+            self._guard_depth += 1
+        for child in node.body:
+            self.visit(child)
+        if polarity is True:
+            self._guard_depth -= 1
+        if polarity is False:
+            self._guard_depth += 1
+        for child in node.orelse:
+            self.visit(child)
+        if polarity is False:
+            self._guard_depth -= 1
+
+    # -- the rules ------------------------------------------------------
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if _is_jax_jit(node) and not self.rel_path.replace(os.sep, "/").endswith(
+            _JIT_HOME.replace(os.sep, "/")
+        ):
+            self._emit(
+                "MTL102", node,
+                "bare `jax.jit`; compile through"
+                " `metrics_tpu.utilities.jit.tpu_jit` so compilation policy"
+                " has one home",
+            )
+        if (
+            self._traced is not None
+            and self._guard_depth == 0
+            and isinstance(node.value, ast.Name)
+            and node.value.id in self.numpy_aliases
+        ):
+            self._emit(
+                "MTL101", node,
+                f"`{node.value.id}.{node.attr}` inside traced scope"
+                f" `{self._traced.name}`: numpy executes on the host and"
+                " breaks (or silently constant-folds) the traced program",
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        # MTL103: step-rate warning without warn_once
+        if self._warnscope_stack:
+            warn_call = (
+                isinstance(func, ast.Name) and func.id in self.warn_names
+            ) or (
+                isinstance(func, ast.Attribute)
+                and func.attr == "warn"
+                and isinstance(func.value, ast.Name)
+                and func.value.id == "warnings"
+            )
+            if warn_call:
+                self._emit(
+                    "MTL103", node,
+                    f"unconditioned warning inside update path"
+                    f" `{self._warnscope_stack[-1]}` fires every step; use"
+                    " `warn_once` with a stable key",
+                )
+        # MTL104: add_state without a reduction
+        if isinstance(func, ast.Attribute) and func.attr == "add_state":
+            self._check_add_state(node)
+        # MTL101: host reads in traced scope
+        if self._traced is not None and self._guard_depth == 0:
+            if isinstance(func, ast.Attribute) and func.attr == "item" and not node.args:
+                self._emit(
+                    "MTL101", node,
+                    f"`.item()` inside traced scope `{self._traced.name}`"
+                    " forces a device->host sync (or a tracer error under"
+                    " jit)",
+                )
+            elif (
+                isinstance(func, ast.Name)
+                and func.id in _CAST_BUILTINS
+                and len(node.args) == 1
+                and not self._static_expr(node.args[0])
+            ):
+                self._emit(
+                    "MTL101", node,
+                    f"`{func.id}(...)` of a traced value inside"
+                    f" `{self._traced.name}` concretizes under jit; guard"
+                    " with `_is_concrete` or keep the value on device",
+                )
+            elif isinstance(func, ast.Name) and func.id in self.numpy_from_names:
+                self._emit(
+                    "MTL101", node,
+                    f"`{func.id}(...)` (imported from numpy) inside traced"
+                    f" scope `{self._traced.name}`: numpy executes on the"
+                    " host and breaks (or silently constant-folds) the"
+                    " traced program",
+                )
+        # a callback's function argument is host code BY CONTRACT — jax
+        # ships it to the host at run time, so host ops inside it are the
+        # point, not a leak (the callback call itself is pass 1's MTA002);
+        # both spellings count: `jax.pure_callback(...)` and a bare
+        # `pure_callback(...)` from `from jax import pure_callback`
+        callback_name = (
+            func.attr if isinstance(func, ast.Attribute)
+            else func.id if isinstance(func, ast.Name) else None
+        )
+        if callback_name in CALLBACK_PRIMITIVES and node.args:
+            self.visit(func)
+            for arg in node.args[1:]:
+                self.visit(arg)
+            for kw in node.keywords:
+                self.visit(kw)
+            return
+        self.generic_visit(node)
+
+    def _static_expr(self, node: ast.AST) -> bool:
+        """True when the expression provably involves no traced values:
+        literals, jit-static parameters, trace-static metadata reads
+        (`x.shape`/`x.ndim`/`x.size`/`x.dtype` — static under jit even on
+        tracers), and `self.<attr>` configuration reads (metric
+        hyper-parameters, never array state in update signatures' hot
+        path... state reads are `self.<state>` too, so casts of self
+        attributes are accepted — the program audit (pass 1) catches a
+        genuine state concretization dynamically)."""
+        scope = self._traced
+        static_names = scope.static_args if scope is not None else set()
+        shape_builtins = _CAST_BUILTINS | {"len", "max", "min"}
+        static_attrs = {"shape", "ndim", "size", "dtype"}
+        # the gate is name/call based: an expression is static iff every
+        # Name it references is a jit-static parameter, `self`, one of the
+        # shape-arithmetic builtins, or the base of a static metadata read,
+        # and every call it makes is such a builtin or a `self.<method>()`;
+        # all other node kinds (constants, arithmetic) carry no traced
+        # values of their own
+        stack: List[ast.AST] = [node]
+        while stack:
+            n = stack.pop()
+            if (
+                isinstance(n, ast.Attribute)
+                and n.attr in static_attrs
+                and isinstance(n.value, ast.Name)
+            ):
+                continue  # x.shape etc.: don't descend into the base name
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Name)
+                and n.func.id == "len"
+            ):
+                # len(...) always yields a python int — on a tracer it
+                # reads shape[0], static under jit like `.shape` itself;
+                # don't descend into the (possibly traced) argument
+                continue
+            if isinstance(n, ast.Name):
+                if n.id not in static_names | {"self"} | shape_builtins:
+                    return False
+            elif isinstance(n, ast.Call):
+                fn = n.func
+                ok = (
+                    isinstance(fn, ast.Name) and fn.id in shape_builtins
+                ) or (isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name)
+                      and fn.value.id == "self")
+                if not ok:
+                    return False
+            stack.extend(ast.iter_child_nodes(n))
+        return True
+
+    def _check_add_state(self, node: ast.Call) -> None:
+        default: Optional[ast.AST] = None
+        reduction: Optional[ast.AST] = None
+        have_reduction = False
+        if len(node.args) >= 2:
+            default = node.args[1]
+        if len(node.args) >= 3:
+            reduction, have_reduction = node.args[2], True
+        for kw in node.keywords:
+            if kw.arg == "default":
+                default = kw.value
+            elif kw.arg == "dist_reduce_fx":
+                reduction, have_reduction = kw.value, True
+        if isinstance(default, ast.List) and not default.elts:
+            return  # list state: rank-order concat is the implied reduction
+        is_none = isinstance(reduction, ast.Constant) and reduction.value is None
+        if not have_reduction or is_none:
+            self._emit(
+                "MTL104", node,
+                "array state registered without a `dist_reduce_fx`:"
+                " cross-replica sync would leave a stacked (world, ...)"
+                " array (list states may omit it; everything else must"
+                " declare its merge)",
+            )
+
+
+def lint_source(source: str, rel_path: str) -> List[Finding]:
+    """Lint one module's source text; ``rel_path`` labels findings and
+    decides path-scoped rules (MTL102's ``utilities/jit.py`` home)."""
+    tree = ast.parse(source, filename=rel_path)
+    linter = _Linter(rel_path, source)
+    linter.visit(tree)
+    allow = dict(parse_allow_comments(source))
+    # an allow comment opening a comment block suppresses the first code
+    # line after the block (multi-line rationales are the norm): propagate
+    # each comment's rules downward through consecutive comment-only lines
+    lines = source.splitlines()
+    for lineno in sorted(allow):
+        cursor = lineno
+        while cursor <= len(lines) and lines[cursor - 1].lstrip().startswith("#"):
+            cursor += 1
+        if cursor != lineno:
+            allow.setdefault(cursor, set())
+            allow[cursor] |= allow[lineno]
+    findings: List[Finding] = []
+    for f in linter.findings:
+        line = f.detail.get("line", 0)
+        allowed = allow.get(line, set()) | allow.get(line - 1, set())
+        if f.rule in allowed:
+            f.suppressed = True
+        findings.append(f)
+    return findings
+
+
+def lint_file(path: str, root: Optional[str] = None) -> List[Finding]:
+    """Lint one file from disk; findings are labeled relative to ``root``."""
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    rel = os.path.relpath(path, root) if root else path
+    return lint_source(source, rel)
+
+
+def lint_paths(
+    paths: Optional[Sequence[str]] = None,
+    root: Optional[str] = None,
+    include_suppressed: bool = True,
+) -> List[Finding]:
+    """Lint a set of files (default: every ``.py`` under the installed
+    ``metrics_tpu`` package), sorted by path. Suppressed findings are
+    included (flagged) unless ``include_suppressed=False``."""
+    if paths is None:
+        root = root or default_lint_root()
+        paths = []
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            paths.extend(
+                os.path.join(dirpath, f) for f in sorted(filenames) if f.endswith(".py")
+            )
+    out: List[Finding] = []
+    for p in sorted(paths):
+        out.extend(lint_file(p, root=root or default_lint_root()))
+    if not include_suppressed:
+        out = [f for f in out if not f.suppressed]
+    return out

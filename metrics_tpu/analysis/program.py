@@ -1,0 +1,673 @@
+"""Pass 1 — trace-time audit of metric programs (jaxpr level).
+
+The runtime promises every metric safe accumulation, sound cross-replica
+reduction, and donation-safe device placement — and today enforces them
+*dynamically*: StateGuard catches the NaN after it lands, the watchdog
+counts the retrace after it happened, the engine demotes to eager after a
+dispatch dies. This pass proves (or refutes) the same contracts **before
+dispatch** by tracing each metric's program abstractly — the reasoning
+EQuARX applies to quantized all-reduce soundness and weight-update sharding
+applies to sharded update programs, pointed at our ``dist_reduce_fx``
+merges and donated engine buffers.
+
+What it traces, per metric:
+
+* ``update`` on fresh default state with representative batch inputs
+  (``jax.make_jaxpr(..., return_shape=True)``) — one abstract trace, no
+  device math;
+* for engine-eligible metrics, the **actual compiled step program** via
+  :meth:`CompiledStepEngine.abstract_step` — shared canonicalization,
+  update, batch-local compute, and the reduction merge, exactly what a
+  production step dispatches.
+
+The jaxpr walker (:func:`iter_eqns`) recurses into every sub-jaxpr —
+``pjit`` bodies, ``scan`` carries, ``cond``/``while`` branches — so a
+callback hidden three layers deep is still found.
+
+Metrics that are *eager-only by design* (list/"cat" states, host-side
+densification) are not traced against compiled-path rules: their update
+programs never run under jit, so a host op there is architecture, not a
+violation. They are reported as ``infos`` for visibility.
+
+:func:`audit_registry` runs the audit over every metric family in
+:func:`registry_cases` (the same ~29-family universe the reliability
+round-trip bed covers) and emits a JSON-able report; ``scripts/lint_metrics.py``
+writes it to ``ANALYSIS.json`` and CI pins the clean baseline.
+"""
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.analysis.rules import (
+    CALLBACK_PRIMITIVES as _CALLBACK_PRIMITIVES,
+    RULES,
+    Finding,
+    class_allowed_rules,
+    state_allowed_rules,
+)
+from metrics_tpu.utilities.data import (
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+
+__all__ = [
+    "AuditResult",
+    "audit_collection",
+    "audit_metric",
+    "audit_registry",
+    "hint_for_watch_key",
+    "iter_eqns",
+]
+
+Array = jax.Array
+
+# names that mark a sum-reduced companion count for a "mean" state
+_COUNT_STATE_HINTS = ("total", "count", "n_obs", "num", "weight", "denom", "support")
+
+_KNOWN_REDUCTIONS = {
+    dim_zero_sum: "sum",
+    dim_zero_mean: "mean",
+    dim_zero_cat: "cat",
+    dim_zero_min: "min",
+    dim_zero_max: "max",
+}
+
+
+# ---------------------------------------------------------------------------
+# jaxpr plumbing
+# ---------------------------------------------------------------------------
+def _sub_jaxprs(params: Dict[str, Any]) -> Iterator[Any]:
+    """Every Jaxpr nested in an equation's params — covers ``pjit``
+    (``jaxpr``), ``scan`` (``jaxpr``), ``cond`` (``branches``),
+    ``while`` (``cond_jaxpr``/``body_jaxpr``) and anything future that
+    stores (Closed)Jaxprs in params, by duck-typing instead of a
+    primitive-name allowlist."""
+    stack = list(params.values())
+    while stack:
+        v = stack.pop()
+        if hasattr(v, "eqns") and hasattr(v, "invars"):  # core.Jaxpr
+            yield v
+        elif hasattr(v, "jaxpr") and hasattr(v, "consts"):  # core.ClosedJaxpr
+            yield v.jaxpr
+        elif isinstance(v, (tuple, list)):
+            stack.extend(v)
+
+
+def iter_eqns(jaxpr: Any) -> Iterator[Any]:
+    """Depth-first walk over every equation of ``jaxpr`` including all
+    nested sub-jaxprs (pjit/scan/cond/while bodies)."""
+    if hasattr(jaxpr, "jaxpr"):  # accept ClosedJaxpr too
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn.params):
+            yield from iter_eqns(sub)
+
+
+def _callback_eqns(closed: Any) -> List[str]:
+    return [e.primitive.name for e in iter_eqns(closed) if e.primitive.name in _CALLBACK_PRIMITIVES]
+
+
+def _duplicate_outvars(closed: Any) -> List[Tuple[int, List[int]]]:
+    """Output positions backed by one jaxpr variable: ``(var_count,
+    positions)`` for every var appearing in more than one output leaf.
+    With donation, two outputs sharing a buffer either double-donate or
+    leave two live states aliased."""
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    seen: Dict[Any, List[int]] = {}
+    for pos, v in enumerate(jaxpr.outvars):
+        if type(v).__name__ == "Literal":
+            continue
+        seen.setdefault(v, []).append(pos)
+    return [(len(p), p) for v, p in seen.items() if len(p) > 1]
+
+
+def _trace_error_kind(err: BaseException) -> Optional[str]:
+    """Classify a trace failure: concretization-family errors are host
+    syncs (``.item()``/``float()``-shaped reads of traced values);
+    anything else is a generic trace failure."""
+    import jax.errors as je
+
+    host_sync = (
+        je.ConcretizationTypeError,
+        je.TracerArrayConversionError,
+        je.TracerBoolConversionError,
+        je.TracerIntegerConversionError,
+        je.NonConcreteBooleanIndexError,
+    )
+    return "host-sync" if isinstance(err, host_sync) else "trace-failure"
+
+
+# ---------------------------------------------------------------------------
+# single-metric audit
+# ---------------------------------------------------------------------------
+@dataclass
+class AuditResult:
+    """Findings for one metric program."""
+
+    name: str
+    engine_eligible: bool
+    eager_reason: Optional[str] = None
+    findings: List[Finding] = field(default_factory=list)
+    suppressed: List[Finding] = field(default_factory=list)
+    infos: List[str] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "name": self.name,
+            "engine_eligible": self.engine_eligible,
+            "eager_reason": self.eager_reason,
+            "findings": [f.to_dict() for f in self.findings],
+            "suppressed": [f.to_dict() for f in self.suppressed],
+            "infos": list(self.infos),
+        }
+
+
+def _update_program(metric) -> Callable:
+    """The metric's update as a pure ``states, args, kwargs -> new_states``
+    function (the same temporary-attribute-mutation reuse the engine's
+    step function performs), restorable even when tracing raises."""
+
+    def fn(states, args, kwargs):
+        saved = metric._snapshot_state()
+        try:
+            for k, v in states.items():
+                setattr(metric, k, v)
+            metric.update(*args, **metric._filter_kwargs(**kwargs))
+            return {k: getattr(metric, k) for k in metric._defaults}
+        finally:
+            metric._restore_state(saved)
+            metric._computed = None
+
+    return fn
+
+
+def _default_states(metric) -> Dict[str, Any]:
+    return {
+        k: ([] if isinstance(d, list) else d) for k, d in metric._defaults.items()
+    }
+
+
+def _widest_float_input(args: tuple, kwargs: dict) -> Optional[Any]:
+    widest = None
+    for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and jnp.issubdtype(dt, jnp.floating):
+            if widest is None or jnp.dtype(dt).itemsize > jnp.dtype(widest).itemsize:
+                widest = jnp.dtype(dt)
+    return widest
+
+
+def _audit_reductions(metric, findings: List[Finding]) -> None:
+    """MTA004: is every declared ``dist_reduce_fx`` a sound cross-replica
+    merge for its state?"""
+    cls = type(metric).__name__
+    reductions = metric._reductions
+    has_paired_count = any(
+        reductions.get(s) is dim_zero_sum and any(h in s.lower() for h in _COUNT_STATE_HINTS)
+        for s in metric._defaults
+    )
+    for sname, red in reductions.items():
+        default = metric._defaults[sname]
+        is_list = isinstance(default, list)
+        subject = f"{cls}.{sname}"
+        if red is None:
+            if not is_list:
+                findings.append(Finding(
+                    "MTA004", subject,
+                    "array state declares no dist_reduce_fx; cross-replica sync"
+                    " would leave it as a stacked (world, ...) array",
+                ))
+            continue  # list state: rank-order concat is the implied reduction
+        kind = _KNOWN_REDUCTIONS.get(red)
+        if kind == "mean":
+            if not has_paired_count:
+                findings.append(Finding(
+                    "MTA004", subject,
+                    "'mean' reduction with no paired sum-reduced count state:"
+                    " mean-of-means is wrong whenever replicas see different"
+                    " batch counts",
+                ))
+        elif kind is None:  # custom callable: probe commutativity
+            note = _commutativity_probe(red, default)
+            if note is not None:
+                findings.append(Finding("MTA004", subject, note))
+        if metric._fused_forward and not is_list and not type(metric)._merge_reduction_supported(red):
+            findings.append(Finding(
+                "MTA004", subject,
+                f"fused-forward metric declares a non-mergeable"
+                f" '{kind or getattr(red, '__name__', red)}' reduction; the"
+                " one-update forward's (accumulated, batch) fold is undefined"
+                " for it",
+            ))
+    # cat-state metrics must demote in compiled engines, never compile
+    from metrics_tpu.engine import CompiledStepEngine
+
+    has_list_state = any(isinstance(d, list) for d in metric._defaults.values())
+    if has_list_state and CompiledStepEngine._static_ineligibility(metric) is None:
+        findings.append(Finding(
+            "MTA004", cls,
+            "cat-state metric reports as engine-compilable; per-step list"
+            " growth cannot run as a fixed-signature donated program",
+        ))
+
+
+def _commutativity_probe(red: Callable, default: Any) -> Optional[str]:
+    """Property-probe a custom reduction on a stacked 2-replica state:
+    ``red(stack([a, b]))`` must equal ``red(stack([b, a]))`` (two-element
+    folds of IEEE sum/min/max are bitwise order-independent, so a mismatch
+    is structural, not rounding)."""
+    if isinstance(default, list):
+        return None  # list states concat rank-ordered; custom fx sees the flat list
+    rng = np.random.RandomState(0xA4)
+    shape = tuple(jnp.shape(default))
+    dtype = jnp.asarray(default).dtype
+    if jnp.issubdtype(dtype, jnp.floating):
+        a = jnp.asarray(rng.rand(*((2,) + shape)).astype(np.float32) + 0.25, dtype)
+    else:
+        a = jnp.asarray(rng.randint(1, 17, size=(2,) + shape), dtype)
+    try:
+        fwd = red(a)
+        rev = red(a[::-1])
+    except Exception as err:  # noqa: BLE001 — probe must never crash the audit
+        return (
+            f"custom reduction {getattr(red, '__name__', red)!r} failed the"
+            f" commutativity probe outright ({type(err).__name__}: {err})"
+        )
+    if not np.allclose(np.asarray(fwd), np.asarray(rev), equal_nan=True):
+        return (
+            f"custom reduction {getattr(red, '__name__', red)!r} is"
+            " order-dependent: red(stack([a, b])) != red(stack([b, a])), so"
+            " every replica layout computes a different merged state"
+        )
+    return None
+
+
+def _audit_traced_update(metric, args: tuple, kwargs: dict, findings: List[Finding],
+                         infos: List[str], traceable_contract: bool) -> None:
+    """Trace ``update`` abstractly; apply MTA001/MTA002/MTA003 to the
+    resulting jaxpr. ``traceable_contract`` is True when this metric claims
+    it can run compiled (then any trace failure is a violation, not a
+    design note)."""
+    cls = type(metric).__name__
+    states = _default_states(metric)
+    try:
+        closed, out_shape = jax.make_jaxpr(
+            _update_program(metric), return_shape=True
+        )(states, args, kwargs)
+    except Exception as err:  # noqa: BLE001 — classify below
+        kind = _trace_error_kind(err)
+        msg = str(err).splitlines()[0] if str(err) else type(err).__name__
+        if traceable_contract:
+            findings.append(Finding(
+                "MTA002", f"{cls}.update",
+                ("host synchronization while tracing update"
+                 if kind == "host-sync" else "update failed to trace")
+                + f" ({type(err).__name__}: {msg}); the first compiled step"
+                " will silently demote this metric to eager",
+                detail={"kind": kind},
+            ))
+        else:
+            infos.append(
+                f"{cls}.update is untraceable ({type(err).__name__});"
+                " eager-only by design, compiled-path rules not applied"
+            )
+        return
+
+    # compiled-path rules only bind metrics that claim they can compile:
+    # an eager-only metric's update never runs as a donated jitted program,
+    # so a callback there is architecture and aliasing is harmless sharing
+    callbacks = _callback_eqns(closed)
+    if traceable_contract:
+        if callbacks:
+            findings.append(Finding(
+                "MTA002", f"{cls}.update",
+                f"host callback primitive(s) {sorted(set(callbacks))} inside the"
+                " traced update program; every step dispatch will block on the"
+                " host",
+                detail={"primitives": sorted(set(callbacks))},
+            ))
+
+        for count, positions in _duplicate_outvars(closed):
+            findings.append(Finding(
+                "MTA003", f"{cls}.update",
+                f"one buffer is aliased into {count} state outputs (output"
+                f" positions {positions}); donation would double-donate it or"
+                " leave live states sharing storage",
+            ))
+    elif callbacks:
+        infos.append(
+            f"{cls}.update contains host callback(s)"
+            f" {sorted(set(callbacks))}; eager-only by design, so the"
+            " compiled-path MTA002 rule is not applied"
+        )
+
+    widest_in = _widest_float_input(args, kwargs)
+    for sname, default in metric._defaults.items():
+        if isinstance(default, list):
+            continue
+        out = out_shape[sname]
+        in_aval = jnp.asarray(default).aval
+        if out.dtype != in_aval.dtype:
+            findings.append(Finding(
+                "MTA001", f"{cls}.{sname}",
+                f"state dtype drifts {in_aval.dtype} -> {out.dtype} across one"
+                " update: every later step sees a new input signature and"
+                " recompiles",
+                detail={"before": str(in_aval.dtype), "after": str(out.dtype)},
+            ))
+        elif bool(getattr(out, "weak_type", False)) != bool(in_aval.weak_type):
+            findings.append(Finding(
+                "MTA001", f"{cls}.{sname}",
+                f"state weak_type flips {in_aval.weak_type} -> "
+                f"{bool(out.weak_type)} across one update (silent weak-type"
+                " promotion): signature churn the watchdog only sees after"
+                " the fact",
+            ))
+        if (
+            widest_in is not None
+            and jnp.issubdtype(in_aval.dtype, jnp.floating)
+            and jnp.dtype(in_aval.dtype).itemsize < jnp.dtype(widest_in).itemsize
+        ):
+            findings.append(Finding(
+                "MTA001", f"{cls}.{sname}",
+                f"floating accumulator ({in_aval.dtype}) is narrower than the"
+                f" floating input it accumulates ({widest_in}): precision is"
+                " silently destroyed at accumulation",
+                detail={"state": str(in_aval.dtype), "input": str(widest_in)},
+            ))
+
+
+def _audit_engine_program(metric, args: tuple, kwargs: dict, findings: List[Finding]) -> None:
+    """Trace the *actual* donated step program (update + batch-local
+    compute + merge) and audit it: callbacks (MTA002) and donated-buffer
+    aliasing across outputs (MTA003)."""
+    from metrics_tpu.engine import CompiledStepEngine
+
+    cls = type(metric).__name__
+    engine = CompiledStepEngine(metric, observe=False)
+    try:
+        closed, _out_shape, _n_donated = engine.abstract_step(*args, **kwargs)
+    except Exception as err:  # noqa: BLE001
+        kind = _trace_error_kind(err)
+        msg = str(err).splitlines()[0] if str(err) else type(err).__name__
+        findings.append(Finding(
+            "MTA002", f"{cls}.step",
+            ("host synchronization while tracing the compiled step"
+             if kind == "host-sync" else "compiled step failed to trace")
+            + f" ({type(err).__name__}: {msg}); the engine will demote this"
+            " metric to eager on its first dispatch",
+            detail={"kind": kind},
+        ))
+        return
+
+    callbacks = _callback_eqns(closed)
+    if callbacks:
+        findings.append(Finding(
+            "MTA002", f"{cls}.step",
+            f"host callback primitive(s) {sorted(set(callbacks))} inside the"
+            " compiled step program",
+            detail={"primitives": sorted(set(callbacks))},
+        ))
+    for count, positions in _duplicate_outvars(closed):
+        findings.append(Finding(
+            "MTA003", f"{cls}.step",
+            f"one buffer is aliased into {count} outputs of the donated step"
+            f" program (output positions {positions}): donation double-books"
+            " the buffer (state/state or state/batch-value alias)",
+        ))
+
+
+def audit_metric(metric, args: Sequence[Any] = (), kwargs: Optional[dict] = None) -> AuditResult:
+    """Run the full pass-1 audit over one metric with representative
+    batch inputs.
+
+    Rules applied: MTA001 (accumulator dtype), MTA002 (host sync in traced
+    regions), MTA003 (donation aliasing), MTA004 (reduction soundness).
+    Suppression: any rule named in a ``# metrics-tpu: allow(...)`` comment
+    at class-body level (or in an iterable ``_analysis_allow`` attribute)
+    is reported under ``suppressed`` instead of ``findings``; a mapping
+    ``_analysis_allow = {rule_id: (state_name, ...)}`` — on the class or
+    set per-instance by state-registration code — suppresses a rule for
+    exactly the named states.
+    """
+    from metrics_tpu.engine import CompiledStepEngine
+
+    args = tuple(args)
+    kwargs = dict(kwargs or {})
+    cls = type(metric).__name__
+    eager_reason = CompiledStepEngine._static_ineligibility(metric)
+    result = AuditResult(name=cls, engine_eligible=eager_reason is None, eager_reason=eager_reason)
+
+    findings: List[Finding] = []
+    _audit_reductions(metric, findings)
+    _audit_traced_update(metric, args, kwargs, findings, result.infos,
+                         traceable_contract=eager_reason is None)
+    if eager_reason is None:
+        _audit_engine_program(metric, args, kwargs, findings)
+    elif not any(isinstance(d, list) for d in metric._defaults.values()):
+        result.infos.append(f"{cls} runs eager in engines: {eager_reason}")
+
+    allowed = class_allowed_rules(type(metric))
+    scoped = state_allowed_rules(metric)  # instance-resolved: dynamic states
+    for f in findings:
+        state = f.subject.split(".", 1)[1] if "." in f.subject else None
+        if f.rule in allowed or (state is not None and state in scoped.get(f.rule, ())):
+            f.suppressed = True
+            result.suppressed.append(f)
+        else:
+            result.findings.append(f)
+    _note_audit(cls, result)
+    return result
+
+
+def audit_collection(collection, args: Sequence[Any] = (), kwargs: Optional[dict] = None) -> Dict[str, Any]:
+    """Audit every member of a :class:`~metrics_tpu.MetricCollection` plus
+    the cross-metric compiled step program a ``compiled=True`` forward
+    would dispatch (one donated program over ALL compilable members —
+    the surface where a buffer aliased *between* metrics double-donates).
+
+    Returns ``{"members": {name: AuditResult}, "engine": [Finding, ...],
+    "eager_fallbacks": {name: reason}}``.
+    """
+    from metrics_tpu.engine import CompiledStepEngine
+
+    args = tuple(args)
+    kwargs = dict(kwargs or {})
+    members = {
+        name: audit_metric(m, args, kwargs) for name, m in collection.items()
+    }
+    # audit_metric registers results by class name; engine watch keys for
+    # collections are built from the collection's own keys ("engine[acc,mse]"
+    # when members carry custom names), so register under those too or the
+    # watchdog cross-link silently never resolves for renamed members
+    for name, result in members.items():
+        _note_audit(name, result)
+    engine_findings: List[Finding] = []
+    engine = CompiledStepEngine(dict(collection.items()), observe=False)
+    if engine._compiled_names():
+        names = "+".join(engine._compiled_names())
+        try:
+            closed, _shapes, _n_donated = engine.abstract_step(*args, **kwargs)
+        except Exception as err:  # noqa: BLE001
+            msg = str(err).splitlines()[0] if str(err) else type(err).__name__
+            engine_findings.append(Finding(
+                "MTA002", f"collection[{names}].step",
+                f"collection step failed to trace ({type(err).__name__}:"
+                f" {msg}); a compiled collection forward will demote these"
+                " members to eager",
+            ))
+        else:
+            for prim in sorted(set(_callback_eqns(closed))):
+                engine_findings.append(Finding(
+                    "MTA002", f"collection[{names}].step",
+                    f"host callback primitive {prim!r} inside the compiled"
+                    " collection step",
+                ))
+            for count, positions in _duplicate_outvars(closed):
+                engine_findings.append(Finding(
+                    "MTA003", f"collection[{names}].step",
+                    f"one buffer aliased into {count} outputs of the donated"
+                    f" collection step (positions {positions}) — possibly"
+                    " across two member metrics",
+                ))
+    return {
+        "members": members,
+        "engine": engine_findings,
+        "eager_fallbacks": engine.eager_fallbacks,
+    }
+
+
+# ---------------------------------------------------------------------------
+# the registry: one representative config per metric family
+# ---------------------------------------------------------------------------
+def _registry_cases() -> List[Tuple[str, Callable, tuple]]:
+    """(family, factory, sample update args) — the same ~29-family universe
+    the reliability round-trip bed pins, deterministic inputs."""
+    import metrics_tpu as M
+
+    rng = np.random.RandomState(0x7B0)
+    n, c = 32, 4
+    probs = rng.rand(n, c).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    mc = (jnp.asarray(probs), jnp.asarray(rng.randint(c, size=n)))
+    binary = (jnp.asarray(probs[:, 1]), jnp.asarray(rng.randint(2, size=n)))
+    reg = (
+        jnp.asarray(rng.rand(n).astype(np.float32)),
+        jnp.asarray(rng.rand(n).astype(np.float32)),
+    )
+    ret = (
+        jnp.asarray(rng.randint(6, size=n)),
+        jnp.asarray(rng.rand(n).astype(np.float32)),
+        jnp.asarray(rng.randint(2, size=n)),
+    )
+    hinge = (jnp.asarray(rng.randn(n).astype(np.float32)), binary[1])
+    curve = (jnp.linspace(0.0, 1.0, 16), jnp.linspace(0.0, 1.0, 16))
+    return [
+        ("Accuracy", M.Accuracy, mc),
+        ("Precision", lambda: M.Precision(num_classes=c, average="macro"), mc),
+        ("Recall", lambda: M.Recall(num_classes=c, average="macro"), mc),
+        ("F1", lambda: M.F1(num_classes=c, average="macro"), mc),
+        ("FBeta", lambda: M.FBeta(num_classes=c, beta=0.5, average="macro"), mc),
+        ("StatScores", lambda: M.StatScores(reduce="micro"), mc),
+        ("ConfusionMatrix", lambda: M.ConfusionMatrix(num_classes=c), mc),
+        ("IoU", lambda: M.IoU(num_classes=c), mc),
+        ("MatthewsCorrcoef", lambda: M.MatthewsCorrcoef(num_classes=c), mc),
+        ("CohenKappa", lambda: M.CohenKappa(num_classes=c), mc),
+        ("HammingDistance", M.HammingDistance, binary),
+        ("Hinge", M.Hinge, hinge),
+        ("AUROC", M.AUROC, binary),
+        ("AveragePrecision", M.AveragePrecision, binary),
+        ("PrecisionRecallCurve", M.PrecisionRecallCurve, binary),
+        ("ROC", M.ROC, binary),
+        ("AUC", lambda: M.AUC(reorder=True), curve),
+        ("BinnedAUROC", lambda: M.BinnedAUROC(num_bins=16), binary),
+        ("BinnedAveragePrecision", lambda: M.BinnedAveragePrecision(num_bins=16), binary),
+        ("MeanSquaredError", M.MeanSquaredError, reg),
+        ("MeanAbsoluteError", M.MeanAbsoluteError, reg),
+        ("MeanSquaredLogError", M.MeanSquaredLogError, reg),
+        ("R2Score", M.R2Score, reg),
+        ("ExplainedVariance", M.ExplainedVariance, reg),
+        ("PSNR", lambda: M.PSNR(data_range=1.0), reg),
+        ("RetrievalMAP", M.RetrievalMAP, ret),
+        ("RetrievalMRR", M.RetrievalMRR, ret),
+        ("RetrievalPrecision", lambda: M.RetrievalPrecision(k=2), ret),
+        ("RetrievalRecall", lambda: M.RetrievalRecall(k=2), ret),
+    ]
+
+
+_REGISTRY_CACHE: List[Tuple[str, Callable, tuple]] = []
+
+
+def registry_cases() -> List[Tuple[str, Callable, tuple]]:
+    """The audited family universe, ``(family, factory, sample args)``.
+    Built lazily on first call: importing the analyzer must not import
+    every metric family (the watchdog cross-link imports this module
+    before the package finishes initializing)."""
+    if not _REGISTRY_CACHE:
+        _REGISTRY_CACHE.extend(_registry_cases())
+    return list(_REGISTRY_CACHE)
+
+
+def audit_registry(write_path: Optional[str] = None) -> Dict[str, Any]:
+    """Pass 1 over every registered metric family; returns (and optionally
+    atomically writes) the JSON report CI pins.
+
+    The clean-baseline contract: ``report["summary"]["findings"] == 0``.
+    Suppressed findings and design notes (eager-only families) stay
+    visible in the report without failing the gate.
+    """
+    families: Dict[str, Any] = {}
+    totals = {"findings": 0, "suppressed": 0}
+    for name, factory, args in registry_cases():
+        result = audit_metric(factory(), args)
+        families[name] = result.to_dict()
+        totals["findings"] += len(result.findings)
+        totals["suppressed"] += len(result.suppressed)
+    report = {
+        "schema": "metrics_tpu.analysis_report",
+        "version": 1,
+        "rules": {rid: r.to_dict() for rid, r in sorted(RULES.items())},
+        "families": families,
+        "summary": {
+            "families": len(families),
+            "findings": totals["findings"],
+            "suppressed": totals["suppressed"],
+        },
+    }
+    if write_path is not None:
+        from metrics_tpu.reliability.journal import atomic_write_json
+
+        atomic_write_json(write_path, report)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# watchdog cross-link
+# ---------------------------------------------------------------------------
+# class name -> unsuppressed findings from the most recent audit of that
+# class (any entry point: audit_metric, audit_registry, tests). The
+# RecompilationWatchdog consults this when it fires so its warning can name
+# the analyzer rule likely responsible for the churn it observed.
+_LAST_AUDIT: Dict[str, List[Finding]] = {}
+
+
+def _note_audit(cls_name: str, result: AuditResult) -> None:
+    _LAST_AUDIT[cls_name] = list(result.findings)
+
+
+def hint_for_watch_key(key: str) -> Optional[str]:
+    """A one-line analyzer attribution for a watchdog key (an engine label
+    like ``engine[Accuracy,MeanSquaredError]`` or a bare metric-class
+    name), or None when the last audit holds nothing relevant. MTA001
+    findings front the list: signature churn is exactly what the watchdog
+    measures.
+
+    Best-effort by construction: the lookup is keyed by bare class name
+    and reflects the *most recent* audit of any class with that name —
+    two same-named classes collide, and a finding fixed in source still
+    hints until the class is re-audited. The hint's "a likely cause"
+    phrasing is the contract; treat it as a lead, not a verdict."""
+    inner = key
+    if "[" in key and key.endswith("]"):
+        inner = key[key.index("[") + 1:-1]
+    names = [p.strip() for p in inner.split(",") if p.strip()]
+    relevant: List[Finding] = []
+    for n in names:
+        relevant.extend(_LAST_AUDIT.get(n, ()))
+    if not relevant:
+        return None
+    relevant.sort(key=lambda f: (f.rule != "MTA001", f.rule))
+    f = relevant[0]
+    slug = RULES[f.rule].slug if f.rule in RULES else ""
+    more = f" (+{len(relevant) - 1} more)" if len(relevant) > 1 else ""
+    return (
+        f"static analysis flagged {f.rule} ({slug}) on {f.subject}{more} —"
+        " a likely cause; see docs/static_analysis.md"
+    )

@@ -1,0 +1,312 @@
+"""Rule catalog + finding model for the static metric-program auditor.
+
+Every contract the L2 runtime enforces *dynamically* — StateGuard's finite
+checks, the engine's eager demotion, the chaos drills — corresponds to a
+program property that can be checked *before* dispatch. This module names
+those properties. Each :class:`Rule` has a stable ID used in three places:
+
+* findings emitted by the two analysis passes
+  (:mod:`metrics_tpu.analysis.program` walks jaxprs,
+  :mod:`metrics_tpu.analysis.lint` walks the repo's ASTs),
+* suppression comments in source — ``# metrics-tpu: allow(MTA001)`` on the
+  offending line (lint) or at class-body level in a metric class (program
+  audit; state-scoped suppression uses the ``_analysis_allow`` mapping),
+* the :class:`~metrics_tpu.observability.RecompilationWatchdog` cross-link,
+  which names the rule likely responsible when it fires.
+
+``MTA*`` rules are **program-audit** rules: they reason about the traced
+XLA program of a metric (its jaxpr) the way EQuARX reasons about reduction
+soundness of a quantized all-reduce. ``MTL*`` rules are **repo-invariant
+lint** rules: shallow, syntactic, zero-false-positive properties of the
+source tree itself.
+"""
+import io
+import re
+import textwrap
+import tokenize
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set
+
+__all__ = [
+    "CALLBACK_PRIMITIVES",
+    "Finding",
+    "Rule",
+    "RULES",
+    "rule",
+    "parse_allow_comments",
+    "class_allowed_rules",
+    "state_allowed_rules",
+]
+
+# jax primitives (and their bare-name python spellings) that synchronize
+# with the host from inside a traced program; shared by pass 1 (MTA002
+# flags the primitives in jaxprs) and pass 2 (MTL101 exempts host ops
+# inside their function argument — host by contract)
+CALLBACK_PRIMITIVES = ("pure_callback", "io_callback", "debug_callback")
+
+_ALLOW_RE = re.compile(r"#\s*metrics-tpu:\s*allow\(\s*([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)\s*\)")
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One checkable contract: stable ID, the pass that owns it, and the
+    failure mode it guards against."""
+
+    id: str
+    slug: str
+    owner: str  # "program" (pass 1, jaxpr) | "lint" (pass 2, AST)
+    summary: str
+    rationale: str
+
+    def to_dict(self) -> Dict[str, str]:
+        return {
+            "id": self.id,
+            "slug": self.slug,
+            "owner": self.owner,
+            "summary": self.summary,
+            "rationale": self.rationale,
+        }
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(id: str, slug: str, owner: str, summary: str, rationale: str) -> Rule:
+    r = Rule(id, slug, owner, summary, rationale)
+    RULES[id] = r
+    return r
+
+
+# ---------------------------------------------------------------------------
+# pass 1 — program audit (jaxpr)
+# ---------------------------------------------------------------------------
+MTA001 = rule(
+    "MTA001",
+    "narrow-accumulator",
+    "program",
+    "Accumulator state dtype drifts across an update, or is narrower than"
+    " the floating input it accumulates.",
+    "A state whose dtype changes after one update (silent promotion, or a"
+    " weak-type flip) changes the step's input signature, so every"
+    " subsequent step retraces/recompiles — churn the recompilation"
+    " watchdog only sees after the fact. A floating accumulator narrower"
+    " than its input (f16 sums of f32 batches) silently destroys precision"
+    " the way bf16 moment sums do.",
+)
+
+MTA002 = rule(
+    "MTA002",
+    "host-sync-in-trace",
+    "program",
+    "Host synchronization inside a traced region: callback primitives in"
+    " the jaxpr, or a concretization error (`.item()`/`float()`-shaped"
+    " reads) while tracing a program that is supposed to compile.",
+    "A `pure_callback`/`io_callback` in the step program serializes the"
+    " donated dispatch the engine exists to keep async; a concretization"
+    " failure means the metric silently demotes to eager on its first"
+    " compiled step.",
+)
+
+MTA003 = rule(
+    "MTA003",
+    "donation-alias",
+    "program",
+    "One buffer aliased into more than one output of a donated step"
+    " program (two states, or a state and the batch value, sharing one"
+    " jaxpr output variable).",
+    "The engine donates the state pytree to XLA. Two outputs backed by one"
+    " donated buffer either fail dispatch (double-donation) or leave two"
+    " live states sharing storage, so the next in-place step corrupts one"
+    " through the other — the same class of bit-garbling the durable-"
+    " session work fixed dynamically for checkpoint restores.",
+)
+
+MTA004 = rule(
+    "MTA004",
+    "unsound-reduction",
+    "program",
+    "A declared `dist_reduce_fx` that cannot soundly merge cross-replica"
+    " state: a custom reduction that fails a commutativity probe, a 'mean'"
+    " state with no paired count, a fused-forward state outside the"
+    " mergeable set, or a cat-state metric that an engine would compile.",
+    "Cross-replica sync all-gathers per-rank states and folds them with"
+    " the declared reduction; `psum`-style folds assume commutative,"
+    " weight-aware merges. An order-dependent reduction gives every rank"
+    " layout a different answer; a bare mean-of-means is wrong whenever"
+    " ranks see different batch counts; cat states must demote to eager"
+    " rather than compile.",
+)
+
+# ---------------------------------------------------------------------------
+# pass 2 — repo-invariant lint (AST)
+# ---------------------------------------------------------------------------
+MTL101 = rule(
+    "MTL101",
+    "host-op-in-traced-path",
+    "lint",
+    "`np.*`, `.item()`, or `float()/int()/bool()` on traced values inside"
+    " a jit-compiled function or an `update` method, outside an"
+    " `_is_concrete`/`debug_enabled` guard.",
+    "Host ops under trace either raise at first compile (demoting the"
+    " metric to eager) or bake a stale constant into the program. Value"
+    " probes belong behind `_is_concrete` guards, the repo's idiom for"
+    " eager-only checks.",
+)
+
+MTL102 = rule(
+    "MTL102",
+    "bare-jit",
+    "lint",
+    "A direct `jax.jit` reference outside `utilities/jit.py`; hot paths"
+    " must compile through `tpu_jit`.",
+    "`utilities/jit.py` is the one place compilation policy lives"
+    " (persistent-cache wiring today; donation/telemetry defaults"
+    " tomorrow). Bare `jax.jit` call sites silently opt out of every"
+    " policy added there.",
+)
+
+MTL103 = rule(
+    "MTL103",
+    "hot-path-warn",
+    "lint",
+    "`warnings.warn`/`rank_zero_warn` inside an update path (an `update`"
+    " or `forward` method, or a `_*_update` functional); use `warn_once`.",
+    "Update paths run every step of a training loop; an unconditioned"
+    " warning there floods logs at step rate. `warn_once` keys the"
+    " warning so it fires once per process, the established idiom for"
+    " engine demotion and watchdog warnings.",
+)
+
+MTL104 = rule(
+    "MTL104",
+    "unreduced-state",
+    "lint",
+    "An `add_state` call registering an array state without naming a"
+    " `dist_reduce_fx` (list states may omit it: rank-order concat is"
+    " their implied reduction).",
+    "An array state synced with no reduction comes back as a stacked"
+    " `(world, ...)` array — a silent shape change every downstream"
+    " compute misreads. List states flatten in rank order, which IS"
+    " concatenation, so `None` is sound there.",
+)
+
+
+@dataclass
+class Finding:
+    """One violation (or suppressed violation) of a rule."""
+
+    rule: str
+    subject: str  # "ClassName.update" / "path.py:123"
+    message: str
+    severity: str = "error"  # "error" | "warning"
+    suppressed: bool = False
+    detail: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {
+            "rule": self.rule,
+            "slug": RULES[self.rule].slug if self.rule in RULES else "",
+            "subject": self.subject,
+            "severity": self.severity,
+            "message": self.message,
+        }
+        if self.suppressed:
+            d["suppressed"] = True
+        if self.detail:
+            d["detail"] = self.detail
+        return d
+
+    def __str__(self) -> str:
+        tag = " [suppressed]" if self.suppressed else ""
+        return f"{self.rule}{tag} {self.subject}: {self.message}"
+
+
+def parse_allow_comments(source: str) -> Dict[int, Set[str]]:
+    """``# metrics-tpu: allow(RULE[, RULE])`` comments by 1-based line.
+
+    A finding on line ``L`` is suppressed when an allow comment for its
+    rule sits on ``L`` itself (trailing) or on ``L - 1`` (the line above);
+    :func:`metrics_tpu.analysis.lint.lint_file` applies that adjacency.
+
+    Only real ``#`` COMMENT tokens count — a docstring that *documents* the
+    syntax (like this module's own) must not widen anyone's suppression
+    set, so the source is tokenized rather than regex-scanned. Sources the
+    tokenizer rejects (truncated class bodies from ``inspect.getsource``)
+    fall back to the line scan.
+    """
+    out: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(textwrap.dedent(source)).readline)
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                m = _ALLOW_RE.search(tok.string)
+                if m:
+                    out.setdefault(tok.start[0], set()).update(
+                        r.strip() for r in m.group(1).split(",")
+                    )
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        out.clear()
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            m = _ALLOW_RE.search(line)
+            if m:
+                out[lineno] = {r.strip() for r in m.group(1).split(",")}
+    return out
+
+
+def _method_body_lines(source: str) -> Set[int]:
+    """1-based line numbers covered by function/method bodies in ``source``
+    (a class body from ``inspect.getsource``). Unparseable sources return
+    the empty set — every comment then counts, the pre-scoping behavior."""
+    import ast
+
+    lines: Set[int] = set()
+    try:
+        tree = ast.parse(textwrap.dedent(source))
+    except SyntaxError:
+        return lines
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            lines.update(range(node.lineno, (node.end_lineno or node.lineno) + 1))
+    return lines
+
+
+def class_allowed_rules(cls: type) -> Set[str]:
+    """Class-wide program-audit suppression set for a metric class: rule
+    IDs from any ``# metrics-tpu: allow(...)`` comment at **class-body
+    level** (directly in the class body, not inside a method — a comment
+    scoped to one ``add_state`` line must not silence the rule for every
+    state of every subclass), unioned over the MRO, plus an explicit
+    ``_analysis_allow`` attribute (an iterable of rule IDs) for
+    dynamically built classes whose source is unavailable.
+
+    For suppression scoped to *specific states*, ``_analysis_allow`` may
+    instead be a mapping ``{rule_id: (state_name, ...)}`` — resolved by
+    :func:`state_allowed_rules`, not here."""
+    import inspect
+
+    attr = getattr(cls, "_analysis_allow", ()) or ()
+    allowed: Set[str] = set() if isinstance(attr, dict) else set(attr)
+    for klass in cls.__mro__:
+        if klass in (object,):
+            continue
+        try:
+            src = inspect.getsource(klass)
+        except (OSError, TypeError):
+            continue
+        method_lines = _method_body_lines(src)
+        for lineno, ids in parse_allow_comments(src).items():
+            if lineno not in method_lines:
+                allowed |= ids
+    return allowed
+
+
+def state_allowed_rules(obj: Any) -> Dict[str, Set[str]]:
+    """State-scoped program-audit suppression: ``{rule_id: {state names}}``
+    from a mapping-form ``_analysis_allow``. Accepts a metric *instance*
+    (so registration code that creates states dynamically — e.g. a mixin
+    building streams from a spec dict — can scope its suppression to
+    exactly the states it registered) or a class."""
+    attr = getattr(obj, "_analysis_allow", None)
+    if not isinstance(attr, dict):
+        return {}
+    return {rule_id: set(names) for rule_id, names in attr.items()}

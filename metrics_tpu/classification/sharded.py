@@ -52,6 +52,8 @@ from metrics_tpu.parallel.sample_sort import (
 )
 
 
+from metrics_tpu.utilities.data import _is_concrete
+from metrics_tpu.utilities.jit import tpu_jit
 from metrics_tpu.parallel.sharded_metric import (  # noqa: F401  (re-exported for tests/users)
     ShardedStreamsMixin,
     _default_mesh,
@@ -60,7 +62,7 @@ from metrics_tpu.parallel.sharded_metric import (  # noqa: F401  (re-exported fo
 )
 
 
-@jax.jit
+@tpu_jit
 def _masked_weighted_auroc_ap(preds, target, mask, weights, pos_label):
     """Single-replica weighted (AUROC, AP) of a masked gathered stream —
     the sample-sort epilogue (`parallel/sample_sort._tie_stats_w`) with
@@ -193,7 +195,7 @@ def _ovr_a2a_program(mesh: Mesh, axis: str, kernel, num_classes: int, weighted: 
         )
 
     extra = (P(axis),) if weighted else ()
-    return jax.jit(
+    return tpu_jit(
         jax.shard_map(
             _local,
             mesh=mesh,
@@ -243,7 +245,7 @@ def _ovr_program(mesh: Mesh, axis: str, kernel, weighted: bool = False):
         )
 
     extra = (P(),) if weighted else ()
-    return jax.jit(
+    return tpu_jit(
         jax.shard_map(
             _local,
             mesh=mesh,
@@ -326,11 +328,12 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
         ``utilities/checks._guard_sample_weights``)."""
         # keep host inputs on host — _append_streams casts to the stream
         # dtypes and stages exactly once (multi-process staging needs host
-        # arrays anyway)
+        # arrays anyway); only plain python sequences are converted, traced
+        # arrays always have .shape and pass through untouched
         if not hasattr(preds, "shape"):
-            preds = np.asarray(preds)
+            preds = np.asarray(preds)  # metrics-tpu: allow(MTL101)
         if not hasattr(target, "shape"):
-            target = np.asarray(target)
+            target = np.asarray(target)  # metrics-tpu: allow(MTL101)
         if self.with_sample_weights != (sample_weights is not None):
             raise ValueError(
                 "pass `sample_weights` to every update iff the metric was"
@@ -340,7 +343,8 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
             )
         if sample_weights is not None:
             if not hasattr(sample_weights, "shape"):
-                sample_weights = np.asarray(sample_weights, np.float32)
+                # host-sequence staging, as for preds/target above
+                sample_weights = np.asarray(sample_weights, np.float32)  # metrics-tpu: allow(MTL101)
             if sample_weights.shape != (target.shape[0],):
                 raise ValueError(
                     f"expected 1-d sample_weights of shape {(target.shape[0],)},"
@@ -358,10 +362,13 @@ class ShardedCurveMetric(ShardedStreamsMixin, Metric):
                 f"expected preds of shape {shape_desc} and 1-d target,"
                 f" got {preds.shape} and {target.shape}"
             )
-        if self.preds_suffix:
+        if self.preds_suffix and _is_concrete(target):
             # eager value probe, same discipline as the replicated path
             # (utilities/checks.py): an out-of-range label would silently
-            # count as all-negative in every one-vs-rest column
+            # count as all-negative in every one-vs-rest column. Skipped
+            # under tracing — previously the int() reads here concretized
+            # a traced target and crashed the trace (analysis rule MTL101
+            # surfaced it); every other value probe in the repo skips.
             if isinstance(target, np.ndarray):
                 lo, hi = int(target.min()), int(target.max())
             else:

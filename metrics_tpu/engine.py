@@ -56,6 +56,7 @@ from metrics_tpu.parallel.backend import is_distributed_initialized
 from metrics_tpu.reliability import guard as _rguard
 from metrics_tpu.utilities.checks import shared_canonicalization
 from metrics_tpu.utilities.prints import warn_once
+from metrics_tpu.utilities.jit import tpu_jit
 
 __all__ = ["CompiledStepEngine"]
 
@@ -99,7 +100,12 @@ class CompiledStepEngine:
         self,
         metrics: Union[Metric, Mapping[str, Metric]],
         cache_size: int = _DEFAULT_CACHE_SIZE,
+        observe: bool = True,
     ):
+        """``observe=False`` builds an analysis-only engine: no telemetry
+        events at construction (the static auditor traces programs without
+        ever dispatching — its engines must not look like production
+        demotions in the event log)."""
         if isinstance(metrics, Metric):
             self._single = True
             self._metrics: "OrderedDict[str, Metric]" = OrderedDict([("metric", metrics)])
@@ -127,8 +133,18 @@ class CompiledStepEngine:
         # from LRU-eviction thrash for the recompilation watchdog) and the
         # human-readable key telemetry counters/warnings use for this engine
         self._seen_signatures = set()
-        self._watch_key = "engine[" + ",".join(self._metrics) + "]"
-        if _obs.enabled() and self._eager_names:
+        # single metrics are keyed "metric" internally; label the watch key
+        # with the class name so telemetry reads and the static-analysis
+        # cross-link both resolve (hint_for_watch_key matches audit results
+        # by class name; audit_collection additionally registers results
+        # under the collection's own keys for custom-named members)
+        labels = (
+            [type(m).__name__ for m in self._metrics.values()]
+            if self._single
+            else list(self._metrics)
+        )
+        self._watch_key = "engine[" + ",".join(labels) + "]"
+        if observe and _obs.enabled() and self._eager_names:
             tel = _obs.get()
             for name, reason in self._eager_names.items():
                 tel.event("eager_fallback", engine=self._watch_key, metric=name, reason=reason)
@@ -171,7 +187,12 @@ class CompiledStepEngine:
     # flows through the traced pytrees, so it is pure despite the
     # temporary attribute mutation used to reuse the update/compute code)
     # ------------------------------------------------------------------
-    def _make_step_fn(self, names: Tuple[str, ...], guard_token: Optional[str] = None) -> Callable:
+    def _make_step_fn(
+        self,
+        names: Tuple[str, ...],
+        guard_token: Optional[str] = None,
+        observe: bool = True,
+    ) -> Callable:
         metrics = self._metrics
 
         def step_fn(states, args, kwargs):
@@ -179,9 +200,13 @@ class CompiledStepEngine:
             # the tracer-side retrace counter the watchdog listens to. The
             # budget tracks the LRU capacity: up to cache_size distinct
             # signatures is a legitimately warm engine, beyond it eviction
-            # thrash gives the exact note_compile signal anyway
-            self.trace_count += 1
-            _obs.note_trace(self._watch_key, budget=max(8, self._cache_size))
+            # thrash gives the exact note_compile signal anyway.
+            # (observe=False: analysis-only traces — abstract_step — must
+            # not count as churn or the auditor pollutes the very watchdog
+            # it cross-links with)
+            if observe:
+                self.trace_count += 1
+                _obs.note_trace(self._watch_key, budget=max(8, self._cache_size))
             new_states = {}
             values = {}
             finites = {}
@@ -281,7 +306,7 @@ class CompiledStepEngine:
         if len(self._seen_signatures) >= 4096:
             self._seen_signatures.clear()  # polymorphic caller: stay bounded
         self._seen_signatures.add(signature)
-        fn = jax.jit(self._make_step_fn(names, guard_token), donate_argnums=(0,))
+        fn = tpu_jit(self._make_step_fn(names, guard_token), donate_argnums=(0,))
         if len(self._compiled) >= self._cache_size:
             self._compiled.popitem(last=False)  # LRU eviction
             if _obs.enabled():
@@ -476,6 +501,30 @@ class CompiledStepEngine:
                         f" {name}.{sname}; accumulated state lost —"
                         f" reset() the metric"
                     ) from err
+
+    def abstract_step(self, *args: Any, **kwargs: Any):
+        """Trace the compiled step program abstractly, without compiling or
+        dispatching: returns ``(closed_jaxpr, out_shapes, n_donated_leaves)``
+        for the exact program :meth:`step` would jit for these inputs (the
+        unguarded program shape; guard tokens only add a finite-flag
+        epilogue). This is the static-analysis hook
+        (:mod:`metrics_tpu.analysis.program` audits the jaxpr for host
+        callbacks and donated-buffer aliasing before anything dispatches);
+        it does not touch the signature cache, any metric state, the trace
+        counter, or the recompilation watchdog."""
+        names = self._compiled_names()
+        if not names:
+            raise ValueError(
+                "every metric in this engine runs eager"
+                f" ({self._eager_names}); there is no compiled step program"
+                " to trace"
+            )
+        states = self._donatable_states(names)
+        n_donated = len(jax.tree_util.tree_leaves(states))
+        closed, out_shapes = jax.make_jaxpr(
+            self._make_step_fn(names, None, observe=False), return_shape=True
+        )(states, args, kwargs)
+        return closed, out_shapes, n_donated
 
     def _run_eager(self, names: Tuple[str, ...], args: tuple, kwargs: dict) -> Dict[str, Any]:
         with shared_canonicalization(), regression_family_sharing():

@@ -1,5 +1,4 @@
 """Accuracy (functional). Parity: ``torchmetrics/functional/classification/accuracy.py``."""
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -15,9 +14,10 @@ from metrics_tpu.utilities.checks import (
     fast_path_memo,
 )
 from metrics_tpu.utilities.enums import DataType
+from metrics_tpu.utilities.jit import tpu_jit
 
 
-@partial(jax.jit, static_argnames=("mode", "subset_accuracy"))
+@tpu_jit(static_argnames=("mode", "subset_accuracy"))
 def _accuracy_count(preds, target, mode, subset_accuracy):
     """Fused (correct, total) counting on canonical inputs — one XLA program per case."""
     mode = DataType(mode)
@@ -38,9 +38,7 @@ def _accuracy_count(preds, target, mode, subset_accuracy):
     return correct.astype(jnp.int32), jnp.asarray(total, dtype=jnp.int32)
 
 
-@partial(
-    jax.jit,
-    static_argnames=("p_shape", "t_shape", "case", "threshold", "top_k", "subset_accuracy", "sum_atol"),
+@tpu_jit(static_argnames=("p_shape", "t_shape", "case", "threshold", "top_k", "subset_accuracy", "sum_atol"),
 )
 def _accuracy_probe_count(preds, target, p_shape, t_shape, case, threshold, top_k, subset_accuracy, sum_atol):
     """Single-pass probe + (correct, total) straight from RAW inputs.

@@ -3,7 +3,6 @@
 The count is a static-length ``jnp.bincount`` of ``target * C + preds`` —
 a fixed-shape scatter-add that XLA lowers efficiently (SURVEY §7 step 5).
 """
-from functools import partial
 from typing import Optional
 
 import jax
@@ -21,9 +20,10 @@ from metrics_tpu.utilities.checks import (
 )
 from metrics_tpu.utilities.data import _is_concrete
 from metrics_tpu.utilities.enums import DataType
+from metrics_tpu.utilities.jit import tpu_jit
 
 
-@partial(jax.jit, static_argnames=("num_classes", "multilabel", "argmax_first"))
+@tpu_jit(static_argnames=("num_classes", "multilabel", "argmax_first"))
 def _confmat_count(preds, target, num_classes, multilabel, argmax_first):
     if argmax_first:
         preds = jnp.argmax(preds, axis=1)
@@ -42,7 +42,7 @@ def _confmat_count(preds, target, num_classes, multilabel, argmax_first):
     return bins.reshape(num_classes, num_classes)
 
 
-@partial(jax.jit, static_argnames=("argmax_first",))
+@tpu_jit(static_argnames=("argmax_first",))
 def _max_label_probe(preds, target, argmax_first):
     if argmax_first:
         preds = jnp.argmax(preds, axis=1)
@@ -50,9 +50,7 @@ def _max_label_probe(preds, target, argmax_first):
     return jnp.maximum(jnp.max(preds), jnp.max(target))
 
 
-@partial(
-    jax.jit,
-    static_argnames=("p_shape", "t_shape", "case", "num_classes", "threshold", "multilabel", "sum_atol"),
+@tpu_jit(static_argnames=("p_shape", "t_shape", "case", "num_classes", "threshold", "multilabel", "sum_atol"),
 )
 def _confmat_probe_count(preds, target, p_shape, t_shape, case, num_classes, threshold, multilabel, sum_atol):
     """Single-pass probe + confusion counts straight from RAW inputs.

@@ -4,7 +4,6 @@ The reference loops over classes in Python, calling a per-class
 ``_stat_scores``; here the per-class TP/FP/FN come from one confusion-style
 bincount so the whole score is a single XLA program.
 """
-from functools import partial
 from typing import Tuple
 
 import jax
@@ -12,6 +11,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.utilities.data import to_categorical
 from metrics_tpu.utilities.distributed import reduce
+from metrics_tpu.utilities.jit import tpu_jit
 
 
 def _stat_scores(
@@ -45,7 +45,7 @@ def _stat_scores(
     return tp, fp, tn, fn, sup
 
 
-@partial(jax.jit, static_argnames=("bg", "nan_score", "no_fg_score", "reduction"))
+@tpu_jit(static_argnames=("bg", "nan_score", "no_fg_score", "reduction"))
 def _dice_score_jit(
     pred: jax.Array,
     target: jax.Array,

@@ -1,5 +1,4 @@
 """Hamming distance (functional). Parity: ``torchmetrics/functional/classification/hamming_distance.py``."""
-from functools import partial
 from typing import Optional, Tuple, Union
 
 import jax
@@ -15,14 +14,15 @@ from metrics_tpu.utilities.checks import (
 )
 from metrics_tpu.utilities.data import _is_concrete
 from metrics_tpu.utilities.enums import DataType
+from metrics_tpu.utilities.jit import tpu_jit
 
 
-@jax.jit
+@tpu_jit
 def _hamming_count(preds, target):
     return jnp.sum(preds == target)
 
 
-@partial(jax.jit, static_argnames=("p_shape", "t_shape", "case", "threshold", "sum_atol"))
+@tpu_jit(static_argnames=("p_shape", "t_shape", "case", "threshold", "sum_atol"))
 def _hamming_probe_count(preds, target, p_shape, t_shape, case, threshold, sum_atol):
     """Single-pass probe + agreement count straight from RAW inputs.
 

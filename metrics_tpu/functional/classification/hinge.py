@@ -1,11 +1,11 @@
 """Hinge loss (functional). Parity: ``torchmetrics/functional/classification/hinge.py``."""
-from functools import partial
 from typing import Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.enums import DataType, EnumStr
+from metrics_tpu.utilities.jit import tpu_jit
 
 
 class MulticlassMode(EnumStr):
@@ -42,7 +42,7 @@ def _check_shape_and_type_consistency_hinge(preds: jax.Array, target: jax.Array)
     return mode
 
 
-@partial(jax.jit, static_argnames=("mode", "squared", "one_vs_all"))
+@tpu_jit(static_argnames=("mode", "squared", "one_vs_all"))
 def _hinge_measures(preds, target, mode, squared, one_vs_all):
     """Summed hinge measures, fully vectorized (no boolean fancy indexing)."""
     mode = DataType(mode)

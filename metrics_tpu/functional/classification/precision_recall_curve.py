@@ -12,7 +12,6 @@ the dedup mask, a function of the sorted scores alone) are ever consumed,
 which is what lets the accelerator branch use an unstable co-sort; the CPU
 branches keep stable argsorts.
 """
-from functools import partial
 from typing import List, Optional, Sequence, Tuple, Union
 
 import jax
@@ -20,11 +19,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from metrics_tpu.ops.auroc_kernel import _descending_key, _score_from_key, _use_host_sort
-from metrics_tpu.utilities import rank_zero_warn
+from metrics_tpu.utilities import warn_once
 from metrics_tpu.utilities.data import _is_concrete
+from metrics_tpu.utilities.jit import tpu_jit
 
 
-@partial(jax.jit, static_argnames=("weighted",))
+@tpu_jit(static_argnames=("weighted",))
 def _sorted_cumulants_xla(preds, target, pos_label, sample_weights=None, weighted: bool = False):
     """Descending-score sort and cumulative true/false-positive counts.
 
@@ -161,7 +161,9 @@ def _precision_recall_curve_update(
 
     if preds.ndim == target.ndim:
         if pos_label is None:
-            rank_zero_warn("`pos_label` automatically set 1.")
+            # fires per update call on the binary path: rate-limit it
+            # (MTL103) instead of warning every step of an eval loop
+            warn_once("`pos_label` automatically set 1.", key="prc-pos-label-default")
             pos_label = 1
         if num_classes is not None and num_classes != 1:
             # multilabel problem
@@ -182,9 +184,10 @@ def _precision_recall_curve_update(
     # multi class problem
     if preds.ndim == target.ndim + 1:
         if pos_label is not None:
-            rank_zero_warn(
+            warn_once(
                 "Argument `pos_label` should be `None` when running"
-                f" multiclass precision recall curve. Got {pos_label}"
+                f" multiclass precision recall curve. Got {pos_label}",
+                key="prc-pos-label-multiclass",
             )
         if num_classes != preds.shape[1]:
             raise ValueError(

@@ -6,7 +6,6 @@ common eager cases skip the one-hot canonicalization entirely via a fused
 probe+count kernel in label space (bincounts), like the accuracy and
 confusion-matrix fast paths.
 """
-from functools import partial
 from typing import Optional, Tuple
 
 import jax
@@ -26,6 +25,7 @@ from metrics_tpu.utilities.checks import (
 )
 from metrics_tpu.utilities.data import _is_concrete
 from metrics_tpu.utilities.enums import DataType
+from metrics_tpu.utilities.jit import tpu_jit
 
 
 def _del_column(x: jax.Array, index: int) -> jax.Array:
@@ -33,7 +33,7 @@ def _del_column(x: jax.Array, index: int) -> jax.Array:
     return jnp.concatenate([x[:, :index], x[:, (index + 1):]], axis=1)
 
 
-@jax.jit
+@tpu_jit
 def _all_binary_jit(x: jax.Array) -> jax.Array:
     """True iff every element is exactly 0 or 1 (debug-mode probe)."""
     return jnp.all((x == 0) | (x == 1))
@@ -100,10 +100,7 @@ def _stat_scores(
     return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
 
 
-from functools import partial as _partial
-
-
-@_partial(jax.jit, static_argnames=("reduce", "mdmc_reduce", "ignore_index"))
+@tpu_jit(static_argnames=("reduce", "mdmc_reduce", "ignore_index"))
 def _stat_scores_count(preds, target, reduce, mdmc_reduce, ignore_index):
     """Fused counting on canonical inputs — one XLA program per configuration."""
     if preds.ndim == 3 and mdmc_reduce == "global":
@@ -127,8 +124,7 @@ def _stat_scores_count(preds, target, reduce, mdmc_reduce, ignore_index):
     return tp, fp, tn, fn
 
 
-@partial(
-    jax.jit,
+@tpu_jit(
     static_argnames=(
         "p_shape", "t_shape", "case", "reduce", "mdmc_reduce", "num_classes", "top_k", "threshold",
         "ignore_index", "sum_atol",

@@ -6,6 +6,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from metrics_tpu.utilities.jit import tpu_jit
 
 
 def _image_gradients_validate(img) -> None:
@@ -16,7 +17,7 @@ def _image_gradients_validate(img) -> None:
         raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
 
 
-@jax.jit
+@tpu_jit
 def _compute_image_gradients(img: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """1-step forward differences, zero-padded at the far edge."""
     dy = jnp.pad(img[..., 1:, :] - img[..., :-1, :], ((0, 0), (0, 0), (0, 1), (0, 0)))

@@ -6,9 +6,10 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utilities.jit import tpu_jit
 
 
-@jax.jit
+@tpu_jit
 def _ap_sorted(preds: jax.Array, target: jax.Array) -> jax.Array:
     """AP over one query, fully vectorized (no boolean indexing).
 

@@ -2,16 +2,16 @@
 
 Parity: ``torchmetrics/functional/retrieval/precision.py:20-56``.
 """
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utilities.jit import tpu_jit
 
 
-@partial(jax.jit, static_argnames=("k",))
+@tpu_jit(static_argnames=("k",))
 def _precision_sorted(preds: jax.Array, target: jax.Array, k: int) -> jax.Array:
     # divide by the requested k even when it exceeds the number of documents
     t_sorted = target[jnp.argsort(-preds, stable=True)].astype(jnp.float32)

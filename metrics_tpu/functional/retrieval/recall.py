@@ -2,16 +2,16 @@
 
 Parity: ``torchmetrics/functional/retrieval/recall.py:20-57``.
 """
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utilities.jit import tpu_jit
 
 
-@partial(jax.jit, static_argnames=("k",))
+@tpu_jit(static_argnames=("k",))
 def _recall_sorted(preds: jax.Array, target: jax.Array, k: int) -> jax.Array:
     t_sorted = target[jnp.argsort(-preds, stable=True)].astype(jnp.float32)
     n_rel = jnp.sum(t_sorted)

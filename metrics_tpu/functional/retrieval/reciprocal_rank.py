@@ -6,9 +6,10 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.utilities.checks import _check_retrieval_functional_inputs
+from metrics_tpu.utilities.jit import tpu_jit
 
 
-@jax.jit
+@tpu_jit
 def _rr_sorted(preds: jax.Array, target: jax.Array) -> jax.Array:
     t_sorted = target[jnp.argsort(-preds, stable=True)].astype(jnp.float32)
     rank = jnp.arange(1, target.shape[0] + 1, dtype=jnp.float32)

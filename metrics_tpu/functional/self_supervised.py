@@ -3,13 +3,13 @@
 Parity: ``torchmetrics/functional/self_supervised.py:20-57``. The pairwise
 matmul is a single MXU-friendly ``(B, D) @ (D, B)`` contraction.
 """
-from functools import partial
 
 import jax
 import jax.numpy as jnp
+from metrics_tpu.utilities.jit import tpu_jit
 
 
-@partial(jax.jit, static_argnames=("similarity", "reduction", "zero_diagonal"))
+@tpu_jit(static_argnames=("similarity", "reduction", "zero_diagonal"))
 def embedding_similarity(
     batch: jax.Array,
     similarity: str = "cosine",

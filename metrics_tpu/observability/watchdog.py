@@ -31,6 +31,18 @@ _DEFAULT_TRACE_BUDGET = 8
 _MAX_KEYS = 256
 
 
+def _analysis_hint(key: str) -> Optional[str]:
+    """Best-effort attribution from the static analyzer's last audit
+    (lazy import: observability must stay importable before analysis, and
+    a watchdog warning must never crash on the cross-link)."""
+    try:
+        from metrics_tpu.analysis.program import hint_for_watch_key
+
+        return hint_for_watch_key(key)
+    except Exception:  # noqa: BLE001 — advisory only
+        return None
+
+
 class RecompilationWatchdog:
     """Per-key trace/retrace bookkeeping (keys are engine labels or jitted
     functional names)."""
@@ -100,6 +112,13 @@ class RecompilationWatchdog:
 
     def _fire(self, key: str, entry: Dict[str, int], reason: str) -> None:
         entry["retraces"] += 1
+        # static-analysis cross-link: when the auditor has findings for the
+        # metrics behind this key (e.g. MTA001 accumulator-dtype churn),
+        # name the rule — the watchdog sees the symptom, the analyzer names
+        # the cause
+        hint = _analysis_hint(key)
+        if hint is not None:
+            reason = f"{reason}; {hint}"
         if self._telemetry is not None:
             self._telemetry.count("watchdog.retraces")
             self._telemetry.event("retrace", key=key, reason=reason)

@@ -23,6 +23,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from metrics_tpu.utilities.jit import tpu_jit
 
 # numpy scalar, NOT jnp: a module-level jnp constant would initialize the
 # device backend at import time (observed hanging the whole package import
@@ -234,7 +235,7 @@ def _pallas_auroc_ap(preds: jax.Array, rel: jax.Array, weight: jax.Array = None)
     return auroc_ap_from_stats(tie_group_reduce(key_s, pay_s))
 
 
-@jax.jit
+@tpu_jit
 def _binary_auroc_xla(preds: jax.Array, rel: jax.Array) -> jax.Array:
     """The on-device co-sort formulation (every non-CPU backend; the XLA
     epilogue is also kept independently tested on CPU so the program logic
@@ -244,7 +245,7 @@ def _binary_auroc_xla(preds: jax.Array, rel: jax.Array) -> jax.Array:
     return _auroc_from_groups(*_sorted_tie_groups(preds, rel))
 
 
-@jax.jit
+@tpu_jit
 def binary_auroc(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax.Array:
     """Exact AUROC of 1-d scores vs binary targets, jittable end-to-end.
 
@@ -270,7 +271,7 @@ def binary_auroc(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax
     return _binary_auroc_xla(preds, rel)
 
 
-@jax.jit
+@tpu_jit
 def multiclass_auroc_ovr(preds: jax.Array, target: jax.Array) -> jax.Array:
     """Per-class one-vs-rest AUROC of ``(N, C)`` scores vs ``(N,)`` labels.
 
@@ -312,7 +313,7 @@ def _ap_from_groups(tps, fps, is_last, tps_prev) -> jax.Array:
     return jnp.where(n_pos == 0, jnp.nan, ap)
 
 
-@jax.jit
+@tpu_jit
 def masked_binary_auroc(preds: jax.Array, target: jax.Array, mask: jax.Array, pos_label: int = 1) -> jax.Array:
     """Exact AUROC over the ``mask``-valid subset, static shape, jittable.
 
@@ -330,7 +331,7 @@ def masked_binary_auroc(preds: jax.Array, target: jax.Array, mask: jax.Array, po
     return _auroc_from_groups(tps, fps, is_last, tps_prev, fps_prev)
 
 
-@jax.jit
+@tpu_jit
 def masked_binary_average_precision(
     preds: jax.Array, target: jax.Array, mask: jax.Array, pos_label: int = 1
 ) -> jax.Array:
@@ -347,7 +348,7 @@ def masked_binary_average_precision(
     return _ap_from_groups(tps, fps, is_last, tps_prev)
 
 
-@jax.jit
+@tpu_jit
 def _binary_average_precision_xla(preds: jax.Array, rel: jax.Array) -> jax.Array:
     """The on-device co-sort AP (every non-CPU backend; the XLA epilogue is
     independently tested on CPU)."""
@@ -357,7 +358,7 @@ def _binary_average_precision_xla(preds: jax.Array, rel: jax.Array) -> jax.Array
     return _ap_from_groups(tps, fps, is_last, tps_prev)
 
 
-@jax.jit
+@tpu_jit
 def binary_average_precision(preds: jax.Array, target: jax.Array, pos_label: int = 1) -> jax.Array:
     """Exact average precision of 1-d scores vs binary targets, jittable.
 

@@ -17,11 +17,11 @@ manual tiling here because the histogram is reduction-bound, not
 memory-layout-bound; don't resurrect the Pallas version without first
 beating the numbers above with the chained-dispatch timing method.
 """
-from functools import partial
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+from metrics_tpu.utilities.jit import tpu_jit
 
 
 # past this many buckets the chunked one-hot contraction's N x K compare
@@ -45,7 +45,7 @@ _COMPARE_MAX_LENGTH_CPU = 8
 _CONTRACTION_CHUNK = 262144
 
 
-@partial(jax.jit, static_argnames=("length",))
+@tpu_jit(static_argnames=("length",))
 def label_bincount(indices: jax.Array, length: int, weights: jax.Array = None) -> jax.Array:
     """``jnp.bincount`` with a TPU-shaped formulation for small lengths.
 
@@ -128,7 +128,7 @@ def _contraction_bincount(indices: jax.Array, length: int, weights: jax.Array = 
     return out
 
 
-@partial(jax.jit, static_argnames=("num_bins",))
+@tpu_jit(static_argnames=("num_bins",))
 def score_histograms(
     preds: jax.Array, target: jax.Array, num_bins: int = 256, mask: jax.Array = None,
     weights: jax.Array = None,
@@ -201,7 +201,7 @@ def _cum_counts_and_thresholds(hist_pos: jax.Array, hist_neg: jax.Array):
     return tps, fps, thresholds
 
 
-@jax.jit
+@tpu_jit
 def histogram_roc(hist_pos: jax.Array, hist_neg: jax.Array):
     """(fpr, tpr, thresholds) from score histograms, descending thresholds.
 
@@ -215,7 +215,7 @@ def histogram_roc(hist_pos: jax.Array, hist_neg: jax.Array):
     return fpr, tpr, thresholds
 
 
-@jax.jit
+@tpu_jit
 def histogram_auroc(hist_pos: jax.Array, hist_neg: jax.Array) -> jax.Array:
     """AUROC from score histograms via the trapezoidal rule.
 
@@ -229,7 +229,7 @@ def histogram_auroc(hist_pos: jax.Array, hist_neg: jax.Array) -> jax.Array:
     return jnp.where(n_pos * n_neg == 0, jnp.nan, auc)
 
 
-@jax.jit
+@tpu_jit
 def histogram_pr_curve(hist_pos: jax.Array, hist_neg: jax.Array):
     """(precision, recall, thresholds) from score histograms.
 
@@ -243,7 +243,7 @@ def histogram_pr_curve(hist_pos: jax.Array, hist_neg: jax.Array):
     return precision, recall, thresholds
 
 
-@jax.jit
+@tpu_jit
 def histogram_average_precision(hist_pos: jax.Array, hist_neg: jax.Array) -> jax.Array:
     """Average precision ``sum((recall_k - recall_{k-1}) * precision_k)``."""
     precision, recall, _ = histogram_pr_curve(hist_pos, hist_neg)

@@ -8,7 +8,6 @@ single XLA program: one lexicographic sort by ``(query, -score)`` followed by
 segment reductions, so an entire epoch of retrieval state is scored in a few
 fused kernels on the MXU/VPU and the per-query loop disappears.
 """
-from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -17,6 +16,7 @@ import numpy as np
 from jax import lax
 
 from metrics_tpu.ops.auroc_kernel import _descending_key, _use_host_sort
+from metrics_tpu.utilities.jit import tpu_jit
 
 
 class RankedGroupStats(NamedTuple):
@@ -41,7 +41,7 @@ def _host_lex_order(group, key):
     return np.argsort(composite, kind="stable").astype(np.int32)
 
 
-@jax.jit
+@tpu_jit
 def _lex_order_xla(group, preds):
     """The (group asc, score desc, stable) permutation as XLA argsorts —
     kept as the reference formulation for the co-sort below and for the
@@ -53,7 +53,7 @@ def _lex_order_xla(group, preds):
     return order_by_score[jnp.argsort(group[order_by_score], stable=True)]
 
 
-@jax.jit
+@tpu_jit
 def _lex_cosort_xla(group, preds, target):
     """One stable two-key ``lax.sort`` — (group asc, score desc), ``target``
     co-sorted as payload. Returns ``(g_sorted, t_sorted)`` WITHOUT ever
@@ -66,7 +66,7 @@ def _lex_cosort_xla(group, preds, target):
     return g_s, t_s
 
 
-@partial(jax.jit, static_argnames=("num_groups",))
+@tpu_jit(static_argnames=("num_groups",))
 def ranked_group_stats(
     group: jax.Array, preds: jax.Array, target: jax.Array, num_groups: int
 ) -> RankedGroupStats:

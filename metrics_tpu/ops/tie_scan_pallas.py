@@ -37,6 +37,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from metrics_tpu.utilities.jit import tpu_jit
+
 _ROWS = 256  # sublanes per block; block = (256, 128) = 32k elements
 _LANES = 128
 
@@ -206,7 +208,7 @@ def _tie_scan_kernel(*refs, weighted: bool = False):
     out_ref[...] = jnp.where((orow == 0) & (ocol < 4), vals, 0.0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@tpu_jit(static_argnames=("interpret",))
 def tie_group_reduce(
     key_s: jax.Array,
     payload_s: jax.Array,

@@ -55,6 +55,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu.ops.auroc_kernel import _descending_key, _use_host_sort
+from metrics_tpu.utilities.jit import tpu_jit
 
 _PAD_KEY = np.uint32(0xFFFFFFFF)
 _R = 64  # key samples per device; balance error ~ N/R per bucket
@@ -226,7 +227,7 @@ def _program_a(mesh: Mesh, axis: str, weighted: bool = False):
         return key_s, pay_s, splitters, counts_all
 
     extra = (P(axis),) if weighted else ()
-    return jax.jit(
+    return tpu_jit(
         jax.shard_map(
             _local,
             mesh=mesh,
@@ -325,7 +326,7 @@ def _program_b(mesh: Mesh, axis: str, slot: int, weighted: bool = False):
         return auroc, ap_v
 
     extra = (P(axis),) if weighted else ()
-    return jax.jit(
+    return tpu_jit(
         jax.shard_map(
             _local,
             mesh=mesh,
@@ -347,7 +348,7 @@ def _full_counts(arr: jax.Array, mesh: Mesh, axis: str) -> jax.Array:
 
     world = mesh.shape[axis]
     per_dev = arr.shape[0] // world
-    return jax.jit(
+    return tpu_jit(
         functools.partial(jnp.full, (world,), per_dev, jnp.int32),
         out_shardings=NamedSharding(mesh, P(axis)),
     )()
@@ -641,7 +642,7 @@ def _retrieval_program_a(mesh: Mesh, axis: str, exclude: int):
         counts_all = lax.all_gather(counts, axis)
         return qkey_s, preds_s, pay_s, gpos_s, splitters, counts_all
 
-    return jax.jit(
+    return tpu_jit(
         jax.shard_map(
             _local,
             mesh=mesh,
@@ -739,7 +740,7 @@ def _retrieval_program_b(mesh: Mesh, axis: str, slot: int, scorer, scorer_static
         mean = jnp.where(n_q == 0, 0.0, total / jnp.maximum(n_q, 1.0))
         return mean, any_empty
 
-    prog = jax.jit(
+    prog = tpu_jit(
         jax.shard_map(
             _local,
             mesh=mesh,

@@ -20,6 +20,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu.parallel.collective import masked_cat_sync
+from metrics_tpu.utilities.jit import tpu_jit
 
 
 def _default_mesh(axis_name: str) -> Mesh:
@@ -45,7 +46,7 @@ def _programs(mesh: Mesh, axis: str, n_streams: int = 2):
         return bufs, count + batches[0].shape[0]
 
     spec_streams = (P(axis),) * n_streams
-    jit_update = jax.jit(
+    jit_update = tpu_jit(
         jax.shard_map(
             _local_update,
             mesh=mesh,
@@ -79,7 +80,7 @@ def _programs(mesh: Mesh, axis: str, n_streams: int = 2):
             outs.append(g)
         return tuple(outs), mask
 
-    jit_gather = jax.jit(
+    jit_gather = tpu_jit(
         jax.shard_map(
             _gather,
             mesh=mesh,
@@ -158,15 +159,22 @@ class ShardedStreamsMixin:
             # jit-with-out-shardings creates each process's local shards
             # in-program — works on meshes with non-addressable devices,
             # where a host-side device_put cannot
-            zeros = jax.jit(
+            zeros = tpu_jit(
                 functools.partial(jnp.zeros, (self.capacity, *suffix), dtype),
                 out_shardings=sharding,
             )()
+            # metrics-tpu: allow(MTL104) — mesh-sharded stream: reduction
+            # happens in-program (psum/all_gather over the mesh axis), never
+            # through the host gather path a dist_reduce_fx describes
             self.add_state(name, default=zeros, dist_reduce_fx=None)
-        counts = jax.jit(
+        counts = tpu_jit(
             functools.partial(jnp.zeros, (self.world,), jnp.int32), out_shardings=sharding
         )()
+        # metrics-tpu: allow(MTL104) — same in-program merge as the streams
         self.add_state("counts", default=counts, dist_reduce_fx=None)
+        # program-audit suppression scoped to exactly these states: a
+        # subclass state with a genuinely unsound reduction must still flag
+        self._analysis_allow = {"MTA004": (*self._stream_names, "counts")}
 
     def _append_streams(self, *arrays: jax.Array) -> None:
         """Append one batch (first dim = n) to every stream, in spec order.

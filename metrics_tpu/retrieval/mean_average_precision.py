@@ -8,6 +8,7 @@ import jax.numpy as jnp
 from metrics_tpu.functional.retrieval.average_precision import retrieval_average_precision
 from metrics_tpu.ops.segment import RankedGroupStats
 from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from metrics_tpu.utilities.jit import tpu_jit
 
 
 class RetrievalMAP(RetrievalMetric):
@@ -30,7 +31,7 @@ class RetrievalMAP(RetrievalMetric):
         return retrieval_average_precision(preds, target)
 
 
-@jax.jit
+@tpu_jit
 def _map_segments(stats: RankedGroupStats) -> jax.Array:
     """AP per group in one segment reduction: sum(rel·cum_rel/rank)/n_rel."""
     num_groups = stats.pos_per_group.shape[0]

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 from metrics_tpu.functional.retrieval.reciprocal_rank import retrieval_reciprocal_rank
 from metrics_tpu.ops.segment import RankedGroupStats
 from metrics_tpu.retrieval.retrieval_metric import RetrievalMetric
+from metrics_tpu.utilities.jit import tpu_jit
 
 
 class RetrievalMRR(RetrievalMetric):
@@ -30,7 +31,7 @@ class RetrievalMRR(RetrievalMetric):
         return retrieval_reciprocal_rank(preds, target)
 
 
-@jax.jit
+@tpu_jit
 def _mrr_segments(stats: RankedGroupStats) -> jax.Array:
     """1 / (rank of first relevant doc) per group via a segment-min."""
     num_groups = stats.pos_per_group.shape[0]

@@ -12,7 +12,6 @@ scoring via :meth:`_score_groups`; the reference's per-query extension point
 :meth:`_metric` is kept as a fallback path for user subclasses.
 """
 from abc import ABC
-from functools import partial
 from typing import Any, Callable, Optional
 
 import jax
@@ -22,6 +21,7 @@ import numpy as np
 from metrics_tpu.metric import Metric
 from metrics_tpu.ops.segment import RankedGroupStats, ranked_group_stats
 from metrics_tpu.utilities.checks import _check_retrieval_inputs
+from metrics_tpu.utilities.jit import tpu_jit
 
 #: predictions with target equal to this value are excluded from scoring
 IGNORE_IDX = -100
@@ -156,7 +156,7 @@ class RetrievalMetric(Metric, ABC):
         raise NotImplementedError
 
 
-@partial(jax.jit, static_argnames=("action",))
+@tpu_jit(static_argnames=("action",))
 def _reduce_over_queries(scores: jax.Array, pos_per_group: jax.Array, action: str = "skip") -> jax.Array:
     """Apply ``empty_target_action`` and average over queries."""
     empty = pos_per_group == 0

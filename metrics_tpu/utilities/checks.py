@@ -23,7 +23,6 @@ raises a clear error when traced, where the caller must supply
 """
 import threading
 from contextlib import contextmanager
-from functools import partial
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -32,6 +31,7 @@ import numpy as np
 
 from metrics_tpu.utilities.data import _is_concrete, select_topk, to_onehot
 from metrics_tpu.utilities.enums import DataType
+from metrics_tpu.utilities.jit import tpu_jit
 
 
 def _is_floating(x: jax.Array) -> bool:
@@ -71,7 +71,7 @@ def _probe_scalars(preds, target, check_prob_sum, sum_atol):
     return pmin, pmax, tmin, tmax, prob_ok
 
 
-@partial(jax.jit, static_argnames=("p_shape", "t_shape", "check_prob_sum", "sum_atol"))
+@tpu_jit(static_argnames=("p_shape", "t_shape", "check_prob_sum", "sum_atol"))
 def _value_probe_jit(preds, target, p_shape, t_shape, check_prob_sum, sum_atol=1e-5):
     preds = preds.reshape(p_shape).astype(jnp.float32)
     target = target.reshape(t_shape)
@@ -345,8 +345,7 @@ def _check_classification_inputs(
     return case
 
 
-@partial(
-    jax.jit,
+@tpu_jit(
     static_argnames=("p_shape", "t_shape", "case", "threshold", "top_k", "num_classes", "is_multiclass"),
 )
 def _canonicalize_jit(preds, target, p_shape, t_shape, case, threshold, top_k, num_classes, is_multiclass):
@@ -649,7 +648,7 @@ def _input_format_classification_one_hot(
     return _one_hot_transform_jit(preds, target, num_classes=num_classes, threshold=threshold, multilabel=multilabel)
 
 
-@partial(jax.jit, static_argnames=("num_classes", "threshold", "multilabel"))
+@tpu_jit(static_argnames=("num_classes", "threshold", "multilabel"))
 def _one_hot_transform_jit(preds, target, num_classes, threshold, multilabel):
     if preds.ndim == target.ndim + 1:
         # multi class probabilities
@@ -699,7 +698,7 @@ def _check_retrieval_functional_inputs(preds, target) -> Tuple[jax.Array, jax.Ar
     return preds.astype(jnp.float32), target.astype(jnp.int32)
 
 
-@jax.jit
+@tpu_jit
 def _min_max_jit(x):
     return jnp.min(x), jnp.max(x)
 

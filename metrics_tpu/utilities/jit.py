@@ -1,17 +1,46 @@
-"""Compilation-cache helpers.
+"""Compilation policy: the one home for ``jax.jit`` in metrics_tpu.
 
 All hot paths in metrics_tpu run under ``jax.jit`` so XLA fuses them and —
 critically for fast cold starts — compiled executables can be served from
 JAX's persistent compilation cache. Call :func:`enable_persistent_cache`
 early (the test suite and ``bench.py`` both do) to make every distinct
 (op, shape) compile a one-time cost across processes.
+
+Every jit in the package routes through :func:`tpu_jit` — a repo invariant
+the static analyzer enforces (rule ``MTL102``,
+:mod:`metrics_tpu.analysis.lint`). Today the wrapper is a transparent
+passthrough; having one choke point is the point: compilation-wide policy
+(persistent-cache defaults, donation conventions, trace-count telemetry)
+lands here once instead of at fifty call sites, and the analyzer can
+reason about "a jitted function" as a single syntactic category.
 """
+import functools
 import os
-from typing import Optional
+from typing import Any, Callable, Optional
 
 import jax
 
 _ENABLED = False
+
+
+def tpu_jit(fun: Optional[Callable] = None, **jit_kwargs: Any):
+    """The sanctioned ``jax.jit`` entry point (repo invariant ``MTL102``).
+
+    Drop-in for every ``jax.jit`` spelling the package uses::
+
+        @tpu_jit
+        def f(x): ...
+
+        @tpu_jit(static_argnames=("k",))
+        def g(x, k): ...
+
+        step = tpu_jit(fn, donate_argnums=(0,))
+
+    All keyword arguments pass through to ``jax.jit`` unchanged.
+    """
+    if fun is None:
+        return functools.partial(tpu_jit, **jit_kwargs)
+    return jax.jit(fun, **jit_kwargs)
 
 
 def enable_persistent_cache(path: Optional[str] = None) -> None:

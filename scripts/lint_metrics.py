@@ -1,0 +1,96 @@
+"""Repo analysis gate: run both static-analysis passes, write ANALYSIS.json.
+
+Usage::
+
+    python scripts/lint_metrics.py            # report, exit 0
+    python scripts/lint_metrics.py --strict   # exit 1 on any unsuppressed finding
+    make lint                                 # the CI spelling (strict)
+
+Pass 1 (:func:`metrics_tpu.analysis.audit_registry`) traces every metric
+family's program and audits accumulator dtypes, host sync, donation
+aliasing, and reduction soundness. Pass 2
+(:func:`metrics_tpu.analysis.lint_paths`) lints the ``metrics_tpu`` source
+tree for the repo invariants (MTL101-MTL104).
+
+The combined report is written atomically (tmp + fsync + ``os.replace``
+via ``reliability.journal.atomic_write_json``) so a crashed or ^C'd run
+never leaves a torn artifact for CI to misread. ``tests/analysis/
+test_lint_clean.py`` pins the zero-unsuppressed-findings baseline in
+tier-1.
+"""
+import argparse
+import os
+import sys
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--strict", action="store_true",
+                    help="exit non-zero on any unsuppressed finding")
+    ap.add_argument("--json", default="ANALYSIS.json", metavar="PATH",
+                    help="report artifact path (default: ANALYSIS.json; '-' to skip)")
+    ap.add_argument("--skip-audit", action="store_true",
+                    help="pass 2 only (no metric tracing)")
+    ap.add_argument("--skip-lint", action="store_true",
+                    help="pass 1 only (no AST lint)")
+    args = ap.parse_args(argv)
+
+    from metrics_tpu.analysis import audit_registry, lint_paths
+    from metrics_tpu.reliability.journal import atomic_write_json
+
+    report = {"schema": "metrics_tpu.analysis_report", "version": 1}
+    unsuppressed = 0
+
+    if not args.skip_audit:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # config-edge warnings from factories
+            audit = audit_registry()
+        report["program_audit"] = audit
+        unsuppressed += audit["summary"]["findings"]
+        print(
+            f"pass 1 (program audit): {audit['summary']['families']} families,"
+            f" {audit['summary']['findings']} findings"
+            f" ({audit['summary']['suppressed']} suppressed)"
+        )
+        for fam, entry in audit["families"].items():
+            for f in entry["findings"]:
+                print(f"  {f['rule']} {f['subject']}: {f['message']}")
+
+    if not args.skip_lint:
+        findings = lint_paths()
+        live = [f for f in findings if not f.suppressed]
+        report["lint"] = {
+            "findings": [f.to_dict() for f in findings],
+            "summary": {
+                "findings": len(live),
+                "suppressed": len(findings) - len(live),
+            },
+        }
+        unsuppressed += len(live)
+        print(
+            f"pass 2 (repo lint): {len(live)} findings"
+            f" ({len(findings) - len(live)} suppressed)"
+        )
+        for f in live:
+            print(f"  {f.rule} {f.subject}: {f.message}")
+
+    report["summary"] = {"unsuppressed_findings": unsuppressed}
+    if args.json != "-":
+        atomic_write_json(args.json, report)
+        print(f"wrote {args.json}")
+
+    if args.strict and unsuppressed:
+        print(f"STRICT: {unsuppressed} unsuppressed finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

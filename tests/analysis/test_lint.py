@@ -1,0 +1,393 @@
+"""Lint-pass internals on synthetic modules: each rule's fire/no-fire
+boundary, the guard and static-argument exemptions, and the suppression
+syntax (trailing, line-above, and comment-block forms)."""
+import textwrap
+
+import pytest
+
+from metrics_tpu.analysis.lint import lint_source
+from metrics_tpu.analysis.rules import parse_allow_comments
+
+
+def _lint(code, rel_path="pkg/mod.py"):
+    return lint_source(textwrap.dedent(code), rel_path)
+
+
+def _rules(findings, include_suppressed=False):
+    return sorted(f.rule for f in findings if include_suppressed or not f.suppressed)
+
+
+# ---------------------------------------------------------------------------
+# MTL101 — host ops in traced paths
+# ---------------------------------------------------------------------------
+def test_numpy_in_update_method_fires():
+    code = """
+    import numpy as np
+    class Foo:
+        def update(self, preds):
+            return np.asarray(preds)
+    """
+    assert _rules(_lint(code)) == ["MTL101"]
+
+
+def test_numpy_alias_is_tracked_per_module():
+    code = """
+    import numpy as xnp
+    class Foo:
+        def update(self, preds):
+            return xnp.asarray(preds)
+    """
+    assert _rules(_lint(code)) == ["MTL101"]
+
+
+def test_from_numpy_import_in_update_fires():
+    """`from numpy import asarray` is the same host op as `np.asarray` —
+    the bare-name spelling must not escape MTL101."""
+    code = """
+    from numpy import asarray as host_asarray
+    class Foo:
+        def update(self, preds):
+            return host_asarray(preds)
+    """
+    assert _rules(_lint(code)) == ["MTL101"]
+
+
+def test_from_numpy_import_outside_traced_scope_is_fine():
+    code = """
+    from numpy import asarray
+    def helper(x):
+        return asarray(x)
+    class Foo:
+        def compute(self):
+            return asarray([1.0])
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_numpy_outside_traced_scope_is_fine():
+    code = """
+    import numpy as np
+    def helper(x):
+        return np.asarray(x)
+    class Foo:
+        def compute(self):
+            return np.zeros(3)
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_item_and_cast_in_jitted_function_fire():
+    code = """
+    from metrics_tpu.utilities.jit import tpu_jit
+    @tpu_jit
+    def f(x):
+        return x.item() + float(x)
+    """
+    assert _rules(_lint(code)) == ["MTL101", "MTL101"]
+
+
+def test_is_concrete_guard_exempts_value_probes():
+    code = """
+    class Foo:
+        def update(self, x):
+            if _is_concrete(x):
+                lo = float(x.min())
+            if debug_enabled() and _is_concrete(x):
+                hi = int(x.max())
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_guard_does_not_leak_into_else_branch():
+    code = """
+    class Foo:
+        def update(self, x):
+            if _is_concrete(x):
+                pass
+            else:
+                lo = float(x)
+    """
+    assert _rules(_lint(code)) == ["MTL101"]
+
+
+def test_negated_guard_body_runs_under_tracing_and_fires():
+    """`if not _is_concrete(x):` — the body executes precisely when x is a
+    tracer, so host ops there are the exact bug MTL101 exists to catch;
+    guard detection must be polarity-aware, not mention-based."""
+    code = """
+    import numpy as np
+    class Foo:
+        def update(self, x):
+            if not _is_concrete(x):
+                y = np.asarray(x)
+                return float(x)
+            return x
+    """
+    assert _rules(_lint(code)) == ["MTL101", "MTL101"]
+
+
+def test_or_compound_guard_does_not_exempt_body():
+    """`_is_concrete(x) or flag` can be true on a tracer (flag=True), so
+    the body is NOT a concrete-only region."""
+    code = """
+    class Foo:
+        def update(self, x, flag):
+            if _is_concrete(x) or flag:
+                return float(x)
+    """
+    assert _rules(_lint(code)) == ["MTL101"]
+
+
+def test_negated_guard_else_branch_is_exempt():
+    """The orelse of a negated guard (and of the repo's
+    `if not (_is_concrete(a) and _is_concrete(b)): raise` idiom) only runs
+    on concrete values."""
+    code = """
+    class Foo:
+        def update(self, preds, target):
+            if not (_is_concrete(preds) and _is_concrete(target)):
+                pass
+            else:
+                lo = float(preds.min())
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_static_argnames_are_exempt():
+    code = """
+    from metrics_tpu.utilities.jit import tpu_jit
+    @tpu_jit(static_argnames=("k", "flag"))
+    def f(x, k, flag):
+        start = 1 - int(bool(flag))
+        return x[:int(k)] * start
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_static_argnums_resolve_to_positional_names():
+    """`static_argnums` positions map onto the decorated function's own
+    positional parameters: a cast of a static-by-position value is
+    host-static, not a concretization."""
+    code = """
+    from metrics_tpu.utilities.jit import tpu_jit
+    @tpu_jit(static_argnums=(1,))
+    def f(x, k):
+        return x[:int(k)]
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_static_argnums_do_not_exempt_traced_positions():
+    code = """
+    from metrics_tpu.utilities.jit import tpu_jit
+    @tpu_jit(static_argnums=(1,))
+    def f(x, k):
+        return float(x) + int(k)
+    """
+    assert _rules(_lint(code)) == ["MTL101"]
+
+
+def test_callback_body_is_host_code_by_contract():
+    code = """
+    import numpy as np
+    import jax
+    class Foo:
+        def update(self, x):
+            return jax.pure_callback(lambda v: np.asarray(v), shape, x)
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_bare_name_callback_import_is_also_exempt():
+    """`from jax import pure_callback` spells the same contract."""
+    code = """
+    import numpy as np
+    from jax import pure_callback
+    class Foo:
+        def update(self, x):
+            return pure_callback(lambda v: np.asarray(v), shape, x)
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_shape_metadata_reads_are_static_under_jit():
+    """`x.shape`/`x.ndim`/`x.size` are trace-static even on tracers —
+    casting them is safe and must not fire MTL101."""
+    code = """
+    from metrics_tpu.utilities.jit import tpu_jit
+    @tpu_jit
+    def f(x):
+        scale = float(x.shape[0])
+        rank = int(x.ndim)
+        return x * scale * rank
+
+    class Foo:
+        def update(self, preds):
+            n = float(preds.shape[0] * preds.shape[1])
+            return preds / n
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_len_of_traced_value_is_static_under_jit():
+    """`len(x)` on a tracer reads `shape[0]` — a python int, same static
+    category as `.shape` itself; `float(len(x))` must not fire MTL101."""
+    code = """
+    from metrics_tpu.utilities.jit import tpu_jit
+    @tpu_jit
+    def f(x):
+        return x.sum() / float(len(x))
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_value_reads_next_to_shape_reads_still_fire():
+    code = """
+    from metrics_tpu.utilities.jit import tpu_jit
+    @tpu_jit
+    def f(x):
+        return float(x.shape[0] + x[0])
+    """
+    assert _rules(_lint(code)) == ["MTL101"]
+
+
+# ---------------------------------------------------------------------------
+# MTL102 — bare jax.jit
+# ---------------------------------------------------------------------------
+def test_bare_jit_fires_everywhere_but_its_home():
+    code = """
+    import jax
+    f = jax.jit(lambda x: x)
+    """
+    assert _rules(_lint(code)) == ["MTL102"]
+    assert _rules(_lint(code, rel_path="utilities/jit.py")) == []
+
+
+def test_partial_jit_decorator_fires_once():
+    code = """
+    import jax
+    from functools import partial
+    @partial(jax.jit, static_argnames=("k",))
+    def f(x, k):
+        return x
+    """
+    assert _rules(_lint(code)) == ["MTL102"]
+
+
+def test_tpu_jit_is_the_sanctioned_spelling():
+    code = """
+    from metrics_tpu.utilities.jit import tpu_jit
+    @tpu_jit(static_argnames=("k",))
+    def f(x, k):
+        return x
+    """
+    assert _rules(_lint(code)) == []
+
+
+# ---------------------------------------------------------------------------
+# MTL103 — step-rate warnings
+# ---------------------------------------------------------------------------
+def test_warn_in_update_method_and_update_functional_fire():
+    code = """
+    import warnings
+    def _foo_update(x):
+        rank_zero_warn("every step")
+    class Foo:
+        def update(self, x):
+            warnings.warn("every step")
+        def forward(self, x):
+            rank_zero_warn("every step")
+    """
+    assert _rules(_lint(code)) == ["MTL103", "MTL103", "MTL103"]
+
+
+def test_warn_once_and_cold_paths_are_fine():
+    code = """
+    def _foo_update(x):
+        warn_once("rate limited", key="k")
+    def _foo_compute(x):
+        rank_zero_warn("epoch-end is cold")
+    class Foo:
+        def __init__(self):
+            rank_zero_warn("init-time is cold")
+    """
+    assert _rules(_lint(code)) == []
+
+
+# ---------------------------------------------------------------------------
+# MTL104 — unreduced array states
+# ---------------------------------------------------------------------------
+def test_array_state_without_reduction_fires():
+    code = """
+    class Foo:
+        def __init__(self):
+            self.add_state("acc", default=jnp.zeros(3))
+            self.add_state("acc2", jnp.zeros(3), None)
+            self.add_state("acc3", default=jnp.zeros(3), dist_reduce_fx=None)
+    """
+    assert _rules(_lint(code)) == ["MTL104", "MTL104", "MTL104"]
+
+
+def test_list_states_and_named_reductions_are_fine():
+    code = """
+    class Foo:
+        def __init__(self, fx):
+            self.add_state("cat", default=[], dist_reduce_fx=None)
+            self.add_state("cat2", default=[])
+            self.add_state("acc", default=jnp.zeros(3), dist_reduce_fx="sum")
+            self.add_state("acc2", default=jnp.zeros(3), dist_reduce_fx=fx)
+    """
+    assert _rules(_lint(code)) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression syntax
+# ---------------------------------------------------------------------------
+def test_parse_allow_comments():
+    allow = parse_allow_comments(
+        "x = 1\n# metrics-tpu: allow(MTL101)\ny = 2  # metrics-tpu: allow(MTA001, MTL104)\n"
+    )
+    assert allow == {2: {"MTL101"}, 3: {"MTA001", "MTL104"}}
+
+
+@pytest.mark.parametrize(
+    "placement",
+    ["trailing", "line-above", "comment-block"],
+    ids=["trailing", "line-above", "comment-block"],
+)
+def test_allow_comment_suppresses(placement):
+    if placement == "trailing":
+        body = "    f = jax.jit(lambda x: x)  # metrics-tpu: allow(MTL102)"
+    elif placement == "line-above":
+        body = "    # metrics-tpu: allow(MTL102)\n    f = jax.jit(lambda x: x)"
+    else:
+        body = (
+            "    # metrics-tpu: allow(MTL102) — rationale line one\n"
+            "    # continues on a second comment line\n"
+            "    f = jax.jit(lambda x: x)"
+        )
+    findings = _lint("import jax\nif True:\n" + body + "\n")
+    assert [f.rule for f in findings] == ["MTL102"]
+    assert findings[0].suppressed
+
+
+def test_allow_syntax_in_strings_is_not_a_suppression():
+    """Docstrings that *document* the allow syntax (rules.py's own module
+    docstring does) must not widen a class's suppression set — only real
+    ``#`` comment tokens count."""
+    code = (
+        "def f():\n"
+        '    """Suppress with # metrics-tpu: allow(MTA001)."""\n'
+        '    s = "# metrics-tpu: allow(MTL102)"\n'
+        "    return s\n"
+        "# metrics-tpu: allow(MTL104)\n"
+        "x = 1\n"
+    )
+    assert parse_allow_comments(code) == {5: {"MTL104"}}
+
+
+def test_allow_comment_is_rule_specific():
+    code = "import jax\nf = jax.jit(lambda x: x)  # metrics-tpu: allow(MTL104)\n"
+    findings = lint_source(code, "pkg/mod.py")
+    assert [f.rule for f in findings] == ["MTL102"]
+    assert not findings[0].suppressed

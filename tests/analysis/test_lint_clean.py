@@ -1,0 +1,104 @@
+"""Tier-1 gate: the repo's own clean baseline under both analysis passes.
+
+Any new violation — a metric whose program trips an MTA rule, or source
+that breaks a repo invariant — fails CI here. Legitimate exceptions carry
+a ``# metrics-tpu: allow(<rule>)`` with a rationale and land in the
+suppressed bucket, which stays visible in ANALYSIS.json without failing
+the gate.
+"""
+import os
+import subprocess
+import sys
+import warnings
+
+import pytest
+
+from metrics_tpu.analysis import audit_registry, lint_paths
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(scope="module")
+def registry_report():
+    # one trace of all ~29 families shared by every assertion below —
+    # tier-1 wall-clock is a budget, and the report is deterministic
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return audit_registry()
+
+
+def test_registry_audit_has_zero_unsuppressed_findings(registry_report):
+    """Acceptance gate: pass 1 over every metric family reports zero
+    unsuppressed violations."""
+    report = registry_report
+    assert report["summary"]["families"] >= 29
+    offenders = {
+        fam: entry["findings"]
+        for fam, entry in report["families"].items()
+        if entry["findings"]
+    }
+    assert report["summary"]["findings"] == 0, offenders
+
+
+def test_repo_lint_has_zero_unsuppressed_findings():
+    findings = lint_paths()
+    live = [str(f) for f in findings if not f.suppressed]
+    assert live == [], live
+
+
+def test_suppressions_are_rare_and_deliberate():
+    """The suppressed bucket is an allowlist, not a loophole: it should
+    stay small, and every entry must be an MTL101/MTL104 design exception
+    (host staging in the sharded streams, in-program mesh reductions).
+    Growing it means either a real fix was skipped or the rule needs to
+    learn a new idiom."""
+    findings = [f for f in lint_paths() if f.suppressed]
+    assert len(findings) <= 10, [str(f) for f in findings]
+    assert {f.rule for f in findings} <= {"MTL101", "MTL104"}
+
+
+def test_report_schema_is_stable(registry_report):
+    report = registry_report
+    assert report["schema"] == "metrics_tpu.analysis_report"
+    assert set(report["rules"]) == {
+        "MTA001", "MTA002", "MTA003", "MTA004",
+        "MTL101", "MTL102", "MTL103", "MTL104",
+    }
+    for entry in report["families"].values():
+        assert set(entry) == {
+            "name", "engine_eligible", "eager_reason",
+            "findings", "suppressed", "infos",
+        }
+
+
+@pytest.mark.slow  # re-execs a fresh jax process (the repo's slow contract)
+def test_gate_script_writes_atomic_artifact(tmp_path):
+    """`scripts/lint_metrics.py --strict` (the `make lint` spelling) exits
+    0 on the clean tree and leaves a parseable ANALYSIS.json. Lint-only:
+    the in-process tests above already cover the registry audit."""
+    out = tmp_path / "ANALYSIS.json"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts", "lint_metrics.py"),
+         "--strict", "--skip-audit", "--json", str(out)],
+        capture_output=True, text=True, cwd=_REPO, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    report = json.loads(out.read_text())
+    assert report["summary"]["unsuppressed_findings"] == 0
+    assert report["lint"]["summary"]["findings"] == 0
+
+
+def test_gate_script_strict_fails_on_violation(tmp_path):
+    """--strict turns findings into a non-zero exit: pointed at a tree
+    containing one bare jax.jit, the gate must go red."""
+    pkg = tmp_path / "metrics_tpu"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text("import jax\nf = jax.jit(lambda x: x)\n")
+    from metrics_tpu.analysis import lint_paths as lp
+
+    findings = lp(paths=[str(pkg / "bad.py")], root=str(tmp_path))
+    assert [f.rule for f in findings] == ["MTL102"]
+    assert not findings[0].suppressed

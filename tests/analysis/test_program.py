@@ -1,0 +1,280 @@
+"""Analyzer internals: the jaxpr walker (pjit/scan/cond recursion), the
+engine's abstract-step hook, dtype-drift detection on real metrics, and
+the watchdog cross-link."""
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+from metrics_tpu.analysis import audit_metric, hint_for_watch_key, iter_eqns
+from metrics_tpu.analysis import fixtures as fx
+from metrics_tpu.analysis.program import (
+    _callback_eqns,
+    _duplicate_outvars,
+    _LAST_AUDIT,
+)
+
+_X = (jnp.linspace(0.0, 1.0, 8),)
+
+
+def _cb(v):
+    return jax.pure_callback(
+        lambda a: np.asarray(a, np.float32), jax.ShapeDtypeStruct((), jnp.float32), v
+    )
+
+
+# ---------------------------------------------------------------------------
+# the walker: sub-jaxpr recursion
+# ---------------------------------------------------------------------------
+def test_walker_finds_callback_inside_pjit():
+    closed = jax.make_jaxpr(lambda x: jax.jit(lambda v: _cb(jnp.sum(v)))(x))(jnp.ones(4))
+    assert "pure_callback" in _callback_eqns(closed)
+
+
+def test_walker_finds_callback_inside_scan():
+    def f(x):
+        def body(carry, t):
+            return carry + _cb(t), carry
+
+        out, _ = jax.lax.scan(body, jnp.asarray(0.0), x)
+        return out
+
+    closed = jax.make_jaxpr(f)(jnp.ones(4))
+    assert "pure_callback" in _callback_eqns(closed)
+
+
+def test_walker_finds_callback_inside_cond_branch():
+    def f(x):
+        return jax.lax.cond(x[0] > 0, lambda v: _cb(jnp.sum(v)), lambda v: jnp.sum(v), x)
+
+    closed = jax.make_jaxpr(f)(jnp.ones(4))
+    assert "pure_callback" in _callback_eqns(closed)
+
+
+def test_walker_finds_callback_three_levels_deep():
+    def f(x):
+        def inner(v):
+            def body(c, t):
+                return c + _cb(t), c
+
+            return jax.lax.scan(body, jnp.asarray(0.0), v)[0]
+
+        return jax.jit(inner)(x)
+
+    closed = jax.make_jaxpr(f)(jnp.ones(4))
+    assert "pure_callback" in _callback_eqns(closed)
+
+
+def test_walker_clean_program_has_no_callbacks():
+    closed = jax.make_jaxpr(lambda x: jnp.sum(x) * 2)(jnp.ones(4))
+    assert _callback_eqns(closed) == []
+    assert len(list(iter_eqns(closed))) >= 2
+
+
+def test_duplicate_outvars_detects_aliasing():
+    closed = jax.make_jaxpr(lambda x: (jnp.sum(x),) * 2)(jnp.ones(4))
+    dups = _duplicate_outvars(closed)
+    assert len(dups) == 1 and dups[0][0] == 2
+
+    clean = jax.make_jaxpr(lambda x: (jnp.sum(x), jnp.max(x)))(jnp.ones(4))
+    assert _duplicate_outvars(clean) == []
+
+
+# ---------------------------------------------------------------------------
+# the engine hook
+# ---------------------------------------------------------------------------
+def test_abstract_step_traces_without_dispatch():
+    m = M.MeanSquaredError()
+    engine = M.CompiledStepEngine(m)
+    closed, out_shapes, n_donated = engine.abstract_step(*(_X[0], _X[0]))
+    assert n_donated == 2  # sum_squared_error + total
+    assert engine.cache_info()["compiled_signatures"] == 0  # no compile happened
+    new_states, values = out_shapes
+    assert set(new_states["metric"]) == {"sum_squared_error", "total"}
+    # state is conserved abstractly: merged dtypes match the defaults
+    assert new_states["metric"]["sum_squared_error"].dtype == jnp.float32
+    # and metric state is untouched by tracing
+    assert int(m.total) == 0
+
+
+def test_abstract_step_refuses_all_eager_engine():
+    engine = M.CompiledStepEngine(M.AUROC())  # list states: eager-only
+    with pytest.raises(ValueError, match="eager"):
+        engine.abstract_step(_X[0], jnp.ones(8, jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# dtype-drift detection on real metrics
+# ---------------------------------------------------------------------------
+def test_bf16_cast_metric_with_f32_inputs_is_flagged():
+    """`.bfloat16()` states fed f32 batches silently promote back to f32
+    after one update — the precision policy evaporates AND every later
+    step recompiles. The auditor names it before the first dispatch."""
+    m = M.MeanSquaredError().bfloat16()
+    result = audit_metric(m, (_X[0], _X[0]))
+    rules = {f.rule for f in result.findings}
+    assert rules == {"MTA001"}
+
+
+def test_bf16_inputs_with_f32_accumulators_is_clean():
+    """The sound half-precision loop — bf16 batches into f32 sufficient
+    stats (the `promote_accumulator` discipline) — stays clean."""
+    m = M.MeanSquaredError()
+    xb = _X[0].astype(jnp.bfloat16)
+    result = audit_metric(m, (xb, xb))
+    assert result.findings == []
+
+
+def test_audit_leaves_metric_usable():
+    m = M.Accuracy()
+    raw = np.random.RandomState(0).rand(16, 4).astype(np.float32)
+    preds = jnp.asarray(raw / raw.sum(1, keepdims=True))
+    target = jnp.asarray(np.random.RandomState(1).randint(4, size=16))
+    audit_metric(m, (preds, target))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        value = m(preds, target)
+    assert 0.0 <= float(value) <= 1.0
+    assert int(m.total) == 16  # audit tracing never touched live state
+
+
+# ---------------------------------------------------------------------------
+# compiled-path rules bind only metrics that claim they can compile
+# ---------------------------------------------------------------------------
+class _EagerAlias(M.Metric):
+    """No `_fused_forward`: never compiled, never donated — the aliased
+    states are legal sharing, not a donation hazard."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("a", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("b", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        total = jnp.sum(x)
+        self.a = total
+        self.b = total
+
+    def compute(self):
+        return self.a
+
+
+class _EagerCallback(M.Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.acc = self.acc + _cb(jnp.sum(x))
+
+    def compute(self):
+        return self.acc
+
+
+def test_engine_ineligible_metric_is_exempt_from_donation_aliasing():
+    result = audit_metric(_EagerAlias(), _X)
+    assert result.findings == []
+    assert not result.engine_eligible
+
+
+def test_engine_ineligible_callback_is_info_not_finding():
+    result = audit_metric(_EagerCallback(), _X)
+    assert result.findings == []
+    assert any("pure_callback" in i for i in result.infos)
+
+
+def test_fused_variants_of_the_same_programs_still_flag():
+    """Identical update programs with the fused-forward opt-in DO get the
+    compiled-path rules (the fixture classes pin the full messages)."""
+    alias = type("_FusedAlias", (_EagerAlias,), {"_fused_forward": True})
+    cb = type("_FusedCallback", (_EagerCallback,), {"_fused_forward": True})
+    assert {f.rule for f in audit_metric(alias(), _X).findings} == {"MTA003"}
+    assert {f.rule for f in audit_metric(cb(), _X).findings} == {"MTA002"}
+
+
+# ---------------------------------------------------------------------------
+# watchdog cross-link
+# ---------------------------------------------------------------------------
+def test_hint_names_rule_for_engine_watch_key():
+    audit_metric(fx.NarrowAccumulator(), _X)
+    hint = hint_for_watch_key("engine[NarrowAccumulator]")
+    assert hint is not None and "MTA001" in hint and "narrow-accumulator" in hint
+
+
+def test_single_metric_engine_watch_key_matches_audit_names():
+    """A lone metric is keyed 'metric' inside the engine; its watch key
+    must still carry the class name or the analyzer cross-link (and
+    telemetry readability) dies for the most common engine shape."""
+    engine = M.CompiledStepEngine(fx.NarrowAccumulator())
+    assert engine._watch_key == "engine[NarrowAccumulator]"
+    audit_metric(fx.NarrowAccumulator(), _X)
+    assert hint_for_watch_key(engine._watch_key) is not None
+
+
+def test_abstract_step_does_not_feed_the_watchdog():
+    """Analysis-only traces must not count as churn: auditing in a
+    telemetry session leaves the recompilation watchdog silent."""
+    from metrics_tpu import observability as obs
+
+    with obs.telemetry_scope() as tel:
+        for _ in range(tel.watchdog.trace_budget + 4):
+            audit_metric(M.MeanSquaredError(), (_X[0], _X[0]))
+        assert tel.watchdog.retrace_count() == 0
+        assert tel.watchdog.snapshot()["keys"] == {}
+
+
+def test_audit_does_not_emit_eager_fallback_events():
+    """The auditor's throwaway engines must not look like production
+    demotions in the event log: auditing an eager member (AUROC) in a
+    telemetry session leaves zero `eager_fallback` events."""
+    import warnings
+
+    from metrics_tpu import observability as obs
+    from metrics_tpu.analysis import audit_collection
+
+    binary = (jnp.linspace(0.0, 1.0, 8), jnp.ones(8, jnp.int32))
+    with obs.telemetry_scope() as tel:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            audit_metric(M.AUROC(), binary)
+            audit_collection(
+                M.MetricCollection({"auroc": M.AUROC(), "mse": M.MeanSquaredError()}),
+                binary,
+            )
+        events = [e for e in tel.events if e.get("kind") == "eager_fallback"]
+        assert events == []
+
+
+def test_hint_resolves_custom_named_collection_members():
+    """Collection engine watch keys are built from the collection's own
+    keys; auditing the collection must register results under those names
+    too, or renamed members ({'bad': ...} -> 'engine[bad]') never get an
+    attribution."""
+    from metrics_tpu.analysis import audit_collection
+
+    audit_collection(M.MetricCollection({"bad": fx.NarrowAccumulator()}), _X)
+    hint = hint_for_watch_key("engine[bad]")
+    assert hint is not None and "MTA001" in hint
+
+
+def test_hint_none_for_clean_or_unknown_keys():
+    audit_metric(M.Accuracy(), (jnp.ones((4, 2)), jnp.ones(4, jnp.int32)))
+    assert hint_for_watch_key("engine[Accuracy]") is None
+    assert hint_for_watch_key("engine[NeverAudited]") is None
+
+
+def test_watchdog_warning_carries_the_hint():
+    from metrics_tpu.observability.watchdog import RecompilationWatchdog
+
+    audit_metric(fx.NarrowAccumulator(), _X)
+    assert "NarrowAccumulator" in _LAST_AUDIT
+    wd = RecompilationWatchdog()
+    key = "engine[NarrowAccumulator,hint-test]"
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        wd.note_compile(key, new_signature=False)
+    messages = [str(w.message) for w in caught]
+    assert any("MTA001" in m and "thrashing" in m for m in messages), messages
