@@ -1,9 +1,9 @@
-.PHONY: ci lint test test-tpu test-tpu-suite doctest bench dryrun fuzz fuzz-sharded chaos clean
+.PHONY: ci lint test test-tpu test-tpu-suite doctest bench sentinel dryrun fuzz fuzz-sharded chaos clean
 
 ci:
 	# the full CI gate as one machine-runnable target (mirrors
 	# .github/workflows/ci.yml): lint -> suite (incl. doctests + api-surface
-	# guard) -> fuzz smoke -> multi-chip dryrun
+	# guard) -> fuzz smoke -> multi-chip dryrun -> perf sentinel (advisory)
 	python -m compileall -q metrics_tpu tests scripts bench.py tpu_correctness.py __graft_entry__.py
 	# lint-only: the suite runs the full program audit in-process
 	# (tests/analysis/test_lint_clean.py); `make lint` runs both passes
@@ -12,6 +12,15 @@ ci:
 	python scripts/fuzz_parity.py --trials 50
 	python scripts/fuzz_sharded.py --trials 25
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	# perf-regression sentinel, ADVISORY (reports, never gates — `make
+	# sentinel` or --strict to gate; the leading `-` makes a bench hiccup
+	# non-fatal for real): one fresh bench run with the flight recorder
+	# armed and per-leg Perfetto traces kept, compared per leg against the
+	# committed BENCH_r0*.json trajectory. Writes SENTINEL.json; CI uploads
+	# it (plus flight-dumps/ and bench-traces/) as workflow artifacts.
+	-METRICS_TPU_FLIGHT=flight-dumps python bench.py --trace-out bench-traces | tee bench_current.txt
+	-tail -n 1 bench_current.txt > bench_current.json
+	-python scripts/perf_sentinel.py --current bench_current.json
 
 lint:
 	# static analysis gate: pass 1 traces every metric family's program
@@ -51,6 +60,12 @@ bench:
 	# north-star benchmark; prints one JSON line (real TPU when available)
 	python bench.py
 
+sentinel:
+	# perf-regression sentinel, STRICT: fresh bench.py run compared per leg
+	# against the committed BENCH_r0*.json trajectory; exit 1 on any leg
+	# above threshold x baseline. Writes SENTINEL.json.
+	python scripts/perf_sentinel.py --strict
+
 fuzz:
 	# randomized differential parity vs the reference library (functional +
 	# stateful module layers); exits non-zero on any mismatch
@@ -75,5 +90,6 @@ dryrun:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 clean:
-	rm -rf .pytest_cache .jax_cache
+	rm -rf .pytest_cache .jax_cache flight-dumps bench-traces
+	rm -f bench_current.txt bench_current.json
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -501,6 +501,8 @@ def _forward_leg() -> None:
             "cache_misses": int(counters.get("engine.cache_misses", 0)),
         }
 
+    trace_dir = os.environ.get("BENCH_TRACE_OUT")
+
     def leg(marker, col, p, t):
         if obs.enabled():
             obs.get().reset()  # fresh telemetry window per leg
@@ -513,6 +515,20 @@ def _forward_leg() -> None:
             best = min(best, (time.perf_counter() - t0) / 10 * 1e3)
         print(marker, best, flush=True)
         print("TELEMETRY", marker, _json.dumps(telemetry_block(col)), flush=True)
+        if trace_dir:
+            # --trace-out: one Perfetto trace_event file per leg, recorded
+            # on ONE extra steady-state step AFTER the timed loop (the
+            # timed numbers above stay untraced) — BENCH runs double as a
+            # trace corpus for the perf sentinel and the docs
+            from metrics_tpu.reliability.journal import atomic_write_json
+
+            os.makedirs(trace_dir, exist_ok=True)
+            with obs.tracing_scope() as tracer:
+                run(col, p, t)
+            atomic_write_json(
+                os.path.join(trace_dir, f"{marker.lower()}.trace.json"),
+                tracer.to_perfetto(),
+            )
 
     leg("FORWARD_MS", cls_col(False), probs, target)
     leg("FORWARD_COMPILED_MS", cls_col(True), probs, target)
@@ -968,6 +984,15 @@ def _run_jax_leg_isolated() -> tuple:
 
 
 def main() -> None:
+    import os
+
+    if "--trace-out" in sys.argv:
+        # per-leg Perfetto traces (see _forward_leg): exported through the
+        # environment so the subprocess legs see it too
+        idx = sys.argv.index("--trace-out") + 1
+        if idx >= len(sys.argv) or sys.argv[idx].startswith("--"):
+            raise SystemExit("--trace-out needs a directory argument")
+        os.environ["BENCH_TRACE_OUT"] = sys.argv[idx]
     if "--leg-jax" in sys.argv:
         per_step, acc, auroc, platform = _bench_jax()
         print(f"JAXLEG {per_step} {acc} {auroc} {platform}")

@@ -51,7 +51,9 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.regression.sufficient_stats import regression_family_sharing
 from metrics_tpu.metric import Metric
+from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.observability import trace as _trace
 from metrics_tpu.parallel.backend import is_distributed_initialized
 from metrics_tpu.reliability import guard as _rguard
 from metrics_tpu.utilities.checks import shared_canonicalization
@@ -367,25 +369,44 @@ class CompiledStepEngine:
         out: Dict[str, Any] = {}
         if names:
             with self._lock:
+                # step attribution for tracing/flight: one engine dispatch =
+                # one step (an EvalSession pins its own cursor over this via
+                # step_scope, so session-driven spans carry the durable index)
+                if _trace.tracing_enabled() or _flight.flight_enabled():
+                    _trace.advance_step()
                 guard = _rguard.active()
                 guard_token = self._guard_token(guard)
-                signature = self._signature(names, args, kwargs, guard_token)
-                fn, cache_hit = self._get_compiled(signature, names, guard_token)
+                with _trace.span(
+                    "engine.cache_lookup", phase="dispatch", engine=self._watch_key
+                ):
+                    signature = self._signature(names, args, kwargs, guard_token)
+                    fn, cache_hit = self._get_compiled(signature, names, guard_token)
                 # guard-active steps donate COPIES so the live attributes
                 # double as a last-good snapshot (restorable if the dispatch
                 # fails after donation); unguarded steps keep the pristine
                 # zero-copy donation
-                states = self._donatable_states(names, copy_all=guard is not None)
+                with _trace.span("engine.donate", phase="dispatch", copy_all=guard is not None):
+                    states = self._donatable_states(names, copy_all=guard is not None)
                 telemetry_on = _obs.enabled()
+                if _flight.flight_enabled():
+                    _flight.record(
+                        "engine_dispatch", engine=self._watch_key, cache_hit=cache_hit
+                    )
                 if telemetry_on:
                     _obs.get().count("engine.dispatches")
                     t0 = _time.perf_counter()
                 try:
-                    if guard_token is None:
-                        new_states, values = fn(states, args, kwargs)
-                        finites = None
-                    else:
-                        new_states, values, finites = fn(states, args, kwargs)
+                    with _trace.span(
+                        "engine.dispatch",
+                        phase="dispatch",
+                        engine=self._watch_key,
+                        cache_hit=cache_hit,
+                    ):
+                        if guard_token is None:
+                            new_states, values = fn(states, args, kwargs)
+                            finites = None
+                        else:
+                            new_states, values, finites = fn(states, args, kwargs)
                 except Exception as err:  # noqa: BLE001 — any trace failure
                     self._compiled.pop(signature, None)
                     if guard is None:
@@ -413,6 +434,16 @@ class CompiledStepEngine:
                         # the eager rerun succeeded where the dispatch died:
                         # THIS is the recovery event
                         _obs.get().count("reliability.engine_dispatch_recoveries")
+                    # flight recorder: the eager rerun succeeding is what
+                    # makes this a demotion (a bad input re-raises above and
+                    # never reaches here) — one dump per demoted engine, with
+                    # the last-N-steps window leading up to the failure
+                    _flight.dump_on_failure(
+                        "engine_dispatch_failure",
+                        engine=self._watch_key,
+                        error=f"{type(err).__name__}: {err}",
+                        demoted=list(names),
+                    )
                     for n in names:
                         self._eager_names.setdefault(
                             n, f"trace failed: {type(err).__name__}: {err}"

@@ -23,6 +23,7 @@ TPU-native design decisions:
 import functools
 import inspect
 import operator
+import time as _time
 from abc import ABC, abstractmethod
 from collections.abc import Sequence
 from copy import deepcopy
@@ -32,6 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.observability import trace as _obs_trace
 from metrics_tpu.parallel.backend import is_distributed_initialized
 from metrics_tpu.reliability import guard as _rguard
 from metrics_tpu.reliability import sync as _rsync
@@ -345,7 +347,24 @@ class Metric(ABC):
 
     def _sync_dist(self, dist_sync_fn: Callable = gather_all_tensors) -> None:
         """All-gather every registered state and apply its reduction
-        (reference ``metric.py:176-194``)."""
+        (reference ``metric.py:176-194``). With telemetry on, the whole
+        sync (gathers + reductions) feeds the fixed-bucket
+        ``sync.latency_ms`` / ``sync.payload_bytes`` histograms — the
+        per-collective evidence stream the compressed-sync ROADMAP work
+        sizes itself against; with span tracing on it records one
+        phase="sync" span per sync."""
+        telemetry_on = _obs.enabled()
+        t0 = _time.perf_counter() if telemetry_on else 0.0
+        with _obs_trace.span(f"metrics_tpu.{type(self).__name__}.sync", phase="sync"):
+            self._sync_dist_impl(dist_sync_fn)
+        if telemetry_on:
+            _obs.get().observe_hist(
+                "sync.latency_ms",
+                (_time.perf_counter() - t0) * 1e3,
+                _obs.LATENCY_BUCKETS_MS,
+            )
+
+    def _sync_dist_impl(self, dist_sync_fn: Callable = gather_all_tensors) -> None:
         input_dict = {attr: getattr(self, attr) for attr in self._reductions}
         if _obs.enabled():
             tel = _obs.get()
@@ -356,6 +375,7 @@ class Metric(ABC):
             )
             tel.count("sync.calls")
             tel.count("sync.payload_bytes", payload)
+            tel.observe_hist("sync.payload_bytes", payload, _obs.PAYLOAD_BUCKETS_BYTES)
             tel.event("sync", metric=type(self).__name__, payload_bytes=payload)
         # reliability hook: an installed SyncPolicy adds timeout + bounded
         # retry around every gather; a plain passthrough (one global read)

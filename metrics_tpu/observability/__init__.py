@@ -19,7 +19,17 @@ Quick start::
         ... one eval pass ...
         assert tel.watchdog.retrace_count() == 0
 """
+from metrics_tpu.observability.flight import (  # noqa: F401
+    FlightRecorder,
+    disable_flight,
+    enable_flight,
+    flight_enabled,
+    flight_scope,
+    get_flight,
+)
 from metrics_tpu.observability.telemetry import (  # noqa: F401
+    LATENCY_BUCKETS_MS,
+    PAYLOAD_BUCKETS_BYTES,
     Telemetry,
     disable,
     enable,
@@ -30,10 +40,22 @@ from metrics_tpu.observability.telemetry import (  # noqa: F401
     profile_span,
     telemetry_scope,
 )
+from metrics_tpu.observability.trace import (  # noqa: F401
+    PHASES,
+    TraceRecorder,
+    disable_tracing,
+    enable_tracing,
+    get_tracer,
+    step_scope,
+    tracing_enabled,
+    tracing_scope,
+)
 from metrics_tpu.observability.watchdog import RecompilationWatchdog  # noqa: F401
 
 __all__ = [
     "Telemetry",
+    "TraceRecorder",
+    "FlightRecorder",
     "RecompilationWatchdog",
     "enable",
     "disable",
@@ -43,6 +65,20 @@ __all__ = [
     "note_trace",
     "metric_scope",
     "profile_span",
+    "enable_tracing",
+    "disable_tracing",
+    "tracing_enabled",
+    "tracing_scope",
+    "get_tracer",
+    "step_scope",
+    "PHASES",
+    "enable_flight",
+    "disable_flight",
+    "flight_enabled",
+    "flight_scope",
+    "get_flight",
+    "LATENCY_BUCKETS_MS",
+    "PAYLOAD_BUCKETS_BYTES",
     "report",
     "to_json",
 ]

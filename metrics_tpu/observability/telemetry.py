@@ -22,6 +22,7 @@ Enable via ``metrics_tpu.observability.enable()``, the
 the environment (parsed once at import by ``utilities/env.py``).
 """
 import atexit
+import bisect
 import json
 import os
 import threading
@@ -30,8 +31,10 @@ from collections import deque
 from contextlib import contextmanager, nullcontext
 from typing import Any, Dict, Iterator, Optional
 
+from metrics_tpu.observability import trace as _trace
 from metrics_tpu.observability.watchdog import RecompilationWatchdog
 from metrics_tpu.utilities.env import telemetry_requested
+from metrics_tpu.utilities.prints import warn_once
 
 __all__ = [
     "Telemetry",
@@ -43,9 +46,21 @@ __all__ = [
     "note_trace",
     "metric_scope",
     "profile_span",
+    "LATENCY_BUCKETS_MS",
+    "PAYLOAD_BUCKETS_BYTES",
 ]
 
 _DEFAULT_MAX_EVENTS = 1024
+
+# fixed histogram bucket edges (upper bounds; one implicit +Inf bucket at
+# the end). FIXED by design: per-collective latency/payload distributions
+# recorded on different hosts/rounds must merge bucket-by-bucket, and the
+# BENCH trajectory's sentinel can only compare like against like when the
+# edges never move. Latency spans the observed sync range (sub-ms local
+# gathers to the 50–125 ms 8-dev legs and beyond); payload spans one
+# scalar state to a gathered 1M-row cat buffer.
+LATENCY_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0)
+PAYLOAD_BUCKETS_BYTES = (64, 256, 1024, 4096, 16384, 65536, 262144, 1048576, 4194304, 16777216, 67108864)
 
 
 class Telemetry:
@@ -62,7 +77,13 @@ class Telemetry:
         self.gauges: Dict[str, float] = {}
         # name -> [total_seconds, count]
         self._timers: Dict[str, list] = {}
+        # name -> {"buckets": [...edges...], "counts": [len(edges)+1],
+        #          "sum": float, "count": int} — fixed-bucket histograms
+        self.histograms: Dict[str, Dict[str, Any]] = {}
         self.events: "deque[Dict[str, Any]]" = deque(maxlen=self.max_events)
+        # events evicted by the bounded log wrapping — surfaced in
+        # report() so "the log looks complete" is never silently false
+        self.dropped_events = 0
         self.watchdog = RecompilationWatchdog(telemetry=self)
 
     # ------------------------------------------------------------------
@@ -84,7 +105,29 @@ class Telemetry:
 
     def event(self, kind: str, **fields: Any) -> None:
         with self._lock:
+            if len(self.events) == self.events.maxlen:
+                self.dropped_events += 1
             self.events.append({"kind": kind, **fields})
+
+    def observe_hist(self, name: str, value: float, buckets: tuple) -> None:
+        """Record ``value`` into the fixed-bucket histogram ``name``
+        (``buckets`` are inclusive upper bounds; overflow lands in the
+        implicit +Inf bucket). The bucket edges are set by the FIRST
+        observation of a name and never change after — fixed buckets are
+        what makes histograms mergeable across hosts and bench rounds."""
+        with self._lock:
+            h = self.histograms.get(name)
+            if h is None:
+                h = self.histograms[name] = {
+                    "buckets": list(buckets),
+                    "counts": [0] * (len(buckets) + 1),
+                    "sum": 0.0,
+                    "count": 0,
+                }
+            idx = bisect.bisect_left(h["buckets"], value)
+            h["counts"][idx] += 1
+            h["sum"] += float(value)
+            h["count"] += 1
 
     @contextmanager
     def timer(self, name: str) -> Iterator[None]:
@@ -107,7 +150,12 @@ class Telemetry:
                     name: {"total_s": total, "count": count}
                     for name, (total, count) in self._timers.items()
                 },
+                "histograms": {
+                    name: dict(h, counts=list(h["counts"]), buckets=list(h["buckets"]))
+                    for name, h in self.histograms.items()
+                },
                 "events": list(self.events),
+                "dropped_events": self.dropped_events,
                 "watchdog": self.watchdog.snapshot(),
             }
 
@@ -138,6 +186,20 @@ class Telemetry:
             lines.append(f"  {name:<48} {t['total_s'] * 1e3:>10.3f} / {t['count']}")
         if not snap["timers"]:
             lines.append("  (none)")
+        if snap["histograms"]:
+            lines.append("histograms (count / mean / p-buckets):")
+            for name in sorted(snap["histograms"]):
+                h = snap["histograms"][name]
+                mean = h["sum"] / h["count"] if h["count"] else 0.0
+                # compact: only the occupied buckets
+                occupied = [
+                    f"<={h['buckets'][i] if i < len(h['buckets']) else 'inf'}:{c}"
+                    for i, c in enumerate(h["counts"])
+                    if c
+                ]
+                lines.append(
+                    f"  {name:<48} n={h['count']} mean={mean:.4g} " + " ".join(occupied)
+                )
         wd = snap["watchdog"]
         lines.append("recompilation watchdog:")
         if not wd["keys"]:
@@ -148,7 +210,14 @@ class Telemetry:
                 f"  {key:<48} traces={entry['traces']}"
                 f" retraces={entry['retraces']} [{verdict}]"
             )
-        lines.append(f"events recorded: {len(snap['events'])} (cap {self.max_events})")
+        dropped = (
+            f", {snap['dropped_events']} dropped by the bounded log"
+            if snap["dropped_events"]
+            else ""
+        )
+        lines.append(
+            f"events recorded: {len(snap['events'])} (cap {self.max_events}{dropped})"
+        )
         return "\n".join(lines)
 
     def reset(self) -> None:
@@ -156,7 +225,9 @@ class Telemetry:
             self.counters.clear()
             self.gauges.clear()
             self._timers.clear()
+            self.histograms.clear()
             self.events.clear()
+            self.dropped_events = 0
             self.watchdog.reset()
 
 
@@ -273,27 +344,62 @@ def profile_span(name: str):
     return _Span(name)
 
 
+def _in_traced_region() -> bool:
+    """True when a JAX trace is currently in progress on this thread (the
+    compiled step engine tracing its step function, a user jit). Never
+    raises — the hook must not depend on jax internals staying stable."""
+    try:
+        import jax
+
+        return not jax.core.trace_state_clean()
+    except Exception:  # noqa: BLE001 — advisory check only
+        return False
+
+
+# host-timing phases attributed to the canonical trace-phase set; forward
+# folds update+merge, so its span files under "update"
+_TRACE_PHASE = {"update": "update", "compute": "compute", "forward": "update"}
+
+
 @contextmanager
 def _metric_scope_impl(metric: Any, phase: str) -> Iterator[None]:
     name = type(metric).__name__
+    if _enabled and _in_traced_region():
+        # under tracing the counters stay useful (they ARE the retrace
+        # signal), but the perf_counter delta below measures TRACING cost,
+        # not step cost — say so once instead of letting a meaningless
+        # timer masquerade as a hot-path measurement
+        warn_once(
+            f"metrics_tpu telemetry: metric_scope({name}.{phase}) entered"
+            " under an active JAX trace — the recorded host wall-time is"
+            " trace-time cost, not step cost (lint rule MTL103 covers the"
+            " same hazard for step-rate warnings; see"
+            " docs/static_analysis.md)",
+            key=f"host-timing-under-trace:{name}.{phase}",
+        )
     t0 = time.perf_counter()
-    with profile_span(f"metrics_tpu.{name}.{phase}"):
+    with profile_span(f"metrics_tpu.{name}.{phase}"), _trace.span(
+        f"metrics_tpu.{name}.{phase}", phase=_TRACE_PHASE.get(phase, "other")
+    ):
         try:
             yield
         finally:
-            _telemetry.count(f"metric.{name}.{phase}_calls")
-            _telemetry.observe(f"metric.{name}.{phase}_s", time.perf_counter() - t0)
-            if phase == "forward":
-                nbytes = _state_nbytes(metric)
-                if nbytes is not None:
-                    _telemetry.gauge(f"metric.{name}.state_nbytes", nbytes)
+            if _enabled:
+                _telemetry.count(f"metric.{name}.{phase}_calls")
+                _telemetry.observe(f"metric.{name}.{phase}_s", time.perf_counter() - t0)
+                if phase == "forward":
+                    nbytes = _state_nbytes(metric)
+                    if nbytes is not None:
+                        _telemetry.gauge(f"metric.{name}.state_nbytes", nbytes)
 
 
 def metric_scope(metric: Any, phase: str):
     """Lifecycle hook for ``Metric`` update/compute/forward: wall time,
-    call count, and (on forward) accumulated-state nbytes. Returns a
-    shared null context when disabled — the hot path pays one branch."""
-    if not _enabled:
+    call count, and (on forward) accumulated-state nbytes — plus a
+    step-structured trace span when span tracing is on. Returns a shared
+    null context when both recorders are off — the hot path pays two
+    global reads."""
+    if not _enabled and not _trace.tracing_enabled():
         return _NULL_CM
     return _metric_scope_impl(metric, phase)
 
@@ -344,15 +450,25 @@ def _dump_at_exit() -> None:
     """When ``METRICS_TPU_TELEMETRY_DUMP=<path>`` is set and telemetry ran,
     write the final registry snapshot there at interpreter exit — the
     mechanism ``scripts/tpu_suite.py`` uses to collect per-chunk telemetry
-    from its pytest subprocesses on failure."""
+    from its pytest subprocesses on failure. Atomic (tmp + fsync +
+    ``os.replace`` via ``journal.atomic_write_json``): a crash landing
+    mid-dump — exactly the moment this hook exists for — must leave the
+    previous dump, never a torn JSON the suite then fails to parse."""
     path = os.environ.get(_DUMP_ENV)
     if not path or not (_enabled or _telemetry.counters or _telemetry.events):
         return
     try:
-        with open(path, "w") as f:
-            f.write(_telemetry.to_json(indent=1))
-    except OSError:
-        pass
+        # lazy import: journal imports this module; the cycle is harmless
+        # at exit time (both fully initialized) but not at import time
+        from metrics_tpu.reliability.journal import atomic_write_json
+
+        atomic_write_json(path, _telemetry.snapshot())
+    except Exception:  # noqa: BLE001 — interpreter is exiting; best-effort
+        try:
+            with open(path, "w") as f:
+                f.write(_telemetry.to_json(indent=1))
+        except OSError:
+            pass
 
 
 atexit.register(_dump_at_exit)

@@ -1,0 +1,352 @@
+"""Step-structured tracing: where inside a step does the time go.
+
+The telemetry layer (``telemetry.py``) counts and totals; it cannot answer
+the ROADMAP's next-frontier question — *where inside a step* the 50–125 ms
+sync legs go (``BENCH_r04/r05 sync_8dev_cpu_ms``), or what ordering of
+canonicalize / update / compute / sync / checkpoint work a failing step
+actually performed. The :class:`TraceRecorder` closes that gap with
+step-indexed spans:
+
+* every span carries a **step index** (the engine's dispatch counter, or
+  the :class:`~metrics_tpu.reliability.EvalSession` step cursor when a
+  session pins it via :func:`step_scope`), a **phase** from the canonical
+  attribution set (:data:`PHASES`), wall-clock start/duration, and
+  parent/child nesting (per-thread span stack);
+* recording is a ring buffer (``deque(maxlen=...)``) — bounded memory, the
+  newest spans win;
+* the whole recording exports as Chrome/Perfetto ``trace_event`` JSON via
+  :meth:`TraceRecorder.to_perfetto` (load it in https://ui.perfetto.dev or
+  ``chrome://tracing``), and ``scripts/trace_export.py`` converts saved
+  dumps from the command line.
+
+Like every observability feature the default is OFF and zero-overhead:
+every hook reads one module global and branches; a disabled
+:func:`span` returns a shared null context and contributes nothing to any
+traced/compiled program. Enable with :func:`enable_tracing`,
+:func:`tracing_scope`, or ``METRICS_TPU_TRACE=1`` in the environment.
+
+Scope note: spans measure **host** wall-clock. Under the compiled step
+engine the update/compute hooks fire at trace time only (they are inside
+the jitted step function); the host-visible per-step phases — dispatch,
+cache lookup, donation, sync, checkpoint — are instrumented at their host
+call sites, which is where the step time the telemetry timers report
+actually goes. For device-side attribution use the profiler spans
+(``profile_span``/``BENCH_PROFILE``), which name XLA ops.
+"""
+import itertools
+import json
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager, nullcontext
+from typing import Any, Dict, Iterator, List, Optional
+
+from metrics_tpu.utilities.env import trace_requested
+
+__all__ = [
+    "PHASES",
+    "TraceRecorder",
+    "enable_tracing",
+    "disable_tracing",
+    "tracing_enabled",
+    "tracing_scope",
+    "get_tracer",
+    "span",
+    "instant",
+    "step_scope",
+    "advance_step",
+    "current_step",
+    "spans_to_perfetto",
+]
+
+# the canonical phase-attribution set: where inside a metric step work can
+# go. "dispatch" covers the engine's host-side step machinery (cache
+# lookup, donation, the XLA dispatch itself); "other" is the explicit
+# bucket for spans that predate a phase assignment.
+PHASES = ("canonicalize", "update", "compute", "sync", "checkpoint", "dispatch", "other")
+
+_DEFAULT_MAX_SPANS = 8192
+
+
+class TraceRecorder:
+    """Bounded recorder of step-indexed, phase-attributed, nested spans.
+
+    Thread-safe: completed spans commit under a lock; the open-span stack
+    (parent/child nesting) is per-thread, so concurrent sync workers and
+    the main loop nest independently.
+    """
+
+    def __init__(self, max_spans: int = _DEFAULT_MAX_SPANS):
+        self._lock = threading.RLock()
+        self.max_spans = int(max_spans)
+        self.spans: "deque[Dict[str, Any]]" = deque(maxlen=self.max_spans)
+        self.dropped = 0
+        self._ids = itertools.count(1)
+        self._origin_ns = time.perf_counter_ns()
+        self._tls = threading.local()
+
+    # ------------------------------------------------------------------
+    # recording
+    # ------------------------------------------------------------------
+    def _stack(self) -> List[int]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _commit(self, record: Dict[str, Any]) -> None:
+        with self._lock:
+            if len(self.spans) == self.spans.maxlen:
+                self.dropped += 1
+            self.spans.append(record)
+
+    @contextmanager
+    def span(
+        self, name: str, phase: str = "other", step: Optional[int] = None, **attrs: Any
+    ) -> Iterator[None]:
+        """Record one nested span around a ``with`` block."""
+        sid = next(self._ids)
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        stack.append(sid)
+        t0 = time.perf_counter_ns()
+        try:
+            yield
+        finally:
+            dur = time.perf_counter_ns() - t0
+            stack.pop()
+            self._commit(
+                {
+                    "name": name,
+                    "phase": phase if phase in PHASES else "other",
+                    "step": current_step() if step is None else int(step),
+                    "ts_us": (t0 - self._origin_ns) / 1e3,
+                    "dur_us": dur / 1e3,
+                    "tid": threading.get_ident() & 0xFFFF,
+                    "id": sid,
+                    "parent": parent,
+                    "args": attrs,
+                }
+            )
+
+    def instant(
+        self, name: str, phase: str = "other", step: Optional[int] = None, **attrs: Any
+    ) -> None:
+        """Record one zero-duration point event."""
+        self._commit(
+            {
+                "name": name,
+                "phase": phase if phase in PHASES else "other",
+                "step": current_step() if step is None else int(step),
+                "ts_us": (time.perf_counter_ns() - self._origin_ns) / 1e3,
+                "dur_us": None,
+                "tid": threading.get_ident() & 0xFFFF,
+                "id": next(self._ids),
+                "parent": None,
+                "args": attrs,
+            }
+        )
+
+    # ------------------------------------------------------------------
+    # reading / export
+    # ------------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-serializable native dump: ``{"format": ..., "spans": [...]}``."""
+        with self._lock:
+            return {
+                "format": "metrics_tpu.trace",
+                "schema_version": 1,
+                "max_spans": self.max_spans,
+                "dropped": self.dropped,
+                "spans": list(self.spans),
+            }
+
+    def to_perfetto(self) -> Dict[str, Any]:
+        """The recording as Chrome/Perfetto ``trace_event`` JSON (loadable
+        in https://ui.perfetto.dev and ``chrome://tracing``)."""
+        with self._lock:
+            return spans_to_perfetto(list(self.spans))
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def step_range(self) -> Optional[List[int]]:
+        """``[first, last]`` step index seen across recorded spans (None
+        when nothing step-attributed was recorded)."""
+        with self._lock:
+            steps = [s["step"] for s in self.spans if s.get("step") is not None]
+        return [min(steps), max(steps)] if steps else None
+
+    def reset(self) -> None:
+        with self._lock:
+            self.spans.clear()
+            self.dropped = 0
+            self._origin_ns = time.perf_counter_ns()
+
+
+def spans_to_perfetto(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Convert native span records to the ``trace_event`` JSON schema —
+    shared by :meth:`TraceRecorder.to_perfetto` and the
+    ``scripts/trace_export.py`` CLI (one converter, no format drift).
+
+    Complete events (``ph: "X"``) carry microsecond ``ts``/``dur``;
+    instants are ``ph: "i"`` with thread scope. The step index and span
+    attrs ride in ``args`` so Perfetto's query/selection UI can group by
+    step; the phase is the event category (``cat``).
+    """
+    events: List[Dict[str, Any]] = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": 0,
+            "args": {"name": "metrics_tpu"},
+        }
+    ]
+    for s in spans:
+        args = {"step": s.get("step")}
+        args.update(s.get("args") or {})
+        ev: Dict[str, Any] = {
+            "name": s["name"],
+            "cat": s.get("phase", "other"),
+            "pid": 1,
+            "tid": s.get("tid", 0),
+            "ts": round(float(s["ts_us"]), 3),
+            "args": args,
+        }
+        if s.get("dur_us") is None:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        else:
+            ev["ph"] = "X"
+            ev["dur"] = round(float(s["dur_us"]), 3)
+        events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# ----------------------------------------------------------------------
+# module-level singleton + enable/disable switch (telemetry's shape)
+# ----------------------------------------------------------------------
+_recorder = TraceRecorder()
+_enabled = False
+
+# step attribution: a process-wide monotone dispatch counter, overridable
+# per host op by an EvalSession pinning its own step cursor (step_scope).
+# The lock keeps concurrent engine dispatches (each engine holds only its
+# own instance lock) from losing or duplicating step indices.
+_auto_step = 0
+_auto_step_lock = threading.Lock()
+_step_tls = threading.local()
+
+
+def get_tracer() -> TraceRecorder:
+    """The process-local recorder (valid whether or not tracing is on)."""
+    return _recorder
+
+
+def tracing_enabled() -> bool:
+    """The ONE check every hook makes; keep it a plain global read."""
+    return _enabled
+
+
+def enable_tracing(max_spans: Optional[int] = None) -> TraceRecorder:
+    """Turn span recording on (idempotent); ``max_spans`` resizes the ring
+    buffer, preserving the newest spans."""
+    global _enabled
+    if max_spans is not None and max_spans != _recorder.max_spans:
+        with _recorder._lock:
+            _recorder.max_spans = int(max_spans)
+            _recorder.spans = deque(_recorder.spans, maxlen=_recorder.max_spans)
+    _enabled = True
+    return _recorder
+
+
+def disable_tracing() -> None:
+    """Turn recording off. Recorded spans stay readable via
+    :func:`get_tracer`."""
+    global _enabled
+    _enabled = False
+
+
+@contextmanager
+def tracing_scope(max_spans: Optional[int] = None, fresh: bool = True) -> Iterator[TraceRecorder]:
+    """Enable tracing for a ``with`` block::
+
+        with obs.tracing_scope() as tracer:
+            run_eval()
+        json.dump(tracer.to_perfetto(), open("step.trace.json", "w"))
+
+    ``fresh=True`` (default) clears the recorder on entry so the yielded
+    recording covers exactly the block; prior enabled/disabled state is
+    restored on exit.
+    """
+    global _enabled
+    prior = _enabled
+    rec = enable_tracing(max_spans)
+    if fresh:
+        rec.reset()
+    try:
+        yield rec
+    finally:
+        _enabled = prior
+
+
+# ----------------------------------------------------------------------
+# step attribution
+# ----------------------------------------------------------------------
+def current_step() -> int:
+    """The step index new spans are attributed to: the session-pinned step
+    inside a :func:`step_scope`, else the process-wide dispatch counter."""
+    pinned = getattr(_step_tls, "pinned", None)
+    return pinned if pinned is not None else _auto_step
+
+
+def advance_step() -> int:
+    """Advance the process-wide step counter (one call per engine dispatch
+    / top-level metric forward). Inside a :func:`step_scope` the pinned
+    step wins and the auto counter is left untouched — the session, not
+    the engine, owns step numbering then."""
+    global _auto_step
+    pinned = getattr(_step_tls, "pinned", None)
+    if pinned is not None:
+        return pinned
+    with _auto_step_lock:
+        _auto_step += 1
+        return _auto_step
+
+
+@contextmanager
+def step_scope(step_index: int) -> Iterator[None]:
+    """Pin the step index for every span/event recorded in the block (the
+    :class:`~metrics_tpu.reliability.EvalSession` wraps each forward so
+    spans carry the durable step cursor, not the raw dispatch count)."""
+    prev = getattr(_step_tls, "pinned", None)
+    _step_tls.pinned = int(step_index)
+    try:
+        yield
+    finally:
+        _step_tls.pinned = prev
+
+
+# ----------------------------------------------------------------------
+# hook helpers (cheap no-ops when disabled)
+# ----------------------------------------------------------------------
+_NULL_CM = nullcontext()
+
+
+def span(name: str, phase: str = "other", **attrs: Any):
+    """A recorder span when tracing is enabled, a shared null context
+    otherwise — the hook every instrumented call site uses."""
+    if not _enabled:
+        return _NULL_CM
+    return _recorder.span(name, phase=phase, **attrs)
+
+
+def instant(name: str, phase: str = "other", **attrs: Any) -> None:
+    """A point event when tracing is enabled; no-op otherwise."""
+    if _enabled:
+        _recorder.instant(name, phase=phase, **attrs)
+
+
+if trace_requested():
+    enable_tracing()

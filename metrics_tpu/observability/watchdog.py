@@ -23,6 +23,7 @@ Telemetry` registry and only hears anything while telemetry is enabled.
 """
 from typing import Any, Dict, Optional
 
+from metrics_tpu.observability import flight as _flight
 from metrics_tpu.utilities.prints import warn_once
 
 __all__ = ["RecompilationWatchdog"]
@@ -122,6 +123,16 @@ class RecompilationWatchdog:
         if self._telemetry is not None:
             self._telemetry.count("watchdog.retraces")
             self._telemetry.event("retrace", key=key, reason=reason)
+        # a watchdog verdict is a failure the loop survives — exactly what
+        # the flight recorder's last-N-steps window is for. The dump
+        # carries the analyzer-rule hint so the reader gets symptom
+        # (churn), context (the steps before it), and likely cause (rule)
+        # in one artifact.
+        _flight.record("watchdog_retrace", key=key)
+        if entry["retraces"] == 1:  # one dump per key — thrash fires per occurrence
+            # "verdict", not "reason": the positional dump reason is the
+            # trigger kind; the watchdog's sentence rides as context
+            _flight.dump_on_failure("watchdog_retrace", hint=hint, key=key, verdict=reason)
         warn_once(
             f"metrics_tpu recompilation watchdog: {key}: {reason}"
             " (warning once; see observability report for counts)",

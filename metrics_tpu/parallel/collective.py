@@ -51,6 +51,12 @@ def sync_array(x: jax.Array, reduction: Reduction, axis_name: str) -> jax.Array:
         tel.count(f"collective.{reduction if reduction is not None else 'gather'}")
         tel.count("collective.ops")
         tel.count("collective.payload_bytes", payload)
+        # per-collective payload distribution (fixed buckets, mergeable
+        # across hosts/rounds) — the counter above totals, the histogram
+        # shows whether the bytes are one big gather or many small psums
+        tel.observe_hist(
+            "collective.payload_bytes", payload, _obs.PAYLOAD_BUCKETS_BYTES
+        )
     if reduction == "sum":
         return lax.psum(x, axis_name)
     if reduction == "mean":
@@ -94,9 +100,13 @@ def masked_cat_sync(buffer: jax.Array, count: jax.Array, axis_name: str):
     """
     if _obs.enabled():
         tel = _obs.get()
+        payload = _obs.array_nbytes(buffer) + _obs.array_nbytes(count)
         tel.count("collective.cat")
         tel.count("collective.ops", 2)
-        tel.count("collective.payload_bytes", _obs.array_nbytes(buffer) + _obs.array_nbytes(count))
+        tel.count("collective.payload_bytes", payload)
+        tel.observe_hist(
+            "collective.payload_bytes", payload, _obs.PAYLOAD_BUCKETS_BYTES
+        )
     gathered = lax.all_gather(buffer, axis_name, tiled=True)
     counts = lax.all_gather(count, axis_name)
     capacity = buffer.shape[0]

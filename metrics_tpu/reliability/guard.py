@@ -48,6 +48,7 @@ from typing import Any, Dict, Iterator, Optional, Union
 import jax
 import jax.numpy as jnp
 
+from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.utilities.prints import warn_once
 
@@ -179,6 +180,15 @@ class StateGuard:
         function performs the last-good select in-program."""
         name = type(metric).__name__
         self.stats["violations"] += 1
+        # flight recorder: a rollback (raise/quarantine) is a survived
+        # failure worth a black-box dump; "warn" keeps the poisoned state,
+        # which re-flags every later batch — record the event, but a dump
+        # per step would bury the one that matters
+        _flight.record("nonfinite_state", metric=name, context=context, policy=self.policy)
+        if self.policy in ("raise", "quarantine"):
+            _flight.dump_on_failure(
+                f"state_guard_{self.policy}", metric=name, context=context
+            )
         if _obs.enabled():
             if name not in self._event_keys and len(self._event_keys) < 1024:
                 self._event_keys.add(name)

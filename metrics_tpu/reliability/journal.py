@@ -36,7 +36,9 @@ import subprocess
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
+from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.observability import trace as _trace
 from metrics_tpu.reliability.checkpoint import (
     CheckpointCorruptionError,
     CheckpointError,
@@ -199,7 +201,10 @@ class CheckpointJournal:
         steps leaves a valid journal."""
         records = self.records()
         generation = (int(records[-1]["generation"]) + 1) if records else 1
-        write_envelope(self._gen_path(generation), envelope)
+        with _trace.span(
+            "journal.write_envelope", phase="checkpoint", generation=generation
+        ):
+            write_envelope(self._gen_path(generation), envelope)
         record = {
             "generation": generation,
             "cursor": int(cursor),
@@ -210,24 +215,26 @@ class CheckpointJournal:
             record["note"] = note
         records.append(record)
         keep = records[-self.keep_last:]
-        atomic_write_json(
-            self.manifest_path,
-            {
-                "format": MANIFEST_FORMAT,
-                "schema_version": MANIFEST_VERSION,
-                "keep_last": self.keep_last,
-                "generations": keep,
-            },
-        )
-        kept = {int(r["generation"]) for r in keep}
-        for r in records[:-self.keep_last]:
-            self._remove_generation(int(r["generation"]), kept)
-        # stray files from a crash between manifest write and GC, or from a
-        # prior run with a larger keep_last
-        for path in glob.glob(os.path.join(self.directory, "gen-*.npz")):
-            m = _GEN_RE.match(os.path.basename(path))
-            if m and int(m.group(1)) not in kept:
-                self._remove_generation(int(m.group(1)), kept)
+        with _trace.span("journal.rotate", phase="checkpoint", generation=generation):
+            atomic_write_json(
+                self.manifest_path,
+                {
+                    "format": MANIFEST_FORMAT,
+                    "schema_version": MANIFEST_VERSION,
+                    "keep_last": self.keep_last,
+                    "generations": keep,
+                },
+            )
+            kept = {int(r["generation"]) for r in keep}
+            for r in records[:-self.keep_last]:
+                self._remove_generation(int(r["generation"]), kept)
+            # stray files from a crash between manifest write and GC, or
+            # from a prior run with a larger keep_last
+            for path in glob.glob(os.path.join(self.directory, "gen-*.npz")):
+                m = _GEN_RE.match(os.path.basename(path))
+                if m and int(m.group(1)) not in kept:
+                    self._remove_generation(int(m.group(1)), kept)
+        _flight.record("journal_commit", generation=generation, cursor=int(cursor))
         return record
 
     def _remove_generation(self, generation: int, kept: set) -> None:
@@ -270,6 +277,18 @@ class CheckpointJournal:
                         generation=generation,
                         error=f"{type(err).__name__}: {err}",
                     )
+                # flight recorder: one dump per unusable generation — the
+                # black box for "what was the session doing when the write
+                # this resume just skipped was torn"
+                _flight.record(
+                    "session_torn_write_fallback", generation=generation
+                )
+                _flight.dump_on_failure(
+                    "session_torn_write_fallback",
+                    generation=generation,
+                    directory=self.directory,
+                    error=f"{type(err).__name__}: {err}",
+                )
                 warn_once(
                     f"checkpoint generation {generation} at {path!r} is"
                     f" unusable ({type(err).__name__}: {err}); falling back to"

@@ -58,7 +58,9 @@ import numpy as np
 # NOTE: metrics_tpu.metric/.collections import the reliability package; the
 # Metric/MetricCollection imports here are function-level (construction-time
 # only, never hot) to keep the package import DAG acyclic.
+from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.observability import trace as _trace
 from metrics_tpu.parallel.backend import get_sync_backend
 from metrics_tpu.reliability import sync as _rsync
 from metrics_tpu.reliability.checkpoint import load_envelope, save_envelope
@@ -230,10 +232,16 @@ class EvalSession:
             return None
         self._inflight = step_index
         try:
-            if self.step_deadline_s is None:
-                value = self.metric(*args, **kwargs)
-            else:
-                value = self._step_with_deadline(args, kwargs)
+            # pin the durable step cursor as the trace/flight step index for
+            # everything this forward does (engine dispatch, sync,
+            # checkpointing) — spans then carry the session's batch index,
+            # not the engine's raw dispatch count
+            with _trace.step_scope(step_index):
+                _flight.record("session_step", step=step_index)
+                if self.step_deadline_s is None:
+                    value = self.metric(*args, **kwargs)
+                else:
+                    value = self._step_with_deadline(args, kwargs)
         finally:
             self._inflight = None
         self.cursor = step_index
@@ -327,7 +335,10 @@ class EvalSession:
         """Commit the current state (cursor embedded) as a new journal
         generation; returns the manifest record."""
         self.metric._session_cursor = self.cursor
-        record = self.journal.commit(save_envelope(self.metric), self.cursor, note=note)
+        with _trace.span("session.checkpoint", phase="checkpoint", cursor=self.cursor):
+            record = self.journal.commit(
+                save_envelope(self.metric), self.cursor, note=note
+            )
         self._steps_since_checkpoint = 0
         self.stats["checkpoints"] += 1
         if _obs.enabled():
@@ -365,11 +376,13 @@ class EvalSession:
         replicas on the cursor, and return it (-1 when the journal is
         empty: a fresh start). After this, re-feed the stream from the
         top — the replay guard makes it exactly-once."""
-        envelope, record, _skipped = self.journal.load_latest_good()
-        if envelope is None:
-            self._agree_on_cursor()  # ranks must agree even about "fresh"
-            return self.cursor
-        self._load(envelope, record)
+        with _trace.span("session.resume", phase="checkpoint"):
+            envelope, record, _skipped = self.journal.load_latest_good()
+            if envelope is None:
+                self._agree_on_cursor()  # ranks must agree even about "fresh"
+                return self.cursor
+            self._load(envelope, record)
+        _flight.record("session_resume", step=self.cursor)
         self.stats["resumes"] += 1
         if _obs.enabled():
             _obs.get().count("reliability.session_resumes")

@@ -49,7 +49,9 @@ from typing import Any, Callable, Iterator, Optional
 
 import jax.numpy as jnp
 
+from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.observability import trace as _trace
 from metrics_tpu.utilities.prints import warn_once
 
 __all__ = [
@@ -221,8 +223,20 @@ def apply_sync_policy(fn: Callable) -> Callable:
         delay: Optional[float] = None
         last_err: Optional[BaseException] = None
         for attempt in range(policy.max_retries + 1):
+            t0 = time.perf_counter()
             try:
-                return _attempt(fn, (x, *args), kwargs, policy.timeout_s)
+                with _trace.span("sync.gather", phase="sync", attempt=attempt):
+                    result = _attempt(fn, (x, *args), kwargs, policy.timeout_s)
+                if _obs.enabled():
+                    # per-collective latency histogram (fixed buckets: the
+                    # evidence stream the compressed-collective ROADMAP item
+                    # needs — where do the 50–125 ms sync legs actually go)
+                    _obs.get().observe_hist(
+                        "reliability.sync_attempt_ms",
+                        (time.perf_counter() - t0) * 1e3,
+                        _obs.LATENCY_BUCKETS_MS,
+                    )
+                return result
             except Exception as err:  # noqa: BLE001 — any backend failure
                 last_err = err
                 if isinstance(err, SyncTimeoutError):
@@ -241,6 +255,20 @@ def apply_sync_policy(fn: Callable) -> Callable:
                         )
                     delay = policy.next_backoff(delay)
                     time.sleep(delay)
+        # flight recorder: the sync is now TERMINALLY failed for this call —
+        # dump once HERE, whether the caller re-raises or degrades to
+        # local-only state (degraded_local_fallback deliberately does not
+        # dump again: one injected fault, one dump)
+        timed_out = isinstance(last_err, SyncTimeoutError)
+        _flight.record(
+            "sync_failure", timeout=timed_out, error=f"{type(last_err).__name__}: {last_err}"
+        )
+        _flight.dump_on_failure(
+            "sync_timeout" if timed_out else "sync_failed",
+            error=f"{type(last_err).__name__}: {last_err}",
+            attempts=policy.max_retries + 1,
+            timeout_s=policy.timeout_s,
+        )
         if isinstance(last_err, SyncFailedError):
             # keep the subtype catchable: a terminal timeout surfaces as
             # SyncTimeoutError (which IS-A SyncFailedError), not re-wrapped
@@ -263,6 +291,10 @@ def degraded_local_fallback(err: BaseException) -> Optional[Callable]:
     if policy is None or not policy.degraded_ok:
         return None
     policy.stats["degraded"] += 1
+    # event only — the terminal gather already wrote this fault's flight
+    # dump inside apply_sync_policy; a second dump per degradation would
+    # double-count one failure
+    _flight.record("degraded_sync", error=f"{type(err).__name__}: {err}")
     if _obs.enabled():
         _obs.get().count("reliability.degraded_syncs")
         _obs.get().event("degraded_sync", error=f"{type(err).__name__}: {err}")

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.observability import trace as _obs_trace
 from metrics_tpu.utilities.data import _is_concrete, select_topk, to_onehot
 from metrics_tpu.utilities.enums import DataType
 from metrics_tpu.utilities.jit import tpu_jit
@@ -555,6 +556,28 @@ def _input_format_classification(
             return hit[2]
         memo_orig = (preds, target)  # pin originals so their ids stay valid
 
+    # step-structured tracing: the canonicalize leg of the step (memo hits
+    # above are intentionally outside the span — they cost a dict probe,
+    # not a canonicalization)
+    with _obs_trace.span("checks.input_format_classification", phase="canonicalize"):
+        return _input_format_classification_impl(
+            preds, target, threshold, top_k, num_classes, is_multiclass,
+            _num_classes_hint, store, memo_key, memo_orig,
+        )
+
+
+def _input_format_classification_impl(
+    preds,
+    target,
+    threshold,
+    top_k,
+    num_classes,
+    is_multiclass,
+    _num_classes_hint,
+    store,
+    memo_key,
+    memo_orig,
+) -> Tuple[jax.Array, jax.Array, "DataType"]:
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
 

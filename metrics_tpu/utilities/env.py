@@ -16,7 +16,14 @@ subsequent legs.
 import os
 from typing import Dict, Optional
 
-__all__ = ["parse_flag", "debug_enabled", "telemetry_requested", "refresh"]
+__all__ = [
+    "parse_flag",
+    "debug_enabled",
+    "telemetry_requested",
+    "trace_requested",
+    "flight_dir",
+    "refresh",
+]
 
 _TRUTHY = frozenset(("1", "true", "yes", "on"))
 
@@ -30,6 +37,8 @@ def _read() -> Dict[str, bool]:
     return {
         "debug": parse_flag(os.environ.get("METRICS_TPU_DEBUG")),
         "telemetry": parse_flag(os.environ.get("METRICS_TPU_TELEMETRY")),
+        "trace": parse_flag(os.environ.get("METRICS_TPU_TRACE")),
+        "flight": (os.environ.get("METRICS_TPU_FLIGHT") or "").strip() or None,
     }
 
 
@@ -46,6 +55,18 @@ def telemetry_requested() -> bool:
     """``METRICS_TPU_TELEMETRY``: enable the observability subsystem at
     import (equivalent to calling ``metrics_tpu.observability.enable()``)."""
     return _flags["telemetry"]
+
+
+def trace_requested() -> bool:
+    """``METRICS_TPU_TRACE``: enable step-structured span tracing at
+    import (equivalent to ``metrics_tpu.observability.enable_tracing()``)."""
+    return _flags["trace"]
+
+
+def flight_dir() -> Optional[str]:
+    """``METRICS_TPU_FLIGHT=<dir>``: enable the failure flight recorder at
+    import with ``<dir>`` as the dump directory (None = disabled)."""
+    return _flags["flight"]
 
 
 def refresh() -> Dict[str, bool]:

@@ -1,0 +1,291 @@
+#!/usr/bin/env python
+"""Perf-regression sentinel: fresh bench numbers vs the committed trajectory.
+
+The BENCH_r0*.json files are the repo's performance ledger — every round of
+the north-star benchmark, committed next to the code that produced it. But a
+ledger nobody diffs is a ledger that can silently regress: a change that
+doubles `collection_forward_1m_cpu_ms` ships unnoticed until someone reads
+the next round by hand. This sentinel automates the diff, **per leg**:
+
+1. load the committed trajectory (``BENCH_r0*.json``; robust to wrapper
+   files whose ``parsed`` is null and whose ``tail`` truncates the JSON
+   line — leg values are then recovered textually);
+2. obtain a *current* run — either a fresh ``python bench.py`` subprocess
+   (the default) or a pre-captured output via ``--current``;
+3. compare every lower-is-better millisecond leg present on both sides
+   against a per-leg baseline (default: the **median** across
+   platform-matching trajectory rounds — the committed rounds are noisy,
+   e.g. ``sync_8dev_cpu_ms`` spans 51–492 ms, so best-ever would cry wolf);
+4. flag legs where ``current > threshold x baseline`` and write the full
+   comparison atomically to ``SENTINEL.json``.
+
+The gate is **advisory** by default (exit 0 even with regressions; CI
+surfaces the report as an artifact); ``--strict`` exits 1 on any flag.
+
+Usage::
+
+    python scripts/perf_sentinel.py                       # fresh bench run
+    python scripts/perf_sentinel.py --current OUT.json    # pre-captured run
+    python scripts/perf_sentinel.py --threshold 1.5 --strict
+    python scripts/perf_sentinel.py --trajectory 'BENCH_r05.json'
+"""
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# legs that do NOT measure this library's current run: stale accelerator
+# carry-overs and the reference library's own numbers
+_EXCLUDED_PATHS = ("last_good_accelerator", "value_tpu", "reference_", "ref_cpu_ms")
+# flattened keys eligible as legs: lower-is-better millisecond timings
+_LEG_RE = re.compile(r"(^value$|_ms$)")
+
+
+def _flatten(d: Dict[str, Any], prefix: str = "") -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for k, v in d.items():
+        path = prefix + k
+        if isinstance(v, dict):
+            out.update(_flatten(v, path + "."))
+        elif isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[path] = float(v)
+    return out
+
+
+def extract_legs(parsed: Dict[str, Any]) -> Dict[str, float]:
+    """The comparable legs of one bench result: flattened dotted paths that
+    end in ``_ms`` (plus the top-level ``value``), excluding stale/foreign
+    numbers (``last_good_accelerator``, ``value_tpu``, ``reference_*``)."""
+    return {
+        k: v
+        for k, v in _flatten(parsed).items()
+        if _LEG_RE.search(k.rsplit(".", 1)[-1])
+        and not any(e in k for e in _EXCLUDED_PATHS)
+    }
+
+
+def _legs_from_text(text: str) -> Tuple[Dict[str, float], Optional[str]]:
+    """Textual leg recovery for wrapper tails that truncate the result
+    line's opening brace (BENCH_r05.json does): scan ``"name": number``
+    pairs in the region BEFORE ``last_good_accelerator`` — past that point
+    the same key names carry a different (stale, accelerator) round."""
+    cut = text.find('"last_good_accelerator"')
+    if cut != -1:
+        text = text[:cut]
+    legs: Dict[str, float] = {}
+    for m in re.finditer(r'"([A-Za-z0-9_]+)":\s*\{"cpu_ms":\s*([0-9.eE+-]+)', text):
+        legs[f"config_matrix.{m.group(1)}.cpu_ms"] = float(m.group(2))
+    for m in re.finditer(r'"([A-Za-z0-9_]*_ms|value)":\s*([0-9.eE+-]+)', text):
+        key = m.group(1)
+        if key in ("cpu_ms", "ref_cpu_ms"):  # config_matrix members, seen above
+            continue
+        if key == "value_ms":  # value_cpu/value_tpu envelope member
+            key = "value_cpu.value_ms"
+        legs.setdefault(key, float(m.group(2)))
+    plat = re.search(r'"platform":\s*"([a-z]+)"', text)
+    return legs, plat.group(1) if plat else None
+
+
+def load_round(path: str) -> Optional[Dict[str, Any]]:
+    """One trajectory round -> ``{"path", "platform", "legs"}`` (or None
+    when nothing numeric is recoverable). Accepts either a raw bench result
+    object or the committed wrapper (``{"parsed": ..., "tail": ...}``)."""
+    with open(path) as f:
+        try:
+            blob = json.load(f)
+        except ValueError as err:
+            # a captured bench stdout tail that wasn't the JSON result line
+            # (bench crashed, printed a warning last, ...) must surface as a
+            # clean verdict, not a JSONDecodeError traceback
+            raise SystemExit(f"{path!r} is not JSON ({err}); was the bench run healthy?")
+    parsed = blob.get("parsed") if isinstance(blob.get("parsed"), dict) else None
+    if parsed is None and "tail" not in blob and "value" in blob:
+        parsed = blob  # a raw bench.py JSON result, not the wrapper
+    if parsed is not None:
+        legs, platform = extract_legs(parsed), parsed.get("platform")
+    else:
+        tail = (blob.get("tail") or "").strip()
+        if not tail:
+            return None
+        legs, platform = _legs_from_text(tail.splitlines()[-1])
+    if not legs:
+        return None
+    return {"path": os.path.basename(path), "platform": platform, "legs": legs}
+
+
+def run_bench() -> Dict[str, Any]:
+    """One fresh ``python bench.py`` subprocess; its result is the LAST
+    JSON line of stdout (bench prints progress markers before it)."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and "value" in parsed:
+            return parsed
+    raise SystemExit(
+        f"bench.py produced no parseable result line (rc={proc.returncode});"
+        f" stderr tail: {proc.stderr[-500:]!r}"
+    )
+
+
+def compare(
+    current: Dict[str, float],
+    rounds: List[Dict[str, Any]],
+    threshold: float,
+    per_leg: Dict[str, float],
+    baseline_mode: str,
+    min_ms: float,
+) -> Dict[str, Any]:
+    """Per-leg verdicts: for every leg present in the current run AND at
+    least one trajectory round, ``ratio = current / baseline`` where the
+    baseline is the median (default), min, or last of the trajectory
+    values; ``ratio > threshold`` flags a regression. Legs whose baseline
+    is under ``min_ms`` are skipped (pure jitter territory)."""
+    agg = {
+        "median": statistics.median,
+        "min": min,
+        "last": lambda xs: xs[-1],
+    }[baseline_mode]
+    legs: Dict[str, Any] = {}
+    regressions: List[str] = []
+    for name in sorted(current):
+        history = [r["legs"][name] for r in rounds if name in r["legs"]]
+        if not history:
+            continue
+        baseline = float(agg(history))
+        limit = per_leg.get(name, threshold)
+        if baseline < min_ms:
+            legs[name] = {"current_ms": current[name], "baseline_ms": baseline,
+                          "verdict": "skipped", "why": f"baseline under --min-ms {min_ms}"}
+            continue
+        ratio = current[name] / baseline
+        regressed = ratio > limit
+        legs[name] = {
+            "current_ms": round(current[name], 3),
+            "baseline_ms": round(baseline, 3),
+            "rounds": len(history),
+            "ratio": round(ratio, 3),
+            "threshold": limit,
+            "verdict": "regression" if regressed else "ok",
+        }
+        if regressed:
+            regressions.append(name)
+    return {"legs": legs, "regressions": regressions}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--trajectory",
+        default=os.path.join(REPO, "BENCH_r0*.json"),
+        help="glob of committed trajectory rounds (default: repo BENCH_r0*.json)",
+    )
+    ap.add_argument(
+        "--current",
+        help="pre-captured bench result (raw bench.py JSON or a wrapper file);"
+        " default: run `python bench.py` fresh",
+    )
+    ap.add_argument("--threshold", type=float, default=1.75,
+                    help="flag legs above threshold x baseline (default 1.75)")
+    ap.add_argument("--leg-threshold", action="append", default=[], metavar="LEG=RATIO",
+                    help="per-leg threshold override (repeatable)")
+    ap.add_argument("--baseline", choices=("median", "min", "last"), default="median",
+                    help="per-leg baseline across the trajectory (default median)")
+    ap.add_argument("--min-ms", type=float, default=0.5,
+                    help="skip legs whose baseline is under this (default 0.5 ms)")
+    ap.add_argument("--out", default=os.path.join(REPO, "SENTINEL.json"),
+                    help="report path, written atomically (default SENTINEL.json)")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any regression (default: advisory, exit 0)")
+    args = ap.parse_args(argv)
+
+    per_leg: Dict[str, float] = {}
+    for spec in args.leg_threshold:
+        leg, _, ratio = spec.partition("=")
+        if not ratio:
+            ap.error(f"--leg-threshold needs LEG=RATIO, got {spec!r}")
+        per_leg[leg] = float(ratio)
+
+    paths = sorted(glob.glob(args.trajectory))
+    rounds = [r for r in (load_round(p) for p in paths) if r is not None]
+    if not rounds:
+        raise SystemExit(f"no trajectory rounds recoverable from {args.trajectory!r}")
+
+    if args.current:
+        cur_round = load_round(args.current)
+        if cur_round is None:
+            raise SystemExit(f"no bench legs recoverable from {args.current!r}")
+        current, platform = cur_round["legs"], cur_round["platform"]
+    else:
+        parsed = run_bench()
+        current, platform = extract_legs(parsed), parsed.get("platform")
+
+    # compare like against like: a cpu run measured against tpu rounds (or
+    # platform-unknown early rounds) would flag nothing but noise — and a
+    # current run whose own platform is unrecoverable cannot be compared
+    # against ANY baseline honestly, so refuse rather than silently mix
+    if platform is None:
+        raise SystemExit(
+            "the current run's platform is unrecoverable; refusing to compare"
+            " against a mixed-platform baseline (pass a --current with a"
+            ' "platform" field)'
+        )
+    matching = [r for r in rounds if r["platform"] == platform]
+    if not matching:
+        raise SystemExit(
+            f"no trajectory rounds match platform {platform!r}"
+            f" (have: {[r['platform'] for r in rounds]})"
+        )
+
+    result = compare(current, matching, args.threshold, per_leg, args.baseline, args.min_ms)
+    report = {
+        "format": "metrics_tpu.perf_sentinel",
+        "schema_version": 1,
+        "platform": platform,
+        "baseline_mode": args.baseline,
+        "threshold": args.threshold,
+        "trajectory": [r["path"] for r in matching],
+        **result,
+    }
+    from metrics_tpu.reliability.journal import atomic_write_json  # noqa: E402
+
+    atomic_write_json(args.out, report)
+
+    for name, leg in report["legs"].items():
+        if leg["verdict"] == "skipped":
+            continue
+        mark = "REGRESSION" if leg["verdict"] == "regression" else "ok"
+        print(
+            f"{mark:>10}  {name:<46} {leg['current_ms']:>10.3f} ms"
+            f" vs {leg['baseline_ms']:>10.3f} ms ({args.baseline} of"
+            f" {leg['rounds']}) ratio {leg['ratio']:.2f} (limit {leg['threshold']:.2f})"
+        )
+    n_reg = len(report["regressions"])
+    print(
+        f"perf sentinel: {len(report['legs'])} legs compared against"
+        f" {len(matching)} {platform or 'any-platform'} rounds;"
+        f" {n_reg} regression(s); report: {args.out}"
+    )
+    if n_reg and not args.strict:
+        print("advisory mode: regressions reported, exit 0 (pass --strict to gate)")
+    return 1 if (n_reg and args.strict) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Convert metrics_tpu observability dumps to Chrome/Perfetto trace JSON.
+
+Accepts either artifact the observability layer writes:
+
+* a **native trace dump** (``TraceRecorder.snapshot()`` / ``to_json()``,
+  format marker ``metrics_tpu.trace``) — spans become complete
+  (``ph: "X"``) trace events with phase categories and step args;
+* a **flight-recorder dump** (``metrics_tpu.flight_dump``) — the event
+  ring becomes instant events on a synthetic timeline (events carry
+  relative seconds, not span timestamps), so the last-N-steps window
+  before a failure is scrubbable in the same UI.
+
+Already-converted Perfetto files (a ``traceEvents`` key) pass through
+unchanged, so globbing a mixed dump directory is safe.
+
+Usage::
+
+    python scripts/trace_export.py DUMP.json [...more] [-o OUT.json]
+    python scripts/trace_export.py flight-dumps/*.json
+
+With one input, ``-o`` names the output (default: ``<input>.perfetto.json``
+next to the input); with several, each converts next to its input and
+``-o`` is rejected. Open the results at https://ui.perfetto.dev or
+``chrome://tracing``.
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from metrics_tpu.observability.trace import spans_to_perfetto  # noqa: E402
+
+
+def flight_to_perfetto(dump: dict) -> dict:
+    """Flight-dump events as Perfetto instants (µs timeline from the
+    recorder's relative-seconds stamps), one row per event kind."""
+    events = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": 0,
+            "args": {"name": f"flight:{dump.get('reason', 'dump')}"},
+        }
+    ]
+    for e in dump.get("events", []):
+        fields = {k: v for k, v in e.items() if k not in ("t", "kind")}
+        events.append(
+            {
+                "name": e.get("kind", "event"),
+                "cat": "flight",
+                "ph": "i",
+                "s": "t",
+                "pid": 1,
+                "tid": 1,
+                "ts": round(float(e.get("t", 0.0)) * 1e6, 3),
+                "args": fields,
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def convert(blob: dict) -> dict:
+    if "traceEvents" in blob:
+        return blob  # already Perfetto: pass through
+    fmt = blob.get("format")
+    if fmt == "metrics_tpu.trace" or "spans" in blob:
+        return spans_to_perfetto(blob.get("spans", []))
+    # the marker-less "events" fallback must not swallow telemetry exit
+    # dumps (they also carry an events list, but timeline-less): globbing a
+    # mixed artifact dir should skip those loudly, not emit an all-ts-0 trace
+    if fmt == "metrics_tpu.flight_dump" or (
+        "events" in blob and "counters" not in blob
+    ):
+        return flight_to_perfetto(blob)
+    raise ValueError(
+        "unrecognized dump: expected a metrics_tpu trace dump (spans),"
+        " a flight dump (events), or trace_event JSON (traceEvents) —"
+        " telemetry snapshots have no timeline to convert;"
+        f" got keys {sorted(blob)[:8]}"
+    )
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("inputs", nargs="+", help="dump file(s) to convert")
+    ap.add_argument("-o", "--output", help="output path (single input only)")
+    args = ap.parse_args(argv)
+    if args.output and len(args.inputs) > 1:
+        ap.error("-o/--output needs exactly one input")
+    for path in args.inputs:
+        with open(path) as f:
+            blob = json.load(f)
+        out = args.output or (os.path.splitext(path)[0] + ".perfetto.json")
+        with open(out, "w") as f:
+            json.dump(convert(blob), f)
+        print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

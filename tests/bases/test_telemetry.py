@@ -75,6 +75,7 @@ def test_disabled_by_default_records_nothing():
     assert snap["counters"] == {}
     assert snap["events"] == []
     assert snap["timers"] == {}
+    assert snap["histograms"] == {} and snap["dropped_events"] == 0
     assert snap["watchdog"]["keys"] == {}
 
 
@@ -372,3 +373,99 @@ def test_warn_once_rate_limits_per_key():
         assert warn_once("different message, default key") is True
     messages = [str(w.message) for w in caught]
     assert messages == ["first", "different message, default key"]
+
+
+# ----------------------------------------------------------------------
+# 6. fixed-bucket histograms + bounded-log accounting (ISSUE 6)
+# ----------------------------------------------------------------------
+def test_observe_hist_fixed_buckets_and_overflow():
+    tel = obs.enable()
+    edges = obs.LATENCY_BUCKETS_MS
+    tel.observe_hist("drill.ms", 0.05, edges)    # under the first edge
+    tel.observe_hist("drill.ms", 0.1, edges)     # ON an edge: inclusive upper bound
+    tel.observe_hist("drill.ms", 75.0, edges)    # mid-range
+    tel.observe_hist("drill.ms", 10**9, edges)   # beyond the last edge: +Inf bucket
+    h = tel.snapshot()["histograms"]["drill.ms"]
+    assert h["buckets"] == list(edges)
+    assert len(h["counts"]) == len(edges) + 1  # one terminal +Inf bucket
+    assert h["counts"][0] == 2                 # 0.05 and 0.1 share the first bucket
+    assert h["counts"][edges.index(100.0)] == 1  # 75 lands in (50, 100]
+    assert h["counts"][-1] == 1                # the overflow
+    assert h["count"] == 4 and h["sum"] == pytest.approx(0.05 + 0.1 + 75.0 + 10**9)
+    assert "histograms" in obs.report() and "drill.ms" in obs.report()
+    tel.reset()
+    assert tel.snapshot()["histograms"] == {}
+
+
+def test_sync_histograms_recorded_on_host_sync():
+    from metrics_tpu.utilities.distributed import gather_all_tensors
+
+    obs.enable()
+    m = Accuracy()
+    p, t = _cls_batch()
+    m.update(p, t)
+    m.dist_sync_fn = gather_all_tensors  # force the host sync path
+    m.compute()
+    hists = obs.get().snapshot()["histograms"]
+    assert hists["sync.latency_ms"]["count"] == 1
+    assert hists["sync.latency_ms"]["buckets"] == list(obs.LATENCY_BUCKETS_MS)
+    assert hists["sync.payload_bytes"]["count"] == 1
+    assert hists["sync.payload_bytes"]["sum"] > 0
+
+
+def test_dropped_events_surfaced_when_the_bounded_log_wraps():
+    tel = obs.enable(max_events=4)
+    try:
+        for i in range(10):
+            tel.event("e", i=i)
+        snap = tel.snapshot()
+        assert len(snap["events"]) == 4
+        assert snap["dropped_events"] == 6
+        assert "6 dropped by the bounded log" in tel.report()
+        tel.reset()
+        assert tel.snapshot()["dropped_events"] == 0
+    finally:
+        obs.enable(max_events=1024)  # restore the default cap
+
+
+def test_host_timing_under_trace_warns_once_with_lint_crosslink():
+    """ISSUE 6 satellite: metric_scope host timing entered from a traced
+    region measures trace-time cost, not step cost — one warning per
+    Name.phase key, cross-linking lint rule MTL103."""
+    import jax
+
+    from metrics_tpu.observability import telemetry as telemetry_mod
+
+    class HostTimedDrillMetric:  # unique name => fresh warn_once key
+        pass
+
+    def f(x):
+        with telemetry_mod.metric_scope(HostTimedDrillMetric(), "update"):
+            return x + 1
+
+    obs.enable()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        jax.jit(f)(jnp.asarray(1.0))  # first call traces: hook runs under trace
+        jax.jit(f)(jnp.asarray(1.0))  # steady state: no second trace, no spam
+    fired = [w for w in caught if "trace-time cost" in str(w.message)]
+    assert len(fired) == 1
+    msg = str(fired[0].message)
+    assert "HostTimedDrillMetric.update" in msg and "MTL103" in msg
+
+
+def test_exit_dump_is_atomic_and_parseable(tmp_path, monkeypatch):
+    """ISSUE 6 satellite: the at-exit dump goes through
+    journal.atomic_write_json — the written file is complete JSON and no
+    tmp carcass is left beside it."""
+    from metrics_tpu.observability import telemetry as telemetry_mod
+
+    target = tmp_path / "dump.json"
+    monkeypatch.setenv(telemetry_mod._DUMP_ENV, str(target))
+    tel = obs.enable()
+    tel.count("drill.exit", 7)
+    telemetry_mod._dump_at_exit()
+    blob = json.loads(target.read_text())
+    assert blob["counters"]["drill.exit"] == 7
+    assert blob["dropped_events"] == 0
+    assert [p.name for p in tmp_path.iterdir()] == ["dump.json"]

@@ -1,0 +1,333 @@
+"""Tests for the step-structured tracing subsystem (`observability/trace.py`).
+
+The contract under test, in priority order:
+
+1. **Disabled is invisible**: the default is off, a metric run records no
+   spans, and results with tracing enabled are bit-identical to a bare
+   run — the spans are host-side wall-clock bookkeeping, never part of
+   any traced/compiled program.
+2. **Spans are step-structured**: every span carries a step index (the
+   engine's dispatch counter, or a pinned session cursor via
+   ``step_scope``), a phase from the canonical attribution set, and
+   parent/child nesting.
+3. **Perfetto export is schema-valid**: ``to_perfetto()`` (and the
+   ``scripts/trace_export.py`` converter built on the same function)
+   emits ``trace_event`` JSON that chrome://tracing / ui.perfetto.dev
+   will load — every event carries the required keys with the required
+   types, and the whole thing JSON round-trips.
+4. **The ring buffer is bounded**: overflow drops the oldest spans and
+   counts what it dropped.
+"""
+import json
+import subprocess
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import Accuracy, F1, MetricCollection, Precision
+from metrics_tpu.observability import trace as trace_mod
+from metrics_tpu.utilities.distributed import gather_all_tensors
+from tests.helpers import seed_all
+
+seed_all(42)
+
+
+@pytest.fixture(autouse=True)
+def _pristine_tracing():
+    """Every test starts and ends with tracing off, the process-global
+    recorder empty, and the ring at its default capacity (the switch,
+    recorder, and its max_spans are all process-global — a resize test
+    must not starve a later test's span budget)."""
+    def pristine():
+        obs.enable_tracing(max_spans=trace_mod._DEFAULT_MAX_SPANS)
+        obs.disable_tracing()
+        obs.get_tracer().reset()
+        obs.disable()
+        obs.get().reset()
+
+    pristine()
+    yield
+    pristine()
+
+
+def _cls_batch(n=128, c=4, seed=0):
+    rng = np.random.RandomState(seed)
+    probs = rng.rand(n, c).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    return jnp.asarray(probs), jnp.asarray(rng.randint(c, size=n))
+
+
+def _collection(compiled=False):
+    return MetricCollection(
+        [Accuracy(), Precision(num_classes=4, average="macro"), F1(num_classes=4, average="macro")],
+        compiled=compiled,
+    )
+
+
+# ----------------------------------------------------------------------
+# 1. disabled is invisible
+# ----------------------------------------------------------------------
+def test_tracing_is_off_by_default_and_records_nothing():
+    assert not obs.tracing_enabled()
+    p, t = _cls_batch()
+    m = Accuracy()
+    m(p, t)
+    m.compute()
+    assert len(obs.get_tracer().spans) == 0
+    assert obs.get_tracer().step_range() is None
+
+
+def test_disabled_span_is_the_shared_null_context():
+    a = trace_mod.span("x", phase="update")
+    b = trace_mod.span("y", phase="sync")
+    assert a is b is trace_mod._NULL_CM
+
+
+@pytest.mark.parametrize("compiled", [False, True])
+def test_results_bit_identical_with_tracing_enabled(compiled):
+    p, t = _cls_batch()
+    plain = _collection(compiled)
+    v_plain = plain(p, t)
+    e_plain = plain.compute()
+
+    traced = _collection(compiled)
+    with obs.tracing_scope() as tracer:
+        v_traced = traced(p, t)
+        e_traced = traced.compute()
+    assert len(tracer.spans) > 0  # it did record
+    for k in v_plain:
+        np.testing.assert_array_equal(np.asarray(v_plain[k]), np.asarray(v_traced[k]))
+        np.testing.assert_array_equal(np.asarray(e_plain[k]), np.asarray(e_traced[k]))
+    # and the scope restored the disabled default
+    assert not obs.tracing_enabled()
+
+
+# ----------------------------------------------------------------------
+# 2. span structure: phases, nesting, step attribution
+# ----------------------------------------------------------------------
+def test_metric_phases_are_attributed():
+    p, t = _cls_batch()
+    with obs.tracing_scope() as tracer:
+        m = Accuracy()
+        m(p, t)
+        m.compute()
+    phases = {s["phase"] for s in tracer.spans}
+    assert "update" in phases and "compute" in phases
+    assert phases <= set(obs.PHASES)
+
+
+def test_engine_dispatch_spans_and_step_counter():
+    p, t = _cls_batch()
+    col = _collection(compiled=True)
+    with obs.tracing_scope() as tracer:
+        start = trace_mod.current_step()
+        for _ in range(3):
+            col(p, t)
+    names = [s["name"] for s in tracer.spans]
+    assert names.count("engine.dispatch") == 3
+    assert "engine.cache_lookup" in names and "engine.donate" in names
+    dispatch_phases = {s["phase"] for s in tracer.spans if s["name"].startswith("engine.")}
+    assert dispatch_phases == {"dispatch"}
+    # one engine dispatch = one step: three forwards advance the counter by 3
+    steps = sorted({s["step"] for s in tracer.spans if s["name"] == "engine.dispatch"})
+    assert steps == [start + 1, start + 2, start + 3]
+    assert tracer.step_range() == [start + 1, start + 3]
+
+
+def test_nesting_records_parent_child():
+    rec = trace_mod.TraceRecorder()
+    with rec.span("outer", phase="update"):
+        with rec.span("inner", phase="sync"):
+            pass
+    inner, outer = rec.spans  # children commit first
+    assert inner["name"] == "inner" and outer["name"] == "outer"
+    assert inner["parent"] == outer["id"]
+    assert outer["parent"] is None
+
+
+def test_step_scope_pins_the_step_index():
+    rec = trace_mod.enable_tracing()
+    rec.reset()
+    auto_before = trace_mod.current_step()
+    with trace_mod.step_scope(777):
+        assert trace_mod.current_step() == 777
+        # inside a pinned scope the auto counter is the session's problem
+        assert trace_mod.advance_step() == 777
+        trace_mod.instant("mark")
+    assert trace_mod.current_step() == auto_before  # auto counter untouched
+    assert [s["step"] for s in rec.spans] == [777]
+
+
+def test_sync_span_is_phase_sync():
+    p, t = _cls_batch(n=48)
+    m = Accuracy()
+    m.update(p, t)
+    m.dist_sync_fn = gather_all_tensors
+    with obs.tracing_scope() as tracer:
+        m.compute()
+    sync = [s for s in tracer.spans if s["phase"] == "sync"]
+    assert len(sync) == 1
+    assert sync[0]["name"] == "metrics_tpu.Accuracy.sync"
+
+
+def test_unknown_phase_falls_back_to_other():
+    rec = trace_mod.TraceRecorder()
+    with rec.span("x", phase="not-a-phase"):
+        pass
+    assert rec.spans[0]["phase"] == "other"
+
+
+# ----------------------------------------------------------------------
+# 3. bounded ring buffer
+# ----------------------------------------------------------------------
+def test_ring_buffer_drops_oldest_and_counts():
+    rec = trace_mod.TraceRecorder(max_spans=4)
+    for i in range(10):
+        rec.instant(f"e{i}")
+    assert len(rec.spans) == 4
+    assert rec.dropped == 6
+    assert [s["name"] for s in rec.spans] == ["e6", "e7", "e8", "e9"]
+    snap = rec.snapshot()
+    assert snap["dropped"] == 6 and snap["max_spans"] == 4
+
+
+def test_enable_resize_preserves_newest():
+    rec = trace_mod.enable_tracing(max_spans=8)
+    rec.reset()
+    for i in range(6):
+        rec.instant(f"e{i}")
+    trace_mod.enable_tracing(max_spans=3)
+    assert [s["name"] for s in rec.spans] == ["e3", "e4", "e5"]
+
+
+# ----------------------------------------------------------------------
+# 4. perfetto export schema
+# ----------------------------------------------------------------------
+def _assert_trace_event_schema(blob):
+    """The subset of the Chrome trace_event contract the viewers require:
+    a traceEvents array whose members carry name/ph/pid/tid, complete
+    events ("X") a numeric ts+dur, instants ("i") a scope."""
+    assert isinstance(blob, dict) and "traceEvents" in blob
+    events = blob["traceEvents"]
+    assert isinstance(events, list) and events
+    for ev in events:
+        assert isinstance(ev["name"], str)
+        assert ev["ph"] in ("X", "i", "M")
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float))
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        if ev["ph"] == "i":
+            assert ev["s"] in ("t", "p", "g")
+    # must survive a JSON round-trip intact (what the viewers actually load)
+    assert json.loads(json.dumps(blob)) == blob
+
+
+def test_to_perfetto_is_schema_valid():
+    p, t = _cls_batch()
+    col = _collection(compiled=True)
+    with obs.tracing_scope() as tracer:
+        col(p, t)
+        col.compute()
+        trace_mod.instant("marker", phase="other", note="hi")
+    blob = tracer.to_perfetto()
+    _assert_trace_event_schema(blob)
+    # phases become categories; step indices ride in args
+    cats = {e.get("cat") for e in blob["traceEvents"] if e["ph"] == "X"}
+    assert "dispatch" in cats
+    assert any("step" in e.get("args", {}) for e in blob["traceEvents"])
+    # the instant came through as ph: "i"
+    assert any(e["ph"] == "i" and e["name"] == "marker" for e in blob["traceEvents"])
+
+
+def test_snapshot_json_roundtrip():
+    with obs.tracing_scope() as tracer:
+        with trace_mod.span("a", phase="update", k=1):
+            pass
+    snap = json.loads(tracer.to_json())
+    assert snap["format"] == "metrics_tpu.trace"
+    assert snap["schema_version"] == 1
+    assert len(snap["spans"]) == 1
+    assert snap["spans"][0]["args"] == {"k": 1}
+
+
+# ----------------------------------------------------------------------
+# 5. the trace_export CLI converter
+# ----------------------------------------------------------------------
+def _export_module():
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(__file__), "..", "..", "scripts", "trace_export.py")
+    spec = importlib.util.spec_from_file_location("trace_export", os.path.abspath(path))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_export_converts_native_dump():
+    te = _export_module()
+    with obs.tracing_scope() as tracer:
+        with trace_mod.span("a", phase="sync"):
+            pass
+    blob = te.convert(tracer.snapshot())
+    _assert_trace_event_schema(blob)
+
+
+def test_trace_export_converts_flight_dump_and_passthrough():
+    te = _export_module()
+    dump = {
+        "format": "metrics_tpu.flight_dump",
+        "reason": "sync_timeout",
+        "events": [
+            {"t": 0.5, "step": 3, "kind": "session_step"},
+            {"t": 0.7, "step": 4, "kind": "sync_failure", "timeout": True},
+        ],
+    }
+    blob = te.convert(dump)
+    _assert_trace_event_schema(blob)
+    instants = [e for e in blob["traceEvents"] if e["ph"] == "i"]
+    assert [e["name"] for e in instants] == ["session_step", "sync_failure"]
+    assert instants[1]["args"]["timeout"] is True
+    # already-converted files pass through unchanged (globbing mixed dirs)
+    assert te.convert(blob) is blob
+    with pytest.raises(ValueError, match="unrecognized dump"):
+        te.convert({"some": "thing"})
+
+
+def test_trace_export_cli_writes_next_to_input(tmp_path):
+    te = _export_module()
+    with obs.tracing_scope() as tracer:
+        trace_mod.instant("x")
+    src = tmp_path / "dump.json"
+    src.write_text(tracer.to_json())
+    assert te.main([str(src)]) == 0
+    out = tmp_path / "dump.perfetto.json"
+    _assert_trace_event_schema(json.loads(out.read_text()))
+
+
+# ----------------------------------------------------------------------
+# 6. environment flag
+# ----------------------------------------------------------------------
+def test_metrics_tpu_trace_env_flag_enables_at_import():
+    code = "import metrics_tpu.observability as o; print(o.tracing_enabled())"
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env={**__import__("os").environ, "METRICS_TPU_TRACE": "1", "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.stdout.strip().endswith("True"), out.stderr[-500:]
+
+
+def test_trace_export_rejects_telemetry_snapshots():
+    """A telemetry exit dump also carries an `events` list but has no
+    timeline — globbing a mixed artifact dir must skip it loudly, not
+    emit an all-ts-0 trace."""
+    mod = _export_module()
+    snapshot = {"counters": {"a": 1}, "events": [{"kind": "custom"}], "timers": {}}
+    with pytest.raises(ValueError, match="telemetry snapshots"):
+        mod.convert(snapshot)

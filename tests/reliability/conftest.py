@@ -12,14 +12,16 @@ from metrics_tpu.reliability import sync as _sync
 
 @pytest.fixture(autouse=True)
 def _pristine_reliability():
-    _guard.uninstall_guard()
-    _sync.set_sync_policy(None)
-    set_sync_backend(None)
-    obs.disable()
-    obs.get().reset()
+    def pristine():
+        _guard.uninstall_guard()
+        _sync.set_sync_policy(None)
+        set_sync_backend(None)
+        obs.disable()
+        obs.get().reset()
+        obs.disable_flight()
+        obs.disable_tracing()
+        obs.get_tracer().reset()
+
+    pristine()
     yield
-    _guard.uninstall_guard()
-    _sync.set_sync_policy(None)
-    set_sync_backend(None)
-    obs.disable()
-    obs.get().reset()
+    pristine()

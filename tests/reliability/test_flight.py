@@ -1,0 +1,352 @@
+"""Flight-recorder dump-on-failure drills (`observability/flight.py`).
+
+The contract under test, in priority order:
+
+1. **Healthy runs write nothing**: an armed recorder buffers events but a
+   fault-free eval loop (compiled engine + checkpointing session + host
+   sync) produces ZERO dump files — and the armed run's results are
+   bit-identical to a bare run.
+2. **One injected fault, one dump**: each fault-injection primitive the
+   reliability layer owns (``failing_engine_compile``, flaky/hung sync,
+   ``torn_write``, poisoned updates, watchdog thrash) lands exactly one
+   atomic JSON dump naming the failing step range and trigger reason.
+3. **Disabled is invisible**: with the recorder disarmed every hook is a
+   no-op and nothing touches the filesystem.
+4. **Dumps never break recovery**: a dump failure (unwritable directory)
+   warns once and returns None; the recovery path it documents proceeds.
+"""
+import glob
+import json
+import os
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import (
+    Accuracy,
+    MeanSquaredError,
+    MetricCollection,
+    Precision,
+    reliability,
+)
+from metrics_tpu.observability import flight as flight_mod
+from metrics_tpu.observability.watchdog import RecompilationWatchdog
+from metrics_tpu.reliability import EvalSession, faultinject as fi
+from metrics_tpu.utilities.distributed import gather_all_tensors
+
+pytestmark = pytest.mark.chaos
+
+
+def _dump_files(directory) -> list:
+    return sorted(glob.glob(os.path.join(os.fspath(directory), "flight-*.json")))
+
+
+def _load_dump(path: str) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _cls_batch(n=96, c=4, seed=3):
+    rng = np.random.RandomState(seed)
+    probs = rng.rand(n, c).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    return jnp.asarray(probs), jnp.asarray(rng.randint(c, size=n))
+
+
+def _reg_batches(n=5, size=64, seed=7):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        t = rng.rand(size).astype(np.float32)
+        out.append((jnp.asarray(t + 0.1 * rng.randn(size).astype(np.float32)), jnp.asarray(t)))
+    return out
+
+
+# ----------------------------------------------------------------------
+# 1. the healthy-run-zero-dumps invariant (+ bit-identical results)
+# ----------------------------------------------------------------------
+def test_healthy_run_zero_dumps_and_bit_identical(tmp_path):
+    batches = _reg_batches()
+    bare = MetricCollection([MeanSquaredError()], compiled=True)
+    for p, t in batches:
+        bare(p, t)
+    want = {k: np.asarray(v) for k, v in bare.compute().items()}
+
+    armed_dir = tmp_path / "flight"
+    with obs.flight_scope(armed_dir) as rec:
+        col = MetricCollection([MeanSquaredError()], compiled=True)
+        session = EvalSession(col, tmp_path / "journal", checkpoint_every=2)
+        for i, b in enumerate(batches):
+            session.step(i, *b)
+        got = {k: np.asarray(v) for k, v in session.compute().items()}
+
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k])
+    # the buffer saw the loop (engine dispatches, session steps, commits)...
+    kinds = {e["kind"] for e in rec.events}
+    assert {"engine_dispatch", "session_step", "journal_commit"} <= kinds
+    # ...but a fault-free run dumps NOTHING
+    assert rec.dumps == 0 and rec.dump_paths == []
+    assert _dump_files(armed_dir) == []
+
+
+# ----------------------------------------------------------------------
+# 2. one injected fault, one dump — per failure path
+# ----------------------------------------------------------------------
+def test_engine_dispatch_failure_dumps_exactly_once(tmp_path):
+    p, t = _cls_batch()
+    with obs.flight_scope(tmp_path) as rec:
+        col = MetricCollection([Accuracy(), Precision(average="macro", num_classes=4)], compiled=True)
+        col(p, t)  # healthy warm-up: builds the engine, dumps nothing
+        assert rec.dumps == 0
+        with fi.failing_engine_compile(times=1), warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            # new shape => fresh trace => injected failure => demote-to-eager
+            col(jnp.concatenate([p, p]), jnp.concatenate([t, t]))
+        col(p, t)  # demoted loop keeps running, no further dumps
+
+    files = _dump_files(tmp_path)
+    assert len(files) == 1 and rec.dumps == 1
+    dump = _load_dump(files[0])
+    assert dump["format"] == "metrics_tpu.flight_dump"
+    assert dump["reason"] == "engine_dispatch_failure"
+    assert "FaultInjected" in dump["context"]["error"]
+    assert set(dump["context"]["demoted"]) == {"Accuracy", "Precision"}
+    # the window names the failing step range, and the buffered events
+    # cover the dispatch that died
+    lo, hi = dump["step_range"]
+    assert lo >= 1 and hi >= lo
+    assert any(e["kind"] == "engine_dispatch" for e in dump["events"])
+
+
+def test_state_guard_quarantine_dumps_once_per_poisoned_batch(tmp_path):
+    batches = _reg_batches(4)
+    with obs.flight_scope(tmp_path) as rec:
+        m = MeanSquaredError()
+        with reliability.guard_scope("quarantine") as guard, warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            # update(), not forward(): forward also runs a guard-exempt
+            # batch-local pass, which would consume the injector's budget
+            # without adding violations
+            with fi.nonfinite_updates(m, mode="nan", times=2) as injected:
+                for p, t in batches:
+                    m.update(p, t)
+    assert injected["count"] == 2 and guard.stats["quarantined"] == 2
+    files = _dump_files(tmp_path)
+    assert len(files) == 2 and rec.dumps == 2  # one dump per injected fault
+    for path in files:
+        dump = _load_dump(path)
+        assert dump["reason"] == "state_guard_quarantine"
+        assert dump["context"]["metric"] == "MeanSquaredError"
+
+
+def test_state_guard_warn_policy_records_but_does_not_dump(tmp_path):
+    """`warn` keeps the poisoned state, which re-flags every later batch —
+    a dump per step would bury the one that matters, so warn only buffers
+    events."""
+    batches = _reg_batches(3)
+    with obs.flight_scope(tmp_path) as rec:
+        m = MeanSquaredError()
+        with reliability.guard_scope("warn"), warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with fi.nonfinite_updates(m, mode="inf", times=1):
+                for p, t in batches:
+                    m.update(p, t)
+    assert any(e["kind"] == "nonfinite_state" for e in rec.events)
+    assert rec.dumps == 0 and _dump_files(tmp_path) == []
+
+
+def test_sync_terminal_failure_dumps_exactly_once(tmp_path):
+    p, t = _cls_batch()
+    m = Accuracy()
+    m.update(p, t)
+    m.dist_sync_fn = gather_all_tensors  # force the host sync path
+    with obs.flight_scope(tmp_path) as rec:
+        with fi.flaky_sync_backend(fails=10**6):
+            with reliability.sync_policy_scope(
+                max_retries=1, backoff_s=0.001, degraded_ok=True
+            ):
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    m.compute()  # degrades to local-only state, still computes
+    files = _dump_files(tmp_path)
+    assert len(files) == 1 and rec.dumps == 1
+    dump = _load_dump(files[0])
+    # retries exhausted on a non-timeout error: reason is sync_failed, and
+    # the degradation that followed did NOT double-dump the same fault
+    assert dump["reason"] == "sync_failed"
+    assert dump["context"]["attempts"] == 2
+    assert any(e["kind"] == "sync_failure" for e in dump["events"])
+
+
+def test_hung_sync_timeout_dumps_exactly_once(tmp_path):
+    p, t = _cls_batch()
+    m = Accuracy()
+    m.update(p, t)
+    m.dist_sync_fn = gather_all_tensors
+    with obs.flight_scope(tmp_path) as rec:
+        with fi.flaky_sync_backend(fails=0, delay_s=5.0, slow_calls=10**6):
+            with reliability.sync_policy_scope(
+                max_retries=0, timeout_s=0.05, degraded_ok=True
+            ):
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    m.compute()
+    files = _dump_files(tmp_path)
+    assert len(files) == 1 and rec.dumps == 1
+    dump = _load_dump(files[0])
+    assert dump["reason"] == "sync_timeout"
+    assert dump["context"]["timeout_s"] == 0.05
+
+
+def test_session_torn_write_fallback_dumps_exactly_once(tmp_path):
+    batches = _reg_batches(4)
+    session = EvalSession(MeanSquaredError(), tmp_path / "j", checkpoint_every=1)
+    for i, b in enumerate(batches):
+        session.step(i, *b)
+    newest = session.journal.records()[-1]
+    fi.torn_write(session.journal._gen_path(int(newest["generation"])))
+
+    with obs.flight_scope(tmp_path / "flight") as rec:
+        fresh = EvalSession(MeanSquaredError(), tmp_path / "j", checkpoint_every=1)
+        with pytest.warns(UserWarning, match="falling back"):
+            cursor = fresh.resume()
+    assert cursor == len(batches) - 2  # generation N-1's cursor
+    files = _dump_files(tmp_path / "flight")
+    assert len(files) == 1 and rec.dumps == 1
+    dump = _load_dump(files[0])
+    assert dump["reason"] == "session_torn_write_fallback"
+    assert dump["context"]["generation"] == int(newest["generation"])
+
+
+def test_watchdog_retrace_dumps_once_with_analysis_hint(tmp_path):
+    wd = RecompilationWatchdog()
+    with obs.flight_scope(tmp_path) as rec:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            wd.note_compile("engine[drill]", new_signature=True)  # legit compile
+            assert rec.dumps == 0
+            wd.note_compile("engine[drill]", new_signature=False)  # thrash: fires
+            wd.note_compile("engine[drill]", new_signature=False)  # fires again...
+    # ...but the dump is one per key: the first verdict carries the window
+    files = _dump_files(tmp_path)
+    assert len(files) == 1 and rec.dumps == 1
+    dump = _load_dump(files[0])
+    assert dump["reason"] == "watchdog_retrace"
+    assert dump["context"]["key"] == "engine[drill]"
+    assert "recompiled a previously compiled signature" in dump["context"]["verdict"]
+    # the analyzer-rule hint rides along (None when the auditor has no
+    # findings for this key — the field must still be present)
+    assert "hint" in dump
+    # both fires were buffered as events even though only one dumped
+    assert sum(e["kind"] == "watchdog_retrace" for e in rec.events) == 2
+
+
+# ----------------------------------------------------------------------
+# 3. disabled is invisible
+# ----------------------------------------------------------------------
+def test_disabled_hooks_are_noops(tmp_path):
+    assert not obs.flight_enabled()
+    flight_mod.record("anything", detail=1)
+    assert flight_mod.dump_on_failure("anything") is None
+    assert list(tmp_path.iterdir()) == []
+
+    p, t = _cls_batch()
+    col = MetricCollection([Accuracy()], compiled=True)
+    with fi.failing_engine_compile(times=1), warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        col(p, t)  # demotes; with the recorder disarmed nothing is written
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_flight_scope_restores_prior_recorder(tmp_path):
+    outer = obs.enable_flight(tmp_path / "outer")
+    try:
+        with obs.flight_scope(tmp_path / "inner") as inner:
+            assert obs.get_flight() is inner
+            flight_mod.record("inner_event")
+        assert obs.get_flight() is outer and obs.flight_enabled()
+        flight_mod.record("outer_event")
+        assert [e["kind"] for e in outer.events] == ["outer_event"]
+        assert [e["kind"] for e in inner.events] == ["inner_event"]
+    finally:
+        obs.disable_flight()
+
+
+# ----------------------------------------------------------------------
+# 4. dump mechanics: schema, sequencing, and never-breaks-recovery
+# ----------------------------------------------------------------------
+def test_manual_dump_schema_and_sequencing(tmp_path):
+    with obs.flight_scope(tmp_path) as rec:
+        with obs.tracing_scope():  # pins a current_step for the events
+            rec.record("drill", step=7, detail="a")
+            rec.record("drill", step=9)
+        first = rec.dump("live drill", hint="MTA001", extra=1)
+        second = rec.dump("live drill")
+    assert os.path.basename(first) == "flight-0001-live-drill.json"
+    assert os.path.basename(second) == "flight-0002-live-drill.json"
+    dump = _load_dump(first)
+    assert dump["schema_version"] == 1
+    assert dump["step_range"] == [7, 9]
+    assert dump["hint"] == "MTA001" and dump["context"] == {"extra": 1}
+    assert [e["step"] for e in dump["events"]] == [7, 9]
+    # telemetry was off: the snapshot field records that, not a stale blob
+    assert dump["telemetry"] is None
+
+
+def test_dump_carries_telemetry_snapshot_when_enabled(tmp_path):
+    with obs.telemetry_scope() as tel:
+        tel.count("drill.counter", 3)
+        with obs.flight_scope(tmp_path) as rec:
+            rec.record("drill")
+            path = rec.dump("with telemetry")
+    dump = _load_dump(path)
+    assert dump["telemetry"]["counters"]["drill.counter"] == 3
+
+
+def test_failed_dump_warns_and_returns_none(tmp_path):
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("a file where the dump directory should go")
+    with obs.flight_scope(blocker):
+        flight_mod.record("drill")
+        with pytest.warns(UserWarning, match="dump for 'drill-fault' failed"):
+            assert flight_mod.dump_on_failure("drill-fault") is None
+
+
+def test_failure_dumps_capped_per_reason(tmp_path):
+    """A persistently-failing stream must not turn every step into a dump
+    write: automatic failure dumps cap at max_dumps_per_reason (one
+    warning at the cap), manual dump() calls stay uncapped."""
+    with obs.flight_scope(tmp_path) as rec:
+        rec.max_dumps_per_reason = 2
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for _ in range(5):
+                flight_mod.record("repeat_fault")
+                flight_mod.dump_on_failure("repeat-fault")
+        manual = rec.dump("repeat-fault")  # the live-drill path is uncapped
+    files = _dump_files(tmp_path)
+    assert len(files) == 3 and manual in files
+    capped = [w for w in caught if "2-dump cap" in str(w.message)]
+    assert len(capped) == 1
+    # the event stream kept recording past the cap
+    assert sum(e["kind"] == "repeat_fault" for e in rec.events) == 5
+
+
+def test_rearmed_recorder_never_overwrites_prior_dumps(tmp_path):
+    with obs.flight_scope(tmp_path) as rec:
+        rec.record("first_life")
+        first = rec.dump("same-reason")
+    # a fresh recorder over the SAME directory (e.g. a restarted process
+    # with METRICS_TPU_FLIGHT pointing at a shared dump dir)
+    with obs.flight_scope(tmp_path) as rec2:
+        rec2.record("second_life")
+        second = rec2.dump("same-reason")
+    assert first != second
+    files = _dump_files(tmp_path)
+    assert len(files) == 2
+    assert json.loads(open(first).read())["events"][0]["kind"] == "first_life"
+    assert json.loads(open(second).read())["events"][0]["kind"] == "second_life"

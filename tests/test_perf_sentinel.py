@@ -1,0 +1,180 @@
+"""Perf-regression sentinel tests (`scripts/perf_sentinel.py`).
+
+Acceptance pins, in priority order:
+
+1. **The real trajectory passes**: the newest committed round (BENCH_r05)
+   measured against the committed BENCH_r0*.json history flags nothing —
+   the sentinel must not cry wolf on the repo's own ledger.
+2. **A synthetic 2x regression flags**: doubling every BENCH_r05 leg trips
+   the per-leg comparison, ``--strict`` turns it into exit 1, and the
+   regressed legs are named in SENTINEL.json.
+3. **The report is a machine-readable artifact**: schema-stable JSON,
+   written atomically, with per-leg verdicts CI can surface.
+
+The sentinel never runs ``python bench.py`` here — every test feeds a
+pre-captured ``--current`` (the default fresh-run path is exercised by
+`make ci` / the workflow's advisory step, where a real bench run exists).
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+R05 = os.path.join(REPO, "BENCH_r05.json")
+
+
+@pytest.fixture(scope="module")
+def sentinel():
+    spec = importlib.util.spec_from_file_location(
+        "perf_sentinel", os.path.join(REPO, "scripts", "perf_sentinel.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def r05_legs(sentinel):
+    round_ = sentinel.load_round(R05)
+    assert round_ is not None and round_["platform"] == "cpu"
+    return round_["legs"]
+
+
+def _synthetic_current(tmp_path, legs, factor):
+    """A raw bench-result JSON whose legs are ``factor`` x BENCH_r05's
+    (nested back under config_matrix so extraction sees the real shape)."""
+    blob = {"value": 0.0, "platform": "cpu", "config_matrix": {}}
+    for name, v in legs.items():
+        if name.startswith("config_matrix."):
+            blob["config_matrix"][name.split(".")[1]] = {"cpu_ms": v * factor}
+        elif name == "value_cpu.value_ms":
+            blob["value_cpu"] = {"value_ms": v * factor}
+        elif name != "value":
+            blob[name] = v * factor
+    path = tmp_path / "current.json"
+    path.write_text(json.dumps(blob))
+    return os.fspath(path)
+
+
+def test_real_trajectory_passes(sentinel, tmp_path, capsys):
+    out = tmp_path / "SENTINEL.json"
+    rc = sentinel.main(["--current", R05, "--out", os.fspath(out), "--strict"])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["format"] == "metrics_tpu.perf_sentinel"
+    assert report["regressions"] == []
+    compared = [l for l in report["legs"].values() if l["verdict"] != "skipped"]
+    assert len(compared) >= 10  # the r05 leg set actually got compared
+    assert all(l["verdict"] == "ok" for l in compared)
+    # platform matching: only cpu rounds form the baseline (r01 predates
+    # the platform field and must be excluded, not compared against)
+    assert "BENCH_r01.json" not in report["trajectory"]
+    assert "BENCH_r05.json" in report["trajectory"]
+
+
+def test_synthetic_2x_regression_flags(sentinel, tmp_path, r05_legs):
+    current = _synthetic_current(tmp_path, r05_legs, factor=2.0)
+    out = tmp_path / "SENTINEL.json"
+    rc = sentinel.main(["--current", current, "--out", os.fspath(out), "--strict"])
+    assert rc == 1  # --strict gates
+    report = json.loads(out.read_text())
+    assert report["regressions"]  # the 2x blow-up was flagged...
+    flagged = {report["legs"][n]["verdict"] for n in report["regressions"]}
+    assert flagged == {"regression"}
+    # ...on the big legs for sure (2.0 > any sane threshold over a
+    # median-of-noisy-rounds baseline)
+    assert "collection_forward_1m_cpu_ms" in report["regressions"]
+    for name in report["regressions"]:
+        leg = report["legs"][name]
+        assert leg["ratio"] > leg["threshold"] >= 1.0
+
+
+def test_advisory_mode_reports_but_exits_zero(sentinel, tmp_path, r05_legs):
+    current = _synthetic_current(tmp_path, r05_legs, factor=2.0)
+    out = tmp_path / "SENTINEL.json"
+    rc = sentinel.main(["--current", current, "--out", os.fspath(out)])
+    assert rc == 0  # advisory default: report, don't gate
+    assert json.loads(out.read_text())["regressions"]
+
+
+def test_unregressed_synthetic_passes_and_tiny_legs_skip(sentinel, tmp_path, r05_legs):
+    current = _synthetic_current(tmp_path, r05_legs, factor=1.0)
+    out = tmp_path / "SENTINEL.json"
+    rc = sentinel.main(["--current", current, "--out", os.fspath(out), "--strict"])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["regressions"] == []
+    # sub-ms legs are jitter territory: skipped, with the reason recorded
+    skipped = [n for n, l in report["legs"].items() if l["verdict"] == "skipped"]
+    assert all(report["legs"][n]["baseline_ms"] < 0.5 for n in skipped)
+
+
+def test_per_leg_threshold_override(sentinel, tmp_path, r05_legs):
+    # a 1.3x bump passes the default 1.75 threshold but trips a per-leg 1.2
+    current = _synthetic_current(tmp_path, r05_legs, factor=1.3)
+    out = tmp_path / "SENTINEL.json"
+    rc = sentinel.main(["--current", current, "--out", os.fspath(out), "--strict"])
+    assert rc == 0
+    rc = sentinel.main(
+        ["--current", current, "--out", os.fspath(out), "--strict",
+         "--leg-threshold", "collection_forward_1m_cpu_ms=1.2"]
+    )
+    assert rc == 1
+    report = json.loads(out.read_text())
+    assert report["regressions"] == ["collection_forward_1m_cpu_ms"]
+
+
+def test_legs_extraction_excludes_foreign_numbers(sentinel):
+    legs = sentinel.extract_legs(
+        {
+            "value": 1.0,
+            "platform": "cpu",
+            "collection_forward_1m_cpu_ms": 40.0,
+            "last_good_accelerator": {"sync_8dev_tpu_ms": 3.0},
+            "value_tpu": {"value_ms": 2.0},
+            "config_matrix": {"mse_1m": {"cpu_ms": 1.0, "ref_cpu_ms": 9.0}},
+            "telemetry": None,
+        }
+    )
+    assert legs == {
+        "value": 1.0,
+        "collection_forward_1m_cpu_ms": 40.0,
+        "config_matrix.mse_1m.cpu_ms": 1.0,
+    }
+
+
+def test_every_committed_round_is_recoverable(sentinel):
+    """The ledger itself must stay loadable: every committed BENCH_r0*
+    file yields numeric legs (r05's wrapper truncates the JSON line, so
+    this pins the textual-recovery path too)."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_r0*.json")))
+    assert len(paths) >= 5
+    for path in paths:
+        round_ = sentinel.load_round(path)
+        assert round_ is not None, path
+        assert round_["legs"], path
+        assert all(v >= 0 for v in round_["legs"].values()), path
+
+
+def test_non_json_current_is_a_clean_verdict(sentinel, tmp_path):
+    """A captured bench stdout tail that wasn't the JSON result line (the
+    bench crashed mid-run) must exit with a message, not a JSONDecodeError
+    traceback — the CI advisory step depends on stderr staying readable."""
+    bad = tmp_path / "current.json"
+    bad.write_text("WARNING: module forward leg failed (whatever)\n")
+    with pytest.raises(SystemExit, match="not JSON"):
+        sentinel.main(["--current", os.fspath(bad), "--out", os.fspath(tmp_path / "o.json")])
+
+
+def test_platform_unknown_current_refuses_mixed_baseline(sentinel, tmp_path):
+    """A current run whose platform is unrecoverable must refuse the
+    comparison rather than silently measure cpu legs against tpu rounds."""
+    blob = {"value": 1.0, "collection_forward_1m_cpu_ms": 40.0}  # no platform
+    cur = tmp_path / "current.json"
+    cur.write_text(json.dumps(blob))
+    with pytest.raises(SystemExit, match="platform is unrecoverable"):
+        sentinel.main(["--current", os.fspath(cur), "--out", os.fspath(tmp_path / "o.json")])
