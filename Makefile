@@ -1,4 +1,4 @@
-.PHONY: ci lint test test-tpu test-tpu-suite doctest bench sentinel dryrun fuzz fuzz-sharded chaos clean
+.PHONY: ci lint test test-tpu test-tpu-suite doctest bench bench-sync sentinel dryrun fuzz fuzz-sharded chaos clean
 
 ci:
 	# the full CI gate as one machine-runnable target (mirrors
@@ -60,6 +60,20 @@ bench:
 	# north-star benchmark; prints one JSON line (real TPU when available)
 	python bench.py
 
+bench-sync:
+	# sync legs only (~2 min vs the full bench): the 8-virtual-device
+	# exact-curve legs plus the binned psum tier with its int8/bf16
+	# quantized variants, wire-payload ratio, and abs-err bound legs.
+	# Flight recorder armed (any failure path dumps to flight-dumps/),
+	# one Perfetto trace per leg in bench-traces/, and the perf sentinel
+	# compares the result against the committed BENCH_r0*.json trajectory
+	# — including the quantized legs' registered thresholds and the
+	# absolute error/compression bounds. Writes SENTINEL.json; CI uploads
+	# bench_sync.json + traces + dumps as artifacts.
+	METRICS_TPU_FLIGHT=flight-dumps python bench.py --leg-sync --trace-out bench-traces | tee bench_sync.txt
+	tail -n 1 bench_sync.txt > bench_sync.json
+	python scripts/perf_sentinel.py --current bench_sync.json
+
 sentinel:
 	# perf-regression sentinel, STRICT: fresh bench.py run compared per leg
 	# against the committed BENCH_r0*.json trajectory; exit 1 on any leg
@@ -91,5 +105,5 @@ dryrun:
 
 clean:
 	rm -rf .pytest_cache .jax_cache flight-dumps bench-traces
-	rm -f bench_current.txt bench_current.json
+	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

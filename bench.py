@@ -592,10 +592,11 @@ def _bench_binned_sync() -> dict:
 
     repo = os.path.dirname(os.path.abspath(__file__))
     code = f"""
-import time
+import os, time
 import numpy as np, jax, jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 from metrics_tpu.ops.histogram import score_histograms, histogram_auroc
+from metrics_tpu.utilities.jit import tpu_shard_map
 from sklearn.metrics import roc_auc_score
 
 N = {N}
@@ -611,18 +612,66 @@ def make_step(num_bins):
         hp = jax.lax.psum(hp, "dp")
         hn = jax.lax.psum(hn, "dp")
         return histogram_auroc(hp, hn)
-    return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P()))
+    return jax.jit(tpu_shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P(), check_vma=False))
 
 jp, jt = jnp.asarray(preds), jnp.asarray(target)
-step512 = make_step(512)
-v = float(np.asarray(step512(jp, jt)).ravel()[0])  # warm compile
-times = []
-for _ in range(5):
-    t0 = time.perf_counter()
-    out = step512(jp, jt)
-    jax.block_until_ready(out)
-    times.append(time.perf_counter() - t0)
-print("BINNED_SYNC_MS", min(times) * 1e3)
+
+def time_step(step, tag):
+    v = float(np.asarray(step(jp, jt)).ravel()[0])  # warm compile
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = step(jp, jt)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    # one extra traced+timed step per leg when the parent asked for
+    # Perfetto artifacts (make bench-sync): the host spans bracket the
+    # whole dispatch, so the trace shows where the sync leg's time goes
+    trace_dir = os.environ.get("BENCH_TRACE_OUT")
+    if trace_dir:
+        import json as _json
+        from metrics_tpu.observability import trace as _tr
+        with _tr.tracing_scope() as rec:
+            with _tr.span(f"bench.{{tag}}", phase="sync"):
+                jax.block_until_ready(step(jp, jt))
+            blob = rec.to_perfetto()
+        os.makedirs(trace_dir, exist_ok=True)
+        with open(os.path.join(trace_dir, f"{{tag}}.json"), "w") as f:
+            _json.dump(blob, f)
+    return v, min(times) * 1e3
+
+v, ms = time_step(make_step(512), "binned_sync_exact")
+print("BINNED_SYNC_MS", ms)
+
+# the quantized sync tier on the same histograms: block-scaled int8 /
+# bf16 payloads through qsync_sum, wire-byte telemetry measured in-trace
+from metrics_tpu.parallel.collective import qsync_sum
+from metrics_tpu import observability as obs
+
+def make_qstep(num_bins, precision):
+    def step(p, t):
+        hp, hn = score_histograms(p, t, num_bins)
+        hp = qsync_sum(hp, precision, "dp")
+        hn = qsync_sum(hn, precision, "dp")
+        return histogram_auroc(hp, hn)
+    return jax.jit(tpu_shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P(), check_vma=False))
+
+exact512 = roc_auc_score(target, preds)
+for precision in ("int8", "bf16"):
+    # telemetry on only while THIS program traces, counters cleared per
+    # leg: the trace-time collective counters then hold exactly this
+    # leg's wire/logical bytes (enable() keeps prior counts by design)
+    obs.enable()
+    obs.get().reset()
+    vq, msq = time_step(make_qstep(512, precision), "binned_sync_" + precision)
+    tel = obs.get()
+    wire = tel.counters.get("collective.wire_bytes", 0)
+    logical = tel.counters.get("collective.payload_bytes", 0)
+    obs.disable()
+    print("BINNED_QSYNC_MS", precision, msq)
+    print("BINNED_QERR", precision, 512, abs(vq - exact512))
+    if precision == "int8" and wire:
+        print("SYNC_PAYLOAD_RATIO", logical / wire)
 
 # approximation error vs the exact value, informative + uniform streams
 informative = (rng.rand(N) < preds).astype(np.int32)
@@ -639,6 +688,19 @@ for name, t in [("uniform", target), ("informative", informative)]:
     for line in stdout.splitlines():
         if line.startswith("BINNED_SYNC_MS"):
             out["binned_sync_8dev_cpu_ms"] = round(float(line.split()[1]), 3)
+        elif line.startswith("BINNED_QSYNC_MS"):
+            _, precision, v = line.split()
+            out[f"binned_sync_8dev_{precision}_cpu_ms"] = round(float(v), 3)
+        elif line.startswith("BINNED_QERR"):
+            _, precision, num_bins, err = line.split()
+            # same raw-float rationale as BINNED_ERR below; keyed like the
+            # exact-path entries so the sentinel bound legs stay stable
+            out["binned_abs_err"][f"{precision}_{num_bins}bins"] = float(err)
+        elif line.startswith("SYNC_PAYLOAD_RATIO"):
+            # logical (f32 state) over wire (int8 codes + f32 block scales)
+            # bytes, from the trace-time collective telemetry counters —
+            # the ≥3× compression evidence for the quantized tier
+            out["sync_payload_ratio"] = round(float(line.split()[1]), 3)
         elif line.startswith("BINNED_ERR"):
             _, name, num_bins, err = line.split()
             # raw float: rounding to fixed decimals would quantize errors
@@ -1002,6 +1064,41 @@ def main() -> None:
         return
     if "--leg-forward" in sys.argv:
         _forward_leg()
+        return
+    if "--leg-sync" in sys.argv:
+        # sync legs only (make bench-sync): the 8-virtual-device exact-curve
+        # legs plus the binned psum tier incl. its int8/bf16 quantized
+        # variants and the wire-payload ratio. Prints the same one-JSON-line
+        # contract as the full bench, with platform pinned to "cpu" (these
+        # legs are CPU-forced by design) so the perf sentinel can compare
+        # the result against the committed cpu trajectory rounds.
+        result = {
+            "metric": "sync legs only (bench.py --leg-sync)",
+            "platform": "cpu",
+        }
+        try:
+            sync_ms, sync_gather_ms, collection_sync_ms, sync_weighted_ms = _bench_sync_cpu()
+            result.update(
+                sync_8dev_cpu_ms=round(sync_ms, 3),
+                sync_8dev_cpu_gather_ms=round(sync_gather_ms, 3),
+                collection_sync_8dev_cpu_ms=round(collection_sync_ms, 3),
+                sync_weighted_8dev_cpu_ms=round(sync_weighted_ms, 3),
+            )
+        except Exception as err:
+            print(f"WARNING: 8-device sync leg failed ({err!r})", file=sys.stderr)
+        binned_failed = None
+        try:
+            result.update(_bench_binned_sync())
+        except Exception as err:
+            binned_failed = err
+            print(f"ERROR: binned sync leg failed ({err!r})", file=sys.stderr)
+        print(json.dumps(result))
+        if binned_failed is not None:
+            # the binned/quantized legs are the POINT of --leg-sync: their
+            # absence would also make the sentinel's absolute-bound gate
+            # vacuously green (missing bound legs are skipped), so a broken
+            # leg must fail the run loudly, not degrade to a warning
+            raise SystemExit(1)
         return
 
     jax_time, jax_acc, jax_auroc, platform = _run_jax_leg_isolated()

@@ -19,6 +19,7 @@ import numpy as np
 from metrics_tpu.metric import Metric
 
 __all__ = [
+    "BlockScaledQuantizedSync",
     "CallbackInJit",
     "DonatedAlias",
     "HostSyncUpdate",
@@ -26,6 +27,7 @@ __all__ = [
     "NarrowAccumulator",
     "NonCommutativeMerge",
     "SuppressedNarrowAccumulator",
+    "UnscaledInt8Psum",
 ]
 
 
@@ -150,3 +152,54 @@ class MeanWithoutCount(Metric):
 
     def compute(self) -> jax.Array:
         return self.avg
+
+
+def _unscaled_int8_psum(stacked: jax.Array) -> jax.Array:
+    """The quantized-sync anti-pattern: per-rank contributions cast straight
+    to int8 — no block scales — summed, and cast back. Fractional values
+    truncate to 0 and anything past ±127 saturates; the 'compressed' merge
+    destroys the magnitudes it claims to accumulate."""
+    return stacked.astype(jnp.int8).sum(axis=0).astype(jnp.float32)
+
+
+# the declaration that holds it to the quantized contract (MTA004 probes
+# magnitude preservation on the dequantized result, not just commutativity)
+_unscaled_int8_psum.quantized_precision = "int8"
+
+
+class UnscaledInt8Psum(Metric):
+    """MTA004 (quantized flavor): an int8 psum WITHOUT block scales. Still
+    commutative — the classic probe alone would pass it — but not
+    magnitude-preserving, which is the property that makes a quantized
+    merge sound."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.zeros((8,)), dist_reduce_fx=_unscaled_int8_psum)
+
+    def update(self, x: jax.Array) -> None:
+        self.acc = self.acc + jnp.reshape(x, self.acc.shape)
+
+    def compute(self) -> jax.Array:
+        return jnp.sum(self.acc)
+
+
+class BlockScaledQuantizedSync(Metric):
+    """The POSITIVE control for the quantized MTA004 probe: a 'sum' state on
+    the int8 sync tier (block-scaled, error-feedback residual). Must audit
+    clean — the probe runs on the dequantized composite and the residual
+    companion is exempt from every reduction rule."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state(
+            "hist", default=jnp.zeros((64,)), dist_reduce_fx="sum", sync_precision="int8"
+        )
+
+    def update(self, x: jax.Array) -> None:
+        self.hist = self.hist + jnp.zeros_like(self.hist) + jnp.sum(x) / self.hist.shape[0]
+
+    def compute(self) -> jax.Array:
+        return jnp.sum(self.hist)

@@ -48,6 +48,7 @@ from metrics_tpu.analysis.rules import (
     class_allowed_rules,
     state_allowed_rules,
 )
+from metrics_tpu.parallel import quantize as _q
 from metrics_tpu.utilities.data import (
     dim_zero_cat,
     dim_zero_max,
@@ -206,17 +207,40 @@ def _widest_float_input(args: tuple, kwargs: dict) -> Optional[Any]:
 
 def _audit_reductions(metric, findings: List[Finding]) -> None:
     """MTA004: is every declared ``dist_reduce_fx`` a sound cross-replica
-    merge for its state?"""
+    merge for its state?
+
+    Quantized-tier awareness: error-feedback residual companions
+    (``<state>__qres``, registered by ``sync_precision=``) are library-
+    managed LOCAL compensation state — never synced, so no reduction rule
+    (including the mean-without-count pairing scan) binds them. States on a
+    quantized tier are additionally probed through the quantize → gather →
+    dequantize → sum composite: commutativity is checked on the DEQUANTIZED
+    result and the merge must preserve magnitude within the tier's error
+    bound (an *unscaled* int8 psum fails that and flags)."""
     cls = type(metric).__name__
     reductions = metric._reductions
+    precisions = getattr(metric, "_sync_precisions", {}) or {}
+    residual_names = set(
+        metric._sync_residual_names() if hasattr(metric, "_sync_residual_names") else ()
+    )
     has_paired_count = any(
-        reductions.get(s) is dim_zero_sum and any(h in s.lower() for h in _COUNT_STATE_HINTS)
+        reductions.get(s) is dim_zero_sum
+        and s not in residual_names
+        and any(h in s.lower() for h in _COUNT_STATE_HINTS)
         for s in metric._defaults
     )
     for sname, red in reductions.items():
+        if sname in residual_names:
+            continue  # local-only error-feedback state: never crosses the wire
         default = metric._defaults[sname]
         is_list = isinstance(default, list)
         subject = f"{cls}.{sname}"
+        if precisions.get(sname, "exact") != "exact":
+            note = _quantized_merge_probe(
+                _q.quantized_sum_reduction(precisions[sname]), default
+            )
+            if note is not None:
+                findings.append(Finding("MTA004", subject, note))
         if red is None:
             if not is_list:
                 findings.append(Finding(
@@ -235,7 +259,13 @@ def _audit_reductions(metric, findings: List[Finding]) -> None:
                     " batch counts",
                 ))
         elif kind is None:  # custom callable: probe commutativity
-            note = _commutativity_probe(red, default)
+            if getattr(red, "quantized_precision", None) is not None:
+                # a reduction that declares itself quantized is held to the
+                # quantized contract: commutative on the dequantized result
+                # AND magnitude-preserving within its precision's bound
+                note = _quantized_merge_probe(red, default)
+            else:
+                note = _commutativity_probe(red, default)
             if note is not None:
                 findings.append(Finding("MTA004", subject, note))
         if metric._fused_forward and not is_list and not type(metric)._merge_reduction_supported(red):
@@ -285,6 +315,59 @@ def _commutativity_probe(red: Callable, default: Any) -> Optional[str]:
             f"custom reduction {getattr(red, '__name__', red)!r} is"
             " order-dependent: red(stack([a, b])) != red(stack([b, a])), so"
             " every replica layout computes a different merged state"
+        )
+    return None
+
+
+def _quantized_merge_probe(red: Callable, default: Any) -> Optional[str]:
+    """Property-probe a quantized cross-replica merge on a stacked
+    2-replica state. Two contracts, both on the DEQUANTIZED result:
+
+    * **commutativity** — ``red(stack([a, b])) == red(stack([b, a]))``
+      within the precision's error bound (per-row quantization makes a
+      sound tier bitwise order-independent; the tolerance only forgives
+      accumulation-order rounding);
+    * **magnitude preservation** — ``red(stack([a, b])) ≈ a + b`` within
+      the bound. This is what separates block-SCALED quantization from a
+      bare low-precision cast: an unscaled int8 psum truncates fractional
+      values to zero and saturates at ±127, destroying the very magnitudes
+      the sum exists to accumulate — and must still flag.
+    """
+    if isinstance(default, list):
+        return None
+    precision = getattr(red, "quantized_precision", "int8")
+    name = getattr(red, "__name__", repr(red))
+    rng = np.random.RandomState(0x51)
+    shape = tuple(jnp.shape(default))
+    a = jnp.asarray(rng.rand(*((2,) + shape)).astype(np.float32) * 2.0 + 0.25)
+    exact = np.asarray(a[0] + a[1], dtype=np.float32)
+    # per-replica error ≤ absmax_block/254 (int8, half a step) or a bf16
+    # round (2^-8 relative); 2 replicas, ×4 safety for block padding edges
+    absmax = float(np.abs(np.asarray(a)).max())
+    per_row = absmax / 254.0 if precision == "int8" else absmax * 2.0 ** -8
+    tol = 4.0 * 2 * per_row + 1e-6
+    try:
+        fwd = np.asarray(red(a), dtype=np.float32)
+        rev = np.asarray(red(a[::-1]), dtype=np.float32)
+    except Exception as err:  # noqa: BLE001 — probe must never crash the audit
+        return (
+            f"quantized reduction {name!r} failed the soundness probe outright"
+            f" ({type(err).__name__}: {err})"
+        )
+    if not np.allclose(fwd, rev, atol=tol, equal_nan=True):
+        return (
+            f"quantized reduction {name!r} is order-dependent beyond its"
+            f" precision's error bound ({precision}): the dequantized merge"
+            " gives every replica layout a different state"
+        )
+    drift = float(np.abs(fwd - exact).max())
+    if drift > tol:
+        return (
+            f"quantized reduction {name!r} is not magnitude-preserving:"
+            f" |merged - exact sum| = {drift:.4g} exceeds the {precision}"
+            f" error bound {tol:.4g} — an unscaled low-precision psum"
+            " (no block scales) truncates/saturates the contributions it"
+            " claims to sum"
         )
     return None
 

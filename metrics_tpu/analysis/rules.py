@@ -128,13 +128,20 @@ MTA004 = rule(
     "A declared `dist_reduce_fx` that cannot soundly merge cross-replica"
     " state: a custom reduction that fails a commutativity probe, a 'mean'"
     " state with no paired count, a fused-forward state outside the"
-    " mergeable set, or a cat-state metric that an engine would compile.",
+    " mergeable set, a cat-state metric that an engine would compile, or a"
+    " quantized merge that is not magnitude-preserving (an unscaled"
+    " low-precision psum).",
     "Cross-replica sync all-gathers per-rank states and folds them with"
     " the declared reduction; `psum`-style folds assume commutative,"
     " weight-aware merges. An order-dependent reduction gives every rank"
     " layout a different answer; a bare mean-of-means is wrong whenever"
     " ranks see different batch counts; cat states must demote to eager"
-    " rather than compile.",
+    " rather than compile. Quantized sync tiers (sync_precision=) are"
+    " probed through the quantize→dequantize composite: commutativity is"
+    " checked on the DEQUANTIZED result within the tier's error bound, the"
+    " merge must preserve magnitude (block scales, not bare int8 casts),"
+    " and error-feedback residual companions (`<state>__qres`, local-only"
+    " compensation state) are exempt from every reduction rule.",
 )
 
 # ---------------------------------------------------------------------------

@@ -53,7 +53,7 @@ from metrics_tpu.parallel.sample_sort import (
 
 
 from metrics_tpu.utilities.data import _is_concrete
-from metrics_tpu.utilities.jit import tpu_jit
+from metrics_tpu.utilities.jit import tpu_jit, tpu_shard_map
 from metrics_tpu.parallel.sharded_metric import (  # noqa: F401  (re-exported for tests/users)
     ShardedStreamsMixin,
     _default_mesh,
@@ -196,7 +196,7 @@ def _ovr_a2a_program(mesh: Mesh, axis: str, kernel, num_classes: int, weighted: 
 
     extra = (P(axis),) if weighted else ()
     return tpu_jit(
-        jax.shard_map(
+        tpu_shard_map(
             _local,
             mesh=mesh,
             in_specs=(P(axis), P(axis), *extra, P(axis)),
@@ -246,7 +246,7 @@ def _ovr_program(mesh: Mesh, axis: str, kernel, weighted: bool = False):
 
     extra = (P(),) if weighted else ()
     return tpu_jit(
-        jax.shard_map(
+        tpu_shard_map(
             _local,
             mesh=mesh,
             in_specs=(P(), P(), P(), *extra),

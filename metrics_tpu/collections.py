@@ -27,6 +27,12 @@ class MetricCollection:
               same metric class with different parameters.
 
         prefix: a string to append in front of the keys of the output dict
+        sync_precision: apply a quantized sync tier to every member's
+            eligible (``"sum"``-reduced array) states at construction —
+            ``"bf16"`` or ``"int8"`` (block-scaled with error-feedback
+            residuals; see :meth:`Metric.set_sync_precision`). Ineligible
+            states (cat/list, non-additive reductions) stay exact, by
+            contract. Default None leaves everything exact (bit-identical).
         compiled: route ``forward`` through the compiled step engine
             (:class:`~metrics_tpu.engine.CompiledStepEngine`): the whole
             fan-out — shared canonicalization, every member's update, the
@@ -59,6 +65,7 @@ class MetricCollection:
         metrics: Union[List[Metric], Tuple[Metric, ...], Dict[str, Metric]],
         prefix: Optional[str] = None,
         compiled: bool = False,
+        sync_precision: Optional[str] = None,
     ):
         self._metrics: "OrderedDict[str, Metric]" = OrderedDict()
         self.compiled = bool(compiled)
@@ -84,6 +91,21 @@ class MetricCollection:
             raise ValueError("Unknown input to MetricCollection.")
 
         self.prefix = self._check_prefix_arg(prefix)
+        if sync_precision is not None:
+            self.set_sync_precision(sync_precision)
+
+    def set_sync_precision(self, precision: str) -> Dict[str, Dict[str, str]]:
+        """Switch every member's eligible states onto a quantized sync tier
+        (``"exact"`` | ``"bf16"`` | ``"int8"``); returns the applied
+        ``{member: {state: precision}}`` map. Members with no eligible
+        states (curve/cat-state metrics) are left exact and appear with an
+        empty map. Compiled engines key their signature cache on the
+        precision map, so flipping tiers never reuses a stale program."""
+        return {name: m.set_sync_precision(precision) for name, m in self.items()}
+
+    def sync_precisions(self) -> Dict[str, Dict[str, str]]:
+        """Per-member ``{state: precision}`` maps of the quantized tier."""
+        return {name: m.sync_precisions() for name, m in self.items()}
 
     # --- mapping protocol (stands in for the reference's nn.ModuleDict) ---
     def __getitem__(self, key: str) -> Metric:

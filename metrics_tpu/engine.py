@@ -273,7 +273,15 @@ class CompiledStepEngine:
         guard_token: Optional[str] = None,
     ) -> tuple:
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
-        return (names, guard_token, treedef, tuple(_abstract_leaf(x) for x in leaves))
+        # the quantized sync tier is part of the program identity: a
+        # precision flip changes the state pytree (residual companions
+        # appear/disappear) and, later, any sync folded into the step — a
+        # stale same-shape program must never be reused across tiers
+        precisions = tuple(
+            (n, tuple(sorted(getattr(self._metrics[n], "_sync_precisions", {}).items())))
+            for n in names
+        )
+        return (names, precisions, guard_token, treedef, tuple(_abstract_leaf(x) for x in leaves))
 
     @staticmethod
     def _guard_token(guard) -> Optional[str]:

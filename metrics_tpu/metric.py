@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.observability import trace as _obs_trace
+from metrics_tpu.parallel import quantize as _quant
 from metrics_tpu.parallel.backend import is_distributed_initialized
 from metrics_tpu.reliability import guard as _rguard
 from metrics_tpu.reliability import sync as _rsync
@@ -51,6 +52,13 @@ from metrics_tpu.utilities.data import (
 from metrics_tpu.utilities.distributed import gather_all_tensors
 
 Array = jax.Array
+
+# suffix of the per-quantized-state error-feedback residual companion (see
+# ``Metric.add_state(sync_precision=...)``): ``<state>__qres`` is a REAL
+# registered state — it snapshots, resets, checkpoints and resumes with the
+# state it compensates — but it never crosses the wire (``_sync_dist``
+# excludes it) and always stays f32 (``astype`` skips it)
+_SYNC_RESIDUAL_SUFFIX = "__qres"
 
 
 def _encode_session_cursor(cursor: int) -> Array:
@@ -161,6 +169,11 @@ class Metric(ABC):
         self._defaults: Dict[str, Any] = {}
         self._persistent: Dict[str, bool] = {}
         self._reductions: Dict[str, Optional[Callable]] = {}
+        # state name -> "bf16" | "int8" for states synced through the
+        # quantized tier (absent = exact). Populated by add_state's
+        # sync_precision= / set_sync_precision(); read with getattr
+        # defaults everywhere so pre-existing pickles keep working.
+        self._sync_precisions: Dict[str, str] = {}
 
     def add_state(
         self,
@@ -168,6 +181,7 @@ class Metric(ABC):
         default: Union[Array, list],
         dist_reduce_fx: Optional[Union[str, Callable]] = None,
         persistent: bool = False,
+        sync_precision: str = "exact",
     ) -> None:
         """Register a metric state variable (reference ``metric.py:88-145``).
 
@@ -179,9 +193,30 @@ class Metric(ABC):
                 cross-process gathered state (stacked ``(world, ...)`` for
                 array states, rank-order flattened for list states).
             persistent: include this state in ``state_dict()``.
+            sync_precision: ``"exact"`` (default, bit-identical sync) or a
+                quantized wire tier — ``"bf16"`` (2× payload reduction) or
+                ``"int8"`` (block-scaled, ~3.9×). Only ``"sum"``-reduced
+                array states qualify (cat/list states are always exact);
+                a quantized state gets a persistent f32 error-feedback
+                residual companion (``<name>__qres``) so repeated syncs do
+                not drift. See ``docs/performance.md`` for the per-family
+                error bounds.
         """
         if not isinstance(default, (Array, jnp.ndarray, list)) or (isinstance(default, list) and default):
             raise ValueError("state variable must be a tensor or any empty list (where you can append tensors)")
+        if sync_precision not in _quant.PRECISIONS:
+            raise ValueError(
+                f"`sync_precision` must be one of {_quant.PRECISIONS}, got {sync_precision!r}"
+            )
+        if sync_precision != "exact" and (
+            isinstance(default, list) or dist_reduce_fx != "sum"
+        ):
+            raise ValueError(
+                f"sync_precision={sync_precision!r} requires a 'sum'-reduced"
+                " array state: cat/list states and non-additive reductions"
+                " always sync exact (quantizing a rank-order concat or an"
+                " order-sensitive merge would corrupt it, not compress it)"
+            )
 
         if dist_reduce_fx == "sum":
             dist_reduce_fx = dim_zero_sum
@@ -215,6 +250,98 @@ class Metric(ABC):
         self._defaults[name] = [] if isinstance(default, list) else default
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
+        if sync_precision != "exact":
+            self._register_sync_residual(name, sync_precision, persistent)
+
+    # ------------------------------------------------------------------
+    # quantized sync tier (sync_precision=)
+    # ------------------------------------------------------------------
+    def _register_sync_residual(self, name: str, precision: str, persistent: bool) -> None:
+        """Attach the f32 error-feedback residual companion to a quantized
+        state. Registered like any state (snapshot/reset/checkpoint ride
+        for free) with a zero default and a 'sum' reduction — under the
+        compiled engine's (accumulated, batch) fold the batch residual is
+        always the zero default, so the merge is an identity and the
+        residual only ever changes at sync time."""
+        res_name = name + _SYNC_RESIDUAL_SUFFIX
+        res_default = jnp.zeros(jnp.shape(self._defaults[name]), jnp.float32)
+        setattr(self, res_name, res_default)
+        self._defaults[res_name] = res_default
+        self._persistent[res_name] = persistent
+        self._reductions[res_name] = dim_zero_sum
+        if not hasattr(self, "_sync_precisions"):
+            self._sync_precisions = {}  # pre-knob pickle resumed mid-life
+        self._sync_precisions[name] = precision
+
+    def sync_precisions(self) -> Dict[str, str]:
+        """Per-state wire precision of the quantized sync tier (states not
+        listed sync exact). A copy; mutate via :meth:`set_sync_precision`."""
+        return dict(getattr(self, "_sync_precisions", {}))
+
+    def _sync_residual_names(self) -> tuple:
+        """Names of the error-feedback residual companion states."""
+        return tuple(
+            n + _SYNC_RESIDUAL_SUFFIX for n in getattr(self, "_sync_precisions", {})
+        )
+
+    def set_sync_precision(self, precision: str, states: Optional[Sequence] = None) -> Dict[str, str]:
+        """Switch registered states onto a sync tier post-construction.
+
+        Args:
+            precision: ``"exact"`` | ``"bf16"`` | ``"int8"``.
+            states: state names to switch. Default (None): every *eligible*
+                state — ``"sum"``-reduced array states — with ineligible
+                ones silently left exact (cat/list states are exact by
+                contract). Naming an ineligible state explicitly raises.
+
+        Returns the resulting ``{state: precision}`` map (exact states
+        omitted). Dropping back to ``"exact"`` deregisters the residual
+        companions; switching tiers keeps the residual (it is f32 either
+        way and still describes the last sync's error).
+        """
+        if precision not in _quant.PRECISIONS:
+            raise ValueError(
+                f"`sync_precision` must be one of {_quant.PRECISIONS}, got {precision!r}"
+            )
+        if not hasattr(self, "_sync_precisions"):
+            self._sync_precisions = {}
+        residual_names = set(self._sync_residual_names())
+        if states is None:
+            candidates = [
+                n
+                for n in self._defaults
+                if n not in residual_names
+                and not isinstance(self._defaults[n], list)
+                and self._reductions.get(n) is dim_zero_sum
+            ]
+        else:
+            candidates = list(states)
+            for n in candidates:
+                if n not in self._defaults or n in residual_names:
+                    raise KeyError(f"{type(self).__name__} has no registered state {n!r}")
+                if isinstance(self._defaults[n], list) or self._reductions.get(n) is not dim_zero_sum:
+                    raise ValueError(
+                        f"state {n!r} cannot use sync_precision={precision!r}:"
+                        " only 'sum'-reduced array states qualify (cat/list"
+                        " states are always exact)"
+                    )
+        for n in candidates:
+            if precision == "exact":
+                if n in self._sync_precisions:
+                    del self._sync_precisions[n]
+                    res = n + _SYNC_RESIDUAL_SUFFIX
+                    self._defaults.pop(res, None)
+                    self._persistent.pop(res, None)
+                    self._reductions.pop(res, None)
+                    if hasattr(self, res):
+                        delattr(self, res)
+            elif n in self._sync_precisions:
+                self._sync_precisions[n] = precision
+            else:
+                self._register_sync_residual(n, precision, self._persistent[n])
+        # a cached result no longer describes what sync would now produce
+        self._computed = None
+        return self.sync_precisions()
 
     def forward(self, *args: Any, **kwargs: Any):
         """Update state with the batch; return the batch-local value if
@@ -239,6 +366,13 @@ class Metric(ABC):
                 cache = self._snapshot_state()
 
                 self.reset()
+                # error-feedback residuals belong to the SYNC stream, not
+                # the accumulation: seed the batch-local pass with the
+                # persistent values so a dist_sync_on_step sync compensates
+                # the PREVIOUS step sync's error instead of starting from
+                # the reset zeros every step (a frozen feedback loop)
+                for res_name in self._sync_residual_names():
+                    setattr(self, res_name, cache[res_name])
                 try:
                     self._batch_local_pass = True
                     try:
@@ -258,8 +392,16 @@ class Metric(ABC):
                     # restore accumulated state even when the batch-local
                     # pass raises (e.g. empty_target_action='error'): a
                     # rejected step value must not cost the epoch state or
-                    # leave _to_sync stuck False
+                    # leave _to_sync stuck False. Residuals a step sync just
+                    # committed survive the restore (same contract as the
+                    # compute() wrapper): with no sync they still hold the
+                    # cache values seeded above, so this is exact either way
+                    post_sync_residuals = {
+                        r: getattr(self, r) for r in self._sync_residual_names()
+                    }
                     self._restore_state(cache)
+                    for r, v in post_sync_residuals.items():
+                        setattr(self, r, v)
                     self._to_sync = True
                     self._computed = None
 
@@ -277,6 +419,10 @@ class Metric(ABC):
         with _obs.metric_scope(self, "forward"), shared_canonicalization():
             accumulated = self._snapshot_state()
             self.reset()
+            # sync-stream seeding, as on the classic path: a step sync must
+            # compensate the previous sync's error, not restart from zero
+            for res_name in self._sync_residual_names():
+                setattr(self, res_name, accumulated[res_name])
             try:
                 self.update(*args, **kwargs)  # the ONLY update: batch stats
             except BaseException:
@@ -336,7 +482,16 @@ class Metric(ABC):
         """Fold the current (batch-only) states into ``accumulated`` in
         place of sequential accumulation, combining each state by its
         registered reduction (see :meth:`_merge_state_value`)."""
+        residual_names = set(self._sync_residual_names())
         for name, reduction in self._reductions.items():
+            if name in residual_names:
+                # sync-stream state, not accumulation: the current value —
+                # the residual a dist_sync_on_step sync just committed, or
+                # the seeded persistent value when no sync ran — already IS
+                # the truth. Summing the prior on top would re-apply error
+                # the compensation has already consumed, inflating the next
+                # sync's correction past the per-sync bound.
+                continue
             batch = getattr(self, name)
             if not isinstance(batch, list) and not self._merge_reduction_supported(reduction):
                 raise TypeError(
@@ -365,7 +520,30 @@ class Metric(ABC):
             )
 
     def _sync_dist_impl(self, dist_sync_fn: Callable = gather_all_tensors) -> None:
-        input_dict = {attr: getattr(self, attr) for attr in self._reductions}
+        precisions = getattr(self, "_sync_precisions", {})
+        residual_names = set(self._sync_residual_names())
+        # residual companions never cross the wire: they are LOCAL
+        # compensation state (each rank's own quantization error), and
+        # syncing them would both waste the bytes the tier exists to save
+        # and corrupt the feedback loop
+        input_dict = {
+            attr: getattr(self, attr)
+            for attr in self._reductions
+            if attr not in residual_names
+        }
+        # quantize ONCE, before any gather attempt: a retried gather
+        # re-sends the identical payload, so error feedback cannot
+        # double-apply under SyncPolicy retries; residuals commit only
+        # after the collective actually succeeds (never on the degraded
+        # local-only path, where nothing quantized crossed the wire)
+        wire_dict: Dict[str, Any] = dict(input_dict)
+        new_residuals: Dict[str, Array] = {}
+        for name, precision in precisions.items():
+            payload, new_res = _quant.compensate_and_quantize(
+                input_dict[name], getattr(self, name + _SYNC_RESIDUAL_SUFFIX), precision
+            )
+            wire_dict[name] = payload
+            new_residuals[name] = new_res
         if _obs.enabled():
             tel = _obs.get()
             payload = sum(
@@ -373,10 +551,26 @@ class Metric(ABC):
                 for state in input_dict.values()
                 for v in (state if isinstance(state, list) else [state])
             )
+            # wire bytes: what actually crosses the wire per rank — the
+            # quantized payloads for tiered states, the raw arrays else.
+            # The payload/wire gap is the tier's measured compression.
+            wire = sum(
+                _obs.array_nbytes(v)
+                for state in wire_dict.values()
+                for v in jax.tree_util.tree_leaves(state)
+            )
             tel.count("sync.calls")
             tel.count("sync.payload_bytes", payload)
+            tel.count("sync.wire_bytes", wire)
             tel.observe_hist("sync.payload_bytes", payload, _obs.PAYLOAD_BUCKETS_BYTES)
-            tel.event("sync", metric=type(self).__name__, payload_bytes=payload)
+            tel.observe_hist("sync.wire_bytes", wire, _obs.PAYLOAD_BUCKETS_BYTES)
+            tel.event(
+                "sync",
+                metric=type(self).__name__,
+                payload_bytes=payload,
+                wire_bytes=wire,
+                quantized_states=len(precisions),
+            )
         # reliability hook: an installed SyncPolicy adds timeout + bounded
         # retry around every gather; a plain passthrough (one global read)
         # when no policy is installed. Degradation is handled HERE, not per
@@ -385,9 +579,10 @@ class Metric(ABC):
         # metric (globally-summed `total` with local `correct`), which is
         # silently wrong rather than degraded.
         guarded_sync_fn = _rsync.apply_sync_policy(dist_sync_fn)
+        degraded = False
         try:
             output_dict = apply_to_collection(
-                input_dict,
+                wire_dict,
                 (Array, jnp.ndarray),
                 guarded_sync_fn,
                 group=self.process_group,
@@ -396,14 +591,38 @@ class Metric(ABC):
             local_only = _rsync.degraded_local_fallback(err)
             if local_only is None:
                 raise
+            # degraded local-only sync keeps the EXACT local states for
+            # quantized tiers too: no bytes crossed the wire, so there is
+            # no reason to pay the quantization error locally — and the
+            # residuals stay untouched (committing them would compensate
+            # for a transfer that never happened)
             output_dict = apply_to_collection(
                 input_dict,
                 (Array, jnp.ndarray),
                 local_only,
                 group=self.process_group,
             )
+            degraded = True
 
         for attr, reduction_fn in self._reductions.items():
+            if attr in residual_names:
+                continue
+            if not degraded and attr in precisions:
+                # gathered payload dicts: {"q": [rank0, ...], "scales": [...]};
+                # dequantize each rank's contribution and sum in f32 —
+                # gather-then-locally-reduce, same contract as the exact path
+                # (the one shared merge the MTA004 probe also exercises)
+                gathered = output_dict[attr]
+                local = input_dict[attr]
+                setattr(self, attr, _quant.merge_dequantized(
+                    [
+                        {k: v[r] for k, v in gathered.items()}
+                        for r in range(len(gathered["q"]))
+                    ],
+                    jnp.shape(local),
+                    local.dtype,
+                ))
+                continue
             # array states stack to (world, ...); list states flatten in rank order
             if len(output_dict[attr]) and isinstance(output_dict[attr][0], (Array, jnp.ndarray)):
                 output_dict[attr] = jnp.stack(list(output_dict[attr]))
@@ -413,6 +632,9 @@ class Metric(ABC):
             assert callable(reduction_fn) or reduction_fn is None
             reduced = reduction_fn(output_dict[attr]) if reduction_fn is not None else output_dict[attr]
             setattr(self, attr, reduced)
+        if not degraded:
+            for name, res in new_residuals.items():
+                setattr(self, name + _SYNC_RESIDUAL_SUFFIX, res)
 
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
@@ -462,7 +684,17 @@ class Metric(ABC):
             self._computed = compute(*args, **kwargs)
             self._computed_batch_local = self._batch_local_compute
             if synced:
+                # restore un-synced accumulation, but KEEP the error-feedback
+                # residuals the sync just committed: they describe the error
+                # of the quantization that actually crossed the wire, and
+                # the NEXT sync must compensate for exactly that (reverting
+                # them with the state would freeze the feedback loop)
+                post_sync_residuals = {
+                    r: getattr(self, r) for r in self._sync_residual_names()
+                }
                 self._restore_state(cache)
+                for r, v in post_sync_residuals.items():
+                    setattr(self, r, v)
 
             return self._computed
 
@@ -547,7 +779,13 @@ class Metric(ABC):
                 return v.astype(dtype)
             return v
 
+        residual_names = set(self._sync_residual_names())
         for key in self._defaults:
+            if key in residual_names:
+                # error-feedback residuals are f32 by contract: they hold
+                # sub-quantization-step corrections a narrower dtype would
+                # round away, defeating the compensation they exist for
+                continue
             val = getattr(self, key)
             setattr(self, key, [_cast(v) for v in val] if isinstance(val, list) else _cast(val))
             default = self._defaults[key]

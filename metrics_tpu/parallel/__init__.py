@@ -6,7 +6,22 @@ from metrics_tpu.parallel.backend import (  # noqa: F401
     is_distributed_initialized,
     set_sync_backend,
 )
-from metrics_tpu.parallel.collective import masked_cat_sync, sync_array, sync_state  # noqa: F401
+from metrics_tpu.parallel.collective import (  # noqa: F401
+    masked_cat_sync,
+    qsync_state,
+    qsync_sum,
+    sync_array,
+    sync_state,
+)
+from metrics_tpu.parallel.quantize import (  # noqa: F401
+    DEFAULT_BLOCK_SIZE,
+    PRECISIONS,
+    dequantize_block_scaled,
+    dequantize_payload,
+    quantize_block_scaled,
+    quantize_payload,
+    quantized_sum_reduction,
+)
 from metrics_tpu.parallel.sample_sort import (  # noqa: F401
     host_sample_sort_auroc_ap,
     sample_sort_auroc_ap,

@@ -22,17 +22,35 @@ Use inside ``shard_map``/``pmap`` with the mesh axis name, e.g.::
         state = accuracy_update(state, preds, target)
         return sync_state(state, {"correct": "sum", "total": "sum"}, axis_name="data")
 """
-from typing import Any, Dict, Optional, Union
+from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.parallel import quantize as _q
 
 Reduction = Union[str, None]
 
 _VALID = ("sum", "mean", "min", "max", "cat", None)
+
+
+def _count_collective(op: str, logical_bytes: int, wire_bytes: int, n_ops: int = 1) -> None:
+    """Telemetry for one collective: ``collective.payload_bytes`` counts the
+    LOGICAL state bytes (what the metric semantically syncs, dtype as
+    registered) and ``collective.wire_bytes`` the ACTUAL transfer bytes
+    (post-quantization dtype). For exact-path ops the two are equal; the gap
+    between the two counters/histograms is the compression the quantized
+    tier delivers. Fires at trace time under shard_map/jit (the usual
+    deployment), so steady-state counts stay flat."""
+    tel = _obs.get()
+    tel.count(f"collective.{op}")
+    tel.count("collective.ops", n_ops)
+    tel.count("collective.payload_bytes", logical_bytes)
+    tel.count("collective.wire_bytes", wire_bytes)
+    tel.observe_hist("collective.payload_bytes", logical_bytes, _obs.PAYLOAD_BUCKETS_BYTES)
+    tel.observe_hist("collective.wire_bytes", wire_bytes, _obs.PAYLOAD_BUCKETS_BYTES)
 
 
 def sync_array(x: jax.Array, reduction: Reduction, axis_name: str) -> jax.Array:
@@ -46,16 +64,12 @@ def sync_array(x: jax.Array, reduction: Reduction, axis_name: str) -> jax.Array:
     a retrace signal.
     """
     if _obs.enabled():
-        tel = _obs.get()
+        # exact path: wire bytes == logical bytes (the histogram pair shows
+        # whether the bytes are one big gather or many small psums; the
+        # wire/logical gap only opens on the quantized tier, qsync_sum)
         payload = _obs.array_nbytes(x)
-        tel.count(f"collective.{reduction if reduction is not None else 'gather'}")
-        tel.count("collective.ops")
-        tel.count("collective.payload_bytes", payload)
-        # per-collective payload distribution (fixed buckets, mergeable
-        # across hosts/rounds) — the counter above totals, the histogram
-        # shows whether the bytes are one big gather or many small psums
-        tel.observe_hist(
-            "collective.payload_bytes", payload, _obs.PAYLOAD_BUCKETS_BYTES
+        _count_collective(
+            reduction if reduction is not None else "gather", payload, payload
         )
     if reduction == "sum":
         return lax.psum(x, axis_name)
@@ -99,14 +113,8 @@ def masked_cat_sync(buffer: jax.Array, count: jax.Array, axis_name: str):
     the gathered buffer.
     """
     if _obs.enabled():
-        tel = _obs.get()
         payload = _obs.array_nbytes(buffer) + _obs.array_nbytes(count)
-        tel.count("collective.cat")
-        tel.count("collective.ops", 2)
-        tel.count("collective.payload_bytes", payload)
-        tel.observe_hist(
-            "collective.payload_bytes", payload, _obs.PAYLOAD_BUCKETS_BYTES
-        )
+        _count_collective("cat", payload, payload, n_ops=2)
     gathered = lax.all_gather(buffer, axis_name, tiled=True)
     counts = lax.all_gather(count, axis_name)
     capacity = buffer.shape[0]
@@ -118,3 +126,92 @@ def masked_cat_sync(buffer: jax.Array, count: jax.Array, axis_name: str):
     # which raises loudly on overflow before it can happen)
     mask = pos_in_dev < jnp.minimum(counts[dev], capacity)
     return gathered, counts, mask
+
+
+def qsync_sum(
+    x: jax.Array,
+    precision: str,
+    axis_name: str,
+    residual: Optional[jax.Array] = None,
+    block_size: int = _q.DEFAULT_BLOCK_SIZE,
+):
+    """Quantized cross-device sum of ``x``: block-scaled quantize →
+    all-gather the low-precision payload → dequantize and sum in f32.
+
+    The wire carries only the quantized representation (int8 codes + f32
+    block scales, or a bf16 cast) — a ~3.9× (int8) / 2× (bf16) reduction
+    against the f32 psum for the heavy sum-reduced families (binned
+    histograms, confusion matrices, curve cumulants). Accumulation happens
+    in f32 AFTER dequantization, preserving the library's
+    gather-then-locally-reduce contract: every device computes the
+    identical sum of the identical per-device contributions, so the result
+    is commutative and replica-layout-independent (the property MTA004
+    probes).
+
+    With ``residual`` (a persistent f32 accumulator shaped like ``x``),
+    EQuARX-style error feedback is applied: the previous sync's
+    quantization error is folded into this sync's contribution and the new
+    error returned — call signature becomes
+    ``(synced, new_residual) = qsync_sum(x, precision, axis, residual)``.
+    Without it, only the synced sum is returned.
+
+    ``precision="exact"`` degenerates to :func:`sync_array`'s psum
+    (bit-identical to the pre-quantization path); the residual, if given,
+    passes through unchanged.
+    """
+    if precision == "exact":
+        out = sync_array(x, "sum", axis_name)
+        return out if residual is None else (out, residual)
+    payload, new_residual = _q.compensate_and_quantize(x, residual, precision, block_size)
+    if _obs.enabled():
+        _count_collective(
+            f"qsum_{precision}",
+            _obs.array_nbytes(x),
+            _q.payload_wire_nbytes(payload),
+            n_ops=len(payload),
+        )
+    gathered = {k: lax.all_gather(v, axis_name) for k, v in payload.items()}
+    world = gathered["q"].shape[0]
+    out = _q.merge_dequantized(
+        [{k: v[r] for k, v in gathered.items()} for r in range(world)],
+        x.shape,
+        x.dtype,
+    )
+    return out if residual is None else (out, new_residual)
+
+
+def qsync_state(
+    state: Dict[str, Any],
+    reductions: Dict[str, Reduction],
+    precisions: Dict[str, str],
+    axis_name: str,
+    residuals: Optional[Dict[str, jax.Array]] = None,
+) -> Tuple[Dict[str, Any], Dict[str, jax.Array]]:
+    """:func:`sync_state` with a per-state precision map: states named in
+    ``precisions`` with a non-``"exact"`` tier sync through
+    :func:`qsync_sum` (their reduction must be ``"sum"``), everything else
+    through the exact path. Returns ``(synced_state, new_residuals)``;
+    pass the returned residuals back in on the next sync to keep the
+    error-feedback loop closed."""
+    residuals = residuals or {}
+    out: Dict[str, Any] = {}
+    new_residuals: Dict[str, jax.Array] = {}
+    for name, val in state.items():
+        red = reductions.get(name, "sum")
+        precision = precisions.get(name, "exact")
+        if precision != "exact":
+            if red != "sum":
+                raise ValueError(
+                    f"state {name!r}: sync_precision={precision!r} requires a"
+                    f" 'sum' reduction, got {red!r}"
+                )
+            synced, new_res = qsync_sum(val, precision, axis_name, residual=residuals.get(
+                name, jnp.zeros(jnp.shape(val), jnp.float32)
+            ))
+            out[name] = synced
+            new_residuals[name] = new_res
+        else:
+            out[name] = jax.tree_util.tree_map(
+                lambda v, _red=red: sync_array(v, _red, axis_name), val
+            )
+    return out, new_residuals

@@ -55,7 +55,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from metrics_tpu.ops.auroc_kernel import _descending_key, _use_host_sort
-from metrics_tpu.utilities.jit import tpu_jit
+from metrics_tpu.utilities.jit import tpu_jit, tpu_shard_map
 
 _PAD_KEY = np.uint32(0xFFFFFFFF)
 _R = 64  # key samples per device; balance error ~ N/R per bucket
@@ -228,7 +228,7 @@ def _program_a(mesh: Mesh, axis: str, weighted: bool = False):
 
     extra = (P(axis),) if weighted else ()
     return tpu_jit(
-        jax.shard_map(
+        tpu_shard_map(
             _local,
             mesh=mesh,
             in_specs=(P(axis), P(axis), *extra, P(axis), P()),
@@ -327,7 +327,7 @@ def _program_b(mesh: Mesh, axis: str, slot: int, weighted: bool = False):
 
     extra = (P(axis),) if weighted else ()
     return tpu_jit(
-        jax.shard_map(
+        tpu_shard_map(
             _local,
             mesh=mesh,
             in_specs=(P(axis), P(axis), *extra, P(axis), P()),
@@ -643,7 +643,7 @@ def _retrieval_program_a(mesh: Mesh, axis: str, exclude: int):
         return qkey_s, preds_s, pay_s, gpos_s, splitters, counts_all
 
     return tpu_jit(
-        jax.shard_map(
+        tpu_shard_map(
             _local,
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis)),
@@ -741,7 +741,7 @@ def _retrieval_program_b(mesh: Mesh, axis: str, slot: int, scorer, scorer_static
         return mean, any_empty
 
     prog = tpu_jit(
-        jax.shard_map(
+        tpu_shard_map(
             _local,
             mesh=mesh,
             in_specs=(P(axis), P(axis), P(axis), P(axis), P()),

@@ -20,7 +20,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from metrics_tpu.parallel.collective import masked_cat_sync
-from metrics_tpu.utilities.jit import tpu_jit
+from metrics_tpu.utilities.jit import tpu_jit, tpu_shard_map
 
 
 def _default_mesh(axis_name: str) -> Mesh:
@@ -47,7 +47,7 @@ def _programs(mesh: Mesh, axis: str, n_streams: int = 2):
 
     spec_streams = (P(axis),) * n_streams
     jit_update = tpu_jit(
-        jax.shard_map(
+        tpu_shard_map(
             _local_update,
             mesh=mesh,
             in_specs=(spec_streams, P(axis), spec_streams),
@@ -81,7 +81,7 @@ def _programs(mesh: Mesh, axis: str, n_streams: int = 2):
         return tuple(outs), mask
 
     jit_gather = tpu_jit(
-        jax.shard_map(
+        tpu_shard_map(
             _gather,
             mesh=mesh,
             in_specs=(spec_streams, P(axis)),

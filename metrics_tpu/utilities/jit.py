@@ -43,6 +43,24 @@ def tpu_jit(fun: Optional[Callable] = None, **jit_kwargs: Any):
     return jax.jit(fun, **jit_kwargs)
 
 
+def tpu_shard_map(fun: Callable, *, mesh: Any, in_specs: Any, out_specs: Any, **kwargs: Any):
+    """``jax.shard_map`` across the jax versions this repo meets.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=)`` at the top level;
+    0.4.x only has ``jax.experimental.shard_map.shard_map(..., check_rep=)``.
+    Same choke-point rationale as :func:`tpu_jit`: SPMD-program policy has
+    ONE home, and call sites never need to know which spelling the runtime
+    ships."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(fun, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy_shard_map
+
+    if "check_vma" in kwargs:  # renamed from check_rep when shard_map graduated
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return legacy_shard_map(fun, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
 def enable_persistent_cache(path: Optional[str] = None) -> None:
     """Enable JAX's on-disk compilation cache (idempotent)."""
     global _ENABLED

@@ -17,6 +17,7 @@ EXPECTED = [
     (fx.DonatedAlias, "MTA003"),
     (fx.NonCommutativeMerge, "MTA004"),
     (fx.MeanWithoutCount, "MTA004"),
+    (fx.UnscaledInt8Psum, "MTA004"),
 ]
 
 
@@ -142,3 +143,55 @@ def test_sharded_mixin_suppression_is_instance_scoped():
 
     bad = audit_metric(BadSharded())
     assert [(f.rule, f.subject) for f in bad.findings] == [("MTA004", "BadSharded.weird")]
+
+def test_unscaled_int8_psum_flags_magnitude_not_commutativity():
+    """The quantized flavor of MTA004: a bare int8 cast IS commutative (the
+    classic probe alone would pass it) — it must flag on the magnitude-
+    preservation contract instead."""
+    result = audit_metric(fx.UnscaledInt8Psum(), _X)
+    assert len(result.findings) == 1
+    msg = result.findings[0].message
+    assert "magnitude-preserving" in msg
+    assert "order-dependent" not in msg
+
+
+def test_block_scaled_quantized_sync_audits_clean():
+    """POSITIVE control: a state on the library's int8 sync tier — block
+    scales + error-feedback residual companion — produces zero findings:
+    the commutativity probe runs on the DEQUANTIZED result with the tier's
+    tolerance, and the `__qres` residual is exempt from every reduction
+    rule (it is local-only compensation state, never synced)."""
+    m = fx.BlockScaledQuantizedSync()
+    assert m.sync_precisions() == {"hist": "int8"}
+    assert "hist__qres" in m._defaults  # the companion really registered
+    result = audit_metric(m, _X)
+    assert result.findings == [] and result.suppressed == []
+
+
+def test_residual_companion_does_not_satisfy_mean_without_count():
+    """A quantized state's residual must not double as the 'paired count'
+    that legitimizes a mean state, and must itself produce no findings: the
+    unpaired mean still flags, exactly once, on the mean state."""
+    import jax
+
+    from metrics_tpu.metric import Metric
+
+    class MeanPlusQuantized(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("avg", default=jnp.zeros(()), dist_reduce_fx="mean")
+            self.add_state(
+                "hist", default=jnp.zeros((8,)), dist_reduce_fx="sum", sync_precision="int8"
+            )
+
+        def update(self, x: jax.Array) -> None:
+            self.avg = (self.avg + jnp.mean(x)) / 2.0
+            self.hist = self.hist + x
+
+        def compute(self) -> jax.Array:
+            return self.avg
+
+    result = audit_metric(MeanPlusQuantized(), _X)
+    mean_findings = [f for f in result.findings if "mean" in f.message.lower()]
+    assert len(mean_findings) == 1 and mean_findings[0].subject.endswith(".avg")
+    assert not any(f.subject.endswith("__qres") for f in result.findings)

@@ -1,0 +1,290 @@
+"""The `sync_precision=` knob: registration rules, residual companion
+lifecycle, host-path sync correctness on a 2-rank virtual DDP group,
+bit-identical exact default, and the compiled engine's precision-keyed
+signature cache.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Accuracy, ConfusionMatrix, MetricCollection, ROC
+from metrics_tpu.metric import Metric
+from metrics_tpu.utilities.data import dim_zero_sum
+from metrics_tpu.utilities.distributed import gather_all_tensors
+from tests.helpers.testers import run_virtual_ddp
+
+_RNG = np.random.RandomState(7)
+
+
+class Hist(Metric):
+    """Minimal heavy-state family stand-in: one sum-reduced histogram."""
+
+    def __init__(self, precision="exact", bins=512):
+        super().__init__()
+        self.add_state(
+            "hist", default=jnp.zeros((bins,)), dist_reduce_fx="sum", sync_precision=precision
+        )
+
+    def update(self, x):
+        self.hist = self.hist + x
+
+    def compute(self):
+        return self.hist
+
+
+# ----------------------------------------------------------------------
+# registration / eligibility
+# ----------------------------------------------------------------------
+def test_add_state_rejects_unknown_precision():
+    m = Hist()
+    with pytest.raises(ValueError, match="sync_precision"):
+        m.add_state("bad", default=jnp.zeros((4,)), dist_reduce_fx="sum", sync_precision="fp4")
+
+
+def test_add_state_rejects_list_and_non_sum_states():
+    m = Hist()
+    with pytest.raises(ValueError, match="always sync exact"):
+        m.add_state("cat", default=[], dist_reduce_fx="cat", sync_precision="int8")
+    with pytest.raises(ValueError, match="always sync exact"):
+        m.add_state("mx", default=jnp.zeros(()), dist_reduce_fx="max", sync_precision="bf16")
+
+
+def test_residual_companion_registered_and_reset():
+    m = Hist("int8")
+    assert m.sync_precisions() == {"hist": "int8"}
+    assert m._reductions["hist__qres"] is dim_zero_sum
+    m.update(jnp.ones((512,)))
+    m.hist__qres = jnp.full((512,), 0.5)
+    m.reset()
+    assert float(jnp.abs(m.hist).max()) == 0.0
+    assert float(jnp.abs(m.hist__qres).max()) == 0.0  # resets with its state
+
+
+def test_astype_keeps_residual_f32():
+    m = Hist("int8", bins=16).bfloat16()
+    assert m.hist.dtype == jnp.bfloat16
+    assert m.hist__qres.dtype == jnp.float32  # sub-step corrections need f32
+
+
+def test_set_sync_precision_defaults_to_eligible_states_only():
+    roc = ROC()  # list states only: nothing eligible
+    assert roc.set_sync_precision("int8") == {}
+    cm = ConfusionMatrix(num_classes=4)
+    applied = cm.set_sync_precision("int8")
+    assert applied and all(p == "int8" for p in applied.values())
+
+
+def test_set_sync_precision_explicit_ineligible_state_raises():
+    roc = ROC()
+    with pytest.raises((KeyError, ValueError)):
+        roc.set_sync_precision("int8", states=["preds"])
+    m = Hist("int8")
+    with pytest.raises(KeyError):
+        m.set_sync_precision("bf16", states=["hist__qres"])  # residuals are not addressable
+
+
+def test_revert_to_exact_deregisters_residual():
+    m = Hist("int8")
+    assert "hist__qres" in m._defaults
+    m.set_sync_precision("exact")
+    assert m.sync_precisions() == {}
+    assert "hist__qres" not in m._defaults and not hasattr(m, "hist__qres")
+    # and back again: tier flips are not one-way
+    m.set_sync_precision("bf16")
+    assert m.sync_precisions() == {"hist": "bf16"}
+
+
+def test_state_dict_roundtrip_carries_residual():
+    m = Hist("int8", bins=32)
+    m.update(jnp.asarray(_RNG.rand(32).astype(np.float32)))
+    m.hist__qres = jnp.full((32,), 0.25)
+    m.persistent(True)
+    saved = m.state_dict()
+    assert "hist__qres" in saved
+    m2 = Hist("int8", bins=32)
+    m2.persistent(True)
+    m2.load_state_dict(saved, strict=True)
+    np.testing.assert_array_equal(np.asarray(m2.hist__qres), np.asarray(m.hist__qres))
+
+
+# ----------------------------------------------------------------------
+# host sync path (2-rank virtual DDP)
+# ----------------------------------------------------------------------
+def _ddp_sync(precision, data, results):
+    def worker(rank, world):
+        m = Hist(precision, bins=data.shape[1])
+        m.dist_sync_fn = gather_all_tensors
+        m.update(jnp.asarray(data[rank]))
+        out = np.asarray(m.compute())
+        results[(precision, rank)] = (
+            out,
+            np.asarray(m.hist),
+            np.asarray(getattr(m, "hist__qres", np.zeros(1))),
+        )
+
+    run_virtual_ddp(2, worker)
+
+
+@pytest.mark.parametrize("precision", ["int8", "bf16"])
+def test_quantized_ddp_sync_close_to_exact_and_rank_agreeing(precision):
+    data = (_RNG.rand(2, 512) * 5).astype(np.float32)
+    exact = data[0] + data[1]
+    results = {}
+    _ddp_sync(precision, data, results)
+    out0, local0, res0 = results[(precision, 0)]
+    out1, _, _ = results[(precision, 1)]
+    np.testing.assert_array_equal(out0, out1)  # replica-layout independent
+    bound = 2 * np.abs(data).max() / (254.0 if precision == "int8" else 2.0**8)
+    assert np.abs(out0 - exact).max() <= bound + 1e-6
+    # accumulation itself stays unsynced and unquantized (cache/restore)...
+    np.testing.assert_array_equal(local0, data[0])
+    # ...but the committed residual survives the restore (it describes the
+    # error of the quantization that actually crossed the wire)
+    assert np.abs(res0).max() > 0
+
+
+def test_exact_default_is_bit_identical():
+    data = (_RNG.rand(2, 128) * 3).astype(np.float32)
+    results = {}
+    _ddp_sync("exact", data, results)
+    out0, _, res0 = results[("exact", 0)]
+    np.testing.assert_array_equal(out0, np.asarray(jnp.asarray(data[0]) + jnp.asarray(data[1])))
+    assert np.abs(res0).max() == 0.0  # no residual companion at all
+
+
+def test_repeated_syncs_do_not_drift():
+    """Error feedback across compute() calls: syncing the same growing
+    state many times keeps the reported error at the single-sync level
+    instead of accumulating a bias."""
+    data = (_RNG.rand(2, 256) * 4).astype(np.float32)
+    errs = {}
+
+    def worker(rank, world):
+        m = Hist("int8", bins=256)
+        m.dist_sync_fn = gather_all_tensors
+        batch = jnp.asarray(data[rank])
+        per_sync = []
+        for step in range(1, 9):
+            m.update(batch)
+            out = np.asarray(m.compute())
+            exact = (data[0] + data[1]) * step
+            per_sync.append(np.abs(out - exact).max())
+        errs[rank] = per_sync
+
+    run_virtual_ddp(2, worker)
+    single_sync_bound = 2 * np.abs(data).max() * 8 / 254.0 + 1e-6
+    assert max(errs[0]) <= 4 * single_sync_bound  # bounded, not linear in syncs
+
+
+# ----------------------------------------------------------------------
+# collection knob + compiled engine cache identity
+# ----------------------------------------------------------------------
+def test_collection_knob_applies_to_eligible_members_only():
+    col = MetricCollection({"cm": ConfusionMatrix(num_classes=3), "roc": ROC()},
+                          sync_precision="int8")
+    per_member = col.sync_precisions()
+    assert per_member["roc"] == {}  # curve/list states: exact by contract
+    assert per_member["cm"] and all(p == "int8" for p in per_member["cm"].values())
+
+
+def test_engine_cache_keys_on_precision_flip():
+    probs = jnp.asarray(_RNG.rand(64, 4).astype(np.float32))
+    target = jnp.asarray(_RNG.randint(4, size=64))
+    col = MetricCollection({"acc": Accuracy(), "cm": ConfusionMatrix(num_classes=4)},
+                          compiled=True)
+    col.forward(probs, target)
+    engine = col._engine
+    base_traces = engine.trace_count
+    col.forward(probs, target)
+    assert engine.trace_count == base_traces  # steady state: cache hit
+
+    col.set_sync_precision("int8")
+    col.forward(probs, target)
+    assert engine.trace_count == base_traces + 1  # tier flip: new program
+
+    col.set_sync_precision("exact")
+    col.forward(probs, target)
+    # back to the original signature: the first program is reused
+    assert engine.trace_count == base_traces + 1
+
+
+def test_compiled_results_identical_across_precision_flip_without_sync():
+    """Single-process forward never syncs, so the quantized tier must not
+    change a single bit of the compiled step's results."""
+    probs = jnp.asarray(_RNG.rand(64, 4).astype(np.float32))
+    target = jnp.asarray(_RNG.randint(4, size=64))
+    exact_col = MetricCollection({"cm": ConfusionMatrix(num_classes=4)}, compiled=True)
+    q_col = MetricCollection({"cm": ConfusionMatrix(num_classes=4)}, compiled=True,
+                             sync_precision="int8")
+    a = exact_col.forward(probs, target)
+    b = q_col.forward(probs, target)
+    np.testing.assert_array_equal(np.asarray(a["cm"]), np.asarray(b["cm"]))
+    np.testing.assert_array_equal(
+        np.asarray(exact_col["cm"].confmat), np.asarray(q_col["cm"].confmat)
+    )
+
+
+# ----------------------------------------------------------------------
+# dist_sync_on_step: the residual rides the SYNC stream, not accumulation
+# ----------------------------------------------------------------------
+from metrics_tpu.parallel import quantize as q  # noqa: E402
+
+
+class StepHist(Metric):
+    def __init__(self, fused=False):
+        super().__init__(dist_sync_on_step=True)
+        if fused:
+            self._fused_forward = True
+        self.add_state(
+            "hist", default=jnp.zeros((256,)), dist_reduce_fx="sum", sync_precision="int8"
+        )
+        self.dist_sync_fn = gather_all_tensors  # force the host sync path
+
+    def update(self, x):
+        self.hist = self.hist + x
+
+    def compute(self):
+        return self.hist
+
+
+@pytest.mark.parametrize("fused", [False, True], ids=["classic", "fused"])
+def test_step_sync_error_feedback_advances_across_forwards(fused):
+    """dist_sync_on_step: step N+1's sync must compensate step N's committed
+    quantization error — the residual is seeded into the batch-local pass,
+    survives the post-forward restore, and follows the exact
+    compensate-and-quantize recurrence of the wire codec."""
+    batch = jnp.asarray((_RNG.rand(256) * 5).astype(np.float32))
+    m = StepHist(fused=fused)
+    m(batch)
+    r1 = np.asarray(m.hist__qres)
+    assert np.abs(r1).max() > 0  # the first step sync committed its error
+    # the recurrence the second step must follow: quantize(batch + r1)
+    payload, want_r2 = q.compensate_and_quantize(batch, jnp.asarray(r1), "int8")
+    m(batch)
+    np.testing.assert_array_equal(np.asarray(m.hist__qres), np.asarray(want_r2))
+    # sanity of the loop: the residual stays bounded by one quantization
+    # step of the payload, it does NOT grow with the number of steps
+    for _ in range(6):
+        m(batch)
+    step = (float(jnp.abs(batch).max()) + np.abs(r1).max()) / 127.0
+    assert np.abs(np.asarray(m.hist__qres)).max() <= step + 1e-6
+    # and the accumulation itself is untouched by any of the 8 step syncs
+    np.testing.assert_allclose(
+        np.asarray(m.hist), np.asarray(batch) * 8, rtol=1e-6
+    )
+
+
+def test_fused_merge_does_not_sum_residuals_into_accumulation():
+    """The fused forward's (accumulated, batch) fold must KEEP the committed
+    residual, not add the prior on top: summing would re-apply error the
+    compensation already consumed."""
+    batch = jnp.asarray((_RNG.rand(256) * 3).astype(np.float32))
+    m = StepHist(fused=True)
+    m(batch)
+    r1 = np.asarray(m.hist__qres)
+    m(batch)
+    r2 = np.asarray(m.hist__qres)
+    _, want_r2 = q.compensate_and_quantize(batch, jnp.asarray(r1), "int8")
+    # r2 is the recurrence value alone — NOT r1 + r2 style inflation
+    np.testing.assert_array_equal(r2, np.asarray(want_r2))
+    assert not np.array_equal(r2, r1 + np.asarray(want_r2))

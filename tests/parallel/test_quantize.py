@@ -1,0 +1,264 @@
+"""Quantized sync tier numerics: block-scaled int8/bf16 codecs, the wire
+format, error-feedback residual compensation, and the in-program
+``qsync_sum``/``qsync_state`` collectives on the 8-virtual-device mesh.
+
+These run through ``tpu_shard_map`` (the version-portable choke point), so
+they exercise the REAL collective path on every jax this repo meets —
+unlike the bare ``jax.shard_map`` legacy tests.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+import metrics_tpu.observability as obs
+from metrics_tpu.parallel import quantize as q
+from metrics_tpu.parallel.collective import qsync_state, qsync_sum
+from metrics_tpu.utilities.jit import tpu_shard_map
+
+_RNG = np.random.RandomState(0xA11CE)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()), ("data",))
+
+
+# ----------------------------------------------------------------------
+# codec numerics
+# ----------------------------------------------------------------------
+def test_int8_roundtrip_error_within_half_step_per_block():
+    x = jnp.asarray(_RNG.randn(1000).astype(np.float32) * 10)
+    codes, scales = q.quantize_block_scaled(x)
+    back = q.dequantize_block_scaled(codes, scales, x.shape)
+    assert codes.dtype == jnp.int8 and scales.dtype == jnp.float32
+    assert back.shape == x.shape
+    # per element: |err| <= absmax_of_its_block / 254 (half a quantization step)
+    blocks = np.pad(np.asarray(x), (0, 24)).reshape(-1, q.DEFAULT_BLOCK_SIZE)
+    bound = np.repeat(np.abs(blocks).max(axis=1) / 254.0, q.DEFAULT_BLOCK_SIZE)[:1000]
+    assert np.all(np.abs(np.asarray(back - x)) <= bound + 1e-7)
+
+
+def test_outlier_cost_is_confined_to_its_block():
+    x = np.ones(4 * q.DEFAULT_BLOCK_SIZE, np.float32)
+    x[0] = 1e4  # one huge outlier in block 0
+    back = np.asarray(
+        q.dequantize_block_scaled(*q.quantize_block_scaled(jnp.asarray(x)), x.shape)
+    )
+    # blocks 1..3 keep full small-value resolution despite block 0's scale
+    assert np.abs(back[q.DEFAULT_BLOCK_SIZE:] - 1.0).max() <= 1.0 / 254.0 + 1e-7
+
+
+def test_all_zero_block_roundtrips_exactly():
+    x = jnp.zeros((300,), jnp.float32)
+    codes, scales = q.quantize_block_scaled(x)
+    assert np.all(np.asarray(scales) == 1.0)  # no 0/0
+    assert np.array_equal(np.asarray(q.dequantize_block_scaled(codes, scales, x.shape)), np.zeros(300))
+
+
+def test_padding_dropped_on_dequantize():
+    x = jnp.asarray(_RNG.rand(7, 13).astype(np.float32))  # 91 elems, 1 padded block
+    payload = q.quantize_payload(x, "int8")
+    assert q.dequantize_payload(payload, x.shape).shape == (7, 13)
+
+
+def test_wire_bytes_int8_hits_compression_floor():
+    # the 512-bin histogram state (the binned family's sync payload):
+    # f32 2048B -> 512 int8 codes + 4 f32 block scales = 528B, 3.88x
+    x = jnp.asarray(_RNG.rand(512).astype(np.float32))
+    wire = q.payload_wire_nbytes(q.quantize_payload(x, "int8"))
+    assert wire == 512 + 4 * 4
+    assert x.nbytes / wire >= 3.0  # the acceptance floor, with margin
+
+
+def test_wire_bytes_bf16_is_half():
+    x = jnp.asarray(_RNG.rand(512).astype(np.float32))
+    assert q.payload_wire_nbytes(q.quantize_payload(x, "bf16")) == x.nbytes // 2
+
+
+def test_invalid_precision_rejected():
+    x = jnp.ones((8,))
+    with pytest.raises(ValueError, match="sync_precision"):
+        q.quantize_payload(x, "fp8")
+    with pytest.raises(ValueError, match="exact"):
+        q.quantize_payload(x, "exact")
+    with pytest.raises(ValueError, match="exact"):
+        q.quantized_sum_reduction("exact")
+
+
+def test_error_feedback_cancels_drift_over_repeated_syncs():
+    """EQuARX-style residual compensation: syncing the SAME state many
+    times, the time-averaged signed error of the reported values tends to
+    zero, while naive (residual-free) quantization repeats the identical
+    biased error every round."""
+    x = jnp.asarray(_RNG.rand(640).astype(np.float32) * 3)
+    naive_bias = np.asarray(q.dequantize_payload(q.quantize_payload(x, "int8"), x.shape) - x)
+    res = jnp.zeros_like(x)
+    reported = []
+    for _ in range(32):
+        payload, res = q.compensate_and_quantize(x, res, "int8")
+        reported.append(np.asarray(q.dequantize_payload(payload, x.shape)))
+    ef_bias = np.mean([r - np.asarray(x) for r in reported], axis=0)
+    assert np.abs(ef_bias).max() < np.abs(naive_bias).max() / 4
+    # and the residual itself stays bounded by one quantization step
+    assert np.abs(np.asarray(res)).max() <= float(jnp.abs(x).max()) / 127.0 + 1e-6
+
+
+def test_compensate_without_residual_returns_fresh_error():
+    x = jnp.asarray(_RNG.rand(64).astype(np.float32))
+    payload, new_res = q.compensate_and_quantize(x, None, "int8")
+    back = q.dequantize_payload(payload, x.shape)
+    np.testing.assert_allclose(np.asarray(new_res), np.asarray(x - back), atol=1e-7)
+
+
+@pytest.mark.parametrize("precision", ["int8", "bf16"])
+def test_quantized_sum_reduction_is_commutative_and_magnitude_preserving(precision):
+    red = q.quantized_sum_reduction(precision)
+    assert red.quantized_precision == precision and red.block_scaled
+    a = jnp.asarray(_RNG.rand(2, 200).astype(np.float32) * 5)
+    fwd, rev = np.asarray(red(a)), np.asarray(red(a[::-1]))
+    np.testing.assert_array_equal(fwd, rev)  # per-row quantization: bitwise
+    bound = 2 * float(jnp.abs(a).max()) / (254.0 if precision == "int8" else 2.0**8)
+    assert np.abs(fwd - np.asarray(a[0] + a[1])).max() <= bound + 1e-6
+
+
+# ----------------------------------------------------------------------
+# the in-program collective on the virtual mesh
+# ----------------------------------------------------------------------
+def _qsync_program(mesh, precision, with_residual=False):
+    def step(v):
+        local = jnp.sum(v, axis=0)
+        if with_residual:
+            return qsync_sum(local, precision, "data", residual=jnp.zeros_like(local))
+        return qsync_sum(local, precision, "data")
+
+    return jax.jit(
+        tpu_shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
+    )
+
+
+@pytest.mark.parametrize("precision", ["int8", "bf16"])
+def test_qsync_sum_approximates_psum_on_mesh(precision):
+    mesh = _mesh()
+    n_dev = len(jax.devices())
+    x = jnp.asarray(_RNG.rand(n_dev * 64, 512).astype(np.float32))
+    out = np.asarray(_qsync_program(mesh, precision)(x))
+    exact = np.asarray(x).sum(axis=0)
+    # per-device contribution error <= absmax/254 (int8) or a bf16 round,
+    # summed over n_dev devices
+    per_dev = np.abs(np.asarray(x)).sum(axis=0).max() / (254.0 if precision == "int8" else 2.0**8)
+    assert np.abs(out - exact).max() <= n_dev * per_dev
+    # and it is NOT bit-identical to exact (the tier really quantized)
+    assert not np.array_equal(out, exact)
+
+
+def test_qsync_sum_exact_precision_is_bit_identical_psum():
+    mesh = _mesh()
+    n_dev = len(jax.devices())
+    x = jnp.asarray(_RNG.rand(n_dev * 8, 64).astype(np.float32))
+    from metrics_tpu.parallel.collective import sync_array
+
+    def exact_step(v):
+        return sync_array(jnp.sum(v, axis=0), "sum", "data")
+
+    ref = jax.jit(
+        tpu_shard_map(exact_step, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
+    )(x)
+    out = _qsync_program(mesh, "exact")(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+def test_qsync_sum_integer_state_stays_integral():
+    mesh = _mesh()
+    n_dev = len(jax.devices())
+    x = jnp.asarray(_RNG.randint(0, 50, size=(n_dev * 16, 128)).astype(np.int32))
+    out = np.asarray(_qsync_program(mesh, "int8")(x))
+    assert out.dtype == np.int32  # dequantize rounds back onto the lattice
+
+
+def test_qsync_sum_residual_threading_inside_program():
+    mesh = _mesh()
+    n_dev = len(jax.devices())
+    x = jnp.asarray(_RNG.rand(n_dev * 4, 256).astype(np.float32))
+    synced, new_res = _qsync_program(mesh, "int8", with_residual=True)(x)
+    assert synced.shape == (256,) and new_res.shape == (256,)
+    assert np.abs(np.asarray(new_res)).max() > 0  # a real error was recorded
+
+
+def test_qsync_state_routes_precisions_per_state():
+    mesh = _mesh()
+    n_dev = len(jax.devices())
+
+    def step(v):
+        local = {"hist": jnp.sum(v, axis=0), "count": jnp.sum(jnp.ones_like(v))}
+        synced, residuals = qsync_state(
+            local,
+            {"hist": "sum", "count": "sum"},
+            {"hist": "int8"},  # count stays exact
+            "data",
+        )
+        return synced["hist"], synced["count"], residuals["hist"]
+
+    prog = jax.jit(
+        tpu_shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
+    )
+    x = jnp.asarray(_RNG.rand(n_dev * 8, 128).astype(np.float32))
+    hist, count, res = prog(x)
+    assert float(count) == x.shape[0] * x.shape[1]  # exact path untouched
+    assert np.abs(np.asarray(hist) - np.asarray(x).sum(0)).max() < 0.5
+    assert res.shape == (128,)
+
+
+def test_qsync_state_rejects_non_sum_reduction_on_quantized_state():
+    with pytest.raises(ValueError, match="requires a 'sum' reduction"):
+        qsync_state(
+            {"v": jnp.ones((4,))}, {"v": "max"}, {"v": "int8"}, "data"
+        )
+
+
+# ----------------------------------------------------------------------
+# wire-byte vs logical-byte telemetry (the satellite's counter split)
+# ----------------------------------------------------------------------
+def test_wire_bytes_counted_separately_from_logical_bytes():
+    mesh = _mesh()
+    n_dev = len(jax.devices())
+    x = jnp.asarray(_RNG.rand(n_dev, 512).astype(np.float32))
+    obs.enable()
+    tel = obs.get()
+    tel.reset()
+    try:
+        np.asarray(_qsync_program(mesh, "int8")(x))
+        logical = tel.counters["collective.payload_bytes"]
+        wire = tel.counters["collective.wire_bytes"]
+        assert tel.counters["collective.qsum_int8"] >= 1
+        assert logical == 512 * 4  # the f32 state the metric semantically syncs
+        assert wire == 512 + 4 * 4  # int8 codes + f32 block scales
+        assert logical / wire >= 3.0  # the acceptance-floor evidence
+        assert "collective.wire_bytes" in tel.histograms
+    finally:
+        obs.disable()
+        tel.reset()
+
+
+def test_exact_path_wire_equals_logical_and_keeps_old_key():
+    mesh = _mesh()
+    from metrics_tpu.parallel.collective import sync_array
+
+    def step(v):
+        return sync_array(jnp.sum(v, axis=0), "sum", "data")
+
+    obs.enable()
+    tel = obs.get()
+    tel.reset()
+    try:
+        prog = jax.jit(
+            tpu_shard_map(step, mesh=mesh, in_specs=P("data"), out_specs=P(), check_vma=False)
+        )
+        np.asarray(prog(jnp.ones((len(jax.devices()), 256), jnp.float32)))
+        # the old key still reports the logical payload for exact ops...
+        assert tel.counters["collective.payload_bytes"] == 256 * 4
+        # ...and wire == logical: nothing was compressed
+        assert tel.counters["collective.wire_bytes"] == tel.counters["collective.payload_bytes"]
+    finally:
+        obs.disable()
+        tel.reset()

@@ -1,0 +1,203 @@
+"""Reliability composition of the quantized sync tier (`sync_precision=`):
+
+* a retried gather re-sends the IDENTICAL quantized payload and commits the
+  error-feedback residual exactly once — no double-apply under
+  ``SyncPolicy`` retries;
+* ``degraded_ok`` local-only fallback keeps the EXACT local state (nothing
+  crossed the wire, so nothing pays the quantization error) and leaves the
+  residual untouched;
+* residual companions checkpoint/resume bit-identically through
+  state_dict AND validated envelopes across every metric family.
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import Metric, reliability
+from metrics_tpu.reliability import SyncPolicy, faultinject as fi
+from metrics_tpu.utilities.distributed import gather_all_tensors
+
+from tests.reliability.test_roundtrips import CASES, _values_equal
+
+pytestmark = pytest.mark.chaos
+
+_RNG = np.random.RandomState(0xEF)
+
+
+class QHist(Metric):
+    def __init__(self, precision="int8", bins=256):
+        super().__init__()
+        self.add_state(
+            "hist", default=jnp.zeros((bins,)), dist_reduce_fx="sum", sync_precision=precision
+        )
+
+    def update(self, x):
+        self.hist = self.hist + x
+
+    def compute(self):
+        return self.hist
+
+
+def _filled(precision="int8", seed=3):
+    m = QHist(precision)
+    m.dist_sync_fn = gather_all_tensors  # force the host sync path
+    m.update(jnp.asarray(np.random.RandomState(seed).rand(256).astype(np.float32) * 5))
+    return m
+
+
+def test_retry_resends_identical_payload_and_commits_residual_once():
+    """fails=2 then success: the result and committed residual are
+    BIT-IDENTICAL to a clean quantized sync of the same state — the
+    payload was quantized once, before any attempt, so retries cannot
+    re-apply the compensation."""
+    clean = _filled()
+    want = np.asarray(clean.compute())
+    clean_res = np.asarray(clean.hist__qres)
+    assert np.abs(clean_res).max() > 0  # a real residual was committed
+
+    m = _filled()
+    with fi.flaky_sync_backend(fails=2):
+        with reliability.sync_policy_scope(max_retries=2, backoff_s=0.001) as pol:
+            got = np.asarray(m.compute())
+    assert pol.stats["retries"] == 2 and pol.stats["degraded"] == 0
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(np.asarray(m.hist__qres), clean_res)
+
+
+def test_exhausted_retries_raise_and_leave_residual_unchanged():
+    m = _filled()
+    with fi.flaky_sync_backend(fails=99):
+        with reliability.sync_policy_scope(max_retries=1, backoff_s=0.001):
+            with pytest.raises(reliability.SyncFailedError):
+                m.compute()
+    # nothing crossed the wire: the feedback loop must not have advanced
+    assert np.abs(np.asarray(m.hist__qres)).max() == 0.0
+
+
+def test_degraded_fallback_keeps_exact_local_state_and_residual():
+    """Dead backend + degraded_ok: the local-only result is the EXACT
+    (unquantized) local state — paying the quantization error for a
+    transfer that never happened would be strictly worse — and the
+    residual stays zero."""
+    m = _filled()
+    local = np.asarray(m.hist)
+    with obs.telemetry_scope(), fi.flaky_sync_backend(fails=10**6):
+        with reliability.sync_policy_scope(
+            max_retries=1, backoff_s=0.001, degraded_ok=True
+        ) as pol:
+            with warnings.catch_warnings(record=True):
+                warnings.simplefilter("always")
+                got = np.asarray(m.compute())
+    assert pol.stats["degraded"] == 1
+    np.testing.assert_array_equal(got, local)  # bit-identical local state
+    assert np.abs(np.asarray(m.hist__qres)).max() == 0.0
+
+
+def test_hung_sync_timeout_degrades_without_advancing_residual():
+    m = _filled()
+    local = np.asarray(m.hist)
+    with fi.flaky_sync_backend(fails=0, delay_s=30.0, slow_calls=4):
+        with reliability.sync_policy_scope(
+            max_retries=0, timeout_s=0.2, degraded_ok=True
+        ):
+            with warnings.catch_warnings(record=True):
+                warnings.simplefilter("always")
+                got = np.asarray(m.compute())
+    np.testing.assert_array_equal(got, local)
+    assert np.abs(np.asarray(m.hist__qres)).max() == 0.0
+
+
+def test_second_sync_succeeding_after_degradation_commits_residual():
+    """Recovery after a degraded round: the next healthy sync quantizes
+    fresh (zero residual) and the feedback loop starts advancing."""
+    m = _filled()
+    with fi.flaky_sync_backend(fails=10**6):
+        with reliability.sync_policy_scope(max_retries=0, backoff_s=0.001, degraded_ok=True):
+            with warnings.catch_warnings(record=True):
+                warnings.simplefilter("always")
+                m.compute()
+    m.update(jnp.zeros((256,)))  # invalidate the computed cache
+    got = np.asarray(m.compute())  # healthy backend again
+    want = np.asarray(_filled().compute())
+    np.testing.assert_array_equal(got, want)
+    assert np.abs(np.asarray(m.hist__qres)).max() > 0
+
+
+# ----------------------------------------------------------------------
+# checkpoint/resume of residual states across every family
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "name,factory,args", [(n, f, a) for n, f, a in CASES], ids=[c[0] for c in CASES]
+)
+def test_quantized_roundtrip_every_family(name, factory, args, tmp_path):
+    """`set_sync_precision("int8")` on every family (eligible states tier
+    up, list/cat states silently stay exact), then state_dict AND envelope
+    roundtrips restore states + residual companions bit-identically. The
+    sync that populated the residuals runs through the single-process
+    backend — the same quantize/dequantize/commit path a pod takes."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = factory()
+        applied = m.set_sync_precision("int8")
+        m.update(*args)
+        m.update(*args)
+        if applied:
+            # populate residuals through a real (world=1) quantized sync
+            m.dist_sync_fn = gather_all_tensors
+            m.compute()
+            assert any(
+                np.abs(np.asarray(getattr(m, r))).max() >= 0 for r in m._sync_residual_names()
+            )
+
+        m.persistent(True)
+        saved = m.state_dict()
+        env = reliability.save_envelope(m)  # both snapshots BEFORE the oracle
+        for res_name in m._sync_residual_names():
+            assert res_name in saved, f"{name}: residual {res_name} missing from state_dict"
+
+        # the oracle value: a fresh compute from exactly the saved state
+        # (drop the pre-residual cache; error feedback makes the next sync
+        # residual-dependent, which is the point of carrying the residual)
+        m._computed = None
+        want = m.compute()
+
+        m2 = factory()
+        m2.set_sync_precision("int8")
+        m2.persistent(True)
+        m2.load_state_dict(saved, strict=True)
+        for res_name in m._sync_residual_names():
+            np.testing.assert_array_equal(
+                np.asarray(getattr(m2, res_name)), np.asarray(saved[res_name]), err_msg=name
+            )
+        if applied:
+            m2.dist_sync_fn = gather_all_tensors
+        _values_equal(want, m2.compute(), name)
+
+        # validated envelope through a file: the session/checkpoint path
+        path = tmp_path / f"{name}.npz"
+        reliability.write_envelope(path, env)
+        m3 = factory()
+        m3.set_sync_precision("int8")
+        reliability.load_envelope(m3, reliability.read_envelope(path), strict=True)
+        for res_name in m._sync_residual_names():
+            np.testing.assert_array_equal(
+                np.asarray(getattr(m3, res_name)), np.asarray(saved[res_name]), err_msg=name
+            )
+        if applied:
+            m3.dist_sync_fn = gather_all_tensors
+        _values_equal(want, m3.compute(), name)
+
+
+def test_envelope_strict_load_flags_missing_residual():
+    """A pre-quantization checkpoint (no residual keys) strict-loaded into
+    a quantized metric must fail validation, not silently zero the
+    compensation state."""
+    m = QHist("exact")
+    m.update(jnp.ones((256,)))
+    env = reliability.save_envelope(m)
+    m2 = QHist("int8")
+    with pytest.raises(reliability.CheckpointError):
+        reliability.load_envelope(m2, env, strict=True)

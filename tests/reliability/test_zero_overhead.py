@@ -89,8 +89,11 @@ def test_unguarded_engine_programs_carry_no_guard_token():
     col = _collection(compiled=True)
     col(p, t)
     (signature,) = list(col._engine._compiled)
-    names, guard_token, _, _ = signature
+    names, precisions, guard_token, _, _ = signature
     assert guard_token is None
+    # default metrics sit on the exact tier: the precision slot of the
+    # program identity is empty for every member
+    assert all(p == () for _, p in precisions)
     assert col._engine.trace_count == 1
 
 
