@@ -1,14 +1,27 @@
-.PHONY: ci lint test test-tpu test-tpu-suite doctest bench bench-sync sentinel dryrun fuzz fuzz-sharded chaos clean
+.PHONY: ci lint san test test-tpu test-tpu-suite doctest bench bench-sync sentinel dryrun fuzz fuzz-sharded chaos clean
 
 ci:
 	# the full CI gate as one machine-runnable target (mirrors
 	# .github/workflows/ci.yml): lint -> suite (incl. doctests + api-surface
-	# guard) -> fuzz smoke -> multi-chip dryrun -> perf sentinel (advisory)
+	# guard) -> fuzz smoke -> multi-chip dryrun -> MetricSan (advisory) ->
+	# fingerprint drift (advisory) -> perf sentinel (advisory)
 	python -m compileall -q metrics_tpu tests scripts bench.py tpu_correctness.py __graft_entry__.py
-	# lint-only: the suite runs the full program audit in-process
-	# (tests/analysis/test_lint_clean.py); `make lint` runs both passes
+	# lint-only: the suite runs the full program audit (passes 1+3, incl.
+	# quantized variants) in-process (tests/analysis/test_lint_clean.py);
+	# `make lint` runs everything
 	python scripts/lint_metrics.py --strict --skip-audit
 	python -m pytest tests/ -q
+	# MetricSan advisory pass: sanitizer-armed subset; dumps (if any) name
+	# the MTA rule each violation refutes. Advisory here (leading `-`);
+	# `make san` gates.
+	-$(MAKE) san
+	# program-fingerprint drift sentinel, advisory: re-digest every
+	# family's update/step jaxpr and diff against the committed
+	# FINGERPRINTS.json baseline — unintended semantic drift shows up in
+	# review; intended drift = rerun `make lint` and commit the refresh
+	-python scripts/lint_metrics.py --skip-lint --fingerprints \
+		--json ANALYSIS_current.json --fingerprints-json - \
+		--diff-fingerprints FINGERPRINTS.json
 	python scripts/fuzz_parity.py --trials 50
 	python scripts/fuzz_sharded.py --trials 25
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
@@ -23,13 +36,36 @@ ci:
 	-python scripts/perf_sentinel.py --current bench_current.json
 
 lint:
-	# static analysis gate: pass 1 traces every metric family's program
-	# (accumulator dtypes, host sync, donation aliasing, reduction
-	# soundness), pass 2 lints the source tree for repo invariants;
-	# writes ANALYSIS.json atomically. Also pinned in tier-1 via
-	# tests/analysis/test_lint_clean.py. Rule catalog:
+	# static analysis gate: passes 1+3 trace every metric family's program
+	# — and its sync_precision=int8/bf16 variants — (accumulator dtypes,
+	# host sync, donation aliasing, reduction soundness, N-replica
+	# distributed equivalence, state lifecycle, donation lifetime), pass 2
+	# lints the source tree for repo invariants incl. stale suppressions;
+	# writes ANALYSIS.json atomically WITH the per-family program
+	# fingerprints the CI drift sentinel diffs against. Also pinned in
+	# tier-1 via tests/analysis/test_lint_clean.py. Rule catalog:
 	# docs/static_analysis.md
-	python scripts/lint_metrics.py --strict
+	python scripts/lint_metrics.py --strict --fingerprints
+
+san:
+	# MetricSan-armed test pass: the runtime sanitizer behind the static
+	# analyzer (poison-on-donate canaries, state-write interceptor,
+	# single-replica-sync identity checks) armed over a fast tier-1
+	# subset, with the flight recorder capturing one dump per violation
+	# (each dump names the MTA rule it refutes). The gate is the TEST
+	# exit code — the suite must pass with the sanitizer armed. Dumps in
+	# san-flight-dumps/ are evidence, not a gate: tests deliberately poke
+	# state and inject faults, so some dumps are the drills themselves
+	# firing (one-dump-per-fault and healthy-run-zero are pinned
+	# per-check by tests/analysis/test_sanitizer.py); CI uploads the
+	# directory as an artifact for review. See docs/static_analysis.md
+	# ("Running MetricSan").
+	rm -rf san-flight-dumps
+	METRICS_TPU_SAN=1 METRICS_TPU_FLIGHT=san-flight-dumps \
+		python -m pytest tests/bases tests/regression tests/analysis -q -m 'not slow'
+	@if [ -d san-flight-dumps ] && [ -n "$$(ls san-flight-dumps 2>/dev/null)" ]; then \
+		echo "MetricSan: dumps written (review; drills dump by design):"; ls san-flight-dumps; \
+	else echo "MetricSan: zero dumps"; fi
 
 test:
 	# full suite: sklearn/scipy oracles + package doctests + 8-virtual-device
@@ -104,6 +140,6 @@ dryrun:
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 clean:
-	rm -rf .pytest_cache .jax_cache flight-dumps bench-traces
-	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json
+	rm -rf .pytest_cache .jax_cache flight-dumps bench-traces san-flight-dumps
+	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json ANALYSIS_current.json
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -64,8 +64,10 @@ def main() -> None:
     lines += [f"- **`{n}`** — {d}" for n, d in _functions(R)]
     lines += ["", "## Static analysis (`metrics_tpu.analysis`)", ""]
     lines += [
-        "See `docs/static_analysis.md` for the rule catalog (MTA001-MTA004,"
-        " MTL101-MTL104), suppression syntax, and the `make lint` gate.",
+        "See `docs/static_analysis.md` for the rule catalog (MTA001-MTA007,"
+        " MTL101-MTL105), suppression syntax, the `make lint` gate, the"
+        " program-fingerprint drift sentinel, and the MetricSan runtime"
+        " sanitizer (`METRICS_TPU_SAN=1` / `san_scope()` / `make san`).",
         "",
     ]
     lines += [f"- **`{n}`** — {d}" for n, d in _classes(A)]

@@ -1,22 +1,39 @@
 """Static analysis for metric programs: catch the bad program before it
 dispatches, not after it corrupts an epoch.
 
-Two passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
+Three passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
 
 * **Pass 1 — program audit** (:mod:`metrics_tpu.analysis.program`):
   abstractly traces each metric's ``update`` and, for engine-eligible
   metrics, the actual donated step program, then walks the jaxpr for
   accumulator dtype drift (MTA001), host synchronization (MTA002),
   donated-buffer aliasing (MTA003), and unsound cross-replica reductions
-  (MTA004). ``audit_registry()`` runs it over every metric family.
+  (MTA004). ``audit_registry()`` runs it over every metric family — and
+  over the ``sync_precision="int8"/"bf16"`` variants of every eligible
+  one.
 * **Pass 2 — repo-invariant lint** (:mod:`metrics_tpu.analysis.lint`):
   AST checks over the ``metrics_tpu`` source tree — host ops in traced
   paths (MTL101), bare ``jax.jit`` outside ``utilities/jit.py`` (MTL102),
-  step-rate warnings that bypass ``warn_once`` (MTL103), and array states
-  registered without a ``dist_reduce_fx`` (MTL104).
+  step-rate warnings that bypass ``warn_once`` (MTL103), array states
+  registered without a ``dist_reduce_fx`` (MTL104), and stale
+  suppressions (MTL105).
+* **Pass 3 — distributed equivalence + lifecycle**
+  (:mod:`metrics_tpu.analysis.distributed`): proves, on concrete probe
+  batches, that N-replica sync-then-compute equals compute on the
+  concatenated batch (MTA005 — bit-identical for the exact tier, within
+  the documented bound for quantized tiers), that every state's
+  reset→update→sync→compute→restore lifecycle is sound (MTA006), and
+  that donated-buffer lifetimes survive the compiled step (MTA007).
 
-Suppress a rule at a site with ``# metrics-tpu: allow(<RULE-ID>)``.
-``scripts/lint_metrics.py`` (and ``make lint``) run both passes and write
+The runtime counterpart is **MetricSan**
+(:mod:`metrics_tpu.analysis.sanitizer`): ``METRICS_TPU_SAN=1`` or
+:func:`san_scope` arms poison-on-donate canaries, a state-write
+interceptor, and single-replica-sync identity checks — each violation
+flight-dumped under the static rule it refutes.
+
+Suppress a rule at a site with ``# metrics-tpu: allow(<RULE-ID>)``
+(stale allows are themselves flagged, MTL105).
+``scripts/lint_metrics.py`` (and ``make lint``) run all passes and write
 ``ANALYSIS.json``; a tier-1 test pins the zero-unsuppressed-findings
 baseline. Rule catalog and usage: ``docs/static_analysis.md``.
 """
@@ -29,18 +46,40 @@ from metrics_tpu.analysis.program import (  # noqa: F401
     hint_for_watch_key,
     iter_eqns,
 )
+from metrics_tpu.analysis.distributed import (  # noqa: F401
+    check_donation_lifetime,
+    check_lifecycle,
+    check_replica_equivalence,
+    fingerprint_jaxpr,
+)
 from metrics_tpu.analysis.lint import lint_file, lint_paths  # noqa: F401
+from metrics_tpu.analysis.sanitizer import (  # noqa: F401
+    MetricSan,
+    MetricSanError,
+    disable_san,
+    enable_san,
+    san_scope,
+)
 
 __all__ = [
     "AuditResult",
     "Finding",
+    "MetricSan",
+    "MetricSanError",
     "Rule",
     "RULES",
     "audit_collection",
     "audit_metric",
     "audit_registry",
+    "check_donation_lifetime",
+    "check_lifecycle",
+    "check_replica_equivalence",
+    "disable_san",
+    "enable_san",
+    "fingerprint_jaxpr",
     "hint_for_watch_key",
     "iter_eqns",
     "lint_file",
     "lint_paths",
+    "san_scope",
 ]

@@ -21,13 +21,20 @@ from metrics_tpu.metric import Metric
 __all__ = [
     "BlockScaledQuantizedSync",
     "CallbackInJit",
+    "ComputeMutatesState",
     "DonatedAlias",
     "HostSyncUpdate",
     "MeanWithoutCount",
     "NarrowAccumulator",
     "NonCommutativeMerge",
+    "NonIdentityReset",
+    "OrphanResidual",
+    "ReplicaDependentCount",
+    "StaleSuppression",
     "SuppressedNarrowAccumulator",
+    "UnownedLoader",
     "UnscaledInt8Psum",
+    "UntouchedStatePassthrough",
 ]
 
 
@@ -182,6 +189,154 @@ class UnscaledInt8Psum(Metric):
 
     def compute(self) -> jax.Array:
         return jnp.sum(self.acc)
+
+
+class ReplicaDependentCount(Metric):
+    """MTA005: a sum-reduced state that counts *update calls*, not data.
+    One replica over the whole batch counts 1; R replicas over shards
+    count R — `compute(reduce(states_1..R)) != compute(update-on-concat)`
+    the moment this runs data-parallel. The classic replica-dependence
+    defect: state encodes the execution topology, not the stream."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("batches", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.total = self.total + jnp.sum(x)
+        self.batches = self.batches + 1.0  # per-CALL, not per-sample
+
+    def compute(self) -> jax.Array:
+        return self.total / jnp.maximum(self.batches, 1.0)
+
+
+class NonIdentityReset(Metric):
+    """MTA006 (reset flavor): a sum-reduced state whose reset value is 1,
+    not the reduction's identity 0. Every sync round folds the phantom 1
+    of each freshly-reset (or idle) replica into the merged state.
+    Deliberately eager-only: with an engine opt-in the same defect would
+    *also* surface as MTA005 replica-inequivalence — which is the point
+    of the reset-identity rule catching it earlier and cheaper."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.ones(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.acc = self.acc + jnp.sum(x)
+
+    def compute(self) -> jax.Array:
+        return self.acc
+
+
+class ComputeMutatesState(Metric):
+    """MTA006 (purity flavor): ``compute`` writes a registered state.
+    After one compute the accumulated count is doubled, so every
+    compute-then-keep-accumulating loop (step-value logging mid-epoch)
+    silently corrupts the epoch state. Caught by both the concrete
+    fingerprint probe and, at run time, MetricSan's write interceptor."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.total = self.total + jnp.sum(x)
+
+    def compute(self) -> jax.Array:
+        self.total = self.total * 2.0  # the mutation
+        return self.total
+
+
+class OrphanResidual(Metric):
+    """MTA006 (residual flavor): a state named like an error-feedback
+    companion (``*__qres``) with no ``sync_precision`` entry pairing it.
+    The residual exemption from every sync/reduction rule only covers
+    REGISTERED companions — an orphan is ordinary state wearing the
+    exemption's name."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("hist", default=jnp.zeros((8,)), dist_reduce_fx="sum")
+        self.add_state("hist__qres", default=jnp.zeros((8,)), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.hist = self.hist + jnp.reshape(x, self.hist.shape)
+
+    def compute(self) -> jax.Array:
+        return jnp.sum(self.hist)
+
+
+class UntouchedStatePassthrough(Metric):
+    """MTA007: an engine-eligible metric registering a state its update
+    never writes. The donated step donates the buffer every dispatch and
+    hands the SAME storage back — host references (defaults, snapshots)
+    die for a state that never changes, and ping-pong double-buffering
+    cannot give it two disjoint generations. Configuration belongs in
+    plain attributes, not donated state."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("version", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.acc = self.acc + jnp.sum(x)  # `version` never written
+
+    def compute(self) -> jax.Array:
+        return self.acc
+
+
+class UnownedLoader(Metric):
+    """MTA007 (load flavor): a ``load_state_dict`` override that imports
+    checkpoint values without the ``_device_owned`` copy and without
+    delegating to the library loader. The loaded buffers alias host
+    storage; the compiled engine's donation corrupts them — the
+    bit-garbled-resume hazard the durable-session work fixed."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.acc = self.acc + jnp.sum(x)
+
+    def compute(self) -> jax.Array:
+        return self.acc
+
+    def load_state_dict(self, state_dict, prefix="", strict=False,
+                        _warn_on_zero_match=True):
+        for key in self._defaults:
+            if prefix + key in state_dict:
+                setattr(self, key, jnp.asarray(state_dict[prefix + key]))
+
+
+class StaleSuppression(Metric):
+    """MTL105: a class-body allow for a rule whose violation no longer
+    exists (the program is clean). The unused-noqa analogue — the allow
+    must be deleted, or the next REAL donation alias here sails through
+    pre-suppressed."""
+
+    # metrics-tpu: allow(MTA003) — STALE on purpose: nothing here aliases
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.acc = self.acc + jnp.sum(x)
+
+    def compute(self) -> jax.Array:
+        return self.acc
 
 
 class BlockScaledQuantizedSync(Metric):

@@ -418,29 +418,62 @@ class _Linter(ast.NodeVisitor):
 
 def lint_source(source: str, rel_path: str) -> List[Finding]:
     """Lint one module's source text; ``rel_path`` labels findings and
-    decides path-scoped rules (MTL102's ``utilities/jit.py`` home)."""
+    decides path-scoped rules (MTL102's ``utilities/jit.py`` home).
+
+    Suppression comes with a staleness audit (MTL105, the unused-noqa
+    analogue): every ``allow(<MTL rule>)`` comment must suppress at least
+    one finding in this run or it is itself flagged — an allowlist entry
+    whose violation was fixed is a pre-approved hole for the next real
+    one. ``MTA*`` allows are exempt here (they belong to the program
+    audit, which runs its own staleness check), as is ``allow(MTL105)``."""
     tree = ast.parse(source, filename=rel_path)
     linter = _Linter(rel_path, source)
     linter.visit(tree)
-    allow = dict(parse_allow_comments(source))
+    base_allow = parse_allow_comments(source)
+    allow = {line: set(rules) for line, rules in base_allow.items()}
+    # provenance: effective (line, rule) -> the comment line that grants it
+    origin: Dict[Tuple[int, str], int] = {
+        (line, r): line for line, rules in base_allow.items() for r in rules
+    }
     # an allow comment opening a comment block suppresses the first code
     # line after the block (multi-line rationales are the norm): propagate
     # each comment's rules downward through consecutive comment-only lines
     lines = source.splitlines()
-    for lineno in sorted(allow):
+    for lineno in sorted(base_allow):
         cursor = lineno
         while cursor <= len(lines) and lines[cursor - 1].lstrip().startswith("#"):
             cursor += 1
         if cursor != lineno:
             allow.setdefault(cursor, set())
-            allow[cursor] |= allow[lineno]
+            allow[cursor] |= base_allow[lineno]
+            for r in base_allow[lineno]:
+                origin.setdefault((cursor, r), lineno)
+    used: Set[Tuple[int, str]] = set()
     findings: List[Finding] = []
     for f in linter.findings:
         line = f.detail.get("line", 0)
-        allowed = allow.get(line, set()) | allow.get(line - 1, set())
-        if f.rule in allowed:
-            f.suppressed = True
+        for cand in (line, line - 1):
+            if f.rule in allow.get(cand, set()):
+                f.suppressed = True
+                used.add((origin.get((cand, f.rule), cand), f.rule))
+                break
         findings.append(f)
+    for line, rules in sorted(base_allow.items()):
+        for rule_id in sorted(rules):
+            if not rule_id.startswith("MTL") or rule_id == "MTL105":
+                continue
+            if (line, rule_id) in used:
+                continue
+            stale = Finding(
+                "MTL105", f"{rel_path}:{line}",
+                f"stale suppression: allow({rule_id}) suppressed nothing —"
+                " the violation it excused is gone; delete the comment"
+                " before it silently excuses the next real one",
+                detail={"line": line, "rule": rule_id},
+            )
+            if "MTL105" in allow.get(line, set()) | allow.get(line - 1, set()):
+                stale.suppressed = True
+            findings.append(stale)
     return findings
 
 

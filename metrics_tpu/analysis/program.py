@@ -46,6 +46,7 @@ from metrics_tpu.analysis.rules import (
     RULES,
     Finding,
     class_allowed_rules,
+    own_class_allowed_rules,
     state_allowed_rules,
 )
 from metrics_tpu.parallel import quantize as _q
@@ -158,6 +159,13 @@ class AuditResult:
     findings: List[Finding] = field(default_factory=list)
     suppressed: List[Finding] = field(default_factory=list)
     infos: List[str] = field(default_factory=list)
+    # pass-3 evidence: MTA005 replica counts verified, bit-identity, and
+    # worst state/value deltas (None when the metric was not equivalence-
+    # probed — eager-only families, unshardable batches)
+    distributed: Optional[Dict[str, Any]] = None
+    # jaxpr digests (ops × dtypes × shapes) of the update and compiled
+    # step programs, when fingerprinting was requested
+    fingerprints: Optional[Dict[str, Optional[str]]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -167,21 +175,27 @@ class AuditResult:
             "findings": [f.to_dict() for f in self.findings],
             "suppressed": [f.to_dict() for f in self.suppressed],
             "infos": list(self.infos),
+            "distributed": self.distributed,
+            "fingerprints": self.fingerprints,
         }
 
 
 def _update_program(metric) -> Callable:
     """The metric's update as a pure ``states, args, kwargs -> new_states``
     function (the same temporary-attribute-mutation reuse the engine's
-    step function performs), restorable even when tracing raises."""
+    step function performs), restorable even when tracing raises. Runs
+    under MetricSan's allow scope: an analysis probe must never register
+    as a runtime violation."""
+    from metrics_tpu.metric import _san_allow_ctx
 
     def fn(states, args, kwargs):
         saved = metric._snapshot_state()
         try:
-            for k, v in states.items():
-                setattr(metric, k, v)
-            metric.update(*args, **metric._filter_kwargs(**kwargs))
-            return {k: getattr(metric, k) for k in metric._defaults}
+            with _san_allow_ctx():
+                for k, v in states.items():
+                    setattr(metric, k, v)
+                metric.update(*args, **metric._filter_kwargs(**kwargs))
+                return {k: getattr(metric, k) for k in metric._defaults}
         finally:
             metric._restore_state(saved)
             metric._computed = None
@@ -373,11 +387,12 @@ def _quantized_merge_probe(red: Callable, default: Any) -> Optional[str]:
 
 
 def _audit_traced_update(metric, args: tuple, kwargs: dict, findings: List[Finding],
-                         infos: List[str], traceable_contract: bool) -> None:
+                         infos: List[str], traceable_contract: bool) -> Optional[Any]:
     """Trace ``update`` abstractly; apply MTA001/MTA002/MTA003 to the
     resulting jaxpr. ``traceable_contract`` is True when this metric claims
     it can run compiled (then any trace failure is a violation, not a
-    design note)."""
+    design note). Returns the closed update jaxpr (for fingerprinting), or
+    None when the update is untraceable."""
     cls = type(metric).__name__
     states = _default_states(metric)
     try:
@@ -401,7 +416,7 @@ def _audit_traced_update(metric, args: tuple, kwargs: dict, findings: List[Findi
                 f"{cls}.update is untraceable ({type(err).__name__});"
                 " eager-only by design, compiled-path rules not applied"
             )
-        return
+        return None
 
     # compiled-path rules only bind metrics that claim they can compile:
     # an eager-only metric's update never runs as a donated jitted program,
@@ -465,18 +480,23 @@ def _audit_traced_update(metric, args: tuple, kwargs: dict, findings: List[Findi
                 " silently destroyed at accumulation",
                 detail={"state": str(in_aval.dtype), "input": str(widest_in)},
             ))
+    return closed
 
 
-def _audit_engine_program(metric, args: tuple, kwargs: dict, findings: List[Finding]) -> None:
+def _audit_engine_program(
+    metric, args: tuple, kwargs: dict, findings: List[Finding]
+) -> Optional[Tuple[Any, int]]:
     """Trace the *actual* donated step program (update + batch-local
     compute + merge) and audit it: callbacks (MTA002) and donated-buffer
-    aliasing across outputs (MTA003)."""
+    aliasing across outputs (MTA003). Returns ``(closed_jaxpr,
+    n_donated)`` for the downstream donation-lifetime pass, or None when
+    the step does not trace."""
     from metrics_tpu.engine import CompiledStepEngine
 
     cls = type(metric).__name__
     engine = CompiledStepEngine(metric, observe=False)
     try:
-        closed, _out_shape, _n_donated = engine.abstract_step(*args, **kwargs)
+        closed, _out_shape, n_donated = engine.abstract_step(*args, **kwargs)
     except Exception as err:  # noqa: BLE001
         kind = _trace_error_kind(err)
         msg = str(err).splitlines()[0] if str(err) else type(err).__name__
@@ -488,7 +508,7 @@ def _audit_engine_program(metric, args: tuple, kwargs: dict, findings: List[Find
             " metric to eager on its first dispatch",
             detail={"kind": kind},
         ))
-        return
+        return None
 
     callbacks = _callback_eqns(closed)
     if callbacks:
@@ -505,21 +525,96 @@ def _audit_engine_program(metric, args: tuple, kwargs: dict, findings: List[Find
             f" program (output positions {positions}): donation double-books"
             " the buffer (state/state or state/batch-value alias)",
         ))
+    return closed, n_donated
 
 
-def audit_metric(metric, args: Sequence[Any] = (), kwargs: Optional[dict] = None) -> AuditResult:
-    """Run the full pass-1 audit over one metric with representative
+def _route_suppressions(
+    metric, findings: List[Finding], result: AuditResult, check_staleness: bool = True
+) -> None:
+    """Split raw findings into the result's ``findings``/``suppressed``
+    buckets per the class-level and state-scoped allow sets, then flag
+    stale suppressions (MTL105): allow entries declared on this class
+    itself that suppressed nothing in this audit.
+
+    ``check_staleness=False`` routes only — used by the slimmed
+    ``sync_precision=`` variant audits, which deliberately skip whole rule
+    passes (MTA001, the non-residual MTA006 checks): an allow earning its
+    keep on the base audit must not read as stale in an audit that never
+    ran the rule it suppresses."""
+    allowed = class_allowed_rules(type(metric))
+    scoped = state_allowed_rules(metric)  # instance-resolved: dynamic states
+    for f in findings:
+        state = f.subject.split(".", 1)[1] if "." in f.subject else None
+        if f.rule in allowed or (state is not None and state in scoped.get(f.rule, ())):
+            f.suppressed = True
+            result.suppressed.append(f)
+        else:
+            result.findings.append(f)
+    if not check_staleness:
+        return
+    # MTL105 (program-audit side): staleness is judged only against the
+    # allows THIS class declares (own body / own attribute) — an inherited
+    # allow may be earning its keep on the parent, which audits separately
+    cls = type(metric).__name__
+    used_rules = {f.rule for f in result.suppressed}
+    used_states = {}
+    for f in result.suppressed:
+        if "." in f.subject:
+            used_states.setdefault(f.rule, set()).add(f.subject.split(".", 1)[1])
+    own = own_class_allowed_rules(type(metric)) - {"MTL105"}
+    for rule_id in sorted(own - used_rules):
+        result.findings.append(Finding(
+            "MTL105", cls,
+            f"stale suppression: allow({rule_id}) declared on {cls}"
+            " suppressed nothing in this audit — the violation it excused"
+            " is gone; delete the allow before it hides a real one",
+        ))
+    own_attr = type(metric).__dict__.get("_analysis_allow", None)
+    inst_attr = metric.__dict__.get("_analysis_allow", None)
+    mapping = inst_attr if isinstance(inst_attr, dict) else (
+        own_attr if isinstance(own_attr, dict) else None
+    )
+    if mapping:
+        for rule_id, names in sorted(mapping.items()):
+            stale = sorted(set(names) - used_states.get(rule_id, set()))
+            if stale:
+                result.findings.append(Finding(
+                    "MTL105", cls,
+                    f"stale state-scoped suppression: _analysis_allow"
+                    f" {rule_id} names {stale} but no finding on those"
+                    " states was suppressed in this audit",
+                    detail={"rule": rule_id, "states": stale},
+                ))
+
+
+def audit_metric(
+    metric,
+    args: Sequence[Any] = (),
+    kwargs: Optional[dict] = None,
+    distributed: bool = True,
+    fingerprint: bool = False,
+    _probe_cache: Optional[Dict[str, Any]] = None,
+) -> AuditResult:
+    """Run the full static audit over one metric with representative
     batch inputs.
 
-    Rules applied: MTA001 (accumulator dtype), MTA002 (host sync in traced
-    regions), MTA003 (donation aliasing), MTA004 (reduction soundness).
+    Rules applied — pass 1: MTA001 (accumulator dtype), MTA002 (host sync
+    in traced regions), MTA003 (donation aliasing), MTA004 (reduction
+    soundness); pass 3 (``distributed=True``): MTA005 (N-replica
+    equivalence on concrete probes), MTA006 (state lifecycle: reset
+    identity, compute purity, residual coherence), MTA007 (donation
+    lifetime). ``fingerprint=True`` additionally digests the update and
+    step jaxprs for the drift sentinel.
+
     Suppression: any rule named in a ``# metrics-tpu: allow(...)`` comment
     at class-body level (or in an iterable ``_analysis_allow`` attribute)
     is reported under ``suppressed`` instead of ``findings``; a mapping
     ``_analysis_allow = {rule_id: (state_name, ...)}`` — on the class or
     set per-instance by state-registration code — suppresses a rule for
-    exactly the named states.
+    exactly the named states. Allows that suppress nothing are themselves
+    flagged (MTL105).
     """
+    from metrics_tpu.analysis import distributed as _dist
     from metrics_tpu.engine import CompiledStepEngine
 
     args = tuple(args)
@@ -530,22 +625,41 @@ def audit_metric(metric, args: Sequence[Any] = (), kwargs: Optional[dict] = None
 
     findings: List[Finding] = []
     _audit_reductions(metric, findings)
-    _audit_traced_update(metric, args, kwargs, findings, result.infos,
-                         traceable_contract=eager_reason is None)
+    update_closed = _audit_traced_update(
+        metric, args, kwargs, findings, result.infos,
+        traceable_contract=eager_reason is None,
+    )
+    engine_closed, n_donated = None, 0
     if eager_reason is None:
-        _audit_engine_program(metric, args, kwargs, findings)
+        traced = _audit_engine_program(metric, args, kwargs, findings)
+        if traced is not None:
+            engine_closed, n_donated = traced
     elif not any(isinstance(d, list) for d in metric._defaults.values()):
         result.infos.append(f"{cls} runs eager in engines: {eager_reason}")
 
-    allowed = class_allowed_rules(type(metric))
-    scoped = state_allowed_rules(metric)  # instance-resolved: dynamic states
-    for f in findings:
-        state = f.subject.split(".", 1)[1] if "." in f.subject else None
-        if f.rule in allowed or (state is not None and state in scoped.get(f.rule, ())):
-            f.suppressed = True
-            result.suppressed.append(f)
-        else:
-            result.findings.append(f)
+    if distributed:
+        if eager_reason is None:
+            result.distributed = _dist.check_replica_equivalence(
+                metric, args, kwargs, findings, result.infos,
+                probe_cache=_probe_cache,
+            )
+        _dist.check_lifecycle(
+            metric, args, kwargs, findings, result.infos,
+            probe_cache=_probe_cache,
+        )
+        _dist.check_donation_lifetime(
+            metric, args, kwargs, findings, result.infos,
+            engine_closed=engine_closed, n_donated=n_donated,
+            engine_eligible=eager_reason is None,
+            update_closed=update_closed,
+        )
+    if fingerprint:
+        result.fingerprints = {
+            "update": _dist.fingerprint_jaxpr(update_closed) if update_closed is not None else None,
+            "step": _dist.fingerprint_jaxpr(engine_closed) if engine_closed is not None else None,
+        }
+
+    _route_suppressions(metric, findings, result)
     _note_audit(cls, result)
     return result
 
@@ -678,21 +792,106 @@ def registry_cases() -> List[Tuple[str, Callable, tuple]]:
     return list(_REGISTRY_CACHE)
 
 
-def audit_registry(write_path: Optional[str] = None) -> Dict[str, Any]:
-    """Pass 1 over every registered metric family; returns (and optionally
-    atomically writes) the JSON report CI pins.
+#: quantized wire tiers the registry audit re-proves per eligible family
+QUANTIZED_AUDIT_TIERS = ("int8", "bf16")
+
+
+def _audit_quantized_variant(
+    metric, args: tuple, probe_cache: Optional[Dict[str, Any]] = None
+) -> AuditResult:
+    """A slimmer audit for a ``sync_precision=`` variant of an already-
+    audited family: the *update program* is unchanged by the tier (the
+    residual companion is registered, never written), so re-running
+    MTA001 would re-prove the base audit — what the tier changes is the
+    state pytree, the step program, and the merge. Audited here: MTA004
+    (quantized merge probes), MTA002/MTA003 on the variant's donated step
+    (residuals ride the pytree), MTA005 at the tier's documented bound
+    through the real codec, and MTA006 (residual coherence, reset
+    identity, compute purity)."""
+    from metrics_tpu.analysis import distributed as _dist
+    from metrics_tpu.engine import CompiledStepEngine
+
+    cls = type(metric).__name__
+    eager_reason = CompiledStepEngine._static_ineligibility(metric)
+    result = AuditResult(
+        name=cls, engine_eligible=eager_reason is None, eager_reason=eager_reason
+    )
+    findings: List[Finding] = []
+    _audit_reductions(metric, findings)
+    engine_closed, n_donated = None, 0
+    if eager_reason is None:
+        traced = _audit_engine_program(metric, args, {}, findings)
+        if traced is not None:
+            engine_closed, n_donated = traced
+        result.distributed = _dist.check_replica_equivalence(
+            metric, args, {}, findings, result.infos, probe_cache=probe_cache
+        )
+    _dist.check_lifecycle(metric, args, {}, findings, result.infos, residuals_only=True)
+    _dist.check_donation_lifetime(
+        metric, args, {}, findings, result.infos,
+        engine_closed=engine_closed, n_donated=n_donated,
+        engine_eligible=eager_reason is None,
+    )
+    _route_suppressions(metric, findings, result, check_staleness=False)
+    return result
+
+
+def audit_registry(
+    write_path: Optional[str] = None,
+    quantized: bool = True,
+    fingerprints: bool = False,
+) -> Dict[str, Any]:
+    """The full static audit over every registered metric family; returns
+    (and optionally atomically writes) the JSON report CI pins.
+
+    ``quantized=True`` additionally audits the ``sync_precision="int8"``
+    and ``"bf16"`` variants of every engine-eligible family with
+    quantizable states (reported as ``"<Family>@<tier>"``) — the engine
+    keys programs on the precision map, so the variants ARE different
+    programs. ``fingerprints=True`` digests each family's update/step
+    jaxprs into ``report["fingerprints"]`` for the CI drift sentinel.
 
     The clean-baseline contract: ``report["summary"]["findings"] == 0``.
     Suppressed findings and design notes (eager-only families) stay
     visible in the report without failing the gate.
     """
     families: Dict[str, Any] = {}
+    prints: Dict[str, Any] = {}
     totals = {"findings": 0, "suppressed": 0}
-    for name, factory, args in registry_cases():
-        result = audit_metric(factory(), args)
+
+    def note(name: str, result: AuditResult) -> None:
         families[name] = result.to_dict()
         totals["findings"] += len(result.findings)
         totals["suppressed"] += len(result.suppressed)
+        if result.fingerprints is not None:
+            prints[name] = dict(result.fingerprints)
+
+    for name, factory, args in registry_cases():
+        # one probe cache per family: the per-replica update states and
+        # the full-batch compute are tier-invariant, so the base audit
+        # pays for them once and the int8/bf16 variants reuse them (only
+        # the merge composite differs per tier)
+        probe_cache: Dict[str, Any] = {}
+        note(name, audit_metric(
+            factory(), args, fingerprint=fingerprints, _probe_cache=probe_cache
+        ))
+        if not quantized:
+            continue
+        for tier in QUANTIZED_AUDIT_TIERS:
+            variant = factory()
+            try:
+                tier_map = variant.set_sync_precision(tier)
+            except Exception:  # noqa: BLE001 — family has no eligible state
+                continue
+            if not tier_map:
+                continue
+            from metrics_tpu.engine import CompiledStepEngine
+
+            if CompiledStepEngine._static_ineligibility(variant) is not None:
+                continue  # the tier only matters where the engine compiles
+            note(f"{name}@{tier}", _audit_quantized_variant(
+                variant, args, probe_cache=probe_cache
+            ))
     report = {
         "schema": "metrics_tpu.analysis_report",
         "version": 1,
@@ -704,6 +903,8 @@ def audit_registry(write_path: Optional[str] = None) -> Dict[str, Any]:
             "suppressed": totals["suppressed"],
         },
     }
+    if fingerprints:
+        report["fingerprints"] = prints
     if write_path is not None:
         from metrics_tpu.reliability.journal import atomic_write_json
 

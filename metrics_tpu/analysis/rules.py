@@ -35,6 +35,7 @@ __all__ = [
     "rule",
     "parse_allow_comments",
     "class_allowed_rules",
+    "own_class_allowed_rules",
     "state_allowed_rules",
 ]
 
@@ -144,6 +145,69 @@ MTA004 = rule(
     " compensation state) are exempt from every reduction rule.",
 )
 
+MTA005 = rule(
+    "MTA005",
+    "replica-inequivalence",
+    "distributed",
+    "The N-replica sync-then-compute composite disagrees with compute on"
+    " the concatenated batch: `compute(reduce(states_1..R)) !="
+    " compute(update-on-concat)` on concrete probe batches (R ∈ {1, 2, 4})"
+    " — exactly for the exact sync tier, beyond the documented error bound"
+    " for the bf16/int8 tiers — or the merged state depends on replica"
+    " ORDER (axis-index leakage, order-sensitive state).",
+    "Every scale-out story (vmapped cohorts, hierarchical multi-pod sync,"
+    " async dispatch) assumes data parallelism is semantically invisible:"
+    " R replicas that each update on a shard and then sync must equal one"
+    " replica that saw the whole batch. A metric violating it is silently"
+    " wrong the moment it runs distributed — on EVERY step, not on a rare"
+    " failure path. The exact tier is held to bit-identity (probe batches"
+    " are grid-valued so float accumulation is exactly associative; a"
+    " documented <=8-ulp re-association allowance covers transcendental"
+    " per-element terms), the quantized tiers to their documented"
+    " per-family bounds, quantizing through the real codec.",
+)
+
+MTA006 = rule(
+    "MTA006",
+    "lifecycle-unsound",
+    "distributed",
+    "A state's reset->update*->sync->compute->restore lifecycle is"
+    " unsound: the reset default is not the identity of its"
+    " `dist_reduce_fx` (a second sync round silently folds the non-zero"
+    " reset back in), `compute` mutates registered state (before/after"
+    " state fingerprints differ across a compute), or a `__qres` residual"
+    " companion is incoherent (orphaned, non-zero default, or shape-"
+    " mismatched against the state it compensates).",
+    "Multi-round sync composes only because an idle or freshly-reset"
+    " replica contributes the reduction's identity; a non-identity reset"
+    " corrupts the merged state by exactly the reset value per extra"
+    " round. A compute that mutates state turns every"
+    " compute-then-keep-accumulating loop into silent double counting."
+    " Error-feedback residuals are exempt from sync rules precisely"
+    " because they are local-only zeros-reset compensation state — an"
+    " incoherent residual voids that exemption.",
+)
+
+MTA007 = rule(
+    "MTA007",
+    "donation-lifetime",
+    "distributed",
+    "A donated-buffer lifetime hazard across the compiled step: a state"
+    " buffer passes through the donated step program unchanged (the"
+    " donated input IS an output), or a `load_state_dict` override imports"
+    " checkpoint buffers into donation slots without the `_device_owned`"
+    " copy.",
+    "The engine donates the state pytree every dispatch. A pass-through"
+    " state hands the donated input buffer back as the 'new' state, so"
+    " host references (registered defaults, snapshots) silently die and"
+    " the planned ping-pong double-buffering (two DISJOINT buffer"
+    " generations in flight) is structurally impossible for that state."
+    " Loaded-state buffers that skip `_device_owned` alias host storage"
+    " XLA may reuse — observed historically as bit-garbled resumes and GC"
+    " segfaults, fixed dynamically by the durable-session work and now"
+    " refused statically.",
+)
+
 # ---------------------------------------------------------------------------
 # pass 2 — repo-invariant lint (AST)
 # ---------------------------------------------------------------------------
@@ -195,6 +259,21 @@ MTL104 = rule(
     " `(world, ...)` array — a silent shape change every downstream"
     " compute misreads. List states flatten in rank order, which IS"
     " concatenation, so `None` is sound there.",
+)
+
+
+MTL105 = rule(
+    "MTL105",
+    "stale-suppression",
+    "lint",
+    "A `# metrics-tpu: allow(<RULE>)` comment (or an `_analysis_allow`"
+    " entry) that no longer suppresses any finding — the rule it names"
+    " never fires at that site.",
+    "Suppressions are an allowlist of audited exceptions, and an"
+    " allowlist rots silently: the violation gets fixed, the comment"
+    " stays, and a future REAL violation at the same site sails through"
+    " pre-suppressed. The unused-noqa analogue: every allow must earn its"
+    " keep every run, or be deleted.",
 )
 
 
@@ -304,6 +383,28 @@ def class_allowed_rules(cls: type) -> Set[str]:
         for lineno, ids in parse_allow_comments(src).items():
             if lineno not in method_lines:
                 allowed |= ids
+    return allowed
+
+
+def own_class_allowed_rules(cls: type) -> Set[str]:
+    """Suppression rules declared on ``cls`` ITSELF — its own class-body
+    allow comments plus its own (non-inherited) iterable
+    ``_analysis_allow`` — excluding everything inherited over the MRO.
+    This is the staleness universe for MTL105: an inherited allow may be
+    earning its keep on the parent, so only the declaring class can be
+    told its allow is stale."""
+    import inspect
+
+    attr = cls.__dict__.get("_analysis_allow", ()) or ()
+    allowed: Set[str] = set() if isinstance(attr, dict) else set(attr)
+    try:
+        src = inspect.getsource(cls)
+    except (OSError, TypeError):
+        return allowed
+    method_lines = _method_body_lines(src)
+    for lineno, ids in parse_allow_comments(src).items():
+        if lineno not in method_lines:
+            allowed |= ids
     return allowed
 
 

@@ -1,0 +1,440 @@
+"""MetricSan — the opt-in runtime sanitizer behind the static analyzer.
+
+Pass 3 (:mod:`metrics_tpu.analysis.distributed`) proves what it can see:
+equivalence on probe batches, identity of reset values, purity of traced
+computes, passthrough in traced programs. What it structurally *cannot*
+see — arbitrary host code holding a reference across a donation, a state
+written from outside the metric lifecycle at run time, a live sync that
+drifts where the probe didn't — MetricSan enforces dynamically, and every
+violation is reported under the **static rule it refutes**, so the flight
+dump reads the same whether the defect was caught before dispatch or in
+production:
+
+* **poison-on-donate canaries (MTA007)** — after every successful engine
+  dispatch, the sanitizer sweeps each metric's registered defaults and
+  live state attributes for buffers the donation deleted: a deleted
+  buffer reachable from the metric means a host reference escaped into
+  the donation set (the bit-garbled-resume / GC-segfault class the
+  durable-session work fixed).
+* **state-write interceptor (MTA006)** — while armed, a ``__setattr__``
+  interceptor on :class:`~metrics_tpu.metric.Metric` flags writes to
+  *registered state* from outside the sanctioned lifecycle contexts
+  (update, reset, restore, sync, checkpoint load, dtype/device moves,
+  engine write-back). A ``compute`` that mutates state — or external
+  code poking accumulators directly — is caught at the exact write.
+* **single-replica sync identity (MTA005)** — a sync at world size 1
+  must be an identity (exact tier: bit-identical; quantized tiers:
+  within the documented bound). Any drift means the reduction composite
+  is unsound in a way that R>1 will amplify, caught on the cheapest
+  possible mesh.
+* **reset-identity probe (MTA006)** — the first ``reset()`` of each
+  metric class probes every state's reset value against its
+  ``dist_reduce_fx`` identity, the dynamic twin of the static check (for
+  metrics constructed at run time that no audit ever saw).
+
+Arming: ``METRICS_TPU_SAN=1`` in the environment, :func:`enable_san`,
+or the scoped :func:`san_scope`. Like every observability feature the
+default is OFF and zero-overhead — each hook reads one module-global
+flag (``metrics_tpu.utilities.env.san_enabled``) and branches; the
+``__setattr__`` interceptor is *installed on arm and removed on disarm*,
+so the unarmed hot path pays nothing at all.
+
+Every violation is recorded once per (rule, check, subject), dumped
+through the :class:`~metrics_tpu.observability.flight.FlightRecorder`
+when one is armed (reason ``metricsan_<check>``, hint naming the MTA
+rule), and surfaced as a rate-limited warning — or raised as
+:class:`MetricSanError` under ``san_scope(raise_on_violation=True)``.
+"""
+import functools
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Mapping, Optional, Tuple
+
+import numpy as np
+
+from metrics_tpu.analysis.rules import RULES
+from metrics_tpu.observability import flight as _flight
+from metrics_tpu.utilities import env as _env
+from metrics_tpu.utilities.prints import warn_once
+
+__all__ = [
+    "MetricSan",
+    "MetricSanError",
+    "active",
+    "allow_state_writes",
+    "disable_san",
+    "enable_san",
+    "san_enabled",
+    "san_scope",
+]
+
+
+class MetricSanError(RuntimeError):
+    """A sanitizer violation under ``raise_on_violation=True``."""
+
+
+_tls = threading.local()
+
+
+def _allow_depth() -> int:
+    return getattr(_tls, "allow_depth", 0)
+
+
+@contextmanager
+def allow_state_writes() -> Iterator[None]:
+    """Mark the dynamic extent as a sanctioned state-write context (the
+    lifecycle methods run under this; everything else is a violation)."""
+    _tls.allow_depth = _allow_depth() + 1
+    try:
+        yield
+    finally:
+        _tls.allow_depth -= 1
+
+
+class MetricSan:
+    """The armed sanitizer: violation log + dedup + reporting policy."""
+
+    def __init__(self, raise_on_violation: bool = False):
+        self.raise_on_violation = raise_on_violation
+        self.violations: List[Dict[str, Any]] = []
+        self._seen: set = set()
+        self._identity_probed: set = set()
+        self._lock = threading.Lock()
+
+    def violation(self, rule: str, check: str, subject: str, message: str, **context: Any) -> None:
+        """Record one violation (first occurrence per (rule, check,
+        subject)): append to the log, dump the flight window naming the
+        rule, warn once — or raise under ``raise_on_violation``."""
+        key = (rule, check, subject)
+        with self._lock:
+            if key in self._seen:
+                return
+            self._seen.add(key)
+            self.violations.append(
+                {"rule": rule, "check": check, "subject": subject,
+                 "message": message, **context}
+            )
+        slug = RULES[rule].slug if rule in RULES else ""
+        hint = f"MetricSan: {rule} ({slug}) on {subject} — {message}"
+        _flight.dump_on_failure(
+            f"metricsan_{check}", hint=hint, rule=rule, subject=subject, **context
+        )
+        if self.raise_on_violation:
+            raise MetricSanError(hint)
+        warn_once(hint, key=f"metricsan:{check}:{subject}")
+
+    # ------------------------------------------------------------------
+    # the checks (each invoked from one hook; all no-ops when unreachable)
+    # ------------------------------------------------------------------
+    def check_post_dispatch(self, metrics: Mapping[str, Any], names: Tuple[str, ...]) -> None:
+        """Poison-on-donate canary: donation itself is the poison — any
+        deleted buffer still reachable from a metric after a successful
+        dispatch is a host reference that escaped into the donation set."""
+        for name in names:
+            m = metrics[name]
+            dead: List[str] = []
+            for sname in m._defaults:
+                for label, buf in (
+                    ("registered default", m._defaults.get(sname)),
+                    ("live state", getattr(m, sname, None)),
+                ):
+                    if hasattr(buf, "is_deleted") and buf.is_deleted():
+                        dead.append(f"{sname} ({label})")
+            if dead:
+                # one fault, one dump: a donation that killed N reachable
+                # buffers of one metric is one event, not N
+                self.violation(
+                    "MTA007", "use_after_donate",
+                    type(m).__name__,
+                    f"buffers backing {dead} were donated to the compiled"
+                    " step and are now deleted — host references escaped"
+                    " into the donation set (donation-safe copies were"
+                    " bypassed)",
+                    states=dead,
+                )
+
+    def check_reset_identity(self, metric: Any) -> None:
+        """Once per (class, state): the reset default must be the identity
+        of its reduction — the dynamic twin of the static MTA006 probe,
+        for metrics no audit ever saw. Honors the same suppressions the
+        static pass does (class-level allows and state-scoped
+        ``_analysis_allow`` entries): a documented, audited exception must
+        not re-fire at run time."""
+        from metrics_tpu.analysis.distributed import _reduction_identity_violation
+        from metrics_tpu.analysis.rules import class_allowed_rules, state_allowed_rules
+
+        cls = type(metric).__name__
+        residual_names = set(metric._sync_residual_names())
+        if "MTA006" in class_allowed_rules(type(metric)):
+            return
+        scoped = state_allowed_rules(metric).get("MTA006", set())
+        for sname, red in getattr(metric, "_reductions", {}).items():
+            key = (type(metric), sname)
+            if key in self._identity_probed:
+                continue
+            self._identity_probed.add(key)
+            default = metric._defaults.get(sname)
+            if sname in residual_names or sname in scoped or isinstance(default, list):
+                continue
+            note = _reduction_identity_violation(red, default, default)
+            if note is not None:
+                self.violation("MTA006", "non_identity_reset", f"{cls}.{sname}", note)
+
+    def check_sync_identity(
+        self,
+        metric: Any,
+        pre_states: Dict[str, Any],
+        world: int,
+    ) -> None:
+        """A world-size-1 sync must be an identity: exact states bitwise,
+        quantized states within their documented single-replica bound."""
+        if world != 1:
+            return
+        from metrics_tpu.analysis.distributed import (
+            _exact_state_close,
+            quantized_state_tolerance,
+        )
+        from metrics_tpu.analysis.rules import class_allowed_rules, state_allowed_rules
+
+        cls = type(metric).__name__
+        if "MTA005" in class_allowed_rules(type(metric)):
+            return
+        scoped = state_allowed_rules(metric).get("MTA005", set())
+        precisions = metric.sync_precisions()
+        residual_names = set(metric._sync_residual_names())
+        for sname, before in pre_states.items():
+            if sname in residual_names or sname in scoped or isinstance(before, list):
+                continue
+            if metric._reductions.get(sname) is None:
+                # no declared reduction: sync stacks to (world, ...) by
+                # design; contract questions there belong to MTL104/MTA004
+                # (and the in-program mesh states suppress those), not to
+                # an identity check
+                continue
+            after = getattr(metric, sname, None)
+            if after is None or isinstance(after, list):
+                continue
+            a = np.asarray(before)
+            b = np.asarray(after)
+            tier = precisions.get(sname, "exact")
+            if tier == "exact":
+                ok = _exact_state_close(a, b)[0] if a.shape == b.shape else False
+            elif a.shape != b.shape:
+                ok = False
+            else:
+                tol = quantized_state_tolerance(a[None], tier, 1)
+                if np.issubdtype(a.dtype, np.integer):
+                    tol = max(tol, 1.0)
+                ok = bool(np.all(np.abs(a.astype(np.float64) - b.astype(np.float64)) <= tol))
+            if not ok:
+                self.violation(
+                    "MTA005", "single_replica_sync_drift", f"{cls}.{sname}",
+                    "a world-size-1 sync changed this state"
+                    + ("" if tier == "exact" else f" beyond the {tier} tier bound")
+                    + " — the gather→reduce composite is not an identity on"
+                    " one replica, so it cannot be a sound merge on many",
+                    tier=tier,
+                )
+
+
+# ----------------------------------------------------------------------
+# module-level arm/disarm (telemetry's singleton shape)
+# ----------------------------------------------------------------------
+_active: Optional[MetricSan] = None
+
+
+def active() -> Optional[MetricSan]:
+    """The armed sanitizer (None when disarmed)."""
+    return _active if _env.san_enabled() else None
+
+
+def san_enabled() -> bool:
+    return _env.san_enabled()
+
+
+# (method_owner_attr, method_name) pairs wrapped with allow_state_writes
+# while armed: the sanctioned lifecycle contexts. Wrapping happens on the
+# class object at arm time and is fully undone at disarm, so the unarmed
+# library is bit-for-bit the code that shipped.
+_WRAPPED: List[Tuple[type, str, Any]] = []
+
+
+def _wrap_lifecycle_method(owner: type, name: str, before: Optional[Any] = None) -> None:
+    orig = owner.__dict__.get(name)
+    if orig is None:
+        return
+
+    @functools.wraps(orig)
+    def wrapper(self, *args: Any, **kwargs: Any):
+        if before is not None:
+            before(self)
+        with allow_state_writes():
+            return orig(self, *args, **kwargs)
+
+    _WRAPPED.append((owner, name, orig))
+    setattr(owner, name, wrapper)
+
+
+def _on_reset(metric: Any) -> None:
+    san = _active
+    if san is not None and hasattr(metric, "_defaults"):
+        try:
+            san.check_reset_identity(metric)
+        except MetricSanError:
+            raise
+        except Exception:  # noqa: BLE001 — a probe bug must not break reset
+            pass
+
+
+def _san_setattr(self: Any, name: str, value: Any) -> None:
+    san = _active
+    if (
+        san is not None
+        and _allow_depth() == 0
+        and name in self.__dict__.get("_defaults", ())
+    ):
+        san.violation(
+            "MTA006", "state_write_outside_update",
+            f"{type(self).__name__}.{name}",
+            "registered state written outside a sanctioned lifecycle"
+            " context (update/reset/restore/sync/load/engine write-back) —"
+            " a compute mutating state, or external code poking an"
+            " accumulator",
+        )
+    object.__setattr__(self, name, value)
+
+
+def _install_hooks() -> None:
+    from metrics_tpu.collections import MetricCollection
+    from metrics_tpu.engine import CompiledStepEngine
+    from metrics_tpu.metric import CompositionalMetric, Metric
+
+    if _WRAPPED:  # already installed
+        return
+    Metric.__setattr__ = _san_setattr
+    _wrap_lifecycle_method(Metric, "reset", before=_on_reset)
+    _wrap_lifecycle_method(CompositionalMetric, "reset")
+    _wrap_lifecycle_method(Metric, "_restore_state")
+    _wrap_lifecycle_method(CompositionalMetric, "_restore_state")
+    _wrap_lifecycle_method(Metric, "_merge_states")
+    _wrap_lifecycle_method(Metric, "load_state_dict")
+    _wrap_lifecycle_method(CompositionalMetric, "load_state_dict")
+    _wrap_lifecycle_method(MetricCollection, "load_state_dict")
+    _wrap_lifecycle_method(Metric, "astype")
+    _wrap_lifecycle_method(CompositionalMetric, "astype")
+    _wrap_lifecycle_method(Metric, "to_device")
+    _wrap_lifecycle_method(CompositionalMetric, "to_device")
+    _wrap_lifecycle_method(Metric, "add_state")
+    _wrap_lifecycle_method(Metric, "set_sync_precision")
+    _wrap_lifecycle_method(CompiledStepEngine, "_write_back")
+    _wrap_sync(Metric)
+
+
+def _wrap_sync(owner: type) -> None:
+    """``_sync_dist`` gets a richer wrapper than the plain allow scope:
+    pre-sync state snapshot → sync (sanctioned writes) → the world-size-1
+    identity check."""
+    orig = owner.__dict__.get("_sync_dist")
+    if orig is None:
+        return
+
+    @functools.wraps(orig)
+    def wrapper(self, *args: Any, **kwargs: Any):
+        san = _active
+        pre = snapshot_states(self) if san is not None else None
+        with allow_state_writes():
+            result = orig(self, *args, **kwargs)
+        if san is not None and pre is not None:
+            try:
+                from metrics_tpu.parallel.backend import get_sync_backend
+
+                world = int(get_sync_backend().world_size)
+            except Exception:  # noqa: BLE001 — unknown world: don't guess
+                world = 0
+            san.check_sync_identity(self, pre, world)
+        return result
+
+    _WRAPPED.append((owner, "_sync_dist", orig))
+    setattr(owner, "_sync_dist", wrapper)
+
+
+def _uninstall_hooks() -> None:
+    from metrics_tpu.metric import Metric
+
+    while _WRAPPED:
+        owner, name, orig = _WRAPPED.pop()
+        setattr(owner, name, orig)
+    if Metric.__dict__.get("__setattr__") is _san_setattr:
+        del Metric.__setattr__
+
+
+def enable_san(raise_on_violation: bool = False) -> MetricSan:
+    """Arm MetricSan process-wide. Returns the sanitizer (its
+    ``violations`` list is the machine-readable record)."""
+    global _active
+    _active = MetricSan(raise_on_violation=raise_on_violation)
+    _install_hooks()
+    _env.set_san_enabled(True)
+    return _active
+
+
+def disable_san() -> Optional[MetricSan]:
+    """Disarm and fully undo the hook installation; returns the last
+    sanitizer so callers can inspect its violation log."""
+    global _active
+    _env.set_san_enabled(False)
+    _uninstall_hooks()
+    san, _active = _active, None
+    return san
+
+
+@contextmanager
+def san_scope(raise_on_violation: bool = False) -> Iterator[MetricSan]:
+    """Arm MetricSan for a ``with`` block, restoring the prior state on
+    exit::
+
+        with san_scope() as san:
+            run_eval()
+        assert san.violations == []
+    """
+    prev_active, prev_enabled = _active, _env.san_enabled()
+    san = enable_san(raise_on_violation=raise_on_violation)
+    try:
+        yield san
+    finally:
+        globals()["_active"] = prev_active
+        if prev_active is None or not prev_enabled:
+            _env.set_san_enabled(False)
+            _uninstall_hooks()
+        else:
+            _env.set_san_enabled(True)
+
+
+# --------------------------------------------------------------------
+# engine/metric hook entry points (lazy-imported from the hot paths;
+# every caller guards on env.san_enabled() first)
+# --------------------------------------------------------------------
+def on_engine_dispatch(metrics: Mapping[str, Any], names: Tuple[str, ...]) -> None:
+    san = _active
+    if san is not None:
+        san.check_post_dispatch(metrics, names)
+
+
+def on_sync(metric: Any, pre_states: Dict[str, Any], world: int) -> None:
+    san = _active
+    if san is not None:
+        san.check_sync_identity(metric, pre_states, world)
+
+
+def snapshot_states(metric: Any) -> Dict[str, Any]:
+    """Host copies of the non-list states, for the sync identity check."""
+    out: Dict[str, Any] = {}
+    for sname in metric._defaults:
+        v = getattr(metric, sname, None)
+        if not isinstance(v, list) and v is not None:
+            out[sname] = np.asarray(v).copy()
+    return out
+
+
+if _env.san_requested():  # METRICS_TPU_SAN=1: arm at import
+    enable_san()

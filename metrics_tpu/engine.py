@@ -56,6 +56,7 @@ from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.observability import trace as _trace
 from metrics_tpu.parallel.backend import is_distributed_initialized
 from metrics_tpu.reliability import guard as _rguard
+from metrics_tpu.utilities import env as _env
 from metrics_tpu.utilities.checks import shared_canonicalization
 from metrics_tpu.utilities.prints import warn_once
 from metrics_tpu.utilities.jit import tpu_jit
@@ -491,6 +492,13 @@ class CompiledStepEngine:
                 self._write_back(names, new_states, values)
                 if finites is not None:
                     self._apply_guard_verdicts(guard, names, finites)
+                if _env.san_enabled():
+                    # MetricSan poison-on-donate canary: after a successful
+                    # dispatch, no deleted (donated) buffer may remain
+                    # reachable from the metrics — lazy, cold off-path
+                    from metrics_tpu.analysis import sanitizer as _san
+
+                    _san.on_engine_dispatch(self._metrics, names)
                 for name in names:
                     out[name] = values.get(name)
 

@@ -32,8 +32,11 @@ from typing import Any, Callable, Dict, Optional, Union
 import jax
 import jax.numpy as jnp
 
+from contextlib import nullcontext
+
 from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.observability import trace as _obs_trace
+from metrics_tpu.utilities import env as _env
 from metrics_tpu.parallel import quantize as _quant
 from metrics_tpu.parallel.backend import is_distributed_initialized
 from metrics_tpu.reliability import guard as _rguard
@@ -71,6 +74,24 @@ def _encode_session_cursor(cursor: int) -> Array:
 
 def _decode_session_cursor(value: Any) -> int:
     return int(jnp.asarray(value))
+
+
+_NULL_CTX = nullcontext()
+
+
+def _san_allow_ctx():
+    """Sanctioned state-write scope for MetricSan's write interceptor.
+
+    The update wrapper and forward's residual-seeding writes are
+    legitimate lifecycle writes that are not reachable through the
+    class-level methods the sanitizer wraps at arm time, so they declare
+    themselves here. Zero-overhead when MetricSan is off: one cached
+    flag read and a shared (reentrant) null context."""
+    if _env.san_enabled():
+        from metrics_tpu.analysis import sanitizer as _san
+
+        return _san.allow_state_writes()
+    return _NULL_CTX
 
 
 def _device_owned(v: Any) -> Array:
@@ -371,8 +392,9 @@ class Metric(ABC):
                 # persistent values so a dist_sync_on_step sync compensates
                 # the PREVIOUS step sync's error instead of starting from
                 # the reset zeros every step (a frozen feedback loop)
-                for res_name in self._sync_residual_names():
-                    setattr(self, res_name, cache[res_name])
+                with _san_allow_ctx():
+                    for res_name in self._sync_residual_names():
+                        setattr(self, res_name, cache[res_name])
                 try:
                     self._batch_local_pass = True
                     try:
@@ -400,8 +422,9 @@ class Metric(ABC):
                         r: getattr(self, r) for r in self._sync_residual_names()
                     }
                     self._restore_state(cache)
-                    for r, v in post_sync_residuals.items():
-                        setattr(self, r, v)
+                    with _san_allow_ctx():
+                        for r, v in post_sync_residuals.items():
+                            setattr(self, r, v)
                     self._to_sync = True
                     self._computed = None
 
@@ -421,8 +444,9 @@ class Metric(ABC):
             self.reset()
             # sync-stream seeding, as on the classic path: a step sync must
             # compensate the previous sync's error, not restart from zero
-            for res_name in self._sync_residual_names():
-                setattr(self, res_name, accumulated[res_name])
+            with _san_allow_ctx():
+                for res_name in self._sync_residual_names():
+                    setattr(self, res_name, accumulated[res_name])
             try:
                 self.update(*args, **kwargs)  # the ONLY update: batch stats
             except BaseException:
@@ -644,7 +668,7 @@ class Metric(ABC):
             # span (`metrics_tpu.<Name>.update`) so device profiles
             # attribute compiled time to metric names; a shared null
             # context (one branch) when disabled
-            with _obs.metric_scope(self, "update"):
+            with _obs.metric_scope(self, "update"), _san_allow_ctx():
                 # reliability hook: with a StateGuard installed the update
                 # runs snapshot -> update -> fused isfinite check -> policy;
                 # without one (default) the cost is this one global read
@@ -693,8 +717,9 @@ class Metric(ABC):
                     r: getattr(self, r) for r in self._sync_residual_names()
                 }
                 self._restore_state(cache)
-                for r, v in post_sync_residuals.items():
-                    setattr(self, r, v)
+                with _san_allow_ctx():
+                    for r, v in post_sync_residuals.items():
+                        setattr(self, r, v)
 
             return self._computed
 

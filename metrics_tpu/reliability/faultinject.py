@@ -38,6 +38,7 @@ __all__ = [
     "preempt_at_step",
     "torn_write",
     "cursor_skew",
+    "donation_unsafe_engine",
 ]
 
 
@@ -296,6 +297,46 @@ def torn_write(path: Any, keep_fraction: float = 0.5) -> int:
     new_size = int(size * keep_fraction)
     os.truncate(path, new_size)
     return new_size
+
+
+@contextmanager
+def donation_unsafe_engine() -> Iterator[None]:
+    """While active, :class:`~metrics_tpu.engine.CompiledStepEngine`
+    "donates" without its donation-safe copies: every live state buffer
+    that aliases a registered default is **deleted** when the pytree is
+    built (a copy is dispatched in its place, so the step itself
+    succeeds). This reproduces, on any backend, exactly what real XLA
+    donation does on device when the defensive copies are bypassed — the
+    donated buffer dies while host references (``_defaults``) still point
+    at it. XLA:CPU ignores ``donate_argnums``, so without this injector
+    the use-after-donate hazard is untestable on the CPU suites.
+
+    The MetricSan poison-on-donate canary
+    (:mod:`metrics_tpu.analysis.sanitizer`) must flag it as MTA007."""
+    import jax.numpy as jnp
+
+    from metrics_tpu.engine import CompiledStepEngine
+
+    orig = CompiledStepEngine._donatable_states
+
+    def unsafe(self, names, copy_all: bool = False):
+        out = {}
+        for name in names:
+            m = self._metrics[name]
+            d = {}
+            for sname in m._defaults:
+                v = getattr(m, sname)
+                d[sname] = jnp.array(v, copy=True)
+                if v is m._defaults[sname] and hasattr(v, "delete"):
+                    v.delete()  # what device donation would have done
+            out[name] = d
+        return out
+
+    CompiledStepEngine._donatable_states = unsafe
+    try:
+        yield
+    finally:
+        CompiledStepEngine._donatable_states = orig
 
 
 @contextmanager

@@ -23,6 +23,9 @@ __all__ = [
     "trace_requested",
     "flight_dir",
     "refresh",
+    "san_enabled",
+    "san_requested",
+    "set_san_enabled",
 ]
 
 _TRUTHY = frozenset(("1", "true", "yes", "on"))
@@ -39,6 +42,7 @@ def _read() -> Dict[str, bool]:
         "telemetry": parse_flag(os.environ.get("METRICS_TPU_TELEMETRY")),
         "trace": parse_flag(os.environ.get("METRICS_TPU_TRACE")),
         "flight": (os.environ.get("METRICS_TPU_FLIGHT") or "").strip() or None,
+        "san": parse_flag(os.environ.get("METRICS_TPU_SAN")),
     }
 
 
@@ -67,6 +71,33 @@ def flight_dir() -> Optional[str]:
     """``METRICS_TPU_FLIGHT=<dir>``: enable the failure flight recorder at
     import with ``<dir>`` as the dump directory (None = disabled)."""
     return _flags["flight"]
+
+
+# MetricSan runtime switch. Unlike the flags above this is not purely
+# env-derived: `metrics_tpu.analysis.sanitizer.enable_san()` flips it at
+# run time, and the hot-path hooks in metric.py/engine.py read THIS flag
+# (one function call + dict lookup) instead of importing the sanitizer —
+# which keeps the off state zero-overhead and the import graph acyclic.
+_san_runtime = False
+
+
+def san_requested() -> bool:
+    """``METRICS_TPU_SAN``: arm the MetricSan runtime sanitizer at import
+    (equivalent to ``metrics_tpu.analysis.sanitizer.enable_san()``)."""
+    return _flags["san"]
+
+
+def san_enabled() -> bool:
+    """Is MetricSan currently armed? The ONE check every sanitizer hook
+    makes; keep it a plain global read."""
+    return _san_runtime
+
+
+def set_san_enabled(value: bool) -> None:
+    """Flip the runtime sanitizer flag (called by the sanitizer's
+    enable/disable — not user API)."""
+    global _san_runtime
+    _san_runtime = bool(value)
 
 
 def refresh() -> Dict[str, bool]:

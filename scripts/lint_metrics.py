@@ -1,16 +1,31 @@
-"""Repo analysis gate: run both static-analysis passes, write ANALYSIS.json.
+"""Repo analysis gate: run the static-analysis passes, write ANALYSIS.json.
 
 Usage::
 
     python scripts/lint_metrics.py            # report, exit 0
     python scripts/lint_metrics.py --strict   # exit 1 on any unsuppressed finding
+    python scripts/lint_metrics.py --fingerprints \
+        --diff-fingerprints FINGERPRINTS.json # CI drift sentinel (advisory)
     make lint                                 # the CI spelling (strict)
 
-Pass 1 (:func:`metrics_tpu.analysis.audit_registry`) traces every metric
-family's program and audits accumulator dtypes, host sync, donation
-aliasing, and reduction soundness. Pass 2
-(:func:`metrics_tpu.analysis.lint_paths`) lints the ``metrics_tpu`` source
-tree for the repo invariants (MTL101-MTL104).
+Pass 1 + pass 3 (:func:`metrics_tpu.analysis.audit_registry`) trace every
+metric family's program — and its ``sync_precision="int8"/"bf16"``
+variants — and audit accumulator dtypes, host sync, donation aliasing,
+reduction soundness, N-replica distributed equivalence, state-lifecycle
+soundness, and donation lifetimes. Pass 2
+(:func:`metrics_tpu.analysis.lint_paths`) lints the ``metrics_tpu``
+source tree for the repo invariants (MTL101-MTL105).
+
+``--fingerprints`` adds per-family jaxpr digests (ops × dtypes × shapes
+× static params of the update and compiled-step programs) to the report
+AND refreshes the small committed baseline ``FINGERPRINTS.json``
+(ANALYSIS.json itself is a regenerated-per-run artifact and gitignored).
+``--diff-fingerprints FINGERPRINTS.json`` compares fresh digests against
+that committed baseline and prints every drifted family — the advisory
+CI step that makes unintended semantic drift in a metric's program
+visible in review. Digest drift is *advisory by design*: a jax upgrade
+re-digests everything, and an intended change just needs ``make lint``
+re-run and the refreshed ``FINGERPRINTS.json`` committed.
 
 The combined report is written atomically (tmp + fsync + ``os.replace``
 via ``reliability.journal.atomic_write_json``) so a crashed or ^C'd run
@@ -19,6 +34,7 @@ test_lint_clean.py`` pins the zero-unsuppressed-findings baseline in
 tier-1.
 """
 import argparse
+import json
 import os
 import sys
 import warnings
@@ -30,6 +46,52 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def _load_fingerprints(path: str):
+    """The committed digests from ``path``, or None when unreadable."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return json.load(fh).get("fingerprints") or {}
+    except (OSError, ValueError) as err:
+        print(f"fingerprint diff: cannot read {path} ({err}); skipping")
+        return None
+
+
+def _diff_fingerprints(current: dict, committed, committed_path: str) -> int:
+    """Print the drift between fresh digests and the committed baseline
+    (loaded BEFORE any refresh of the same file — diffing a baseline this
+    run just rewrote would vacuously report no drift); returns the number
+    of drifted/added/removed families."""
+    if committed is None:
+        return 0
+    drift = 0
+    for fam in sorted(set(current) | set(committed)):
+        cur, old = current.get(fam), committed.get(fam)
+        if cur == old:
+            continue
+        drift += 1
+        if old is None:
+            print(f"  NEW      {fam}: {cur}")
+        elif cur is None:
+            print(f"  REMOVED  {fam} (was {old})")
+        else:
+            for leg in sorted(set(cur) | set(old)):
+                if cur.get(leg) != old.get(leg):
+                    print(
+                        f"  DRIFTED  {fam}.{leg}: {old.get(leg)} -> {cur.get(leg)}"
+                        "  (metric program changed: ops/dtypes/shapes differ)"
+                    )
+    if drift:
+        print(
+            f"fingerprint diff: {drift} famil{'y' if drift == 1 else 'ies'} drifted"
+            f" vs {committed_path} — if intended, refresh the committed report"
+            " (`make lint`); if not, a dependency or refactor changed a metric's"
+            " compiled program"
+        )
+    else:
+        print(f"fingerprint diff: no drift vs {committed_path}")
+    return drift
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--strict", action="store_true",
@@ -39,7 +101,19 @@ def main(argv=None) -> int:
     ap.add_argument("--skip-audit", action="store_true",
                     help="pass 2 only (no metric tracing)")
     ap.add_argument("--skip-lint", action="store_true",
-                    help="pass 1 only (no AST lint)")
+                    help="passes 1+3 only (no AST lint)")
+    ap.add_argument("--no-quantized", action="store_true",
+                    help="skip the sync_precision=int8/bf16 variant audits")
+    ap.add_argument("--fingerprints", action="store_true",
+                    help="add per-family jaxpr digests to the report")
+    ap.add_argument("--fingerprints-json", metavar="PATH", default="FINGERPRINTS.json",
+                    help="ALSO write the digests to this small committed"
+                         " baseline file (ANALYSIS.json itself is a"
+                         " regenerated-per-run artifact and gitignored;"
+                         " '-' to skip). Default: FINGERPRINTS.json")
+    ap.add_argument("--diff-fingerprints", metavar="COMMITTED", default=None,
+                    help="compare fresh digests against a committed report"
+                         " (advisory; implies --fingerprints)")
     args = ap.parse_args(argv)
 
     from metrics_tpu.analysis import audit_registry, lint_paths
@@ -47,21 +121,46 @@ def main(argv=None) -> int:
 
     report = {"schema": "metrics_tpu.analysis_report", "version": 1}
     unsuppressed = 0
+    fingerprints = args.fingerprints or args.diff_fingerprints is not None
+
+    # the committed baseline must be read BEFORE any refresh below: with
+    # the default --fingerprints-json the baseline and the diff target are
+    # the same file, and write-then-diff would always report "no drift"
+    committed = (
+        _load_fingerprints(args.diff_fingerprints)
+        if args.diff_fingerprints is not None
+        else None
+    )
 
     if not args.skip_audit:
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")  # config-edge warnings from factories
-            audit = audit_registry()
+            audit = audit_registry(
+                quantized=not args.no_quantized, fingerprints=fingerprints
+            )
         report["program_audit"] = audit
+        if fingerprints:
+            report["fingerprints"] = audit.get("fingerprints", {})
+            if args.fingerprints_json != "-":
+                atomic_write_json(args.fingerprints_json, {
+                    "schema": "metrics_tpu.program_fingerprints",
+                    "version": 1,
+                    "fingerprints": report["fingerprints"],
+                })
+                print(f"wrote {args.fingerprints_json}")
         unsuppressed += audit["summary"]["findings"]
         print(
-            f"pass 1 (program audit): {audit['summary']['families']} families,"
+            f"passes 1+3 (program audit): {audit['summary']['families']} families,"
             f" {audit['summary']['findings']} findings"
             f" ({audit['summary']['suppressed']} suppressed)"
         )
         for fam, entry in audit["families"].items():
             for f in entry["findings"]:
                 print(f"  {f['rule']} {f['subject']}: {f['message']}")
+        if args.diff_fingerprints is not None:
+            _diff_fingerprints(
+                report.get("fingerprints", {}), committed, args.diff_fingerprints
+            )
 
     if not args.skip_lint:
         findings = lint_paths()

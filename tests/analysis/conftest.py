@@ -1,0 +1,20 @@
+"""Shared analysis-suite fixtures.
+
+The full registry audit (passes 1+3 over every family PLUS the
+sync_precision=int8/bf16 variants, with program fingerprints) is the
+single most expensive artifact the suite needs — and it is deterministic.
+One session-scoped run feeds every assertion in test_lint_clean.py and
+test_distributed.py; tier-1 wall-clock is a budget.
+"""
+import warnings
+
+import pytest
+
+from metrics_tpu.analysis import audit_registry
+
+
+@pytest.fixture(scope="session")
+def registry_report():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # config-edge warnings from factories
+        return audit_registry(quantized=True, fingerprints=True)

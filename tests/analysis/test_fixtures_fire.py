@@ -18,6 +18,13 @@ EXPECTED = [
     (fx.NonCommutativeMerge, "MTA004"),
     (fx.MeanWithoutCount, "MTA004"),
     (fx.UnscaledInt8Psum, "MTA004"),
+    (fx.ReplicaDependentCount, "MTA005"),
+    (fx.NonIdentityReset, "MTA006"),
+    (fx.ComputeMutatesState, "MTA006"),
+    (fx.OrphanResidual, "MTA006"),
+    (fx.UntouchedStatePassthrough, "MTA007"),
+    (fx.UnownedLoader, "MTA007"),
+    (fx.StaleSuppression, "MTL105"),
 ]
 
 
@@ -99,14 +106,18 @@ def test_state_scoped_suppression_only_covers_named_states():
     assert result.findings == []
     assert {(f.rule, f.subject) for f in result.suppressed} == {("MTA004", "ScopedSub.acc")}
 
-    # same mapping, wrong state name: the finding stays a finding
+    # same mapping, wrong state name: the finding stays a finding — and
+    # the mapping entry that suppresses nothing is itself flagged stale
+    # (MTL105), the unused-noqa analogue for _analysis_allow
     unscoped = type(
         "UnscopedSub",
         (fx.NonCommutativeMerge,),
         {"_analysis_allow": {"MTA004": ("other_state",)}},
     )
     result = audit_metric(unscoped(), _X)
-    assert {f.rule for f in result.findings} == {"MTA004"}
+    assert {f.rule for f in result.findings} == {"MTA004", "MTL105"}
+    stale = [f for f in result.findings if f.rule == "MTL105"]
+    assert len(stale) == 1 and "other_state" in stale[0].message
     assert result.suppressed == []
 
 
@@ -195,3 +206,16 @@ def test_residual_companion_does_not_satisfy_mean_without_count():
     mean_findings = [f for f in result.findings if "mean" in f.message.lower()]
     assert len(mean_findings) == 1 and mean_findings[0].subject.endswith(".avg")
     assert not any(f.subject.endswith("__qres") for f in result.findings)
+
+
+def test_replica_dependent_count_names_the_divergence():
+    result = audit_metric(fx.ReplicaDependentCount(), _X)
+    assert any("diverges" in f.message for f in result.findings)
+    assert any("batches" in f.subject for f in result.findings)
+
+
+def test_stale_suppression_fixture_names_the_stale_rule():
+    result = audit_metric(fx.StaleSuppression(), _X)
+    assert len(result.findings) == 1
+    assert "MTA003" in result.findings[0].message
+    assert result.suppressed == []

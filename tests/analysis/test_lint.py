@@ -389,5 +389,68 @@ def test_allow_syntax_in_strings_is_not_a_suppression():
 def test_allow_comment_is_rule_specific():
     code = "import jax\nf = jax.jit(lambda x: x)  # metrics-tpu: allow(MTL104)\n"
     findings = lint_source(code, "pkg/mod.py")
+    # the wrong-rule allow suppresses nothing: the MTL102 finding stays
+    # live AND the useless allow is itself flagged stale (MTL105)
+    assert [f.rule for f in findings] == ["MTL102", "MTL105"]
+    assert not findings[0].suppressed and not findings[1].suppressed
+
+
+# ---------------------------------------------------------------------------
+# MTL105 — stale suppressions (unused-noqa analogue)
+# ---------------------------------------------------------------------------
+def test_used_allow_is_not_stale():
+    code = "import jax\nf = jax.jit(lambda x: x)  # metrics-tpu: allow(MTL102)\n"
+    findings = lint_source(code, "pkg/mod.py")
     assert [f.rule for f in findings] == ["MTL102"]
-    assert not findings[0].suppressed
+    assert findings[0].suppressed  # used: no MTL105
+
+
+def test_stale_allow_on_clean_line_flags():
+    code = "x = 1  # metrics-tpu: allow(MTL103)\n"
+    findings = lint_source(code, "pkg/mod.py")
+    assert [f.rule for f in findings] == ["MTL105"]
+    assert "MTL103" in findings[0].message
+    assert findings[0].detail["line"] == 1
+
+
+def test_stale_allow_in_comment_block_flags_at_the_comment_line():
+    code = (
+        "# metrics-tpu: allow(MTL102) — rationale that no longer applies\n"
+        "# (the bare jit below was routed through tpu_jit long ago)\n"
+        "x = 1\n"
+    )
+    findings = lint_source(code, "pkg/mod.py")
+    assert [f.rule for f in findings] == ["MTL105"]
+    assert findings[0].detail["line"] == 1
+
+
+def test_mta_allows_are_exempt_from_lint_staleness():
+    """Class-body MTA allows belong to the program audit (which runs its
+    own staleness check); the lint pass must not second-guess them."""
+    code = (
+        "class Foo:\n"
+        "    # metrics-tpu: allow(MTA001) — program-audit suppression\n"
+        "    pass\n"
+    )
+    assert lint_source(code, "pkg/mod.py") == []
+
+
+def test_mtl105_is_itself_suppressible():
+    code = "x = 1  # metrics-tpu: allow(MTL103, MTL105)\n"
+    findings = lint_source(code, "pkg/mod.py")
+    assert [f.rule for f in findings] == ["MTL105"]
+    assert findings[0].suppressed
+
+
+def test_one_use_marks_only_its_own_comment():
+    """Two allows for the same rule, one used and one stale: staleness is
+    tracked per comment line, not per rule."""
+    code = (
+        "import jax\n"
+        "f = jax.jit(lambda x: x)  # metrics-tpu: allow(MTL102)\n"
+        "y = 2  # metrics-tpu: allow(MTL102)\n"
+    )
+    findings = lint_source(code, "pkg/mod.py")
+    assert [f.rule for f in findings] == ["MTL102", "MTL105"]
+    assert findings[0].suppressed
+    assert findings[1].detail["line"] == 3

@@ -9,27 +9,22 @@ the gate.
 import os
 import subprocess
 import sys
-import warnings
-
 import pytest
 
-from metrics_tpu.analysis import audit_registry, lint_paths
+from metrics_tpu.analysis import lint_paths
 
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-@pytest.fixture(scope="module")
-def registry_report():
-    # one trace of all ~29 families shared by every assertion below —
-    # tier-1 wall-clock is a budget, and the report is deterministic
-    with warnings.catch_warnings():
-        warnings.simplefilter("ignore")
-        return audit_registry()
+# `registry_report` comes session-scoped from conftest.py: ONE audit of
+# every family (plus the sync_precision variants, with fingerprints)
+# shared across the whole analysis suite — the report is deterministic
+# and tier-1 wall-clock is a budget.
 
 
 def test_registry_audit_has_zero_unsuppressed_findings(registry_report):
-    """Acceptance gate: pass 1 over every metric family reports zero
-    unsuppressed violations."""
+    """Acceptance gate: passes 1+3 over every metric family (and every
+    quantized variant) report zero unsuppressed violations."""
     report = registry_report
     assert report["summary"]["families"] >= 29
     offenders = {
@@ -62,12 +57,14 @@ def test_report_schema_is_stable(registry_report):
     assert report["schema"] == "metrics_tpu.analysis_report"
     assert set(report["rules"]) == {
         "MTA001", "MTA002", "MTA003", "MTA004",
-        "MTL101", "MTL102", "MTL103", "MTL104",
+        "MTA005", "MTA006", "MTA007",
+        "MTL101", "MTL102", "MTL103", "MTL104", "MTL105",
     }
     for entry in report["families"].values():
         assert set(entry) == {
             "name", "engine_eligible", "eager_reason",
             "findings", "suppressed", "infos",
+            "distributed", "fingerprints",
         }
 
 

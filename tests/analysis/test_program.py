@@ -278,3 +278,44 @@ def test_watchdog_warning_carries_the_hint():
         wd.note_compile(key, new_signature=False)
     messages = [str(w.message) for w in caught]
     assert any("MTA001" in m and "thrashing" in m for m in messages), messages
+
+
+def test_hint_names_pass3_rules_for_watch_keys():
+    """Watchdog/flight attributions must cover the pass-3 rules: a metric
+    whose last audit holds MTA005/006/007 findings gets a hint naming
+    them (MTA001 still fronts when present — churn is what the watchdog
+    measures)."""
+    audit_metric(fx.ReplicaDependentCount(), _X)
+    hint = hint_for_watch_key("engine[ReplicaDependentCount]")
+    assert hint is not None and "MTA005" in hint and "replica-inequivalence" in hint
+
+    audit_metric(fx.ComputeMutatesState(), _X)
+    hint = hint_for_watch_key("engine[ComputeMutatesState]")
+    assert hint is not None and "MTA006" in hint and "lifecycle-unsound" in hint
+
+    audit_metric(fx.UntouchedStatePassthrough(), _X)
+    hint = hint_for_watch_key("engine[UntouchedStatePassthrough]")
+    assert hint is not None and "MTA007" in hint and "donation-lifetime" in hint
+
+
+def test_hint_name_keying_caveat_latest_audit_wins():
+    """The documented caveat, now pinned: the hint lookup is keyed by bare
+    class name and reflects the MOST RECENT audit of any class with that
+    name. A same-named clean class re-audited afterwards clears the hint;
+    until that re-audit, a stale finding keeps hinting. Treat hints as
+    leads, not verdicts — and treat this test as the contract."""
+    audit_metric(fx.ReplicaDependentCount(), _X)
+    assert hint_for_watch_key("engine[ReplicaDependentCount]") is not None
+
+    # a different class that HAPPENS to share the name (two modules, two
+    # versions of one metric, a test double): latest audit wins the key
+    clean = type(
+        "ReplicaDependentCount", (M.MeanSquaredError,), {}
+    )
+    audit_metric(clean(), (_X[0], _X[0]))
+    assert hint_for_watch_key("engine[ReplicaDependentCount]") is None
+
+    # ...and re-auditing the broken one re-arms the hint (no caching of
+    # cleanliness either — strictly last-writer-wins)
+    audit_metric(fx.ReplicaDependentCount(), _X)
+    assert hint_for_watch_key("engine[ReplicaDependentCount]") is not None

@@ -1,0 +1,260 @@
+"""MetricSan — the runtime sanitizer: healthy runs stay silent, each
+injected fault produces exactly one flight dump naming the MTA rule it
+refutes, and arming/disarming is fully reversible (the unarmed library is
+bit-for-bit the code that shipped)."""
+import glob
+import json
+import os
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+from metrics_tpu.analysis import fixtures as fx
+from metrics_tpu.analysis import san_scope
+from metrics_tpu.analysis.sanitizer import MetricSanError, disable_san, enable_san
+from metrics_tpu.metric import Metric
+from metrics_tpu.observability import flight as _flight
+from metrics_tpu.reliability import faultinject as fi
+from metrics_tpu.utilities import env as _env
+
+_X = jnp.linspace(0.0, 1.0, 8)
+
+
+def _dumps(directory):
+    return sorted(glob.glob(os.path.join(str(directory), "flight-*.json")))
+
+
+@pytest.fixture(autouse=True)
+def _pristine_hooks():
+    """Every test leaves the library disarmed with zero wrapper residue."""
+    yield
+    disable_san()
+    assert "__setattr__" not in Metric.__dict__
+    assert not _env.san_enabled()
+
+
+# ---------------------------------------------------------------------------
+# healthy code under the armed sanitizer: silence
+# ---------------------------------------------------------------------------
+def test_healthy_eager_and_compiled_runs_produce_zero_violations(tmp_path):
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            m = M.MeanSquaredError()
+            for _ in range(3):
+                m(_X, _X)
+            m.compute()
+            m.reset()
+            engine = M.CompiledStepEngine(M.MeanSquaredError())
+            for _ in range(3):
+                engine.step(_X, _X)
+            col = M.MetricCollection(
+                {"mse": M.MeanSquaredError(), "mae": M.MeanAbsoluteError()},
+                compiled=True,
+            )
+            col(_X, _X)
+    assert san.violations == []
+    assert _dumps(tmp_path) == []
+
+
+def test_healthy_quantized_tier_under_san_is_clean(tmp_path):
+    """Residual seeding, sync-stream restores, and tier bookkeeping are
+    sanctioned lifecycle writes — the interceptor must not flag them."""
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            m = M.MeanSquaredError()
+            m.set_sync_precision("int8")
+            for _ in range(2):
+                m(_X, _X)
+            m.compute()
+            m.astype(jnp.float32)
+            sd = m.state_dict()
+            m.load_state_dict(sd)
+    assert san.violations == []
+    assert _dumps(tmp_path) == []
+
+
+def test_checkpoint_roundtrip_and_guard_under_san_is_clean(tmp_path):
+    from metrics_tpu.reliability import guard_scope
+
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            with guard_scope("warn"):
+                m = M.MeanSquaredError()
+                m(_X, _X)
+            m.persistent(True)
+            state = m.state_dict()
+            m2 = M.MeanSquaredError()
+            m2.load_state_dict(state)
+    assert san.violations == []
+    assert _dumps(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# injected faults: exactly one dump each, naming the rule
+# ---------------------------------------------------------------------------
+def test_compute_mutation_dumps_exactly_once_naming_mta006(tmp_path):
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                bad = fx.ComputeMutatesState()
+                bad.update(_X)
+                bad.compute()
+                bad.compute()  # second offence: deduped, still one dump
+    assert [v["rule"] for v in san.violations] == ["MTA006"]
+    dumps = _dumps(tmp_path)
+    assert len(dumps) == 1
+    payload = json.loads(open(dumps[0]).read())
+    assert payload["reason"] == "metricsan_state_write_outside_update"
+    assert "MTA006" in payload["hint"]
+    assert payload["context"]["rule"] == "MTA006"
+
+
+def test_non_identity_reset_dumps_exactly_once_naming_mta006(tmp_path):
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                bad = fx.NonIdentityReset()
+                bad.reset()
+                bad.reset()  # identity probe runs once per class/state
+    assert [v["rule"] for v in san.violations] == ["MTA006"]
+    dumps = _dumps(tmp_path)
+    assert len(dumps) == 1
+    payload = json.loads(open(dumps[0]).read())
+    assert payload["reason"] == "metricsan_non_identity_reset"
+    assert "MTA006" in payload["hint"] and "identity" in payload["hint"]
+
+
+def test_use_after_donate_dumps_exactly_once_naming_mta007(tmp_path):
+    """The donation_unsafe_engine injector deletes live buffers exactly
+    as device donation would when the engine's defensive copies are
+    bypassed (XLA:CPU ignores donate_argnums, so the hazard is otherwise
+    invisible on CPU) — the canary must catch it."""
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                m = M.MeanSquaredError()
+                engine = M.CompiledStepEngine(m)
+                engine.step(_X, _X)  # warm: compile + write back fresh states
+                m.reset()  # live attrs alias the registered defaults again
+                with fi.donation_unsafe_engine():
+                    # cache hit → no retrace; the unsafe donation deletes the
+                    # default-aliased buffers exactly as device donation would
+                    engine.step(_X, _X)
+    rules = {v["rule"] for v in san.violations}
+    assert rules == {"MTA007"}
+    dumps = _dumps(tmp_path)
+    assert len(dumps) == 1
+    payload = json.loads(open(dumps[0]).read())
+    assert payload["reason"] == "metricsan_use_after_donate"
+    assert "MTA007" in payload["hint"] and "donated" in payload["hint"]
+
+
+def test_external_state_poke_is_flagged(tmp_path):
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                m = M.MeanSquaredError()
+                m.total = jnp.asarray(99.0)  # user code poking state
+    assert [v["rule"] for v in san.violations] == ["MTA006"]
+    assert len(_dumps(tmp_path)) == 1
+
+
+def test_single_replica_sync_drift_names_mta005(tmp_path):
+    """A gather→reduce composite that is not an identity at world size 1
+    (here: a doubling reduction) is caught on the cheapest mesh."""
+
+    class DoublingSync(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state(
+                "acc", default=jnp.zeros(()),
+                dist_reduce_fx=lambda stacked: stacked.sum(0) * 2.0,
+            )
+
+        def update(self, x):
+            self.acc = self.acc + jnp.sum(x)
+
+        def compute(self):
+            return self.acc
+
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                m = DoublingSync()
+                m.update(_X)
+                m._sync_dist()  # SingleProcessBackend: world size 1
+    assert [v["rule"] for v in san.violations] == ["MTA005"]
+    payload = json.loads(open(_dumps(tmp_path)[0]).read())
+    assert payload["reason"] == "metricsan_single_replica_sync_drift"
+    assert "MTA005" in payload["hint"]
+
+
+def test_healthy_single_replica_sync_is_identity(tmp_path):
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            m = M.MeanSquaredError()
+            m.update(_X, _X)
+            m._sync_dist()
+    assert san.violations == []
+    assert _dumps(tmp_path) == []
+
+
+# ---------------------------------------------------------------------------
+# arming semantics
+# ---------------------------------------------------------------------------
+def test_raise_mode_raises_metricsan_error():
+    with san_scope(raise_on_violation=True):
+        m = M.MeanSquaredError()
+        with pytest.raises(MetricSanError, match="MTA006"):
+            m.total = jnp.asarray(1.0)
+
+
+def test_disarmed_library_pays_nothing_and_stays_silent(tmp_path):
+    """Off = off: no interceptor installed, no dumps, direct state pokes
+    (however ill-advised) behave exactly as before the sanitizer existed."""
+    assert "__setattr__" not in Metric.__dict__
+    with _flight.flight_scope(tmp_path):
+        m = M.MeanSquaredError()
+        m.total = jnp.asarray(5.0)
+        assert float(m.total) == 5.0
+    assert _dumps(tmp_path) == []
+
+
+def test_san_scope_restores_prior_armed_state():
+    outer = enable_san()
+    try:
+        with san_scope() as inner:
+            assert inner is not outer
+            assert _env.san_enabled()
+        # the outer arming survives the inner scope's exit
+        assert _env.san_enabled()
+        assert "__setattr__" in Metric.__dict__
+    finally:
+        disable_san()
+    assert not _env.san_enabled()
+
+
+def test_env_flag_arms_at_refresh(monkeypatch):
+    monkeypatch.setenv("METRICS_TPU_SAN", "1")
+    flags = _env.refresh()
+    assert flags["san"] is True and _env.san_requested()
+    monkeypatch.delenv("METRICS_TPU_SAN")
+    _env.refresh()
+    assert not _env.san_requested()
+
+
+def test_results_bit_identical_with_and_without_san():
+    m1, m2 = M.MeanSquaredError(), M.MeanSquaredError()
+    v1 = m1(_X, _X * 0.5)
+    with san_scope():
+        v2 = m2(_X, _X * 0.5)
+    assert np.array_equal(np.asarray(v1), np.asarray(v2))
+    assert np.array_equal(np.asarray(m1.compute()), np.asarray(m2.compute()))
