@@ -1,4 +1,4 @@
-.PHONY: ci lint san test test-tpu test-tpu-suite doctest bench bench-sync sentinel dryrun fuzz fuzz-sharded chaos clean
+.PHONY: ci lint san test test-tpu test-tpu-suite doctest bench bench-sync bench-cohort sentinel dryrun fuzz fuzz-sharded chaos clean
 
 ci:
 	# the full CI gate as one machine-runnable target (mirrors
@@ -110,6 +110,18 @@ bench-sync:
 	tail -n 1 bench_sync.txt > bench_sync.json
 	python scripts/perf_sentinel.py --current bench_sync.json
 
+bench-cohort:
+	# multi-tenant cohort legs only (~3 min): the MetricCohort sweep
+	# (1 -> 10k tenants behind one vmapped donated dispatch, power-of-two
+	# capacity buckets) against the 64-tenant sequential-dispatch
+	# baseline. The perf sentinel gates the deterministic acceptance
+	# bounds (cohort_speedup_64 >= 5x, cohort_sublinearity_10k <= 0.25)
+	# strictly and reports ms ratios advisorily. Writes SENTINEL.json;
+	# CI uploads bench_cohort.json + flight dumps as artifacts.
+	METRICS_TPU_FLIGHT=flight-dumps python bench.py --leg-cohort | tee bench_cohort.txt
+	tail -n 1 bench_cohort.txt > bench_cohort.json
+	python scripts/perf_sentinel.py --current bench_cohort.json --strict-bounds
+
 sentinel:
 	# perf-regression sentinel, STRICT: fresh bench.py run compared per leg
 	# against the committed BENCH_r0*.json trajectory; exit 1 on any leg
@@ -141,5 +153,5 @@ dryrun:
 
 clean:
 	rm -rf .pytest_cache .jax_cache flight-dumps bench-traces san-flight-dumps
-	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json ANALYSIS_current.json
+	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json bench_cohort.txt bench_cohort.json ANALYSIS_current.json
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -536,6 +536,143 @@ def _forward_leg() -> None:
     leg("REG_FORWARD_COMPILED_MS", reg_col(True), reg_p, reg_t)
 
 
+def _cohort_leg() -> None:
+    """``--leg-cohort`` child: the multi-tenant vectorized engine sweep.
+
+    One 4-metric classification MetricCollection template, stacked into a
+    :class:`~metrics_tpu.MetricCohort` at 1 / 64 / 1024 / 10000 tenants
+    (power-of-two capacity buckets), ``COHORT <n> <ms>`` per size — one
+    donated vmapped dispatch folding every tenant's 64-row batch. The
+    multi-tenant baseline it displaces: 64 independent ``compiled=True``
+    collections dispatched sequentially on the same data
+    (``COHORT_SEQ64 <ms>``) — the acceptance floor is cohort ≥5× faster
+    at 64 tenants, and per-tenant overhead sublinear at 10k.
+    """
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from metrics_tpu import Accuracy, F1, MetricCohort, MetricCollection, Precision, Recall
+    from metrics_tpu.utilities.jit import enable_persistent_cache
+
+    enable_persistent_cache()
+    B, C = 64, 4
+    sizes = tuple(
+        int(s) for s in os.environ.get("BENCH_COHORT_SIZES", "1,64,1024,10000").split(",")
+    )
+
+    def template():
+        return MetricCollection(
+            [
+                Accuracy(),
+                Precision(num_classes=C, average="macro"),
+                Recall(num_classes=C, average="macro"),
+                F1(num_classes=C, average="macro"),
+            ]
+        )
+
+    def batch(n, seed=0):
+        r = np.random.RandomState(seed)
+        probs = r.rand(n, B, C).astype(np.float32)
+        probs /= probs.sum(-1, keepdims=True)
+        return jnp.asarray(probs), jnp.asarray(r.randint(C, size=(n, B)))
+
+    def block_states(states):
+        for d in states.values():
+            for v in d.values():
+                jax.block_until_ready(v)
+
+    def time_best(fn, reps=3, inner=5):
+        fn()  # warm: trace + compile + transfers
+        best = 1e9
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(inner):
+                fn()
+            best = min(best, (time.perf_counter() - t0) / inner * 1e3)
+        return best
+
+    for n in sizes:
+        cohort = MetricCohort(template(), tenants=n)
+        p, t = batch(n)
+
+        def step(cohort=cohort, p=p, t=t):
+            cohort(p, t)
+            block_states(cohort._states)
+
+        ms = time_best(step, inner=5 if n < 4096 else 3)
+        print("COHORT", n, ms, flush=True)
+
+    # the displaced baseline: one compiled engine per tenant, dispatched
+    # sequentially — N donated dispatches and N cache entries per step
+    seq_n = 64
+    cols = [
+        MetricCollection(
+            [
+                Accuracy(),
+                Precision(num_classes=C, average="macro"),
+                Recall(num_classes=C, average="macro"),
+                F1(num_classes=C, average="macro"),
+            ],
+            compiled=True,
+        )
+        for _ in range(seq_n)
+    ]
+    p, t = batch(seq_n)
+
+    def seq_step():
+        for i, col in enumerate(cols):
+            col(p[i], t[i])
+        for col in cols:
+            for m in col.values():
+                for sname in m._defaults:
+                    jax.block_until_ready(getattr(m, sname))
+
+    print("COHORT_SEQ64", time_best(seq_step, inner=3), flush=True)
+
+
+def _bench_cohort() -> dict:
+    """Parent assembly of the cohort sweep (CPU-forced subprocess, same
+    pattern as the forward legs): per-size ``cohort_forward_{N}_cpu_ms``
+    timings, the 64-tenant sequential baseline, and the derived
+    acceptance metrics — ``cohort_speedup_64`` (sequential / cohort; the
+    ≥5× floor the sentinel bounds) and ``cohort_sublinearity_10k``
+    (t_10k / (10k × t_1); ≪1 means per-tenant overhead is sublinear)."""
+    import os
+    import subprocess
+
+    here = os.path.abspath(__file__)
+    proc = subprocess.run(
+        [sys.executable, here, "--leg-cohort-child"],
+        capture_output=True, text=True, timeout=1800, cwd=os.path.dirname(here),
+    )
+    out = _leg_stdout(proc, "cohort")
+    result: dict = {}
+    sizes = []
+    for line in out.splitlines():
+        if line.startswith("COHORT_SEQ64"):
+            result["cohort_seq64_cpu_ms"] = round(float(line.split()[1]), 3)
+        elif line.startswith("COHORT "):
+            _, n, ms = line.split()
+            sizes.append(int(n))
+            result[f"cohort_forward_{n}_cpu_ms"] = round(float(ms), 3)
+    if not sizes:
+        raise RuntimeError("cohort leg produced no COHORT lines")
+    if "cohort_seq64_cpu_ms" in result and "cohort_forward_64_cpu_ms" in result:
+        result["cohort_speedup_64"] = round(
+            result["cohort_seq64_cpu_ms"] / result["cohort_forward_64_cpu_ms"], 3
+        )
+    if "cohort_forward_10000_cpu_ms" in result and "cohort_forward_1_cpu_ms" in result:
+        t1 = result["cohort_forward_1_cpu_ms"]
+        t10k = result["cohort_forward_10000_cpu_ms"]
+        result["cohort_per_tenant_overhead_us"] = round((t10k - t1) / 9999 * 1e3, 3)
+        result["cohort_sublinearity_10k"] = round(t10k / (10_000 * t1), 6)
+    return result
+
+
 def _bench_module_forward() -> dict:
     """Library-level hot-loop legs (see :func:`_forward_leg`), run
     CPU-forced in a subprocess (the remote-TPU tunnel's ~65ms RTT would
@@ -1065,6 +1202,32 @@ def main() -> None:
     if "--leg-forward" in sys.argv:
         _forward_leg()
         return
+    if "--leg-cohort-child" in sys.argv:
+        _cohort_leg()
+        return
+    if "--leg-cohort" in sys.argv:
+        # cohort legs only (make bench-cohort): the multi-tenant vectorized
+        # engine sweep (1 -> 10k tenants, bucketed) plus the 64-tenant
+        # sequential-dispatch baseline and the derived speedup/sublinearity
+        # acceptance metrics. Same one-JSON-line contract as --leg-sync,
+        # platform pinned "cpu" (the legs are CPU-forced by design).
+        result = {
+            "metric": "cohort legs only (bench.py --leg-cohort)",
+            "platform": "cpu",
+        }
+        cohort_failed = None
+        try:
+            result.update(_bench_cohort())
+        except Exception as err:
+            cohort_failed = err
+            print(f"ERROR: cohort leg failed ({err!r})", file=sys.stderr)
+        print(json.dumps(result))
+        if cohort_failed is not None:
+            # the sweep IS the point of --leg-cohort, and a missing
+            # cohort_speedup_64 leg would make the sentinel's bound gate
+            # vacuously green — fail loudly
+            raise SystemExit(1)
+        return
     if "--leg-sync" in sys.argv:
         # sync legs only (make bench-sync): the 8-virtual-device exact-curve
         # legs plus the binned psum tier incl. its int8/bf16 quantized
@@ -1130,6 +1293,12 @@ def main() -> None:
     except Exception as err:
         print(f"WARNING: module forward leg failed ({err!r})", file=sys.stderr)
         forward_legs = {}
+
+    try:
+        cohort_legs = _bench_cohort()
+    except Exception as err:
+        print(f"WARNING: cohort leg failed ({err!r})", file=sys.stderr)
+        cohort_legs = {}
 
     # north-star proxy (BASELINE.md "sync within +5% of NCCL DDP" is
     # unmeasurable without GPUs): like-for-like sync overhead on this host —
@@ -1208,6 +1377,11 @@ def main() -> None:
         # dispatch per step), plus the regression-family pair whose
         # compiled step reads the inputs once via shared sufficient stats
         **forward_legs,
+        # the multi-tenant vectorized engine: one donated vmapped dispatch
+        # for 1 -> 10k stacked eval streams vs 64 sequential per-collection
+        # dispatches (speedup/sublinearity are the sentinel-bounded
+        # acceptance metrics)
+        **cohort_legs,
         "platform": platform,
     }
 

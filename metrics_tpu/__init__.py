@@ -52,6 +52,7 @@ from metrics_tpu.regression import (  # noqa: F401, E402
 )
 from metrics_tpu.collections import MetricCollection  # noqa: F401, E402
 from metrics_tpu.engine import CompiledStepEngine  # noqa: F401, E402
+from metrics_tpu.cohort import MetricCohort  # noqa: F401, E402
 from metrics_tpu import observability  # noqa: F401, E402
 from metrics_tpu import reliability  # noqa: F401, E402
 from metrics_tpu import analysis  # noqa: F401, E402

@@ -795,6 +795,75 @@ def registry_cases() -> List[Tuple[str, Callable, tuple]]:
 #: quantized wire tiers the registry audit re-proves per eligible family
 QUANTIZED_AUDIT_TIERS = ("int8", "bf16")
 
+#: cohort capacity the registry audit traces the vmapped step at; the
+#: program shape is capacity-independent (vmap batches the same per-tenant
+#: program), so one small bucket proves the structural invariants for all
+_COHORT_AUDIT_CAPACITY = 4
+
+
+def _audit_cohort_variant(metric, args: tuple, fingerprint: bool = False) -> AuditResult:
+    """A slim audit of the vmapped cohort step of an engine-eligible
+    family (reported as ``<Family>@cohort``): the per-tenant math is the
+    already-audited base program, so what the cohort changes — and what is
+    re-proved here on the STACKED pytree — is the donated program shape:
+    MTA002 (no host callbacks survive the vmap), MTA003 (no buffer aliased
+    into two outputs of the stacked donation), MTA007 (no donated stacked
+    invar returned unchanged — ping-pong double-buffering must stay
+    structurally possible for cohorts too). ``fingerprint=True`` digests
+    the vmapped step jaxpr for the drift sentinel."""
+    from metrics_tpu.analysis import distributed as _dist
+    from metrics_tpu.engine import CompiledStepEngine
+
+    cls = type(metric).__name__
+    engine = CompiledStepEngine(metric, observe=False)
+    result = AuditResult(name=cls, engine_eligible=True, eager_reason=None)
+    findings: List[Finding] = []
+    closed = None
+    try:
+        closed, _shapes, n_donated = engine.abstract_cohort_step(
+            *args, capacity=_COHORT_AUDIT_CAPACITY
+        )
+    except Exception as err:  # noqa: BLE001
+        kind = _trace_error_kind(err)
+        msg = str(err).splitlines()[0] if str(err) else type(err).__name__
+        findings.append(Finding(
+            "MTA002", f"{cls}.cohort_step",
+            ("host synchronization while tracing the vmapped cohort step"
+             if kind == "host-sync" else "vmapped cohort step failed to trace")
+            + f" ({type(err).__name__}: {msg}); a MetricCohort of this"
+            " family cannot dispatch",
+            detail={"kind": kind},
+        ))
+    else:
+        for prim in sorted(set(_callback_eqns(closed))):
+            findings.append(Finding(
+                "MTA002", f"{cls}.cohort_step",
+                f"host callback primitive {prim!r} inside the vmapped cohort"
+                " step program",
+            ))
+        for count, positions in _duplicate_outvars(closed):
+            findings.append(Finding(
+                "MTA003", f"{cls}.cohort_step",
+                f"one buffer is aliased into {count} outputs of the donated"
+                f" cohort step (output positions {positions}): donation of"
+                " the stacked pytree double-books the buffer",
+            ))
+        for pos in _dist._donated_passthrough_positions(closed, n_donated):
+            findings.append(Finding(
+                "MTA007", f"{cls}.cohort_step",
+                f"the donated cohort step returns donated stacked input"
+                f" buffer (output position {pos}) unchanged — the cohort"
+                " would hand freshly-donated storage back as live stacked"
+                " state",
+                detail={"position": pos},
+            ))
+    if fingerprint:
+        result.fingerprints = {
+            "cohort_step": _dist.fingerprint_jaxpr(closed) if closed is not None else None,
+        }
+    _route_suppressions(metric, findings, result, check_staleness=False)
+    return result
+
 
 def _audit_quantized_variant(
     metric, args: tuple, probe_cache: Optional[Dict[str, Any]] = None
@@ -839,6 +908,7 @@ def _audit_quantized_variant(
 def audit_registry(
     write_path: Optional[str] = None,
     quantized: bool = True,
+    cohort: bool = True,
     fingerprints: bool = False,
 ) -> Dict[str, Any]:
     """The full static audit over every registered metric family; returns
@@ -848,8 +918,12 @@ def audit_registry(
     and ``"bf16"`` variants of every engine-eligible family with
     quantizable states (reported as ``"<Family>@<tier>"``) — the engine
     keys programs on the precision map, so the variants ARE different
-    programs. ``fingerprints=True`` digests each family's update/step
-    jaxprs into ``report["fingerprints"]`` for the CI drift sentinel.
+    programs. ``cohort=True`` audits every engine-eligible family's
+    vmapped cohort step (``"<Family>@cohort"``): MTA003 donated-aliasing
+    and MTA007 passthrough must hold on the STACKED pytree, not just the
+    per-tenant program. ``fingerprints=True`` digests each family's
+    update/step (and cohort-step) jaxprs into ``report["fingerprints"]``
+    for the CI drift sentinel.
 
     The clean-baseline contract: ``report["summary"]["findings"] == 0``.
     Suppressed findings and design notes (eager-only families) stay
@@ -872,9 +946,14 @@ def audit_registry(
         # pays for them once and the int8/bf16 variants reuse them (only
         # the merge composite differs per tier)
         probe_cache: Dict[str, Any] = {}
-        note(name, audit_metric(
+        base = audit_metric(
             factory(), args, fingerprint=fingerprints, _probe_cache=probe_cache
-        ))
+        )
+        note(name, base)
+        if cohort and base.engine_eligible:
+            note(f"{name}@cohort", _audit_cohort_variant(
+                factory(), args, fingerprint=fingerprints
+            ))
         if not quantized:
             continue
         for tier in QUANTIZED_AUDIT_TIERS:
@@ -938,6 +1017,12 @@ def hint_for_watch_key(key: str) -> Optional[str]:
     two same-named classes collide, and a finding fixed in source still
     hints until the class is re-audited. The hint's "a likely cause"
     phrasing is the contract; treat it as a lead, not a verdict."""
+    # cohort engines suffix their watch key ("engine[A,B]@cohort"): the
+    # suffix routes trace-budget accounting per cohort, not attribution —
+    # strip it so churn on a cohort key still resolves to its members'
+    # findings (MTA001 fronted: unbucketed cohort churn IS signature churn)
+    if key.endswith("@cohort"):
+        key = key[: -len("@cohort")]
     inner = key
     if "[" in key and key.endswith("]"):
         inner = key[key.index("[") + 1:-1]

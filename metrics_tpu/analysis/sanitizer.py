@@ -305,6 +305,7 @@ def _san_setattr(self: Any, name: str, value: Any) -> None:
 
 
 def _install_hooks() -> None:
+    from metrics_tpu.cohort import MetricCohort
     from metrics_tpu.collections import MetricCollection
     from metrics_tpu.engine import CompiledStepEngine
     from metrics_tpu.metric import CompositionalMetric, Metric
@@ -312,6 +313,12 @@ def _install_hooks() -> None:
     if _WRAPPED:  # already installed
         return
     Metric.__setattr__ = _san_setattr
+    # cohort write-back contexts: the vmapped compute installs stacked
+    # state rows onto the template members inside its trace, and unstack
+    # (tenant_collection) seeds clones — both are sanctioned lifecycle
+    # writes, exactly like the engine's _write_back
+    _wrap_lifecycle_method(MetricCohort, "_member_compute")
+    _wrap_lifecycle_method(MetricCohort, "tenant_collection")
     _wrap_lifecycle_method(Metric, "reset", before=_on_reset)
     _wrap_lifecycle_method(CompositionalMetric, "reset")
     _wrap_lifecycle_method(Metric, "_restore_state")

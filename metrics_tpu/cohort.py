@@ -1,0 +1,873 @@
+"""MetricCohort: thousands of eval streams behind ONE donated dispatch.
+
+"Millions of users" means thousands of concurrent, structurally identical
+:class:`~metrics_tpu.MetricCollection`\\ s — per-user, per-model-variant,
+per-A/B-arm — and running each as its own
+:class:`~metrics_tpu.engine.CompiledStepEngine` costs N donated dispatches
+and N cache entries per step. The cohort applies the cross-replica
+weight-update-sharding move (PAPERS.md) to metric state instead of model
+state: stack the N collections' state pytrees along a leading *cohort*
+axis, ``vmap`` the already-traced step program over that axis, and route
+per-tenant rows with tenant-index arrays — one donated, LRU-cached XLA
+dispatch then updates every tenant.
+
+Key design points:
+
+* **Power-of-two capacity buckets.** The stacked state is padded from the
+  live tenant count N up to ``bucket_capacity(N)`` so a 1 → 10k tenant
+  ramp costs one trace per *bucket* (≤ ⌈log2 N⌉ programs), never one per
+  N. The engine keys its signature cache on ``(signature, bucket)`` and
+  the recompilation watchdog accounts the cohort watch key against a
+  bucket-aware budget; unbucketed churn still warns.
+* **Padding slots are inert, not masked per-op.** Under ``vmap`` each
+  tenant's new state depends only on its own rows, so padding slots may
+  accumulate garbage freely — validity is applied at the *read* points
+  (``forward`` values, ``compute``, guard verdicts), which keeps the
+  vmapped program identical to the per-tenant program (the bit-parity
+  contract the test bed pins).
+* **One collective for all tenants.** ``compute()`` under a distributed
+  backend gathers each *stacked* state once (states × world payloads, not
+  tenants × states × world), composing with the quantized
+  ``sync_precision=`` tier: residual companions are registered states, so
+  they gain the cohort axis for free and error feedback stays per-tenant.
+* **Checkpoint parity.** ``state_dict``/``load_state_dict``/
+  ``_named_states`` speak the same protocol as ``MetricCollection``, so
+  validated envelopes (:func:`metrics_tpu.reliability.save_envelope`)
+  round-trip the stacked state — including the active-slot table — under
+  one checksum.
+"""
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from metrics_tpu.engine import CompiledStepEngine, _is_arraylike
+from metrics_tpu.metric import Metric, _device_owned, _san_allow_ctx
+from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.parallel import quantize as _quant
+from metrics_tpu.parallel.backend import is_distributed_initialized
+from metrics_tpu.reliability import sync as _rsync
+from metrics_tpu.utilities.distributed import gather_all_tensors
+from metrics_tpu.utilities.jit import tpu_jit
+from metrics_tpu.utilities.prints import warn_once
+
+__all__ = ["MetricCohort", "bucket_capacity", "route_rows"]
+
+#: checkpoint key of the active-slot table (rides state_dict/_named_states
+#: exactly like member states, so envelopes checksum membership WITH the
+#: stacked state it indexes). Encoded as a FIXED-shape ``(capacity,)``
+#: int8 validity mask — strict envelope validation pins state shapes, and
+#: a variable-length index list would make every membership change a spec
+#: mismatch
+_SLOTS_KEY = "__cohort_slots__"
+
+#: smallest stacked capacity. 2 (not 1) so the canonical 1→10k tenant ramp
+#: stays within ⌈log2(10k)⌉ = 14 buckets: {2, 4, ..., 16384}.
+_MIN_CAPACITY = 2
+
+
+def bucket_capacity(n: int) -> int:
+    """The power-of-two capacity bucket holding ``n`` tenants (min 2).
+
+    >>> [bucket_capacity(n) for n in (1, 2, 3, 9, 10_000)]
+    [2, 2, 4, 16, 16384]
+    """
+    if n < 0:
+        raise ValueError(f"tenant count must be >= 0, got {n}")
+    return max(_MIN_CAPACITY, 1 << max(0, int(n) - 1).bit_length())
+
+
+def route_rows(tenant_ids: jax.Array, *arrays: jax.Array, num_tenants: int):
+    """Route a flat row stream to the cohort's stacked per-tenant layout.
+
+    Serving pipelines deliver interleaved rows tagged with a tenant index;
+    the cohort step wants dense ``(num_tenants, rows_per_tenant, ...)``
+    stacks. One stable argsort of ``tenant_ids`` (ties keep arrival order)
+    plus a gather per array does the routing — fully traceable, no host
+    round-trip.
+
+    Every tenant must contribute the same number of rows (the structurally-
+    identical-streams contract); with concrete ``tenant_ids`` unequal
+    counts raise, under tracing the check is skipped exactly like the
+    library's other eager-only validations.
+    """
+    tenant_ids = jnp.asarray(tenant_ids)
+    if tenant_ids.ndim != 1:
+        raise ValueError(f"tenant_ids must be rank-1, got shape {tenant_ids.shape}")
+    n_rows = tenant_ids.shape[0]
+    if num_tenants < 1 or n_rows % num_tenants:
+        raise ValueError(
+            f"{n_rows} rows do not split evenly over {num_tenants} tenants;"
+            " every tenant must contribute the same number of rows per step"
+        )
+    rows_per_tenant = n_rows // num_tenants
+    from metrics_tpu.utilities.data import _is_concrete
+
+    if _is_concrete(tenant_ids):
+        counts = np.bincount(np.asarray(tenant_ids), minlength=num_tenants)
+        if len(counts) > num_tenants or not (counts == rows_per_tenant).all():
+            raise ValueError(
+                f"tenant_ids rows per tenant {counts.tolist()} != uniform"
+                f" {rows_per_tenant} over {num_tenants} tenants"
+            )
+    order = jnp.argsort(tenant_ids, stable=True)
+    routed = tuple(
+        jnp.asarray(a)[order].reshape((num_tenants, rows_per_tenant) + jnp.shape(a)[1:])
+        for a in arrays
+    )
+    return routed[0] if len(routed) == 1 else routed
+
+
+def _stacked_default(default: jax.Array, capacity: int) -> jax.Array:
+    return jnp.broadcast_to(default, (capacity,) + jnp.shape(default))
+
+
+class MetricCohort:
+    """N structurally-identical metric stacks updated by one donated dispatch.
+
+    Args:
+        metrics: the per-tenant template — a single :class:`Metric`, an
+            ordered ``name -> Metric`` mapping, a list of metrics, or a
+            :class:`~metrics_tpu.MetricCollection`. Every member must be
+            engine-eligible (the cohort has no per-tenant eager fallback:
+            N eager reruns are exactly the cost it exists to remove);
+            ineligible members raise at construction with their reasons.
+        tenants: initial tenant count (slots ``0..tenants-1``).
+        cache_size: LRU capacity of the underlying engine's signature
+            cache (distinct ``(input-signature, capacity-bucket, guard)``
+            programs kept compiled).
+
+    Usage::
+
+        cohort = MetricCohort(MetricCollection([Accuracy(), F1(...)]), tenants=64)
+        values = cohort(preds, target)       # preds: (64, B, C), target: (64, B)
+        per_tenant = cohort.compute()        # {'Accuracy': (64,), 'F1': (64,)}
+
+    Inputs carry the tenant axis first: each array leaf is either
+    ``(len(cohort), ...)`` — one row-block per live tenant, in
+    ``tenant_ids()`` order — or already ``(capacity, ...)`` padded.
+    Flat tagged streams route via :func:`route_rows`.
+
+    Every tenant starts from the registered defaults; to adopt existing
+    accumulated state use :meth:`from_collections`,
+    ``MetricCollection.as_cohort()`` (tenant 0 adopts), or
+    ``add_tenant(state=...)``.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Mapping[str, Metric], Sequence[Metric], Any],
+        tenants: int = 1,
+        cache_size: int = 16,
+    ):
+        self._single = isinstance(metrics, Metric)
+        self._template: "OrderedDict[str, Metric]" = OrderedDict(
+            self._template_items(metrics)
+        )
+        if not self._template:
+            raise ValueError("MetricCohort needs at least one metric")
+        # the engine owns tracing/caching/donation; observe=False at
+        # construction (there is nothing to demote — ineligibility raises
+        # below), dispatch telemetry rides cohort_step per step
+        self._engine = CompiledStepEngine(
+            self._template, cache_size=cache_size, observe=False
+        )
+        if self._engine.eager_fallbacks:
+            raise ValueError(
+                "every cohort member must be engine-eligible (the vmapped"
+                " cohort step has no per-tenant eager fallback); ineligible:"
+                f" {self._engine.eager_fallbacks}"
+            )
+        if int(tenants) < 1:
+            raise ValueError(f"tenants must be >= 1, got {tenants}")
+        self._cache_size = int(cache_size)
+        self._capacity = bucket_capacity(int(tenants))
+        self._active = np.zeros(self._capacity, dtype=bool)
+        self._active[: int(tenants)] = True
+        self._states: Dict[str, Dict[str, jax.Array]] = {
+            name: {
+                sname: _stacked_default(default, self._capacity)
+                for sname, default in m._defaults.items()
+            }
+            for name, m in self._template.items()
+        }
+        self._compute_cache: Tuple[Optional[tuple], Optional[Any]] = (None, None)
+        self._note_membership()
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _template_items(metrics: Any) -> List[Tuple[str, Metric]]:
+        if isinstance(metrics, Metric):
+            return [("metric", metrics)]
+        if isinstance(metrics, Mapping):
+            items = list(metrics.items())
+        elif hasattr(metrics, "items") and hasattr(metrics, "keys"):  # MetricCollection
+            items = list(metrics.items())
+        elif isinstance(metrics, (list, tuple)):
+            items = []
+            for m in metrics:
+                if not isinstance(m, Metric):
+                    raise ValueError(f"{m!r} is not a metrics_tpu.Metric")
+                name = type(m).__name__
+                if any(n == name for n, _ in items):
+                    raise ValueError(f"two template metrics both named {name}")
+                items.append((name, m))
+        else:
+            raise ValueError(f"unknown template input to MetricCohort: {type(metrics)}")
+        for name, m in items:
+            if not isinstance(m, Metric):
+                raise ValueError(f"template member {name!r} is not a metrics_tpu.Metric")
+        return items
+
+    @classmethod
+    def from_collections(cls, collections: Sequence[Any], cache_size: int = 16) -> "MetricCohort":
+        """Stack N independent, structurally-identical collections (or
+        metrics) into one cohort: tenant ``i`` adopts ``collections[i]``'s
+        current state. The first entry becomes the template (deep-copied;
+        the originals are left untouched)."""
+        if not collections:
+            raise ValueError("from_collections needs at least one collection")
+        cohort = cls(deepcopy(collections[0]), tenants=len(collections), cache_size=cache_size)
+        for i, col in enumerate(collections):
+            cohort._adopt_state(i, cohort._extract_states(col))
+        return cohort
+
+    def _extract_states(self, source: Any) -> Dict[str, Dict[str, jax.Array]]:
+        """Per-member state rows from a template-shaped collection/metric,
+        validated against the template's structure."""
+        if isinstance(source, Metric):
+            members: Dict[str, Metric] = {"metric": source}
+        else:
+            members = dict(source.items())
+        if set(members) != set(self._template):
+            raise ValueError(
+                f"structure mismatch: cohort members {sorted(self._template)} !="
+                f" source members {sorted(members)}"
+            )
+        out: Dict[str, Dict[str, jax.Array]] = {}
+        for name, tm in self._template.items():
+            sm = members[name]
+            if set(sm._defaults) != set(tm._defaults):
+                raise ValueError(
+                    f"member {name!r} state mismatch: {sorted(sm._defaults)} !="
+                    f" {sorted(tm._defaults)}"
+                )
+            out[name] = {}
+            for sname, default in tm._defaults.items():
+                v = jnp.asarray(getattr(sm, sname))
+                if v.shape != jnp.shape(default) or v.dtype != jnp.asarray(default).dtype:
+                    raise ValueError(
+                        f"member {name}.{sname}: shape/dtype {v.shape}/{v.dtype}"
+                        f" does not match template"
+                        f" {jnp.shape(default)}/{jnp.asarray(default).dtype}"
+                    )
+                out[name][sname] = v
+        return out
+
+    def _adopt_state(self, slot: int, rows: Dict[str, Dict[str, jax.Array]]) -> None:
+        for name, d in rows.items():
+            for sname, v in d.items():
+                self._states[name][sname] = self._states[name][sname].at[slot].set(v)
+
+    # ------------------------------------------------------------------
+    # membership
+    # ------------------------------------------------------------------
+    def __len__(self) -> int:
+        return int(self._active.sum())
+
+    @property
+    def capacity(self) -> int:
+        """Current padded capacity (a power of two ≥ the tenant count)."""
+        return self._capacity
+
+    def tenant_ids(self) -> Tuple[int, ...]:
+        """Live tenant slots, in the order forward inputs and computed
+        values are laid out."""
+        return tuple(int(i) for i in np.flatnonzero(self._active))
+
+    def _slot_index(self) -> np.ndarray:
+        return np.flatnonzero(self._active)
+
+    def _note_membership(self) -> None:
+        self._compute_cache = (None, None)
+        if _obs.enabled():
+            tel = _obs.get()
+            tel.gauge("cohort.size", len(self))
+            tel.gauge("cohort.capacity", self._capacity)
+
+    def add_tenant(self, state: Optional[Any] = None) -> int:
+        """Admit one tenant; returns its slot id (stable until removed).
+
+        Reuses a freed slot when one exists, else grows the stacked state
+        to the next capacity bucket (padding with registered defaults —
+        the next forward traces the new bucket's program once and the old
+        bucket's program stays cached for shrink-back). ``state`` seeds
+        the new tenant: a template-shaped collection/metric (its current
+        state is adopted) or nothing (registered defaults)."""
+        free = np.flatnonzero(~self._active)
+        if free.size:
+            slot = int(free[0])
+        else:
+            slot = self._capacity
+            self._grow(bucket_capacity(self._capacity + 1))
+        # a reused slot may hold a removed tenant's garbage: re-default it
+        for name, m in self._template.items():
+            for sname, default in m._defaults.items():
+                self._states[name][sname] = (
+                    self._states[name][sname].at[slot].set(default)
+                )
+        self._active[slot] = True
+        if state is not None:
+            self._adopt_state(slot, self._extract_states(state))
+        self._note_membership()
+        return slot
+
+    def remove_tenant(self, tenant: int, return_state: bool = False):
+        """Evict tenant ``tenant``. With ``return_state=True`` the
+        tenant's accumulated state is first unstacked into an independent
+        template clone (see :meth:`tenant_collection`) and returned; the
+        slot is re-defaulted and reusable either way. Capacity never
+        shrinks eagerly — the bucket's compiled program stays warm for the
+        next admission wave."""
+        self._check_tenant(tenant)
+        out = self.tenant_collection(tenant) if return_state else None
+        self._active[tenant] = False
+        for name, m in self._template.items():
+            for sname, default in m._defaults.items():
+                self._states[name][sname] = (
+                    self._states[name][sname].at[tenant].set(default)
+                )
+        self._note_membership()
+        return out
+
+    def _grow(self, new_capacity: int) -> None:
+        for name, m in self._template.items():
+            for sname, default in m._defaults.items():
+                cur = self._states[name][sname]
+                pad = _stacked_default(default, new_capacity - self._capacity)
+                self._states[name][sname] = jnp.concatenate([cur, pad], axis=0)
+        self._active = np.concatenate(
+            [self._active, np.zeros(new_capacity - self._capacity, dtype=bool)]
+        )
+        self._capacity = new_capacity
+
+    def _check_tenant(self, tenant: int) -> None:
+        if not (0 <= int(tenant) < self._capacity) or not self._active[int(tenant)]:
+            raise KeyError(
+                f"no live tenant at slot {tenant} (live: {self.tenant_ids()})"
+            )
+
+    def tenant_collection(self, tenant: int):
+        """Unstack one tenant into an independent object (the inverse of
+        :meth:`from_collections`): a deep copy of the template — a
+        :class:`MetricCollection` for multi-metric cohorts, a bare metric
+        otherwise — holding that tenant's current state."""
+        self._check_tenant(tenant)
+        clones = OrderedDict((n, deepcopy(m)) for n, m in self._template.items())
+        for name, clone in clones.items():
+            with _san_allow_ctx():
+                for sname in clone._defaults:
+                    setattr(clone, sname, self._states[name][sname][int(tenant)])
+            clone._computed = None
+        if self._single:
+            return clones["metric"]
+        from metrics_tpu.collections import MetricCollection
+
+        return MetricCollection(clones)
+
+    # ------------------------------------------------------------------
+    # the hot path
+    # ------------------------------------------------------------------
+    def _route(self, x: Any) -> Any:
+        """One input leaf onto the capacity-padded cohort layout."""
+        if not _is_arraylike(x):
+            return x
+        x = jnp.asarray(x)
+        n = len(self)
+        if x.ndim == 0 or x.shape[0] not in (n, self._capacity):
+            raise ValueError(
+                f"cohort input leaf has leading dim {x.shape[:1]}, expected"
+                f" {n} (one row-block per live tenant) or capacity"
+                f" {self._capacity} (pre-padded); shape {x.shape}"
+            )
+        if x.shape[0] == self._capacity:
+            return x
+        slots = self._slot_index()
+        if slots.size and slots[-1] == n - 1:  # dense prefix: pad, no scatter
+            pad = [(0, 0)] * x.ndim
+            pad[0] = (0, self._capacity - n)
+            return jnp.pad(x, pad)
+        base = jnp.zeros((self._capacity,) + x.shape[1:], x.dtype)
+        return base.at[jnp.asarray(slots)].set(x)
+
+    def _donatable_stacked(self, copy_all: bool = False) -> Dict[str, Dict[str, jax.Array]]:
+        """The stacked pytree as donation-safe buffers: any leaf appearing
+        twice is copied so donation can never double-book one buffer;
+        ``copy_all`` (guard-active steps) copies everything so the live
+        stacked state survives a dispatch that dies after donating."""
+        seen = set()
+        out: Dict[str, Dict[str, jax.Array]] = {}
+        for name, d in self._states.items():
+            nd = {}
+            for sname, v in d.items():
+                if copy_all or id(v) in seen:
+                    v = jnp.array(v, copy=True)
+                seen.add(id(v))
+                nd[sname] = v
+            out[name] = nd
+        return out
+
+    def forward(self, *args: Any, **kwargs: Any):
+        """One vmapped, donated dispatch folding every tenant's batch into
+        its stacked state; returns the per-tenant batch-local values
+        (leading axis = live tenant count, in :meth:`tenant_ids` order).
+        Array inputs carry the tenant axis first (see the class docs);
+        python scalars broadcast to every tenant."""
+        n = len(self)
+        if n == 0:
+            raise ValueError("cohort has no live tenants; add_tenant() first")
+        names = tuple(self._template)
+        # tree_map, not a top-level scan: the engine's in_axes maps EVERY
+        # nested array leaf over axis 0, so routing/padding must reach the
+        # same leaves or a non-full bucket dispatches inconsistent sizes
+        stacked_args = jax.tree_util.tree_map(self._route, tuple(args))
+        stacked_kwargs = jax.tree_util.tree_map(self._route, dict(kwargs))
+        states = self._donatable_stacked(copy_all=_guard_active())
+        # batch-local values are LOCAL by contract (the eager forward sets
+        # `_to_sync = dist_sync_on_step`, which is False for every engine-
+        # eligible metric): pin that during tracing so a distributed
+        # backend can never be reached from inside the traced step — the
+        # cohort syncs at compute() time, one collective for all tenants
+        prev_sync = [(m, m._to_sync) for m in self._template.values()]
+        for m in self._template.values():
+            m._to_sync = False
+        try:
+            new_states, values, finites, guard = self._engine.cohort_step(
+                states,
+                stacked_args,
+                stacked_kwargs,
+                capacity=self._capacity,
+                n_tenants=n,
+            )
+        except Exception:
+            self._check_states_alive()
+            raise
+        finally:
+            for m, p in prev_sync:
+                m._to_sync = p
+        self._states = {name: dict(new_states[name]) for name in names}
+        if finites is not None:
+            self._apply_guard_verdicts(guard, names, finites)
+        from metrics_tpu.utilities import env as _env
+
+        if _env.san_enabled():
+            # MetricSan poison-on-donate canary: the cohort donates only
+            # its own stacked buffers — the template metrics' registered
+            # defaults and attributes must still be alive afterwards
+            from metrics_tpu.analysis import sanitizer as _san
+
+            _san.on_engine_dispatch(self._template, names)
+        out = {
+            name: (self._valid_rows(values[name]) if name in values else None)
+            for name in names
+        }
+        return out["metric"] if self._single else out
+
+    __call__ = forward
+
+    def _valid_rows(self, value: Any) -> Any:
+        """Slice a capacity-stacked value down to the live tenants."""
+        n = len(self)
+        if n == self._capacity:
+            return value
+        slots = self._slot_index()
+        if slots.size and slots[-1] == n - 1:
+            return jax.tree_util.tree_map(lambda v: v[:n], value)
+        idx = jnp.asarray(slots)
+        return jax.tree_util.tree_map(lambda v: v[idx], value)
+
+    def _check_states_alive(self) -> None:
+        for name, d in self._states.items():
+            for sname, v in d.items():
+                if hasattr(v, "is_deleted") and v.is_deleted():
+                    raise RuntimeError(
+                        f"cohort step failed after donating stacked state"
+                        f" {name}.{sname}; accumulated state lost — reset()"
+                        " the cohort or reload a checkpoint"
+                    )
+
+    def _apply_guard_verdicts(self, guard, names, finites) -> None:
+        """Host epilogue of the in-program finite check: one device fetch
+        for every tenant's flags, validity-masked (padding slots may hold
+        garbage by design), one violation per poisoned metric naming the
+        offending tenants. Select policies already rolled the poisoned
+        tenants back in-program — per tenant, not per cohort."""
+        rolled_back = guard.policy in ("raise", "quarantine")
+        host_flags = jax.device_get(finites)
+        live = self._active
+        for name in names:
+            flags = host_flags.get(name)
+            guard.stats["checks"] += 1
+            if flags is None:
+                continue
+            bad = np.flatnonzero(live & ~np.asarray(flags))
+            if bad.size == 0:
+                continue
+            guard.handle_violation(
+                self._template[name],
+                None,
+                context=f"cohort step ({name}, tenants {bad.tolist()})",
+                already_rolled_back=rolled_back,
+            )
+
+    # ------------------------------------------------------------------
+    # compute: one vmapped dispatch for every tenant's epoch value
+    # ------------------------------------------------------------------
+    def _member_compute(self, m: Metric, rows: Dict[str, jax.Array]):
+        """Run one template member's ``compute`` on externally-supplied
+        state rows (traced under vmap). The single sanctioned write
+        context for cohort state installation — MetricSan wraps exactly
+        this method at arm time (see analysis/sanitizer.py)."""
+        saved = m._snapshot_state()
+        prev_sync = m._to_sync
+        try:
+            with _san_allow_ctx():
+                for sname in m._defaults:
+                    setattr(m, sname, rows[sname])
+            # sync happens at cohort level (one collective for ALL
+            # tenants, before this program runs) — the member compute
+            # must not reach a host backend from inside the trace
+            m._to_sync = False
+            m._computed = None
+            return m.compute()
+        finally:
+            m._restore_state(saved)
+            m._to_sync = prev_sync
+            m._computed = None
+
+    def _compute_program(self):
+        key = (
+            self._capacity,
+            tuple(
+                (name, tuple(sorted(m._defaults)))
+                for name, m in self._template.items()
+            ),
+        )
+        cached_key, fn = self._compute_cache
+        if cached_key == key:
+            return fn
+
+        def compute_fn(states):
+            return {
+                name: self._member_compute(self._template[name], states[name])
+                for name in self._template
+            }
+
+        fn = tpu_jit(jax.vmap(compute_fn))
+        self._compute_cache = (key, fn)
+        return fn
+
+    def compute(self, tenant: Optional[int] = None):
+        """Every tenant's epoch value from one vmapped dispatch (or one
+        tenant's with ``tenant=``). Under a distributed backend the
+        stacked states are synced first — one collective per state for the
+        whole cohort — then restored, keeping committed quantization
+        residuals, exactly mirroring ``Metric.compute`` semantics."""
+        synced_cache = None
+        if is_distributed_initialized():
+            synced_cache = {
+                name: dict(d) for name, d in self._states.items()
+            }
+            self._sync_stacked()
+        try:
+            values = self._compute_program()(self._states)
+        finally:
+            if synced_cache is not None:
+                # keep the residual companions the sync just committed
+                # (they describe the error that actually crossed the
+                # wire); everything else resumes un-synced accumulation
+                for name, m in self._template.items():
+                    residuals = set(m._sync_residual_names())
+                    for sname in m._defaults:
+                        if sname not in residuals:
+                            self._states[name][sname] = synced_cache[name][sname]
+        if tenant is not None:
+            self._check_tenant(tenant)
+            values = jax.tree_util.tree_map(lambda v: v[int(tenant)], values)
+        else:
+            values = {n: self._valid_rows(v) for n, v in values.items()}
+        return values["metric"] if self._single else values
+
+    # ------------------------------------------------------------------
+    # cohort sync: one collective per STATE, not per tenant x state
+    # ------------------------------------------------------------------
+    def _sync_stacked(self) -> None:
+        """Gather-then-reduce every stacked state across ranks in one
+        collective each, with the quantized ``sync_precision=`` tier
+        applied to the stacked array (blocks span tenants; the per-element
+        error bound is unchanged) and per-tenant error-feedback residuals
+        committed only on collective success. Degradation is atomic across
+        the whole cohort — mixed world/local tenants would be silently
+        wrong, not degraded."""
+        telemetry_on = _obs.enabled()
+        input_dict: Dict[Tuple[str, str], jax.Array] = {}
+        wire_dict: Dict[Tuple[str, str], Any] = {}
+        new_residuals: Dict[Tuple[str, str], jax.Array] = {}
+        reductions: Dict[Tuple[str, str], Any] = {}
+        precisions: Dict[Tuple[str, str], str] = {}
+        for name, m in self._template.items():
+            res_names = set(m._sync_residual_names())
+            member_prec = getattr(m, "_sync_precisions", {})
+            for sname, red in m._reductions.items():
+                if sname in res_names:
+                    continue  # residuals never cross the wire
+                key = (name, sname)
+                x = self._states[name][sname]
+                input_dict[key] = x
+                reductions[key] = red
+                if sname in member_prec:
+                    precisions[key] = member_prec[sname]
+                    payload, new_res = _quant.compensate_and_quantize(
+                        x,
+                        self._states[name][sname + "__qres"],
+                        member_prec[sname],
+                    )
+                    wire_dict[key] = payload
+                    new_residuals[key] = new_res
+                else:
+                    # exact states cross the wire as COPIES, never the live
+                    # stacked buffer: peers hold their gathered references
+                    # across this rank's next donated dispatch, and donation
+                    # would delete the buffer out from under their reduction
+                    # (quantized payloads are fresh arrays by construction).
+                    # The plain Metric sync path never hits this because a
+                    # distributed engine demotes to eager — the cohort is
+                    # the one donated dispatcher that runs under a backend.
+                    wire_dict[key] = jnp.array(x, copy=True)
+        if telemetry_on:
+            tel = _obs.get()
+            payload = sum(_obs.array_nbytes(v) for v in input_dict.values())
+            wire = sum(
+                _obs.array_nbytes(v)
+                for w in wire_dict.values()
+                for v in jax.tree_util.tree_leaves(w)
+            )
+            tel.count("sync.calls")
+            tel.count("cohort.sync_collectives", len(wire_dict))
+            tel.count("sync.payload_bytes", payload)
+            tel.count("sync.wire_bytes", wire)
+            tel.observe_hist("sync.payload_bytes", payload, _obs.PAYLOAD_BUCKETS_BYTES)
+            tel.observe_hist("sync.wire_bytes", wire, _obs.PAYLOAD_BUCKETS_BYTES)
+            tel.event(
+                "cohort_sync",
+                tenants=len(self),
+                capacity=self._capacity,
+                states=len(wire_dict),
+                payload_bytes=payload,
+                wire_bytes=wire,
+            )
+        guarded = _rsync.apply_sync_policy(gather_all_tensors)
+        degraded = False
+        gathered: Dict[Tuple[str, str], Any] = {}
+        try:
+            for key, w in wire_dict.items():
+                gathered[key] = jax.tree_util.tree_map(guarded, w)
+        except _rsync.SyncFailedError as err:
+            local_only = _rsync.degraded_local_fallback(err)
+            if local_only is None:
+                raise
+            # degraded local-only: exact local states for every tier (no
+            # bytes crossed the wire), residuals untouched
+            gathered = {k: jax.tree_util.tree_map(local_only, v) for k, v in input_dict.items()}
+            degraded = True
+        for key, red in reductions.items():
+            if not degraded and key in precisions:
+                g = gathered[key]  # payload dict of per-rank lists
+                world = len(g["q"])
+                local = input_dict[key]
+                self._states[key[0]][key[1]] = _quant.merge_dequantized(
+                    [{k: v[r] for k, v in g.items()} for r in range(world)],
+                    jnp.shape(local),
+                    local.dtype,
+                )
+                continue
+            stacked = jnp.stack(list(gathered[key]))
+            reduced = red(stacked) if red is not None else stacked
+            self._states[key[0]][key[1]] = reduced
+        if not degraded:
+            for (name, sname), res in new_residuals.items():
+                self._states[name][sname + "__qres"] = res
+
+    # ------------------------------------------------------------------
+    # lifecycle / checkpointing
+    # ------------------------------------------------------------------
+    def reset(self) -> None:
+        """Reset every tenant to the registered defaults (membership and
+        capacity are kept)."""
+        self._states = {
+            name: {
+                sname: _stacked_default(default, self._capacity)
+                for sname, default in m._defaults.items()
+            }
+            for name, m in self._template.items()
+        }
+
+    def _slots_state(self) -> jax.Array:
+        return jnp.asarray(self._active.astype(np.int8))
+
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
+        """Persistent stacked states plus the active-slot table, member-
+        prefixed like ``MetricCollection.state_dict``."""
+        destination = {} if destination is None else destination
+        for name, m in self._template.items():
+            for sname in m._defaults:
+                if m._persistent[sname]:
+                    destination[f"{prefix}{name}.{sname}"] = self._states[name][sname]
+        destination[prefix + _SLOTS_KEY] = self._slots_state()
+        return destination
+
+    def _named_states(self, prefix: str = "") -> list:
+        """Every loadable (key, value) pair — the full stacked state plus
+        the slot table, so envelopes checksum membership with the state it
+        indexes (see ``reliability/checkpoint.py``)."""
+        pairs = []
+        for name, m in self._template.items():
+            for sname in m._defaults:
+                pairs.append((f"{prefix}{name}.{sname}", self._states[name][sname]))
+        pairs.append((prefix + _SLOTS_KEY, self._slots_state()))
+        return pairs
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "", strict: bool = False) -> None:
+        """Restore stacked states saved by :meth:`state_dict` (or carried
+        in a validated envelope). A checkpoint from a different capacity
+        bucket resizes this cohort to match — all loaded stacks must agree
+        on their leading dim. Loaded buffers are imported via the
+        device-owned copy (the PR-4 donation-corruption fix applies to
+        stacked state identically)."""
+        incoming: Dict[str, Dict[str, jax.Array]] = {}
+        caps = set()
+        missing = []
+        for name, m in self._template.items():
+            for sname in m._defaults:
+                key = f"{prefix}{name}.{sname}"
+                if key in state_dict:
+                    v = _device_owned(state_dict[key])
+                    incoming.setdefault(name, {})[sname] = v
+                    caps.add(int(v.shape[0]) if v.ndim else -1)
+                else:
+                    missing.append(key)
+        if strict and missing:
+            raise KeyError(
+                f"strict load_state_dict: MetricCohort is missing state keys {missing}"
+            )
+        slots_key = prefix + _SLOTS_KEY
+        # the slot table loads even when NO member state matched: a
+        # persistent-only state_dict() of an all-default-persistence
+        # template carries nothing but the slot mask, and membership must
+        # still round-trip (dropping it would silently resurrect removed
+        # tenants)
+        slots_mask = None
+        if slots_key in state_dict:
+            slots_mask = np.asarray(state_dict[slots_key]).ravel() != 0
+            if incoming:
+                caps.add(int(slots_mask.size))
+        if not incoming and slots_mask is None:
+            if state_dict:
+                warn_once(
+                    f"load_state_dict: no cohort state key (prefix={prefix!r})"
+                    f" matched the non-empty state_dict ({len(state_dict)}"
+                    " entries); nothing was loaded. Check the prefix used at"
+                    " save time or pass strict=True.",
+                    key=f"load-zero-match:MetricCohort:{prefix}",
+                )
+            return
+        if incoming and (len(caps) != 1 or -1 in caps):
+            raise ValueError(
+                f"loaded cohort stacks disagree on capacity: {sorted(caps)};"
+                " a partial load cannot resize the cohort"
+            )
+        new_capacity = caps.pop() if incoming else int(slots_mask.size)
+        if new_capacity != self._capacity:
+            if missing:
+                raise ValueError(
+                    f"capacity change ({self._capacity} -> {new_capacity})"
+                    f" requires a complete load; missing: {missing}"
+                )
+            self._capacity = int(new_capacity)
+            self._active = np.zeros(self._capacity, dtype=bool)
+            self.reset()
+        for name, d in incoming.items():
+            for sname, v in d.items():
+                self._states[name][sname] = v
+        if slots_mask is not None:
+            if slots_mask.size != self._capacity:
+                raise ValueError(
+                    f"loaded slot mask has {slots_mask.size} entries, capacity"
+                    f" is {self._capacity}"
+                )
+            self._active = slots_mask.astype(bool)
+        else:
+            warn_once(
+                "load_state_dict: cohort checkpoint carries no"
+                f" {_SLOTS_KEY!r} slot table; assuming every slot is a live"
+                " tenant",
+                key=f"cohort-no-slots:{prefix}",
+            )
+            self._active = np.ones(self._capacity, dtype=bool)
+        self._note_membership()
+
+    def persistent(self, mode: bool = True) -> None:
+        """Toggle whether stacked states land in ``state_dict`` (delegates
+        to the template's per-state flags)."""
+        for m in self._template.values():
+            m.persistent(mode)
+
+    # compiled programs close over the template instances and hold
+    # unpicklable XLA executables: copies/pickles drop them and rebuild
+    # lazily against their own template objects (same contract as
+    # MetricCollection.__getstate__)
+    def __getstate__(self) -> dict:
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("_engine", "_compute_cache")
+        }
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        self._engine = CompiledStepEngine(
+            self._template, cache_size=self._cache_size, observe=False
+        )
+        self._compute_cache = (None, None)
+
+    # ------------------------------------------------------------------
+    # diagnostics
+    # ------------------------------------------------------------------
+    def cache_info(self) -> Dict[str, Any]:
+        """Engine cache diagnostics (compiled signatures include one entry
+        per live capacity bucket)."""
+        return self._engine.cache_info()
+
+    def keys(self):
+        return self._template.keys()
+
+    def items(self):
+        return self._template.items()
+
+    def __repr__(self) -> str:
+        body = "\n".join(f"  ({k}): {m!r}" for k, m in self._template.items())
+        return (
+            f"MetricCohort(tenants={len(self)}, capacity={self._capacity},\n{body}\n)"
+        )
+
+
+def _guard_active() -> bool:
+    from metrics_tpu.reliability import guard as _rguard
+
+    return _rguard.active() is not None

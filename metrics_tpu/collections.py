@@ -200,6 +200,21 @@ class MetricCollection:
         mc.prefix = self._check_prefix_arg(prefix)
         return mc
 
+    def as_cohort(self, tenants: int = 1, cache_size: int = 16):
+        """Stack ``tenants`` independent copies of this collection into a
+        :class:`~metrics_tpu.cohort.MetricCohort`: one donated, vmapped
+        dispatch then updates every tenant's state per step. Tenant 0
+        adopts THIS collection's current accumulated state (the remaining
+        tenants start from registered defaults); the collection itself is
+        left untouched — a serving loop migrates by calling ``as_cohort``
+        once and routing subsequent batches through the cohort. Requires
+        every member to be engine-eligible (see the cohort docs)."""
+        from metrics_tpu.cohort import MetricCohort
+
+        cohort = MetricCohort(deepcopy(self), tenants=tenants, cache_size=cache_size)
+        cohort._adopt_state(0, cohort._extract_states(self))
+        return cohort
+
     # compiled programs close over THESE metric instances and hold
     # unpicklable XLA executables: a copy/pickle drops the engine and lazily
     # rebuilds it against its own metric objects on the next forward

@@ -68,6 +68,25 @@ __all__ = ["CompiledStepEngine"]
 # compiled — its state merge is not a pure elementwise fold
 _DEFAULT_CACHE_SIZE = 16
 
+# trace budget for the cohort watch key: a bucketed tenant ramp legitimately
+# traces once per power-of-two capacity bucket (1 -> 64k tenants is 16
+# buckets), so the cohort budget is bucket-aware where the per-signature
+# step budget is not. Unbucketed callers (a new capacity every step) blow
+# through it quickly and get the watchdog churn warning, which is the point.
+_COHORT_TRACE_BUDGET = 16
+
+
+def _is_arraylike(x: Any) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def _cohort_in_axes(tree: Any) -> Any:
+    """``vmap`` in_axes pytree for one input container of the cohort step:
+    array leaves map over the leading cohort axis, python scalars/strings
+    broadcast unmapped (they are static program constants, exactly as the
+    signature cache keys them)."""
+    return jax.tree_util.tree_map(lambda x: 0 if _is_arraylike(x) else None, tree)
+
 
 def _abstract_leaf(x: Any) -> Any:
     """Cache-key atom for one input leaf: arrays key on (shape, dtype);
@@ -264,6 +283,165 @@ class CompiledStepEngine:
         return step_fn
 
     # ------------------------------------------------------------------
+    # the cohort step: the same traced program, vmapped over a leading
+    # tenant axis — N structurally-identical eval streams in ONE dispatch
+    # ------------------------------------------------------------------
+    def _make_cohort_step_fn(
+        self,
+        names: Tuple[str, ...],
+        guard_token: Optional[str] = None,
+        observe: bool = True,
+    ) -> Callable:
+        """The per-tenant step program vmapped over the leading cohort axis
+        of the state pytree and every array input. Tracing cost is
+        independent of the cohort size (vmap traces the per-tenant program
+        once with batched tracers), so a (signature, capacity-bucket)
+        cache entry amortizes over thousands of tenants."""
+        base = self._make_step_fn(names, guard_token, observe=False)
+
+        def cohort_step_fn(states, args, kwargs):
+            # tracer-side retrace counter, keyed per cohort engine with a
+            # bucket-aware budget: one trace per power-of-two capacity
+            # bucket is a legitimately warming ramp, a fresh capacity every
+            # step is churn the watchdog must flag (see ISSUE: unbucketed
+            # cohort use defeats the LRU exactly like shape polymorphism)
+            if observe:
+                self.trace_count += 1
+                _obs.note_trace(
+                    self._cohort_watch_key,
+                    budget=max(_COHORT_TRACE_BUDGET, self._cache_size),
+                )
+            in_axes = (0, _cohort_in_axes(args), _cohort_in_axes(kwargs))
+            return jax.vmap(base, in_axes=in_axes)(states, args, kwargs)
+
+        return cohort_step_fn
+
+    @property
+    def _cohort_watch_key(self) -> str:
+        return self._watch_key + "@cohort"
+
+    def cohort_step(
+        self,
+        states: Dict[str, Dict[str, jax.Array]],
+        args: tuple,
+        kwargs: Optional[dict] = None,
+        *,
+        capacity: int,
+        n_tenants: Optional[int] = None,
+    ):
+        """One donated, LRU-cached dispatch updating every tenant of a
+        stacked-state cohort (see :class:`~metrics_tpu.cohort.MetricCohort`,
+        which owns the stacked pytree, padding, and write-back).
+
+        ``states`` is the stacked pytree (leading axis ``capacity`` on
+        every leaf); array leaves of ``args``/``kwargs`` carry the same
+        leading axis. Returns ``(new_states, values, finites, guard)`` —
+        ``finites`` is None without an active guard, else a per-metric
+        ``(capacity,)`` bool array with the in-program last-good rollback
+        already applied for select policies.
+
+        Unlike :meth:`step` there is no per-tenant eager fallback: N eager
+        reruns are exactly the cost the cohort exists to remove, so every
+        metric must be engine-eligible (the cohort constructor enforces
+        this) and a failed dispatch propagates after dropping the cached
+        program.
+        """
+        kwargs = dict(kwargs or {})
+        names = self._compiled_names()
+        if self._eager_names or not names:
+            raise ValueError(
+                "cohort dispatch requires every metric in the engine to be"
+                f" engine-eligible; eager fallbacks: {self._eager_names}"
+            )
+        with self._lock:
+            if _trace.tracing_enabled() or _flight.flight_enabled():
+                _trace.advance_step()
+            guard = _rguard.active()
+            guard_token = self._guard_token(guard)
+            with _trace.span(
+                "engine.cache_lookup", phase="dispatch", engine=self._cohort_watch_key
+            ):
+                signature = self._signature(
+                    names, args, kwargs, guard_token, cohort=int(capacity)
+                )
+                fn, cache_hit = self._get_compiled(
+                    signature, names, guard_token, maker=self._make_cohort_step_fn
+                )
+            telemetry_on = _obs.enabled()
+            if telemetry_on:
+                tel = _obs.get()
+                tel.count("engine.dispatches")
+                tel.count("cohort.dispatches")
+                if n_tenants is not None:
+                    tel.count("cohort.dispatch_tenants", n_tenants)
+                t0 = _time.perf_counter()
+            if _flight.flight_enabled():
+                _flight.record(
+                    "cohort_dispatch",
+                    engine=self._cohort_watch_key,
+                    cache_hit=cache_hit,
+                    capacity=int(capacity),
+                )
+            try:
+                with _trace.span(
+                    "engine.dispatch",
+                    phase="dispatch",
+                    engine=self._cohort_watch_key,
+                    cache_hit=cache_hit,
+                ):
+                    out = fn(states, args, kwargs)
+            except Exception:
+                # never reuse a program whose dispatch died; the cohort
+                # owner decides whether its stacked state survived (CPU
+                # ignores donation; on accelerators the buffers are gone)
+                self._compiled.pop(signature, None)
+                if telemetry_on:
+                    _obs.get().count("engine.trace_failures")
+                raise
+            if telemetry_on and not cache_hit:
+                _obs.get().observe("engine.trace_s", _time.perf_counter() - t0)
+        if guard_token is None:
+            new_states, values = out
+            finites = None
+        else:
+            new_states, values, finites = out
+        return new_states, values, finites, guard
+
+    def abstract_cohort_step(self, *args: Any, capacity: int = 4, **kwargs: Any):
+        """Trace the vmapped cohort step abstractly (no compile, no
+        dispatch): returns ``(closed_jaxpr, out_shapes, n_donated_leaves)``
+        for the exact program :meth:`cohort_step` would jit at this
+        capacity — the static-analysis hook for the cohort variant audit
+        (MTA003 donated aliasing and MTA007 passthrough must hold on the
+        STACKED pytree, not just the per-tenant program). Inputs are the
+        per-tenant sample args; array leaves are broadcast up the cohort
+        axis here."""
+        names = self._compiled_names()
+        if not names:
+            raise ValueError(
+                "every metric in this engine runs eager"
+                f" ({self._eager_names}); there is no cohort step program to trace"
+            )
+
+        def _stack(x):
+            if _is_arraylike(x):
+                x = jnp.asarray(x)
+                return jnp.broadcast_to(x, (int(capacity),) + x.shape)
+            return x
+
+        base = self._donatable_states(names)
+        states = {
+            n: {s: _stack(v) for s, v in d.items()} for n, d in base.items()
+        }
+        args = tuple(_stack(a) for a in args)
+        kwargs = {k: _stack(v) for k, v in kwargs.items()}
+        n_donated = len(jax.tree_util.tree_leaves(states))
+        closed, out_shapes = jax.make_jaxpr(
+            self._make_cohort_step_fn(names, None, observe=False), return_shape=True
+        )(states, args, kwargs)
+        return closed, out_shapes, n_donated
+
+    # ------------------------------------------------------------------
     # signature cache
     # ------------------------------------------------------------------
     def _signature(
@@ -272,17 +450,29 @@ class CompiledStepEngine:
         args: tuple,
         kwargs: dict,
         guard_token: Optional[str] = None,
+        cohort: Optional[int] = None,
     ) -> tuple:
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
         # the quantized sync tier is part of the program identity: a
         # precision flip changes the state pytree (residual companions
         # appear/disappear) and, later, any sync folded into the step — a
-        # stale same-shape program must never be reused across tiers
+        # stale same-shape program must never be reused across tiers.
+        # `cohort` (the capacity bucket) separates vmapped cohort programs
+        # from the plain step AND from other bucket sizes: with power-of-
+        # two bucketing a 1 -> 10k tenant ramp costs one trace per bucket,
+        # never one per N.
         precisions = tuple(
             (n, tuple(sorted(getattr(self._metrics[n], "_sync_precisions", {}).items())))
             for n in names
         )
-        return (names, precisions, guard_token, treedef, tuple(_abstract_leaf(x) for x in leaves))
+        return (
+            names,
+            precisions,
+            guard_token,
+            cohort,
+            treedef,
+            tuple(_abstract_leaf(x) for x in leaves),
+        )
 
     @staticmethod
     def _guard_token(guard) -> Optional[str]:
@@ -296,9 +486,16 @@ class CompiledStepEngine:
         return "select" if guard.policy in ("raise", "quarantine") else "flag"
 
     def _get_compiled(
-        self, signature: tuple, names: Tuple[str, ...], guard_token: Optional[str] = None
+        self,
+        signature: tuple,
+        names: Tuple[str, ...],
+        guard_token: Optional[str] = None,
+        maker: Optional[Callable] = None,
     ) -> Tuple[Callable, bool]:
-        """Returns ``(step_fn, cache_hit)`` for the signature."""
+        """Returns ``(step_fn, cache_hit)`` for the signature. ``maker``
+        overrides the step-program factory (the cohort path passes
+        :meth:`_make_cohort_step_fn`); plain and cohort programs share one
+        LRU — their signatures differ by the cohort token."""
         hit = self._compiled.get(signature)
         if hit is not None:
             self._compiled.move_to_end(signature)
@@ -317,7 +514,7 @@ class CompiledStepEngine:
         if len(self._seen_signatures) >= 4096:
             self._seen_signatures.clear()  # polymorphic caller: stay bounded
         self._seen_signatures.add(signature)
-        fn = tpu_jit(self._make_step_fn(names, guard_token), donate_argnums=(0,))
+        fn = tpu_jit((maker or self._make_step_fn)(names, guard_token), donate_argnums=(0,))
         if len(self._compiled) >= self._cache_size:
             self._compiled.popitem(last=False)  # LRU eviction
             if _obs.enabled():

@@ -104,6 +104,8 @@ def main(argv=None) -> int:
                     help="passes 1+3 only (no AST lint)")
     ap.add_argument("--no-quantized", action="store_true",
                     help="skip the sync_precision=int8/bf16 variant audits")
+    ap.add_argument("--no-cohort", action="store_true",
+                    help="skip the vmapped cohort-step variant audits")
     ap.add_argument("--fingerprints", action="store_true",
                     help="add per-family jaxpr digests to the report")
     ap.add_argument("--fingerprints-json", metavar="PATH", default="FINGERPRINTS.json",
@@ -136,7 +138,9 @@ def main(argv=None) -> int:
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")  # config-edge warnings from factories
             audit = audit_registry(
-                quantized=not args.no_quantized, fingerprints=fingerprints
+                quantized=not args.no_quantized,
+                cohort=not args.no_cohort,
+                fingerprints=fingerprints,
             )
         report["program_audit"] = audit
         if fingerprints:

@@ -61,6 +61,13 @@ _LEG_RE = re.compile(r"(^value$|_ms$)")
 DEFAULT_LEG_THRESHOLDS: Dict[str, float] = {
     "binned_sync_8dev_int8_cpu_ms": 1.75,
     "binned_sync_8dev_bf16_cpu_ms": 1.75,
+    # the multi-tenant cohort sweep (one vmapped donated dispatch for N
+    # stacked eval streams): sub-5ms legs mostly skip via --min-ms, the
+    # 1024/10k-tenant legs and the sequential baseline gate at the default
+    # ratio — registered here so the legs are load-bearing from round r06
+    "cohort_forward_1024_cpu_ms": 1.75,
+    "cohort_forward_10000_cpu_ms": 1.75,
+    "cohort_seq64_cpu_ms": 1.75,
 }
 
 # absolute bound legs: non-millisecond metrics where the gate is a fixed
@@ -76,6 +83,12 @@ BOUND_LEGS: Dict[str, Tuple[str, float]] = {
     # logical/wire payload bytes of the int8 tier (the ≥3x compression
     # acceptance floor; 3.88x by construction at block size 128)
     "sync_payload_ratio": ("min", 3.0),
+    # multi-tenant cohort acceptance floors (ISSUE 9): one 64-tenant
+    # cohort dispatch must beat 64 sequential per-collection dispatches
+    # ≥5x, and the 10k-tenant dispatch must cost ≪ 10k x the 1-tenant
+    # dispatch (sublinearity = t_10k / (10000 * t_1))
+    "cohort_speedup_64": ("min", 5.0),
+    "cohort_sublinearity_10k": ("max", 0.25),
 }
 
 

@@ -87,7 +87,11 @@ def test_quantized_variants_audited_and_within_bounds(registry_report):
     audited as separate programs (engine signatures key on the precision
     map) and their R-replica equivalence holds within the documented
     tier bounds — quantizing through the real codec."""
-    variants = {f: e for f, e in registry_report["families"].items() if "@" in f}
+    variants = {
+        f: e
+        for f, e in registry_report["families"].items()
+        if "@" in f and f.split("@")[1] != "cohort"
+    }
     assert len(variants) >= 20  # both tiers across the eligible families
     tiers = {f.split("@")[1] for f in variants}
     assert tiers == {"int8", "bf16"}
@@ -302,13 +306,19 @@ def test_fingerprints_change_when_the_program_changes():
 def test_registry_report_carries_fingerprints(registry_report):
     prints = registry_report["fingerprints"]
     # every BASE family is digested (tier variants share the base update
-    # program; their step identity is pinned by the engine signature test)
+    # program; their step identity is pinned by the engine signature test),
+    # plus one vmapped cohort-step digest per engine-eligible family
     base = {f for f in registry_report["families"] if "@" not in f}
-    assert set(prints) == base
+    cohort = {f for f in registry_report["families"] if f.endswith("@cohort")}
+    assert set(prints) == base | cohort
     mse = prints["MeanSquaredError"]
     assert mse["update"] and mse["step"]
     # eager-only families have no step program to digest
     assert prints["AUROC"]["step"] is None
+    # the cohort variant digests the VMAPPED step — a different program
+    # from the per-tenant step, tracked separately by the drift sentinel
+    assert prints["MeanSquaredError@cohort"]["cohort_step"]
+    assert prints["MeanSquaredError@cohort"]["cohort_step"] != mse["step"]
 
 
 def test_fingerprint_digest_reflects_shapes_and_dtypes():
