@@ -1,4 +1,4 @@
-.PHONY: ci lint san test test-tpu test-tpu-suite doctest bench bench-sync bench-cohort sentinel dryrun fuzz fuzz-sharded chaos clean
+.PHONY: ci lint san test test-tpu test-tpu-suite doctest bench bench-sync bench-cohort sentinel serve-metrics dryrun fuzz fuzz-sharded chaos clean
 
 ci:
 	# the full CI gate as one machine-runnable target (mirrors
@@ -25,6 +25,20 @@ ci:
 	python scripts/fuzz_parity.py --trials 50
 	python scripts/fuzz_sharded.py --trials 25
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	# fleet-export scrape smoke (mirrors the ci.yml scrape check, without
+	# the background bench): arm telemetry + the exporter on an OS port,
+	# run one 8-tenant cohort dispatch, scrape /metrics over HTTP, and
+	# gate it through the text-format parser + a per-tenant-health grep
+	python -c "import urllib.request, numpy as np, jax.numpy as jnp; \
+		import metrics_tpu as M, metrics_tpu.observability as obs; \
+		obs.enable(); ex = obs.enable_exporter(0); \
+		c = M.MetricCohort(M.MeanSquaredError(), tenants=8); \
+		x = jnp.asarray(np.random.RandomState(0).rand(8, 64).astype(np.float32)); \
+		c(x, x); c.health(); \
+		t = urllib.request.urlopen(ex.url, timeout=5).read().decode(); \
+		obs.parse_prometheus_text(t); \
+		assert 'metrics_tpu_cohort_tenant_rows_seen' in t; \
+		obs.disable_exporter(); print('fleet-export scrape: OK')"
 	# perf-regression sentinel, ADVISORY (reports, never gates — `make
 	# sentinel` or --strict to gate; the leading `-` makes a bench hiccup
 	# non-fatal for real): one fresh bench run with the flight recorder
@@ -127,6 +141,15 @@ sentinel:
 	# against the committed BENCH_r0*.json trajectory; exit 1 on any leg
 	# above threshold x baseline. Writes SENTINEL.json.
 	python scripts/perf_sentinel.py --strict
+
+serve-metrics:
+	# live fleet-observability demo: a 64-tenant MetricCohort eval loop
+	# (one tenant deliberately poisoned under a quarantine guard) behind
+	# the Prometheus export surface. Scrape http://127.0.0.1:9464/metrics
+	# to watch per-tenant health (staleness, nonfinite/guard verdicts by
+	# slot), the telemetry registry, and /healthz; Ctrl-C to stop. See
+	# docs/observability.md ("Fleet export").
+	python scripts/metrics_exporter.py --demo --port 9464
 
 fuzz:
 	# randomized differential parity vs the reference library (functional +

@@ -47,6 +47,8 @@ import jax.numpy as jnp
 
 from metrics_tpu.engine import CompiledStepEngine, _is_arraylike
 from metrics_tpu.metric import Metric, _device_owned, _san_allow_ctx
+from metrics_tpu.observability import exporter as _exporter
+from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.parallel import quantize as _quant
 from metrics_tpu.parallel.backend import is_distributed_initialized
@@ -163,7 +165,13 @@ class MetricCohort:
         metrics: Union[Metric, Mapping[str, Metric], Sequence[Metric], Any],
         tenants: int = 1,
         cache_size: int = 16,
+        track_health: Optional[bool] = None,
     ):
+        """``track_health`` arms per-tenant health accounting (see
+        :meth:`health`): ``True``/``False`` pin it, ``None`` (default)
+        follows the telemetry switch — health rides exactly when
+        observability is on, and the default cohort program stays
+        untouched (fingerprint-pinned) when it is off."""
         self._single = isinstance(metrics, Metric)
         self._template: "OrderedDict[str, Metric]" = OrderedDict(
             self._template_items(metrics)
@@ -196,6 +204,20 @@ class MetricCohort:
             for name, m in self._template.items()
         }
         self._compute_cache: Tuple[Optional[tuple], Optional[Any]] = (None, None)
+        # per-tenant health: device accumulators created lazily at the
+        # first health-armed dispatch (None until then — the OFF state
+        # carries no arrays at all), a host-side guard-verdict tally (the
+        # guard epilogue already fetches its flags; tallying them here
+        # costs nothing extra), and the cohort's own dispatch counter
+        # (the step index staleness is measured against)
+        self._track_health = track_health
+        self._health: Optional[Dict[str, jax.Array]] = None
+        self._guard_verdicts = np.zeros(self._capacity, dtype=np.int64)
+        self._steps = 0
+        # scrape source enrollment: ONE weak reference — the exporter
+        # never keeps a dropped cohort alive, and unscraped processes pay
+        # nothing else (see observability/exporter.py)
+        self._exporter_id = _exporter.register_cohort(self)
         self._note_membership()
 
     # ------------------------------------------------------------------
@@ -223,6 +245,15 @@ class MetricCohort:
         for name, m in items:
             if not isinstance(m, Metric):
                 raise ValueError(f"template member {name!r} is not a metrics_tpu.Metric")
+            if name.startswith("__") and name.endswith("__"):
+                # dunder names are reserved for the cohort's own entries in
+                # the donated pytree and checkpoint namespace (the health
+                # accumulators, the slot table) — a member with one would
+                # silently collide with them
+                raise ValueError(
+                    f"template member name {name!r} is reserved (dunder"
+                    " names belong to cohort-internal state)"
+                )
         return items
 
     @classmethod
@@ -322,6 +353,7 @@ class MetricCohort:
                 self._states[name][sname] = (
                     self._states[name][sname].at[slot].set(default)
                 )
+        self._reset_slot_health(slot)
         self._active[slot] = True
         if state is not None:
             self._adopt_state(slot, self._extract_states(state))
@@ -343,19 +375,130 @@ class MetricCohort:
                 self._states[name][sname] = (
                     self._states[name][sname].at[tenant].set(default)
                 )
+        self._reset_slot_health(int(tenant))
         self._note_membership()
         return out
 
     def _grow(self, new_capacity: int) -> None:
+        grown = new_capacity - self._capacity
         for name, m in self._template.items():
             for sname, default in m._defaults.items():
                 cur = self._states[name][sname]
-                pad = _stacked_default(default, new_capacity - self._capacity)
+                pad = _stacked_default(default, grown)
                 self._states[name][sname] = jnp.concatenate([cur, pad], axis=0)
         self._active = np.concatenate(
-            [self._active, np.zeros(new_capacity - self._capacity, dtype=bool)]
+            [self._active, np.zeros(grown, dtype=bool)]
         )
+        self._guard_verdicts = np.concatenate(
+            [self._guard_verdicts, np.zeros(grown, dtype=np.int64)]
+        )
+        if self._health is not None:
+            pad = self._default_health(grown)
+            self._health = {
+                k: jnp.concatenate([v, pad[k]], axis=0)
+                for k, v in self._health.items()
+            }
         self._capacity = new_capacity
+
+    # ------------------------------------------------------------------
+    # per-tenant health (the in-dispatch accumulators' host half)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _default_health(capacity: int) -> Dict[str, jax.Array]:
+        """Fresh health accumulators for ``capacity`` slots. int32 by
+        design (the widest integer the default no-x64 runtime keeps):
+        rows-seen saturates after ~2.1e9 rows per tenant, which outlives
+        any eval window the session layer checkpoints."""
+        return {
+            "rows_seen": jnp.zeros((capacity,), jnp.int32),
+            "updates": jnp.zeros((capacity,), jnp.int32),
+            "last_step": jnp.full((capacity,), -1, jnp.int32),
+            "nonfinite": jnp.zeros((capacity,), jnp.int32),
+        }
+
+    def _reset_slot_health(self, slot: int) -> None:
+        """Re-default one slot's health (slot reuse must not inherit the
+        evicted tenant's history)."""
+        self._guard_verdicts[slot] = 0
+        if self._health is None:
+            return
+        h = self._health
+        self._health = {
+            "rows_seen": h["rows_seen"].at[slot].set(0),
+            "updates": h["updates"].at[slot].set(0),
+            "last_step": h["last_step"].at[slot].set(-1),
+            "nonfinite": h["nonfinite"].at[slot].set(0),
+        }
+
+    def _health_enabled(self) -> bool:
+        return (
+            self._track_health
+            if self._track_health is not None
+            else _obs.enabled()
+        )
+
+    def health(self, stale_after: int = 16) -> Optional[Dict[str, Any]]:
+        """Per-tenant health snapshot from the in-dispatch accumulators:
+        ONE small device fetch, never a per-tenant sync. Returns None
+        before any health-armed dispatch (the accumulators do not exist
+        then); otherwise a dict of aligned per-tenant arrays over the
+        live slots (in :meth:`tenant_ids` order):
+
+        ``step`` (the cohort's dispatch index), ``tenants`` (slot ids),
+        ``rows_seen``, ``updates``, ``last_step`` (-1 = never active),
+        ``staleness`` (dispatches since last activity; never-active
+        tenants read the full step count), ``nonfinite`` (in-dispatch
+        nonfinite verdicts), and ``guard_verdicts`` (host-side
+        :class:`~metrics_tpu.reliability.StateGuard` violations
+        attributed to the slot).
+
+        With telemetry on, each snapshot refreshes the ``cohort.tenant.*``
+        gauges (``stale`` counts tenants with ``staleness >=
+        stale_after``); with the flight recorder armed, a
+        ``cohort_health`` breadcrumb naming the stale/poisoned slots
+        rides the event window into any later dump. Health is
+        process-local diagnostics: it does not checkpoint, and a
+        restored cohort starts a fresh window.
+        """
+        if self._health is None:
+            return None
+        host = {k: np.asarray(v) for k, v in jax.device_get(self._health).items()}
+        slots = self._slot_index()
+        step = self._steps
+        last = host["last_step"][slots]
+        staleness = np.where(last < 0, step, step - last).astype(np.int64)
+        snapshot = {
+            "step": step,
+            "tenants": [int(s) for s in slots],
+            "rows_seen": host["rows_seen"][slots],
+            "updates": host["updates"][slots],
+            "last_step": last,
+            "staleness": staleness,
+            "nonfinite": host["nonfinite"][slots],
+            "guard_verdicts": self._guard_verdicts[slots].copy(),
+        }
+        stale = np.flatnonzero(staleness >= int(stale_after))
+        poisoned = np.flatnonzero(
+            (snapshot["nonfinite"] > 0) | (snapshot["guard_verdicts"] > 0)
+        )
+        if _obs.enabled():
+            tel = _obs.get()
+            tel.count("cohort.health_snapshots")
+            tel.gauge("cohort.tenant.stale", int(stale.size))
+            tel.gauge("cohort.tenant.poisoned", int(poisoned.size))
+            tel.gauge(
+                "cohort.tenant.max_staleness",
+                int(staleness.max()) if staleness.size else 0,
+            )
+        if _flight.flight_enabled():
+            _flight.record(
+                "cohort_health",
+                step=step,
+                tenants=int(slots.size),
+                stale=[int(slots[i]) for i in stale],
+                poisoned=[int(slots[i]) for i in poisoned],
+            )
+        return snapshot
 
     def _check_tenant(self, tenant: int) -> None:
         if not (0 <= int(tenant) < self._capacity) or not self._active[int(tenant)]:
@@ -438,7 +581,28 @@ class MetricCohort:
         # same leaves or a non-full bucket dispatches inconsistent sizes
         stacked_args = jax.tree_util.tree_map(self._route, tuple(args))
         stacked_kwargs = jax.tree_util.tree_map(self._route, dict(kwargs))
-        states = self._donatable_stacked(copy_all=_guard_active())
+        guard_on = _guard_active()
+        states = self._donatable_stacked(copy_all=guard_on)
+        # per-tenant health rides the SAME donated dispatch when armed:
+        # accumulators plus the validity mask (padding slots masked
+        # in-program) and this dispatch's step index, all as traced values
+        # so membership churn never retraces. Guard-active steps donate
+        # copies (the live accumulators double as the last-good snapshot,
+        # exactly like the member states).
+        health_state = None
+        if self._health_enabled():
+            if self._health is None:
+                self._health = self._default_health(self._capacity)
+            # ALWAYS donate copies, never the live accumulators: the
+            # exporter scrapes health() from a daemon thread, and a
+            # scrape landing between donation and the write-back below
+            # must read valid buffers (they are 4 tiny int32 rows — the
+            # copy is noise next to the dispatch)
+            health_state = {
+                k: jnp.array(v, copy=True) for k, v in self._health.items()
+            }
+            health_state["valid"] = jnp.asarray(self._active.astype(np.int8))
+            health_state["step"] = jnp.asarray(self._steps + 1, jnp.int32)
         # batch-local values are LOCAL by contract (the eager forward sets
         # `_to_sync = dist_sync_on_step`, which is False for every engine-
         # eligible metric): pin that during tracing so a distributed
@@ -448,12 +612,13 @@ class MetricCohort:
         for m in self._template.values():
             m._to_sync = False
         try:
-            new_states, values, finites, guard = self._engine.cohort_step(
+            new_states, values, finites, guard, new_health = self._engine.cohort_step(
                 states,
                 stacked_args,
                 stacked_kwargs,
                 capacity=self._capacity,
                 n_tenants=n,
+                health_state=health_state,
             )
         except Exception:
             self._check_states_alive()
@@ -462,6 +627,9 @@ class MetricCohort:
             for m, p in prev_sync:
                 m._to_sync = p
         self._states = {name: dict(new_states[name]) for name in names}
+        self._steps += 1
+        if new_health is not None:
+            self._health = new_health
         if finites is not None:
             self._apply_guard_verdicts(guard, names, finites)
         from metrics_tpu.utilities import env as _env
@@ -519,6 +687,18 @@ class MetricCohort:
             bad = np.flatnonzero(live & ~np.asarray(flags))
             if bad.size == 0:
                 continue
+            # per-tenant poison attribution: tally the verdict per slot
+            # (the health() guard_verdicts column) and drop a breadcrumb
+            # naming the slots BEFORE the guard's own dump fires, so the
+            # flight dump's event window carries who was poisoned
+            self._guard_verdicts[bad] += 1
+            if _flight.flight_enabled():
+                _flight.record(
+                    "cohort_tenant_poison",
+                    metric=name,
+                    tenants=bad.tolist(),
+                    policy=guard.policy,
+                )
             guard.handle_violation(
                 self._template[name],
                 None,
@@ -709,7 +889,9 @@ class MetricCohort:
     # ------------------------------------------------------------------
     def reset(self) -> None:
         """Reset every tenant to the registered defaults (membership and
-        capacity are kept)."""
+        capacity are kept). Health accounting resets with the state it
+        described — rows-seen of a fresh accumulator is zero by
+        definition."""
         self._states = {
             name: {
                 sname: _stacked_default(default, self._capacity)
@@ -717,6 +899,10 @@ class MetricCohort:
             }
             for name, m in self._template.items()
         }
+        if self._health is not None:
+            self._health = self._default_health(self._capacity)
+        self._guard_verdicts = np.zeros(self._capacity, dtype=np.int64)
+        self._steps = 0
 
     def _slots_state(self) -> jax.Array:
         return jnp.asarray(self._active.astype(np.int8))
@@ -801,6 +987,11 @@ class MetricCohort:
                 )
             self._capacity = int(new_capacity)
             self._active = np.zeros(self._capacity, dtype=bool)
+            # health is process-local diagnostics (never checkpointed);
+            # a capacity-changing load starts a fresh window at the new
+            # shape rather than carrying stale per-slot history
+            self._health = None
+            self._guard_verdicts = np.zeros(self._capacity, dtype=np.int64)
             self.reset()
         for name, d in incoming.items():
             for sname, v in d.items():
@@ -820,6 +1011,13 @@ class MetricCohort:
                 key=f"cohort-no-slots:{prefix}",
             )
             self._active = np.ones(self._capacity, dtype=bool)
+        # ANY successful restore starts a fresh health window (health is
+        # process-local diagnostics of the state it watched; the loaded
+        # state has a different history) — same-capacity loads included,
+        # not just the resize branch above
+        self._health = None
+        self._guard_verdicts = np.zeros(self._capacity, dtype=np.int64)
+        self._steps = 0
         self._note_membership()
 
     def persistent(self, mode: bool = True) -> None:
@@ -845,6 +1043,9 @@ class MetricCohort:
             self._template, cache_size=self._cache_size, observe=False
         )
         self._compute_cache = (None, None)
+        # a copied/unpickled cohort is a new scrape source (the weak
+        # registry entry belongs to the original object)
+        self._exporter_id = _exporter.register_cohort(self)
 
     # ------------------------------------------------------------------
     # diagnostics

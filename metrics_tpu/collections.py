@@ -200,7 +200,9 @@ class MetricCollection:
         mc.prefix = self._check_prefix_arg(prefix)
         return mc
 
-    def as_cohort(self, tenants: int = 1, cache_size: int = 16):
+    def as_cohort(
+        self, tenants: int = 1, cache_size: int = 16, track_health=None
+    ):
         """Stack ``tenants`` independent copies of this collection into a
         :class:`~metrics_tpu.cohort.MetricCohort`: one donated, vmapped
         dispatch then updates every tenant's state per step. Tenant 0
@@ -208,10 +210,17 @@ class MetricCollection:
         tenants start from registered defaults); the collection itself is
         left untouched — a serving loop migrates by calling ``as_cohort``
         once and routing subsequent batches through the cohort. Requires
-        every member to be engine-eligible (see the cohort docs)."""
+        every member to be engine-eligible (see the cohort docs).
+        ``track_health`` passes through to the cohort's per-tenant health
+        accounting (None = follow the telemetry switch)."""
         from metrics_tpu.cohort import MetricCohort
 
-        cohort = MetricCohort(deepcopy(self), tenants=tenants, cache_size=cache_size)
+        cohort = MetricCohort(
+            deepcopy(self),
+            tenants=tenants,
+            cache_size=cache_size,
+            track_health=track_health,
+        )
         cohort._adopt_state(0, cohort._extract_states(self))
         return cohort
 

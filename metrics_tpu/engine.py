@@ -88,6 +88,44 @@ def _cohort_in_axes(tree: Any) -> Any:
     return jax.tree_util.tree_map(lambda x: 0 if _is_arraylike(x) else None, tree)
 
 
+#: reserved key of the per-tenant health accumulators inside the cohort
+#: step's donated state pytree (never a member-metric name — the cohort
+#: rejects metrics with dunder names long before this). Folding health
+#: into the SAME donated pytree keeps the one-dispatch contract: health
+#: rides the step program, not a second dispatch or a host loop.
+_COHORT_HEALTH_KEY = "__cohort_health__"
+
+
+def _cohort_rows_per_tenant(args: tuple, kwargs: dict) -> int:
+    """Rows each tenant contributes this step, read off the STACKED input
+    shapes at trace time (a static program constant, exactly as batch
+    shape is): the first array leaf's second axis — leaves are
+    ``(capacity, rows, ...)`` after cohort routing. Per-tenant-scalar
+    inputs count 1; no array inputs counts 0 (the dispatch still counts
+    via the ``updates`` accumulator)."""
+    saw_array = False
+    for leaf in jax.tree_util.tree_leaves((args, kwargs)):
+        if _is_arraylike(leaf):
+            if leaf.ndim >= 2:
+                return int(leaf.shape[1])
+            saw_array = True
+    return 1 if saw_array else 0
+
+
+def _tenant_finite_flags(state_rows: Dict[str, jax.Array]) -> Optional[jax.Array]:
+    """Per-tenant all-finite flag over one member's stacked float states
+    (``(capacity,)`` bool); None when the member has no float state. The
+    health program's twin of the guard's fused finite check — reducing
+    over every non-cohort axis instead of all axes."""
+    flags = []
+    for v in state_rows.values():
+        if jnp.issubdtype(v.dtype, jnp.floating):
+            flags.append(jnp.all(jnp.isfinite(v), axis=tuple(range(1, v.ndim))))
+    if not flags:
+        return None
+    return functools.reduce(jnp.logical_and, flags)
+
+
 def _abstract_leaf(x: Any) -> Any:
     """Cache-key atom for one input leaf: arrays key on (shape, dtype);
     everything else (python scalars, strings) keys on its concrete value —
@@ -291,12 +329,27 @@ class CompiledStepEngine:
         names: Tuple[str, ...],
         guard_token: Optional[str] = None,
         observe: bool = True,
+        health: bool = False,
     ) -> Callable:
         """The per-tenant step program vmapped over the leading cohort axis
         of the state pytree and every array input. Tracing cost is
         independent of the cohort size (vmap traces the per-tenant program
         once with batched tracers), so a (signature, capacity-bucket)
-        cache entry amortizes over thousands of tenants."""
+        cache entry amortizes over thousands of tenants.
+
+        ``health=True`` compiles the health-augmented variant: the donated
+        state pytree carries a :data:`_COHORT_HEALTH_KEY` entry of
+        fixed-shape per-tenant accumulators (rows seen, update count, last
+        active step, nonfinite-verdict count), advanced by a handful of
+        elementwise ops riding the SAME dispatch — no per-tenant host
+        sync, padding slots masked by the validity vector the cohort
+        feeds in. The vmapped member program is byte-for-byte the one the
+        plain variant traces (health math happens outside the vmap), so
+        member states stay bit-identical with health on or off; the two
+        variants are distinct signature-cache entries (a health flip is a
+        new program, a flip back is a cache hit), and the DEFAULT variant
+        — the one ``abstract_cohort_step`` traces and FINGERPRINTS.json
+        digests — is untouched."""
         base = self._make_step_fn(names, guard_token, observe=False)
 
         def cohort_step_fn(states, args, kwargs):
@@ -314,7 +367,80 @@ class CompiledStepEngine:
             in_axes = (0, _cohort_in_axes(args), _cohort_in_axes(kwargs))
             return jax.vmap(base, in_axes=in_axes)(states, args, kwargs)
 
-        return cohort_step_fn
+        if not health:
+            return cohort_step_fn
+
+        def cohort_health_step_fn(states, args, kwargs, aux):
+            # `aux` (validity mask + step index) is deliberately OUTSIDE
+            # the donated state pytree: both are consumed, not returned,
+            # and donating a buffer the program never hands back is a
+            # donation-wasted warning per dispatch
+            health_in = states[_COHORT_HEALTH_KEY]
+            member_states = {n: states[n] for n in names}
+            out = cohort_step_fn(member_states, args, kwargs)
+            if guard_token is None:
+                new_states, values = out
+                finites = None
+            else:
+                new_states, values, finites = out
+            new_states = dict(new_states)
+            new_states[_COHORT_HEALTH_KEY] = self._advance_health(
+                health_in, new_states, finites, names, aux, args, kwargs
+            )
+            if guard_token is None:
+                return new_states, values
+            return new_states, values, finites
+
+        return cohort_health_step_fn
+
+    @staticmethod
+    def _advance_health(
+        h: Dict[str, jax.Array],
+        new_states: Dict[str, Dict[str, jax.Array]],
+        finites: Optional[Dict[str, jax.Array]],
+        names: Tuple[str, ...],
+        aux: Dict[str, jax.Array],
+        args: tuple,
+        kwargs: dict,
+    ) -> Dict[str, jax.Array]:
+        """One elementwise advance of the per-tenant health accumulators,
+        traced into the cohort step. ``aux`` carries ``valid`` (per-slot
+        liveness, int8) and ``step`` (the cohort's dispatch index, int32)
+        as traced values — membership or step changes never retrace — and
+        both are consumed here, never returned (returning a donated invar
+        unchanged is exactly the MTA007 passthrough hazard, which is also
+        why they ride outside the donated pytree).
+
+        Nonfinite accounting: with a guard active the guard's own fused
+        per-tenant verdicts are reused (under select policies they flag
+        the poisoned UPDATE the program just rolled back); without one the
+        merged float states are checked directly, so the count reads
+        "dispatches spent with nonfinite state" — both masked to live
+        slots."""
+        valid = aux["valid"].astype(jnp.bool_)
+        count_dtype = h["updates"].dtype
+        nonfinite = jnp.zeros(valid.shape, count_dtype)
+        for name in names:
+            if finites is not None:
+                flag = finites.get(name)
+            else:
+                flag = _tenant_finite_flags(new_states[name])
+            if flag is None:
+                continue
+            flag = jnp.broadcast_to(jnp.asarray(flag), valid.shape)
+            nonfinite = nonfinite + (valid & ~flag).astype(count_dtype)
+        live = valid.astype(count_dtype)
+        step = jnp.broadcast_to(
+            aux["step"].astype(h["last_step"].dtype), valid.shape
+        )
+        return {
+            "rows_seen": h["rows_seen"]
+            + live.astype(h["rows_seen"].dtype)
+            * _cohort_rows_per_tenant(args, kwargs),
+            "updates": h["updates"] + live,
+            "last_step": jnp.where(valid, step, h["last_step"]),
+            "nonfinite": h["nonfinite"] + nonfinite,
+        }
 
     @property
     def _cohort_watch_key(self) -> str:
@@ -328,6 +454,7 @@ class CompiledStepEngine:
         *,
         capacity: int,
         n_tenants: Optional[int] = None,
+        health_state: Optional[Dict[str, jax.Array]] = None,
     ):
         """One donated, LRU-cached dispatch updating every tenant of a
         stacked-state cohort (see :class:`~metrics_tpu.cohort.MetricCohort`,
@@ -335,10 +462,15 @@ class CompiledStepEngine:
 
         ``states`` is the stacked pytree (leading axis ``capacity`` on
         every leaf); array leaves of ``args``/``kwargs`` carry the same
-        leading axis. Returns ``(new_states, values, finites, guard)`` —
-        ``finites`` is None without an active guard, else a per-metric
-        ``(capacity,)`` bool array with the in-program last-good rollback
-        already applied for select policies.
+        leading axis. Returns ``(new_states, values, finites, guard,
+        new_health)`` — ``finites`` is None without an active guard, else
+        a per-metric ``(capacity,)`` bool array with the in-program
+        last-good rollback already applied for select policies;
+        ``new_health`` is None unless ``health_state`` (the cohort's
+        per-tenant health accumulators plus ``valid``/``step`` inputs)
+        was supplied, in which case the health-augmented program variant
+        runs and the advanced accumulators come back with the states —
+        same dispatch, no extra host sync.
 
         Unlike :meth:`step` there is no per-tenant eager fallback: N eager
         reruns are exactly the cost the cohort exists to remove, so every
@@ -358,14 +490,30 @@ class CompiledStepEngine:
                 _trace.advance_step()
             guard = _rguard.active()
             guard_token = self._guard_token(guard)
+            health = health_state is not None
+            aux = None
+            if health:
+                health_state = dict(health_state)
+                aux = {
+                    "valid": health_state.pop("valid"),
+                    "step": health_state.pop("step"),
+                }
+                states = dict(states)
+                states[_COHORT_HEALTH_KEY] = health_state
             with _trace.span(
                 "engine.cache_lookup", phase="dispatch", engine=self._cohort_watch_key
             ):
                 signature = self._signature(
-                    names, args, kwargs, guard_token, cohort=int(capacity)
+                    names, args, kwargs, guard_token, cohort=int(capacity),
+                    health=health,
                 )
                 fn, cache_hit = self._get_compiled(
-                    signature, names, guard_token, maker=self._make_cohort_step_fn
+                    signature,
+                    names,
+                    guard_token,
+                    maker=functools.partial(
+                        self._make_cohort_step_fn, health=health
+                    ),
                 )
             telemetry_on = _obs.enabled()
             if telemetry_on:
@@ -389,7 +537,11 @@ class CompiledStepEngine:
                     engine=self._cohort_watch_key,
                     cache_hit=cache_hit,
                 ):
-                    out = fn(states, args, kwargs)
+                    out = (
+                        fn(states, args, kwargs)
+                        if aux is None
+                        else fn(states, args, kwargs, aux)
+                    )
             except Exception:
                 # never reuse a program whose dispatch died; the cohort
                 # owner decides whether its stacked state survived (CPU
@@ -405,7 +557,11 @@ class CompiledStepEngine:
             finites = None
         else:
             new_states, values, finites = out
-        return new_states, values, finites, guard
+        new_health = None
+        if health:
+            new_states = dict(new_states)
+            new_health = new_states.pop(_COHORT_HEALTH_KEY)
+        return new_states, values, finites, guard, new_health
 
     def abstract_cohort_step(self, *args: Any, capacity: int = 4, **kwargs: Any):
         """Trace the vmapped cohort step abstractly (no compile, no
@@ -451,6 +607,7 @@ class CompiledStepEngine:
         kwargs: dict,
         guard_token: Optional[str] = None,
         cohort: Optional[int] = None,
+        health: bool = False,
     ) -> tuple:
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
         # the quantized sync tier is part of the program identity: a
@@ -460,7 +617,10 @@ class CompiledStepEngine:
         # `cohort` (the capacity bucket) separates vmapped cohort programs
         # from the plain step AND from other bucket sizes: with power-of-
         # two bucketing a 1 -> 10k tenant ramp costs one trace per bucket,
-        # never one per N.
+        # never one per N. `health` separates the health-augmented cohort
+        # variant the same way the guard token separates guarded programs:
+        # arming health mid-run is one new trace, disarming is a cache hit
+        # on the original program.
         precisions = tuple(
             (n, tuple(sorted(getattr(self._metrics[n], "_sync_precisions", {}).items())))
             for n in names
@@ -470,6 +630,7 @@ class CompiledStepEngine:
             precisions,
             guard_token,
             cohort,
+            bool(health),
             treedef,
             tuple(_abstract_leaf(x) for x in leaves),
         )

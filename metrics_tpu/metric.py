@@ -34,6 +34,7 @@ import jax.numpy as jnp
 
 from contextlib import nullcontext
 
+from metrics_tpu.observability import identity as _obs_identity
 from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.observability import trace as _obs_trace
 from metrics_tpu.utilities import env as _env
@@ -534,7 +535,19 @@ class Metric(ABC):
         phase="sync" span per sync."""
         telemetry_on = _obs.enabled()
         t0 = _time.perf_counter() if telemetry_on else 0.0
-        with _obs_trace.span(f"metrics_tpu.{type(self).__name__}.sync", phase="sync"):
+        # sync spans carry the rank identity inline: a merged multi-rank
+        # timeline (`trace_export.py --merge`) then shows which rank a
+        # slow collective lives on without cross-referencing dump files.
+        # Resolved only when tracing is actually on — the disabled path
+        # must stay two global reads.
+        span_attrs = (
+            {"rank": _obs_identity.current_rank()}
+            if _obs_trace.tracing_enabled()
+            else {}
+        )
+        with _obs_trace.span(
+            f"metrics_tpu.{type(self).__name__}.sync", phase="sync", **span_attrs
+        ):
             self._sync_dist_impl(dist_sync_fn)
         if telemetry_on:
             _obs.get().observe_hist(

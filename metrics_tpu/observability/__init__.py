@@ -19,6 +19,16 @@ Quick start::
         ... one eval pass ...
         assert tel.watchdog.retrace_count() == 0
 """
+from metrics_tpu.observability.exporter import (  # noqa: F401
+    MetricsExporter,
+    disable_exporter,
+    enable_exporter,
+    exporter_enabled,
+    exporter_scope,
+    get_exporter,
+    parse_prometheus_text,
+    render_exposition,
+)
 from metrics_tpu.observability.flight import (  # noqa: F401
     FlightRecorder,
     disable_flight,
@@ -26,6 +36,11 @@ from metrics_tpu.observability.flight import (  # noqa: F401
     flight_enabled,
     flight_scope,
     get_flight,
+)
+from metrics_tpu.observability.identity import (  # noqa: F401
+    identity_scope,
+    process_identity,
+    set_process_identity,
 )
 from metrics_tpu.observability.telemetry import (  # noqa: F401
     LATENCY_BUCKETS_MS,
@@ -37,6 +52,7 @@ from metrics_tpu.observability.telemetry import (  # noqa: F401
     get,
     metric_scope,
     note_trace,
+    percentile,
     profile_span,
     telemetry_scope,
 )
@@ -79,8 +95,21 @@ __all__ = [
     "get_flight",
     "LATENCY_BUCKETS_MS",
     "PAYLOAD_BUCKETS_BYTES",
+    "MetricsExporter",
+    "enable_exporter",
+    "disable_exporter",
+    "exporter_enabled",
+    "exporter_scope",
+    "get_exporter",
+    "render_exposition",
+    "parse_prometheus_text",
+    "percentile",
+    "process_identity",
+    "set_process_identity",
+    "identity_scope",
     "report",
     "to_json",
+    "to_prometheus",
 ]
 
 
@@ -92,3 +121,10 @@ def report() -> str:
 def to_json(indent=None) -> str:
     """Shorthand for ``get().to_json()``."""
     return get().to_json(indent=indent)
+
+
+def to_prometheus() -> str:
+    """Shorthand for ``get().to_prometheus()`` — the registry alone; use
+    :func:`render_exposition` for the full ``/metrics`` payload (registry
+    + cohort health + session gauges)."""
+    return get().to_prometheus()

@@ -33,6 +33,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Optional
 
+from metrics_tpu.observability import identity as _identity
 from metrics_tpu.observability import trace as _trace
 from metrics_tpu.utilities.env import flight_dir
 from metrics_tpu.utilities.prints import warn_once
@@ -50,7 +51,9 @@ __all__ = [
 
 _DEFAULT_CAPACITY = 2048
 _DEFAULT_MAX_DUMPS_PER_REASON = 8
+_DEFAULT_KEEP_DUMPS = 32
 _REASON_RE = re.compile(r"[^A-Za-z0-9_.-]+")
+_DUMP_FILE_RE = re.compile(r"^flight-(\d{4,})-.*\.json$")
 
 
 class FlightRecorder:
@@ -64,6 +67,13 @@ class FlightRecorder:
             trigger reason — a persistently-poisoned input stream must not
             turn every step into a full dump write (one warn_once when a
             reason hits its cap; manual :meth:`dump` calls are uncapped).
+        keep_dumps: ``flight-*.json`` files retained in the directory
+            (keep-last-K GC, same ordering discipline as
+            ``CheckpointJournal``: the new dump is committed atomically
+            FIRST, then the oldest files beyond K are removed — a crash
+            between the two steps leaves an extra old dump, never a
+            missing new one). Bounds the disk a flapping fault (or many
+            distinct reasons, each under its per-reason cap) can consume.
     """
 
     def __init__(
@@ -71,10 +81,14 @@ class FlightRecorder:
         directory: Any,
         capacity: int = _DEFAULT_CAPACITY,
         max_dumps_per_reason: int = _DEFAULT_MAX_DUMPS_PER_REASON,
+        keep_dumps: int = _DEFAULT_KEEP_DUMPS,
     ):
+        if keep_dumps < 1:
+            raise ValueError("keep_dumps must be >= 1")
         self.directory = os.fspath(directory)
         self.capacity = int(capacity)
         self.max_dumps_per_reason = int(max_dumps_per_reason)
+        self.keep_dumps = int(keep_dumps)
         self._lock = threading.RLock()
         self.events: "deque[Dict[str, Any]]" = deque(maxlen=self.capacity)
         self.dumps = 0
@@ -124,6 +138,7 @@ class FlightRecorder:
         payload = {
             "format": "metrics_tpu.flight_dump",
             "schema_version": 1,
+            "identity": _identity.process_identity(),
             "reason": reason,
             "hint": hint,
             "context": context,
@@ -136,15 +151,27 @@ class FlightRecorder:
         slug = _REASON_RE.sub("-", reason).strip("-") or "failure"
         os.makedirs(self.directory, exist_ok=True)
         # a re-armed recorder over a directory holding earlier dumps must
-        # extend the sequence, not os.replace() earlier failures' evidence
-        while glob.glob(os.path.join(self.directory, f"flight-{seq:04d}-*.json")):
-            seq += 1
+        # extend the sequence PAST the newest existing file, not fill the
+        # first free slot: keep-last-K GC frees LOW numbers, and reusing
+        # one would make the fresh dump sort oldest — the next GC pass
+        # would then delete the newest evidence first
+        existing = [
+            int(m.group(1))
+            for m in (
+                _DUMP_FILE_RE.match(os.path.basename(p))
+                for p in glob.glob(os.path.join(self.directory, "flight-*.json"))
+            )
+            if m
+        ]
+        if existing:
+            seq = max(seq, max(existing) + 1)
         with self._lock:
             self.dumps = max(self.dumps, seq)
         path = os.path.join(self.directory, f"flight-{seq:04d}-{slug}.json")
         atomic_write_json(path, payload)
         with self._lock:
             self.dump_paths.append(path)
+        self._gc_dumps()
         warn_once(
             f"flight recorder: dumped the last-{len(events)}-event window to"
             f" {path!r} (reason: {reason}); further dumps for this reason are"
@@ -152,6 +179,33 @@ class FlightRecorder:
             key=f"flight-dump:{slug}",
         )
         return path
+
+    def _gc_dumps(self) -> None:
+        """Keep-last-``keep_dumps`` GC over the dump directory, ordered
+        like ``CheckpointJournal``'s rotation: the new dump is already
+        durable (atomic write) before anything is deleted, deletion walks
+        oldest-first, and only files matching the recorder's own
+        ``flight-NNNN-*.json`` naming are ever touched — a crash anywhere
+        leaves at worst an extra old dump for the next GC pass. Never
+        raises: GC is housekeeping, not part of the failure path."""
+        try:
+            entries = []
+            for fname in os.listdir(self.directory):
+                m = _DUMP_FILE_RE.match(fname)
+                if m:
+                    entries.append((int(m.group(1)), fname))
+            entries.sort()
+            for _, fname in entries[: max(0, len(entries) - self.keep_dumps)]:
+                victim = os.path.join(self.directory, fname)
+                try:
+                    os.remove(victim)
+                except OSError:
+                    continue
+                with self._lock:
+                    if victim in self.dump_paths:
+                        self.dump_paths.remove(victim)
+        except OSError:  # noqa: PERF203 — directory listing raced a cleanup
+            pass
 
     def _admit_failure_dump(self, reason: str) -> bool:
         """Per-reason admission for the automatic failure hooks: beyond
@@ -207,11 +261,16 @@ def flight_enabled() -> bool:
     return _enabled
 
 
-def enable_flight(directory: Any, capacity: int = _DEFAULT_CAPACITY) -> FlightRecorder:
+def enable_flight(
+    directory: Any,
+    capacity: int = _DEFAULT_CAPACITY,
+    keep_dumps: int = _DEFAULT_KEEP_DUMPS,
+) -> FlightRecorder:
     """Arm the flight recorder: buffer events, dump to ``directory`` on
-    the reliability layer's failure paths."""
+    the reliability layer's failure paths (at most ``keep_dumps`` dump
+    files retained, oldest GC'd first)."""
     global _recorder, _enabled
-    _recorder = FlightRecorder(directory, capacity=capacity)
+    _recorder = FlightRecorder(directory, capacity=capacity, keep_dumps=keep_dumps)
     _enabled = True
     return _recorder
 

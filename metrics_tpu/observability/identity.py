@@ -1,0 +1,104 @@
+"""Process/rank identity for observability artifacts.
+
+Every observability artifact this process writes — trace snapshots,
+flight dumps, telemetry snapshots, Prometheus expositions — is stamped
+with *which rank of which world* produced it, so a fleet monitor (or
+``scripts/trace_export.py --merge``) can correlate per-rank evidence
+instead of guessing from filenames. The identity comes from the sync
+backend's world view (:mod:`metrics_tpu.parallel.backend`): an installed
+backend's ``rank``/``world_size`` win, else the JAX process index/count,
+else rank 0 of a world of 1.
+
+Tests and virtual-DDP harnesses that simulate several ranks inside one
+process pin the identity explicitly with :func:`set_process_identity` or
+the :func:`identity_scope` context manager (thread-local, so concurrent
+simulated ranks don't clobber each other).
+
+Zero-overhead contract: resolving the identity costs two attribute reads
+and never imports jax eagerly; it is only ever called on cold paths
+(snapshot/dump/scrape time), never per step.
+"""
+import os
+import socket
+import threading
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+__all__ = [
+    "process_identity",
+    "current_rank",
+    "set_process_identity",
+    "identity_scope",
+]
+
+# explicit overrides: (rank, world_size) or None = auto-detect. The
+# process-wide override is what a launcher sets once; the thread-local one
+# is for virtual-DDP rank threads sharing one process.
+_override: Optional[Dict[str, int]] = None
+_tls = threading.local()
+
+
+def _detect() -> Dict[str, int]:
+    """Rank/world from the sync backend's world view (explicit backend
+    first, else the JAX runtime). Never raises — identity is diagnostics,
+    and a half-initialized runtime must not break a flight dump."""
+    try:
+        from metrics_tpu.parallel.backend import get_sync_backend
+
+        backend = get_sync_backend()
+        return {"rank": int(backend.rank), "world_size": int(backend.world_size)}
+    except Exception:  # noqa: BLE001 — advisory metadata only
+        return {"rank": 0, "world_size": 1}
+
+
+def process_identity() -> Dict[str, Any]:
+    """The identity stamp: ``{"rank", "world_size", "host", "pid"}``.
+
+    Resolution order: thread-local :func:`identity_scope` >
+    process-wide :func:`set_process_identity` > the active sync backend's
+    ``rank``/``world_size`` > single-process defaults.
+    """
+    ident = getattr(_tls, "pinned", None) or _override or _detect()
+    return {
+        "rank": ident["rank"],
+        "world_size": ident["world_size"],
+        "host": socket.gethostname(),
+        "pid": os.getpid(),
+    }
+
+
+def current_rank() -> int:
+    """Just the rank — the accessor for call sites that stamp rank on a
+    per-step artifact (sync spans): no hostname syscall, no pid lookup,
+    no stamp dict. Same resolution order as :func:`process_identity`."""
+    ident = getattr(_tls, "pinned", None) or _override or _detect()
+    return ident["rank"]
+
+
+def set_process_identity(
+    rank: Optional[int] = None, world_size: Optional[int] = None
+) -> None:
+    """Pin the process-wide rank identity (``None, None`` restores
+    auto-detection). A launcher that knows its placement calls this once
+    at startup; everything observability writes afterwards carries it."""
+    global _override
+    if rank is None and world_size is None:
+        _override = None
+        return
+    _override = {
+        "rank": int(rank if rank is not None else 0),
+        "world_size": int(world_size if world_size is not None else 1),
+    }
+
+
+@contextmanager
+def identity_scope(rank: int, world_size: int) -> Iterator[None]:
+    """Thread-locally pin the identity for a ``with`` block — the hook
+    virtual-DDP rank threads use so each simulated rank's spans and dumps
+    carry its own rank, not the shared process default."""
+    prev = getattr(_tls, "pinned", None)
+    _tls.pinned = {"rank": int(rank), "world_size": int(world_size)}
+    try:
+        yield
+    finally:
+        _tls.pinned = prev

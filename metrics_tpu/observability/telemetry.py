@@ -24,13 +24,16 @@ the environment (parsed once at import by ``utilities/env.py``).
 import atexit
 import bisect
 import json
+import math
 import os
+import re
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager, nullcontext
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
+from metrics_tpu.observability import identity as _identity
 from metrics_tpu.observability import trace as _trace
 from metrics_tpu.observability.watchdog import RecompilationWatchdog
 from metrics_tpu.utilities.env import telemetry_requested
@@ -46,6 +49,7 @@ __all__ = [
     "note_trace",
     "metric_scope",
     "profile_span",
+    "percentile",
     "LATENCY_BUCKETS_MS",
     "PAYLOAD_BUCKETS_BYTES",
 ]
@@ -137,6 +141,14 @@ class Telemetry:
         finally:
             self.observe(name, time.perf_counter() - t0)
 
+    def percentile(self, name: str, q: float) -> Optional[float]:
+        """Estimated ``q``-th percentile (0–100) of histogram ``name``;
+        None when the histogram is empty or unknown. See :func:`percentile`
+        for the estimation contract."""
+        with self._lock:
+            h = self.histograms.get(name)
+            return percentile(h, q) if h else None
+
     # ------------------------------------------------------------------
     # reading / export
     # ------------------------------------------------------------------
@@ -144,6 +156,7 @@ class Telemetry:
         """JSON-serializable view of everything recorded so far."""
         with self._lock:
             return {
+                "identity": _identity.process_identity(),
                 "counters": dict(self.counters),
                 "gauges": dict(self.gauges),
                 "timers": {
@@ -187,19 +200,18 @@ class Telemetry:
         if not snap["timers"]:
             lines.append("  (none)")
         if snap["histograms"]:
-            lines.append("histograms (count / mean / p-buckets):")
+            # fixed-bucket estimates, not raw bucket dumps: an operator
+            # scanning the report wants the distribution's shape (tail
+            # percentiles), and the shared percentile() helper is the same
+            # estimator the export surface documents
+            lines.append("histograms (count / mean / p50 / p95 / p99):")
             for name in sorted(snap["histograms"]):
                 h = snap["histograms"][name]
                 mean = h["sum"] / h["count"] if h["count"] else 0.0
-                # compact: only the occupied buckets
-                occupied = [
-                    f"<={h['buckets'][i] if i < len(h['buckets']) else 'inf'}:{c}"
-                    for i, c in enumerate(h["counts"])
-                    if c
-                ]
-                lines.append(
-                    f"  {name:<48} n={h['count']} mean={mean:.4g} " + " ".join(occupied)
+                ps = " ".join(
+                    f"p{q:g}={percentile(h, q):.4g}" for q in (50, 95, 99)
                 )
+                lines.append(f"  {name:<48} n={h['count']} mean={mean:.4g} {ps}")
         wd = snap["watchdog"]
         lines.append("recompilation watchdog:")
         if not wd["keys"]:
@@ -220,6 +232,85 @@ class Telemetry:
         )
         return "\n".join(lines)
 
+    def to_prometheus(
+        self,
+        extra_lines: Optional[List[str]] = None,
+        identity: Optional[Dict[str, Any]] = None,
+    ) -> str:
+        """The registry in Prometheus text exposition format (version
+        0.0.4 — what every fleet scraper speaks).
+
+        Rendering contract:
+
+        * counters: sanitized dotted names with the conventional
+          ``_total`` suffix (``engine.dispatches`` →
+          ``metrics_tpu_engine_dispatches_total``), typed ``counter``.
+          The suffix is not just idiom (OpenMetrics requires it): several
+          registry keys exist as BOTH a counter and a histogram
+          (``sync.payload_bytes`` et al.), and one exposition must never
+          declare one family name with two types — a real scraper
+          rejects the whole scrape;
+        * gauges: sanitized dotted names, typed ``gauge``;
+        * timers (total seconds + call count): rendered as a ``summary``
+          pair ``<name>_sum`` / ``<name>_count``;
+        * fixed-bucket histograms: native Prometheus ``histogram`` —
+          the registry's inclusive per-bucket upper bounds map DIRECTLY
+          onto cumulative ``le=`` buckets (that is why the edges are
+          fixed by design), with the implicit overflow bucket as
+          ``le="+Inf"`` plus ``_sum``/``_count``;
+        * one ``metrics_tpu_identity`` gauge carries the rank/world/host
+          labels every other artifact is stamped with.
+
+        The whole exposition is rendered from ONE locked :meth:`snapshot`,
+        so a scrape racing a step sees a consistent registry, never a
+        half-updated one. ``extra_lines`` lets the export surface append
+        already-rendered families (cohort health, session gauges) to the
+        same exposition; ``identity`` overrides the stamp — offline
+        renderers (``scripts/metrics_exporter.py --snapshot``) pass the
+        ARTIFACT's recorded identity so the exposition names the process
+        that produced the numbers, not the one rendering them.
+        """
+        snap = self.snapshot()
+        out: List[str] = []
+        ident = {"rank": 0, "world_size": 1, "host": "unknown"}
+        ident.update(identity if identity is not None else snap["identity"])
+        out.append("# TYPE metrics_tpu_identity gauge")
+        out.append(
+            "metrics_tpu_identity{"
+            f'rank="{ident["rank"]}",world_size="{ident["world_size"]}",'
+            f'host="{_escape_label(str(ident["host"]))}"' "} 1"
+        )
+        for name in sorted(snap["counters"]):
+            pname = prometheus_name(name) + "_total"
+            out.append(f"# TYPE {pname} counter")
+            out.append(f"{pname} {_format_value(snap['counters'][name])}")
+        for name in sorted(snap["gauges"]):
+            pname = prometheus_name(name)
+            out.append(f"# TYPE {pname} gauge")
+            out.append(f"{pname} {_format_value(snap['gauges'][name])}")
+        for name in sorted(snap["timers"]):
+            t = snap["timers"][name]
+            pname = prometheus_name(name)
+            out.append(f"# TYPE {pname} summary")
+            out.append(f"{pname}_sum {_format_value(t['total_s'])}")
+            out.append(f"{pname}_count {t['count']}")
+        for name in sorted(snap["histograms"]):
+            h = snap["histograms"][name]
+            pname = prometheus_name(name)
+            out.append(f"# TYPE {pname} histogram")
+            cumulative = 0
+            for edge, c in zip(h["buckets"], h["counts"]):
+                cumulative += c
+                out.append(
+                    f'{pname}_bucket{{le="{_format_value(edge)}"}} {cumulative}'
+                )
+            out.append(f'{pname}_bucket{{le="+Inf"}} {h["count"]}')
+            out.append(f"{pname}_sum {_format_value(h['sum'])}")
+            out.append(f"{pname}_count {h['count']}")
+        if extra_lines:
+            out.extend(extra_lines)
+        return "\n".join(out) + "\n"
+
     def reset(self) -> None:
         with self._lock:
             self.counters.clear()
@@ -229,6 +320,80 @@ class Telemetry:
             self.events.clear()
             self.dropped_events = 0
             self.watchdog.reset()
+
+
+# ----------------------------------------------------------------------
+# histogram percentile estimation (shared by report() and the exporter)
+# ----------------------------------------------------------------------
+def percentile(histogram: Dict[str, Any], q: float) -> float:
+    """Estimated ``q``-th percentile (0–100) of a fixed-bucket histogram
+    (the ``{"buckets", "counts", "sum", "count"}`` shape ``observe_hist``
+    accumulates).
+
+    Standard monitoring-stack estimator (what PromQL's
+    ``histogram_quantile`` computes from the same ``le=`` buckets):
+    find the bucket where the cumulative count crosses ``q`` percent and
+    interpolate linearly inside it, taking 0 as the first bucket's lower
+    edge. Mass in the overflow (+Inf) bucket clamps to the last finite
+    edge — fixed buckets cannot see beyond their last boundary, and
+    reporting the edge is honest where inventing a tail value is not.
+    Returns 0.0 for an empty histogram.
+    """
+    if not 0 <= q <= 100:
+        raise ValueError(f"percentile q must be in [0, 100], got {q}")
+    total = histogram.get("count", 0)
+    if not total:
+        return 0.0
+    edges = list(histogram["buckets"])
+    counts = list(histogram["counts"])
+    target = q / 100.0 * total
+    cumulative = 0.0
+    for i, c in enumerate(counts):
+        prev_cum = cumulative
+        cumulative += c
+        if cumulative < target or c == 0:
+            continue
+        if i >= len(edges):  # overflow bucket: clamp to the last edge
+            return float(edges[-1]) if edges else 0.0
+        lo = float(edges[i - 1]) if i > 0 else 0.0
+        hi = float(edges[i])
+        frac = (target - prev_cum) / c
+        return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+    return float(edges[-1]) if edges else 0.0
+
+
+# ----------------------------------------------------------------------
+# Prometheus text-format helpers (shared with observability/exporter.py)
+# ----------------------------------------------------------------------
+_PROM_INVALID = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_name(name: str) -> str:
+    """Dotted registry key → valid Prometheus metric name, namespaced
+    under ``metrics_tpu_`` (``sync.latency_ms`` →
+    ``metrics_tpu_sync_latency_ms``)."""
+    sanitized = _PROM_INVALID.sub("_", name)
+    if sanitized and sanitized[0].isdigit():
+        sanitized = "_" + sanitized
+    return "metrics_tpu_" + sanitized
+
+
+def _escape_label(value: str) -> str:
+    """Prometheus label-value escaping (backslash, quote, newline)."""
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _format_value(v: Any) -> str:
+    """Sample-value formatting: integers stay integral, floats use repr
+    (full precision), non-finite values use the exposition spellings."""
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
 
 
 # ----------------------------------------------------------------------

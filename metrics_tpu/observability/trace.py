@@ -41,6 +41,7 @@ from collections import deque
 from contextlib import contextmanager, nullcontext
 from typing import Any, Dict, Iterator, List, Optional
 
+from metrics_tpu.observability import identity as _identity
 from metrics_tpu.utilities.env import trace_requested
 
 __all__ = [
@@ -151,11 +152,15 @@ class TraceRecorder:
     # reading / export
     # ------------------------------------------------------------------
     def snapshot(self) -> Dict[str, Any]:
-        """JSON-serializable native dump: ``{"format": ..., "spans": [...]}``."""
+        """JSON-serializable native dump: ``{"format": ..., "spans": [...]}``,
+        stamped with the process/rank identity so per-rank dumps stay
+        attributable (and mergeable — ``scripts/trace_export.py --merge``
+        aligns N rank dumps on the step index)."""
         with self._lock:
             return {
                 "format": "metrics_tpu.trace",
                 "schema_version": 1,
+                "identity": _identity.process_identity(),
                 "max_spans": self.max_spans,
                 "dropped": self.dropped,
                 "spans": list(self.spans),
@@ -163,9 +168,12 @@ class TraceRecorder:
 
     def to_perfetto(self) -> Dict[str, Any]:
         """The recording as Chrome/Perfetto ``trace_event`` JSON (loadable
-        in https://ui.perfetto.dev and ``chrome://tracing``)."""
+        in https://ui.perfetto.dev and ``chrome://tracing``); the process
+        track is named after the rank identity."""
         with self._lock:
-            return spans_to_perfetto(list(self.spans))
+            return spans_to_perfetto(
+                list(self.spans), identity=_identity.process_identity()
+            )
 
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
@@ -184,7 +192,11 @@ class TraceRecorder:
             self._origin_ns = time.perf_counter_ns()
 
 
-def spans_to_perfetto(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+def spans_to_perfetto(
+    spans: List[Dict[str, Any]],
+    identity: Optional[Dict[str, Any]] = None,
+    ts_offset_us: float = 0.0,
+) -> Dict[str, Any]:
     """Convert native span records to the ``trace_event`` JSON schema —
     shared by :meth:`TraceRecorder.to_perfetto` and the
     ``scripts/trace_export.py`` CLI (one converter, no format drift).
@@ -193,25 +205,41 @@ def spans_to_perfetto(spans: List[Dict[str, Any]]) -> Dict[str, Any]:
     instants are ``ph: "i"`` with thread scope. The step index and span
     attrs ride in ``args`` so Perfetto's query/selection UI can group by
     step; the phase is the event category (``cat``).
+
+    ``identity`` (a :func:`~metrics_tpu.observability.identity
+    .process_identity` stamp) names the process track ``metrics_tpu
+    rank R/W`` and keys it on the rank, so several ranks' conversions
+    compose into one timeline with one track per rank;
+    ``ts_offset_us`` shifts every timestamp (the ``--merge`` aligner
+    uses it to put all ranks on a common step-anchored clock).
     """
+    rank = int(identity["rank"]) if identity else 0
+    pname = (
+        f"metrics_tpu rank {rank}/{identity['world_size']}"
+        if identity
+        else "metrics_tpu"
+    )
+    # perfetto keys tracks on pid; rank+1 keeps pid 0 (reserved-ish in
+    # some viewers) out of the picture while staying stable per rank
+    pid = rank + 1
     events: List[Dict[str, Any]] = [
         {
             "name": "process_name",
             "ph": "M",
-            "pid": 1,
+            "pid": pid,
             "tid": 0,
-            "args": {"name": "metrics_tpu"},
+            "args": {"name": pname},
         }
     ]
     for s in spans:
-        args = {"step": s.get("step")}
+        args = {"step": s.get("step"), "rank": rank}
         args.update(s.get("args") or {})
         ev: Dict[str, Any] = {
             "name": s["name"],
             "cat": s.get("phase", "other"),
-            "pid": 1,
+            "pid": pid,
             "tid": s.get("tid", 0),
-            "ts": round(float(s["ts_us"]), 3),
+            "ts": round(float(s["ts_us"]) + ts_offset_us, 3),
             "args": args,
         }
         if s.get("dur_us") is None:

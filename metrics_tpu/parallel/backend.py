@@ -29,6 +29,15 @@ class SyncBackend(ABC):
     def world_size(self) -> int:
         ...
 
+    @property
+    def rank(self) -> int:
+        """This process's index in the backend's world view (the identity
+        observability stamps on trace spans, flight dumps, and telemetry
+        snapshots — see ``observability/identity.py``). Defaults to the
+        JAX process index; virtual/test backends that simulate several
+        ranks in one process override this per simulated rank."""
+        return jax.process_index()
+
     @abstractmethod
     def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
         """Return ``[x_rank0, x_rank1, ...]``, identical on every rank."""
@@ -40,6 +49,10 @@ class SingleProcessBackend(SyncBackend):
     @property
     def world_size(self) -> int:
         return 1
+
+    @property
+    def rank(self) -> int:
+        return 0
 
     def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
         return [x]

@@ -22,6 +22,7 @@ __all__ = [
     "telemetry_requested",
     "trace_requested",
     "flight_dir",
+    "exporter_port",
     "refresh",
     "san_enabled",
     "san_requested",
@@ -36,12 +37,27 @@ def parse_flag(value: Optional[str]) -> bool:
     return value is not None and value.strip().lower() in _TRUTHY
 
 
-def _read() -> Dict[str, bool]:
+def _parse_port(value: Optional[str]) -> Optional[int]:
+    """``METRICS_TPU_EXPORTER=<port>`` parsing: a base-10 port number
+    (0 = OS-assigned), anything else (unset, empty, garbage) = disabled.
+    Garbage disables LOUDLY at the call site, not silently here."""
+    value = (value or "").strip()
+    if not value:
+        return None
+    try:
+        port = int(value, 10)
+    except ValueError:
+        return -1  # sentinel: set but unparseable (exporter warns once)
+    return port if 0 <= port <= 65535 else -1
+
+
+def _read() -> Dict[str, object]:
     return {
         "debug": parse_flag(os.environ.get("METRICS_TPU_DEBUG")),
         "telemetry": parse_flag(os.environ.get("METRICS_TPU_TELEMETRY")),
         "trace": parse_flag(os.environ.get("METRICS_TPU_TRACE")),
         "flight": (os.environ.get("METRICS_TPU_FLIGHT") or "").strip() or None,
+        "exporter": _parse_port(os.environ.get("METRICS_TPU_EXPORTER")),
         "san": parse_flag(os.environ.get("METRICS_TPU_SAN")),
     }
 
@@ -71,6 +87,14 @@ def flight_dir() -> Optional[str]:
     """``METRICS_TPU_FLIGHT=<dir>``: enable the failure flight recorder at
     import with ``<dir>`` as the dump directory (None = disabled)."""
     return _flags["flight"]
+
+
+def exporter_port() -> Optional[int]:
+    """``METRICS_TPU_EXPORTER=<port>``: arm the Prometheus export surface
+    at import on ``<port>`` (0 = OS-assigned). None = disabled (the
+    zero-sockets default); -1 = the variable was set but unparseable
+    (the exporter warns once and stays off)."""
+    return _flags["exporter"]
 
 
 # MetricSan runtime switch. Unlike the flags above this is not purely

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Command-line wrapper for the metrics_tpu Prometheus export surface.
+
+Three modes, all built on ``metrics_tpu/observability/exporter.py`` (the
+in-process surface a serving binary arms with ``enable_exporter(port)``
+or ``METRICS_TPU_EXPORTER=<port>``):
+
+* ``--demo`` — arm telemetry + the exporter and drive a live 64-tenant
+  :class:`~metrics_tpu.MetricCohort` eval loop (one tenant deliberately
+  poisoned so the per-tenant guard-verdict rows are non-trivial) until
+  interrupted. ``make serve-metrics`` runs this: point a browser or
+  ``curl`` at the printed ``/metrics`` URL to watch per-tenant health
+  move.
+* ``--snapshot FILE`` — render a saved telemetry snapshot
+  (``METRICS_TPU_TELEMETRY_DUMP`` exit dumps, ``tpu_suite`` chunk
+  telemetry) to Prometheus text on stdout: offline artifacts become
+  scrape-shaped without a live process.
+* ``--check FILE`` — validate a text exposition (``-`` = stdin) with the
+  same structural parser the exporter tests run
+  (:func:`~metrics_tpu.observability.exporter.parse_prometheus_text`);
+  exit 1 on any malformed line or histogram invariant violation. The CI
+  scrape step pipes its one scrape through this.
+
+With no mode flag, serves an idle exporter (telemetry armed) until
+interrupted — useful for probing the surface itself.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _hydrate(snapshot: dict):
+    """A Telemetry registry re-filled from a saved snapshot (counters,
+    gauges, timers, histograms — the event log has no exposition form)."""
+    from metrics_tpu.observability.telemetry import Telemetry
+
+    tel = Telemetry()
+    tel.counters.update(snapshot.get("counters") or {})
+    tel.gauges.update(snapshot.get("gauges") or {})
+    for name, t in (snapshot.get("timers") or {}).items():
+        tel._timers[name] = [float(t["total_s"]), int(t["count"])]
+    for name, h in (snapshot.get("histograms") or {}).items():
+        tel.histograms[name] = {
+            "buckets": list(h["buckets"]),
+            "counts": list(h["counts"]),
+            "sum": float(h["sum"]),
+            "count": int(h["count"]),
+        }
+    return tel
+
+
+def _demo_loop(port: int, tenants: int, poison_tenant: int) -> int:
+    import numpy as np
+
+    import metrics_tpu as M
+    import metrics_tpu.observability as obs
+    from metrics_tpu.reliability import guard_scope
+
+    obs.enable()
+    exporter = obs.enable_exporter(port)
+    cohort = M.MetricCohort(
+        M.MetricCollection([M.MeanSquaredError(), M.MeanAbsoluteError()]),
+        tenants=tenants,
+    )
+    rng = np.random.RandomState(0)
+    print(f"serving {exporter.url} (and /healthz); Ctrl-C to stop")
+    print(
+        f"demo: {tenants}-tenant cohort, tenant {poison_tenant} poisoned"
+        " every 5th step (quarantine guard)"
+    )
+    step = 0
+    try:
+        while True:
+            preds = rng.rand(tenants, 64).astype(np.float32)
+            target = rng.rand(tenants, 64).astype(np.float32)
+            if step % 5 == 4:
+                preds[poison_tenant] = np.nan
+            with guard_scope("quarantine"):
+                cohort(preds, target)
+            if step % 10 == 9:
+                cohort.health()
+            step += 1
+            time.sleep(0.25)
+    except KeyboardInterrupt:
+        print(f"\nstopped after {step} steps")
+    finally:
+        obs.disable_exporter()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--port",
+        type=int,
+        default=None,
+        help="exporter port (default: METRICS_TPU_EXPORTER, else 9464; 0 = OS-assigned)",
+    )
+    ap.add_argument(
+        "--demo", action="store_true", help="drive a live 64-tenant cohort workload"
+    )
+    ap.add_argument("--tenants", type=int, default=64, help="demo cohort size")
+    ap.add_argument(
+        "--poison-tenant", type=int, default=3, help="demo slot to poison periodically"
+    )
+    ap.add_argument(
+        "--snapshot", help="render a saved telemetry snapshot JSON to stdout and exit"
+    )
+    ap.add_argument(
+        "--check", help="validate a Prometheus text exposition file ('-' = stdin)"
+    )
+    args = ap.parse_args(argv)
+
+    if args.check is not None:
+        from metrics_tpu.observability.exporter import parse_prometheus_text
+
+        text = sys.stdin.read() if args.check == "-" else open(args.check).read()
+        try:
+            samples = parse_prometheus_text(text)
+        except ValueError as err:
+            print(f"INVALID exposition: {err}", file=sys.stderr)
+            return 1
+        print(f"valid Prometheus text format: {len(samples)} metric families")
+        return 0
+
+    if args.snapshot is not None:
+        with open(args.snapshot) as f:
+            snap = json.load(f)
+        # render under the ARTIFACT's identity stamp: the exposition must
+        # name the rank/host that produced the numbers, not this process
+        sys.stdout.write(
+            _hydrate(snap).to_prometheus(identity=snap.get("identity"))
+        )
+        return 0
+
+    from metrics_tpu.utilities.env import exporter_port
+
+    port = args.port
+    if port is None:
+        env_port = exporter_port()
+        port = env_port if env_port is not None and env_port >= 0 else 9464
+
+    if args.demo:
+        return _demo_loop(port, args.tenants, args.poison_tenant)
+
+    import metrics_tpu.observability as obs
+
+    obs.enable()
+    exporter = obs.enable_exporter(port)
+    print(f"serving {exporter.url} (and /healthz); Ctrl-C to stop")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        obs.disable_exporter()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,7 +5,8 @@ Accepts either artifact the observability layer writes:
 
 * a **native trace dump** (``TraceRecorder.snapshot()`` / ``to_json()``,
   format marker ``metrics_tpu.trace``) — spans become complete
-  (``ph: "X"``) trace events with phase categories and step args;
+  (``ph: "X"``) trace events with phase categories and step args, on a
+  process track named after the dump's rank identity;
 * a **flight-recorder dump** (``metrics_tpu.flight_dump``) — the event
   ring becomes instant events on a synthetic timeline (events carry
   relative seconds, not span timestamps), so the last-N-steps window
@@ -18,11 +19,19 @@ Usage::
 
     python scripts/trace_export.py DUMP.json [...more] [-o OUT.json]
     python scripts/trace_export.py flight-dumps/*.json
+    python scripts/trace_export.py --merge rank0.json rank1.json -o merged.json
 
 With one input, ``-o`` names the output (default: ``<input>.perfetto.json``
 next to the input); with several, each converts next to its input and
-``-o`` is rejected. Open the results at https://ui.perfetto.dev or
-``chrome://tracing``.
+``-o`` is rejected — unless ``--merge`` is given, which aligns N per-rank
+native trace dumps on the **durable step index** into ONE timeline with
+one Perfetto process track per rank (a slow rank inside a sync leg is
+then visible at a glance: same step, longer span). Each rank's clock is
+an arbitrary process-local origin; the merge anchors every rank at the
+host-earliest span of the first step index ALL ranks recorded, which is
+exactly the alignment the step-pinned spans (EvalSession cursors, engine
+dispatch counters) make meaningful. Open the results at
+https://ui.perfetto.dev or ``chrome://tracing``.
 """
 import argparse
 import json
@@ -68,7 +77,7 @@ def convert(blob: dict) -> dict:
         return blob  # already Perfetto: pass through
     fmt = blob.get("format")
     if fmt == "metrics_tpu.trace" or "spans" in blob:
-        return spans_to_perfetto(blob.get("spans", []))
+        return spans_to_perfetto(blob.get("spans", []), identity=blob.get("identity"))
     # the marker-less "events" fallback must not swallow telemetry exit
     # dumps (they also carry an events list, but timeline-less): globbing a
     # mixed artifact dir should skip those loudly, not emit an all-ts-0 trace
@@ -84,11 +93,124 @@ def convert(blob: dict) -> dict:
     )
 
 
+def merge_rank_traces(blobs: list) -> dict:
+    """Merge N per-rank native trace dumps into one Perfetto timeline.
+
+    Alignment contract: each dump's ``ts_us`` clock starts at an
+    arbitrary per-process origin, but the **step index** riding every
+    span is durable and rank-correlated (the engine's dispatch counter,
+    or the EvalSession cursor when a session pins it). The merge anchors
+    every rank's clock so the earliest span of the smallest step index
+    ALL ranks recorded lands at t=0 — after that, per-rank skew *within*
+    a step is real signal (the slow rank), not clock noise. Ranks come
+    from each dump's identity stamp (falling back to input order), one
+    Perfetto process track per rank.
+    """
+    for i, blob in enumerate(blobs):
+        if blob.get("format") != "metrics_tpu.trace" and "spans" not in blob:
+            raise ValueError(
+                "--merge takes native metrics_tpu trace dumps"
+                f" (TraceRecorder.to_json()); input {i} has keys"
+                f" {sorted(blob)[:6]}"
+            )
+    # rank assignment in two passes so a duplicate/unstamped dump can
+    # never steal a LATER input's legitimately-stamped rank (which would
+    # relabel the real rank's track and misattribute the slow-rank
+    # signal): first honor every stamp (first claimer wins), then hand
+    # duplicates and unstamped inputs ranks outside the claimed set.
+    claimed = set()
+    assigned = [None] * len(blobs)
+    for i, blob in enumerate(blobs):
+        identity = blob.get("identity") or {}
+        if "rank" in identity and int(identity["rank"]) not in claimed:
+            assigned[i] = int(identity["rank"])
+            claimed.add(assigned[i])
+    fallback = 0
+    for i, blob in enumerate(blobs):
+        if assigned[i] is not None:
+            continue
+        while fallback in claimed:
+            fallback += 1
+        assigned[i] = fallback
+        claimed.add(fallback)
+        print(
+            f"warning: input {i} has a missing or already-claimed rank"
+            f" identity; assigning it track rank {assigned[i]}",
+            file=sys.stderr,
+        )
+    per_rank = []
+    for i, blob in enumerate(blobs):
+        rank = assigned[i]
+        identity = dict(blob.get("identity") or {})
+        identity.setdefault("world_size", len(blobs))
+        identity["rank"] = rank
+        spans = blob.get("spans", [])
+        steps = {}
+        for s in spans:
+            step = s.get("step")
+            if step is None:
+                continue
+            ts = float(s["ts_us"])
+            if step not in steps or ts < steps[step]:
+                steps[step] = ts
+        per_rank.append({"identity": identity, "spans": spans, "steps": steps})
+    common = None
+    for entry in per_rank:
+        stepset = set(entry["steps"])
+        common = stepset if common is None else (common & stepset)
+    if not common:
+        raise ValueError(
+            "--merge found no step index common to every input trace —"
+            " step-aligned merging needs overlapping step ranges (were"
+            " these dumps recorded over the same eval stream?)"
+        )
+    anchor = min(common)
+    events = []
+    for entry in sorted(per_rank, key=lambda e: e["identity"]["rank"]):
+        offset = -entry["steps"][anchor]
+        converted = spans_to_perfetto(
+            entry["spans"], identity=entry["identity"], ts_offset_us=offset
+        )
+        events.extend(converted["traceEvents"])
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "merged_ranks": sorted(e["identity"]["rank"] for e in per_rank),
+            "anchor_step": anchor,
+        },
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("inputs", nargs="+", help="dump file(s) to convert")
-    ap.add_argument("-o", "--output", help="output path (single input only)")
+    ap.add_argument("-o", "--output", help="output path (single input only, or --merge)")
+    ap.add_argument(
+        "--merge",
+        action="store_true",
+        help="merge N per-rank native trace dumps into ONE timeline"
+        " aligned on the durable step index (one process track per rank)",
+    )
     args = ap.parse_args(argv)
+    if args.merge:
+        if len(args.inputs) < 2:
+            ap.error("--merge needs at least two per-rank trace dumps")
+        blobs = []
+        for path in args.inputs:
+            with open(path) as f:
+                blobs.append(json.load(f))
+        merged = merge_rank_traces(blobs)
+        out = args.output or (
+            os.path.splitext(args.inputs[0])[0] + ".merged.perfetto.json"
+        )
+        with open(out, "w") as f:
+            json.dump(merged, f)
+        print(
+            f"wrote {out} (ranks {merged['metadata']['merged_ranks']},"
+            f" anchored on step {merged['metadata']['anchor_step']})"
+        )
+        return 0
     if args.output and len(args.inputs) > 1:
         ap.error("-o/--output needs exactly one input")
     for path in args.inputs:

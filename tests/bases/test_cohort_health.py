@@ -1,0 +1,437 @@
+"""Per-tenant cohort health tests (`metrics_tpu/cohort.py` +
+`metrics_tpu/engine.py` health variant + the export surface).
+
+The contract, in priority order:
+
+1. **Off = untouched.** With observability disabled the cohort runs the
+   EXACT pre-health program (same abstract jaxpr digest, no health
+   arrays, no counters) and its states/values are bit-identical to a
+   health-armed run — health is a separate signature-cache entry, and
+   toggling it off again is a cache hit, not a retrace.
+2. **In-dispatch accounting.** rows-seen / update count / last-active
+   step / nonfinite counts accumulate inside the one donated dispatch
+   (no per-tenant host sync), padding slots masked, slot reuse
+   re-defaulted.
+3. **Poison attribution by slot.** A deliberately poisoned tenant under
+   a quarantine guard is named by slot in `health()`, in the
+   `cohort.tenant.*` gauges, in flight-dump breadcrumbs, and in the
+   `/metrics` scrape — the fleet-observability acceptance scenario.
+4. **Rank-correlated traces.** Two virtual-DDP rank traces merge into
+   one step-aligned Perfetto timeline with one track per rank.
+"""
+import importlib.util
+import json
+import os
+import urllib.request
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import (
+    MeanAbsoluteError,
+    MeanSquaredError,
+    MetricCohort,
+    MetricCollection,
+    observability as obs,
+)
+from metrics_tpu.analysis import fingerprint_jaxpr
+from metrics_tpu.observability.exporter import parse_prometheus_text
+from metrics_tpu.observability.trace import TraceRecorder
+from metrics_tpu.reliability import guard_scope
+from tests.helpers.testers import run_virtual_ddp
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    obs.disable()
+    obs.get().reset()
+    obs.disable_exporter()
+    yield
+    obs.disable()
+    obs.get().reset()
+    obs.disable_exporter()
+
+
+def _grid(rng, shape):
+    # grid-valued floats (multiples of 1/256): every re-association is
+    # exact, so cohort-vs-cohort comparisons are bitwise (the test bed's
+    # standing methodology, tests/bases/test_cohort.py)
+    return (rng.randint(0, 256, size=shape) / 256.0).astype(np.float32)
+
+
+def _batch(tenants, rows=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(_grid(rng, (tenants, rows))), jnp.asarray(
+        _grid(rng, (tenants, rows))
+    )
+
+
+# ----------------------------------------------------------------------
+# 1. off = untouched
+# ----------------------------------------------------------------------
+def test_health_off_runs_the_default_program_bit_identically():
+    preds, target = _batch(4)
+    off = MetricCohort(MeanSquaredError(), tenants=4)
+    v_off = off(preds, target)
+    assert off.health() is None
+    assert off._health is None
+
+    obs.enable()
+    on = MetricCohort(MeanSquaredError(), tenants=4)
+    v_on = on(preds, target)
+    assert on.health() is not None
+    obs.disable()
+
+    assert (np.asarray(v_off) == np.asarray(v_on)).all()
+    for sname, v in off._states["metric"].items():
+        assert (np.asarray(v) == np.asarray(on._states["metric"][sname])).all(), sname
+    # the default (health-off) program is byte-identical to the pristine
+    # one: same abstract jaxpr digest whether or not a health-armed
+    # cohort exists in the process
+    sample = (np.asarray(preds)[0], np.asarray(target)[0])
+    d_off = fingerprint_jaxpr(off._engine.abstract_cohort_step(*sample)[0])
+    d_on = fingerprint_jaxpr(on._engine.abstract_cohort_step(*sample)[0])
+    assert d_off == d_on
+
+
+def test_health_toggle_is_a_cache_entry_not_a_retrace():
+    preds, target = _batch(4)
+    cohort = MetricCohort(MeanSquaredError(), tenants=4)
+    cohort(preds, target)  # off: program A
+    assert cohort._engine.trace_count == 1
+    obs.enable()
+    cohort(preds, target)  # on: program B (one new trace)
+    assert cohort._engine.trace_count == 2
+    obs.disable()
+    cohort(preds, target)  # off again: cache hit on program A
+    assert cohort._engine.trace_count == 2
+    obs.enable()
+    cohort(preds, target)  # on again: cache hit on program B
+    assert cohort._engine.trace_count == 2
+    obs.disable()
+    # health accumulators only advanced on the armed dispatches
+    h = cohort.health()
+    assert h["updates"].tolist() == [2, 2, 2, 2]
+
+
+# ----------------------------------------------------------------------
+# 2. in-dispatch accounting
+# ----------------------------------------------------------------------
+def test_rows_updates_laststep_accounting():
+    obs.enable()
+    preds, target = _batch(4, rows=16)
+    cohort = MetricCohort(MeanSquaredError(), tenants=4)
+    cohort(preds, target)
+    cohort(preds, target)
+    cohort(preds, target)
+    h = cohort.health()
+    assert h["step"] == 3
+    assert h["rows_seen"].tolist() == [48, 48, 48, 48]
+    assert h["updates"].tolist() == [3, 3, 3, 3]
+    assert h["last_step"].tolist() == [3, 3, 3, 3]
+    assert h["staleness"].tolist() == [0, 0, 0, 0]
+    assert h["nonfinite"].tolist() == [0, 0, 0, 0]
+
+
+def test_new_tenant_starts_fresh_and_never_active_reads_stale():
+    obs.enable()
+    preds, target = _batch(2)
+    cohort = MetricCohort(MeanSquaredError(), tenants=2)
+    cohort(preds, target)
+    cohort(preds, target)
+    slot = cohort.add_tenant()
+    h = cohort.health(stale_after=2)
+    i = h["tenants"].index(slot)
+    assert h["updates"][i] == 0
+    assert h["last_step"][i] == -1
+    # never-active tenants read the full dispatch count as staleness
+    assert h["staleness"][i] == 2
+    assert obs.get().gauges["cohort.tenant.stale"] == 1
+    # once fed, the new tenant catches up
+    p3, t3 = _batch(3, seed=1)
+    cohort(p3, t3)
+    h = cohort.health()
+    i = h["tenants"].index(slot)
+    assert h["updates"][i] == 1 and h["staleness"][i] == 0
+
+
+def test_slot_reuse_resets_health():
+    obs.enable()
+    preds, target = _batch(3)
+    cohort = MetricCohort(MeanSquaredError(), tenants=3)
+    cohort(preds, target)
+    cohort.remove_tenant(1)
+    slot = cohort.add_tenant()
+    assert slot == 1
+    p, t = _batch(3, seed=2)
+    cohort(p, t)
+    h = cohort.health()
+    i = h["tenants"].index(1)
+    assert h["updates"][i] == 1  # not 2: the evicted tenant's history is gone
+    assert h["rows_seen"][i] == 16
+
+
+def test_capacity_growth_preserves_health():
+    obs.enable()
+    preds, target = _batch(2)
+    cohort = MetricCohort(MeanSquaredError(), tenants=2)
+    cohort(preds, target)
+    for _ in range(3):  # grow 2 -> 8 (capacity bucket)
+        cohort.add_tenant()
+    assert cohort.capacity == 8
+    h = cohort.health()
+    assert h["updates"][:2].tolist() == [1, 1]
+    assert h["updates"][2:].tolist() == [0, 0, 0]
+
+
+# ----------------------------------------------------------------------
+# 3. poison attribution by slot
+# ----------------------------------------------------------------------
+def test_poisoned_tenant_named_by_slot_and_breadcrumbed(tmp_path):
+    obs.enable()
+    preds, target = _batch(4)
+    cohort = MetricCohort(MeanSquaredError(), tenants=4)
+    poison = np.asarray(preds).copy()
+    poison[2] = np.nan
+    with obs.flight_scope(tmp_path) as flight:
+        with guard_scope("quarantine"):
+            cohort(preds, target)
+            cohort(jnp.asarray(poison), target)
+        h = cohort.health()
+        assert h["guard_verdicts"].tolist() == [0, 0, 1, 0]
+        assert h["nonfinite"].tolist() == [0, 0, 1, 0]
+        assert obs.get().gauges["cohort.tenant.poisoned"] == 1
+        assert flight.dumps >= 1
+        dump = json.load(open(flight.dump_paths[0]))
+        # the quarantine-time dump already carries the per-tenant poison
+        # breadcrumb (recorded BEFORE the guard's dump fires)...
+        assert dump["identity"]["rank"] == 0
+        poison_events = [
+            e for e in dump["events"] if e["kind"] == "cohort_tenant_poison"
+        ]
+        assert poison_events and poison_events[0]["tenants"] == [2]
+        # ...and any later dump (here: a manual drill) carries the health
+        # snapshot's staleness/poison breadcrumb too
+        drill = json.load(open(flight.dump("drill")))
+    health_events = [e for e in drill["events"] if e["kind"] == "cohort_health"]
+    assert health_events and health_events[0]["poisoned"] == [2]
+
+
+def test_acceptance_64_tenant_scrape_and_disabled_twin():
+    """The ISSUE acceptance scenario: a 64-tenant cohort with the
+    exporter armed serves a valid scrape naming the poisoned tenant by
+    slot with staleness and guard-verdict rows, plus every telemetry
+    registry key; the same run with observability disabled is
+    bit-identical with zero counters and zero sockets."""
+    tenants = 64
+    preds, target = _batch(tenants, rows=32)
+    poison = np.asarray(preds).copy()
+    poison[5] = np.inf
+
+    def drive(cohort):
+        with guard_scope("quarantine"):
+            cohort(preds, target)
+            cohort(jnp.asarray(poison), target)
+            cohort(preds, target)
+        return {k: np.asarray(v) for k, v in cohort._states["metric"].items()}
+
+    # disabled twin FIRST: zero counters, zero sockets, no health arrays
+    twin = MetricCohort(MeanSquaredError(), tenants=tenants)
+    twin_states = drive(twin)
+    assert obs.get().counters == {}
+    assert obs.get_exporter() is None
+    assert twin._health is None
+
+    obs.enable()
+    cohort = MetricCohort(MeanSquaredError(), tenants=tenants)
+    with obs.exporter_scope(0) as ex:
+        states = drive(cohort)
+        cohort.health()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{ex.port}/metrics", timeout=5
+        ) as r:
+            text = r.read().decode()
+    obs.disable()
+
+    # bit-identical states, observability on or off
+    for sname in twin_states:
+        assert (twin_states[sname] == states[sname]).all(), sname
+
+    samples = parse_prometheus_text(text)  # valid text format
+    # the poisoned tenant is identified by slot
+    verdicts = {
+        labels["tenant"]: v
+        for labels, v in samples["metrics_tpu_cohort_tenant_guard_verdicts"]
+        if labels["cohort"] == str(cohort._exporter_id)
+    }
+    assert verdicts["5"] == 1
+    assert all(v == 0 for t, v in verdicts.items() if t != "5")
+    assert len(verdicts) == tenants
+    # staleness rows present for every tenant
+    stale = {
+        labels["tenant"]: v
+        for labels, v in samples["metrics_tpu_cohort_tenant_staleness"]
+        if labels["cohort"] == str(cohort._exporter_id)
+    }
+    assert len(stale) == tenants
+    # every telemetry registry key made it into the scrape
+    from metrics_tpu.observability.telemetry import prometheus_name
+
+    snap = obs.get().snapshot()
+    for name in snap["counters"]:
+        assert prometheus_name(name) + "_total" in samples, name
+    for name in snap["gauges"]:
+        assert prometheus_name(name) in samples, name
+    for name in snap["histograms"]:
+        assert prometheus_name(name) + "_bucket" in samples, name
+
+
+# ----------------------------------------------------------------------
+# 4. rank-correlated traces
+# ----------------------------------------------------------------------
+def _trace_export():
+    spec = importlib.util.spec_from_file_location(
+        "trace_export", os.path.join(REPO, "scripts", "trace_export.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_virtual_ddp_rank_traces_merge_on_step_index():
+    dumps = [None, None]
+
+    def rank_fn(rank, world):
+        rec = TraceRecorder()
+        for step in (1, 2, 3):
+            with rec.span("forward", phase="dispatch", step=step, rank=rank):
+                pass
+        snap = rec.snapshot()
+        # identity auto-detects the virtual rank through the installed
+        # backend's thread-local rank property
+        assert snap["identity"]["rank"] == rank
+        assert snap["identity"]["world_size"] == world
+        dumps[rank] = snap
+
+    run_virtual_ddp(2, rank_fn)
+    merged = _trace_export().merge_rank_traces(dumps)
+    assert merged["metadata"]["merged_ranks"] == [0, 1]
+    assert merged["metadata"]["anchor_step"] == 1
+    tracks = {
+        e["args"]["name"] for e in merged["traceEvents"] if e.get("ph") == "M"
+    }
+    assert tracks == {"metrics_tpu rank 0/2", "metrics_tpu rank 1/2"}
+    pids = {e["pid"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+    assert pids == {1, 2}
+    # anchor alignment: each rank's earliest anchor-step span sits at t=0
+    for pid in pids:
+        anchored = [
+            e["ts"]
+            for e in merged["traceEvents"]
+            if e.get("ph") == "X" and e["pid"] == pid and e["args"]["step"] == 1
+        ]
+        assert min(anchored) == 0.0
+
+
+def test_merge_requires_a_common_step():
+    te = _trace_export()
+    rec_a, rec_b = TraceRecorder(), TraceRecorder()
+    with rec_a.span("w", step=1):
+        pass
+    with rec_b.span("w", step=9):
+        pass
+    with pytest.raises(ValueError, match="no step index common"):
+        te.merge_rank_traces([rec_a.snapshot(), rec_b.snapshot()])
+
+
+def test_sync_spans_carry_rank_attr():
+    ranks_seen = set()
+
+    def rank_fn(rank, world):
+        m = MeanSquaredError()
+        m.update(jnp.ones(4) * (rank + 1), jnp.zeros(4))
+        m.compute()  # triggers _sync_dist through the virtual backend
+
+    with obs.tracing_scope() as tracer:
+        run_virtual_ddp(2, rank_fn)
+        spans = [s for s in tracer.snapshot()["spans"] if s["name"].endswith(".sync")]
+    assert spans
+    for s in spans:
+        ranks_seen.add(s["args"]["rank"])
+    assert ranks_seen == {0, 1}
+
+
+def test_reserved_member_names_are_rejected():
+    """Dunder member names would collide with the cohort's own pytree /
+    checkpoint entries (__cohort_health__, __cohort_slots__)."""
+    with pytest.raises(ValueError, match="reserved"):
+        MetricCohort({"__cohort_health__": MeanSquaredError()})
+    with pytest.raises(ValueError, match="reserved"):
+        MetricCohort({"__cohort_slots__": MeanSquaredError()})
+
+
+def test_merge_duplicate_never_steals_a_stamped_rank():
+    """An unstamped/duplicate dump gets a rank OUTSIDE the claimed set —
+    a genuinely-stamped later input must keep its own track."""
+    te = _trace_export()
+
+    def snap(rank):
+        rec = TraceRecorder()
+        with rec.span("w", step=1):
+            pass
+        s = rec.snapshot()
+        s["identity"] = {"rank": rank, "world_size": 3}
+        return s
+
+    merged = te.merge_rank_traces([snap(0), snap(0), snap(1)])
+    assert merged["metadata"]["merged_ranks"] == [0, 1, 2]
+    tracks = sorted(
+        e["args"]["name"] for e in merged["traceEvents"] if e.get("ph") == "M"
+    )
+    assert tracks == [
+        "metrics_tpu rank 0/3",
+        "metrics_tpu rank 1/3",
+        "metrics_tpu rank 2/3",
+    ]
+
+
+def test_health_buffers_are_never_the_donated_ones():
+    """The exporter scrapes health() from a daemon thread; the dispatch
+    must donate COPIES of the accumulators so a mid-dispatch snapshot
+    reads valid buffers (guard on or off)."""
+    obs.enable()
+    preds, target = _batch(2)
+    cohort = MetricCohort(MeanSquaredError(), tenants=2)
+    cohort(preds, target)
+    before = cohort._health
+    ids_before = {k: id(v) for k, v in before.items()}
+    cohort(preds, target)
+    # the pre-dispatch accumulators were left untouched (readable) and the
+    # post-dispatch dict holds fresh arrays
+    for k, v in before.items():
+        assert np.asarray(v) is not None  # still fetchable, not deleted
+    assert all(id(cohort._health[k]) != ids_before[k] for k in ids_before)
+
+
+def test_any_restore_starts_a_fresh_health_window():
+    """Same-capacity loads too — health describes the state it watched,
+    and the loaded state has a different history."""
+    obs.enable()
+    preds, target = _batch(4)
+    source = MetricCohort(MeanSquaredError(), tenants=4)
+    source(preds, target)
+    blob = source.state_dict()
+
+    cohort = MetricCohort(MeanSquaredError(), tenants=4)
+    cohort(preds, target)
+    cohort(preds, target)
+    assert cohort.health()["step"] == 2
+    cohort.load_state_dict(blob)  # same capacity: no resize branch
+    assert cohort._health is None and cohort._steps == 0
+    assert cohort.health() is None
+    cohort(preds, target)
+    h = cohort.health()
+    assert h["step"] == 1 and h["updates"].tolist() == [1, 1, 1, 1]

@@ -1,0 +1,343 @@
+"""Prometheus export surface tests (`metrics_tpu/observability/exporter.py`).
+
+The contract under test, in priority order:
+
+1. **Zero sockets, zero overhead when off** — running the metric pipeline
+   without `enable_exporter` binds nothing, spawns nothing, and leaves
+   the registry/results bit-identical (the standing observability
+   invariant extended to the export surface).
+2. **Lifecycle** — `enable_exporter` is idempotent, `disable_exporter`
+   releases the port (re-bindable immediately), `exporter_scope`
+   restores the prior state.
+3. **Scrape correctness** — `/metrics` is valid Prometheus text format
+   (validated by the same `parse_prometheus_text` the CI scrape check
+   runs), contains every registry key, and a scrape racing live updates
+   still parses with all histogram invariants intact (consistent
+   snapshot).
+"""
+import json
+import socket
+import threading
+import urllib.request
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import Accuracy, MetricCollection, observability as obs
+from metrics_tpu.observability import telemetry as telemetry_mod
+from metrics_tpu.observability.exporter import (
+    parse_prometheus_text,
+    render_exposition,
+)
+from metrics_tpu.observability.telemetry import prometheus_name
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    obs.disable()
+    obs.get().reset()
+    obs.disable_exporter()
+    yield
+    obs.disable()
+    obs.get().reset()
+    obs.disable_exporter()
+
+
+def _scrape(port: int, path: str = "/metrics") -> str:
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+# ----------------------------------------------------------------------
+# 1. zero-sockets / zero-overhead when off
+# ----------------------------------------------------------------------
+def test_zero_sockets_and_bit_identical_when_off():
+    assert obs.get_exporter() is None
+    col = MetricCollection([Accuracy()], compiled=True)
+    p = jnp.asarray(np.random.RandomState(0).rand(64, 4).astype(np.float32))
+    t = jnp.asarray(np.random.RandomState(1).randint(4, size=64))
+    baseline = np.asarray(col(p, t)["Accuracy"])
+    # no exporter thread appeared as a side effect of the forward
+    assert obs.get_exporter() is None
+    assert not any(
+        th.name.startswith("metrics-tpu-exporter") for th in threading.enumerate()
+    )
+    assert obs.get().counters == {}
+    # the same forward under an armed exporter is bit-identical
+    col2 = MetricCollection([Accuracy()], compiled=True)
+    with obs.exporter_scope(0):
+        again = np.asarray(col2(p, t)["Accuracy"])
+    assert (baseline == again).all()
+
+
+def test_render_does_not_mutate_registry():
+    obs.enable()
+    obs.get().count("engine.dispatches", 2)
+    before = obs.get().snapshot()
+    render_exposition()
+    after = obs.get().snapshot()
+    assert before["counters"] == after["counters"]
+    assert before["gauges"] == after["gauges"]
+
+
+# ----------------------------------------------------------------------
+# 2. lifecycle
+# ----------------------------------------------------------------------
+def test_enable_is_idempotent_and_explicit_port_restarts():
+    first = obs.enable_exporter(0)
+    try:
+        assert obs.enable_exporter() is first  # no port requested: keep
+        assert obs.enable_exporter(first.port) is first  # same port: keep
+        assert obs.enable_exporter(0) is first  # 0 = any port: keep
+    finally:
+        obs.disable_exporter()
+    assert obs.get_exporter() is None
+
+
+def test_disarm_releases_the_port():
+    exporter = obs.enable_exporter(0)
+    port = exporter.port
+    assert _scrape(port, "/healthz")
+    obs.disable_exporter()
+    # the port is immediately re-bindable: disarm closed the listener.
+    # SO_REUSEADDR matches how any server (including a re-armed exporter)
+    # would bind — without the close, even this fails with EADDRINUSE
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    try:
+        s.bind(("127.0.0.1", port))
+    finally:
+        s.close()
+    # and a fresh exporter re-binds the same port for real
+    again = obs.enable_exporter(port)
+    assert again.port == port
+    obs.disable_exporter()
+
+
+def test_exporter_scope_restores_prior_state():
+    with obs.exporter_scope(0) as ex:
+        assert obs.get_exporter() is ex
+    assert obs.get_exporter() is None
+
+
+def test_healthz_carries_identity():
+    with obs.exporter_scope(0) as ex:
+        blob = json.loads(_scrape(ex.port, "/healthz"))
+    assert blob["status"] == "ok"
+    assert blob["rank"] == 0 and blob["world_size"] == 1
+
+
+def test_unknown_path_is_404():
+    with obs.exporter_scope(0) as ex:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _scrape(ex.port, "/nope")
+        assert err.value.code == 404
+
+
+# ----------------------------------------------------------------------
+# 3. scrape correctness
+# ----------------------------------------------------------------------
+def test_scrape_is_valid_and_complete():
+    obs.enable()
+    tel = obs.get()
+    tel.count("engine.dispatches", 7)
+    tel.gauge("cohort.size", 3)
+    tel.observe("metric.Accuracy.forward_s", 0.25)
+    tel.observe_hist("sync.latency_ms", 2.0, obs.LATENCY_BUCKETS_MS)
+    tel.observe_hist("sync.latency_ms", 80.0, obs.LATENCY_BUCKETS_MS)
+    with obs.exporter_scope(0) as ex:
+        text = _scrape(ex.port)
+    samples = parse_prometheus_text(text)
+    snap = tel.snapshot()
+    for name in snap["counters"]:
+        # counters carry the conventional _total suffix — which is also
+        # what keeps counter+histogram double-keys (sync.payload_bytes)
+        # from declaring one family with two types
+        assert prometheus_name(name) + "_total" in samples, name
+    for name in snap["gauges"]:
+        assert prometheus_name(name) in samples, name
+    for name in snap["timers"]:
+        assert prometheus_name(name) + "_sum" in samples, name
+        assert prometheus_name(name) + "_count" in samples, name
+    for name in snap["histograms"]:
+        assert prometheus_name(name) + "_bucket" in samples, name
+    # values survive the round trip
+    assert samples[prometheus_name("engine.dispatches") + "_total"][0][1] == 7
+    hist = samples[prometheus_name("sync.latency_ms") + "_count"]
+    assert hist[0][1] == 2
+    # identity rides the exposition
+    assert samples["metrics_tpu_identity"][0][0]["rank"] == "0"
+
+
+def test_counter_histogram_double_key_renders_one_type_per_family():
+    """sync.payload_bytes (and kin) are recorded as BOTH a counter and a
+    histogram; the exposition must keep those as distinct families (the
+    counter takes _total) — a real scraper rejects a scrape that
+    declares one name with two types."""
+    obs.enable()
+    tel = obs.get()
+    tel.count("sync.payload_bytes", 4096)
+    tel.observe_hist("sync.payload_bytes", 4096, obs.PAYLOAD_BUCKETS_BYTES)
+    samples = parse_prometheus_text(tel.to_prometheus())  # raises on dup TYPE
+    assert prometheus_name("sync.payload_bytes") + "_total" in samples
+    assert prometheus_name("sync.payload_bytes") + "_bucket" in samples
+
+
+def test_parser_rejects_duplicate_family_declarations():
+    with pytest.raises(ValueError, match="declared twice"):
+        parse_prometheus_text(
+            "# TYPE m counter\nm_total 1\n# TYPE m histogram\n"
+            'm_bucket{le="+Inf"} 1\nm_count 1\n'
+        )
+
+
+def test_scrape_counts_scrapes():
+    obs.enable()
+    with obs.exporter_scope(0) as ex:
+        _scrape(ex.port)
+        _scrape(ex.port)
+    assert obs.get().counters["exporter.scrapes"] == 2
+
+
+def test_scrape_while_updating_is_consistent():
+    """A scrape racing a writer thread always parses and always satisfies
+    the histogram invariants (cumulative buckets, +Inf == _count) — the
+    locked-snapshot contract, not a torn registry."""
+    obs.enable()
+    tel = obs.get()
+    stop = threading.Event()
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            tel.count("engine.dispatches")
+            tel.observe_hist("sync.latency_ms", float(i % 100), obs.LATENCY_BUCKETS_MS)
+            tel.gauge("cohort.size", i)
+            i += 1
+
+    writer = threading.Thread(target=hammer, daemon=True)
+    writer.start()
+    try:
+        with obs.exporter_scope(0) as ex:
+            for _ in range(10):
+                samples = parse_prometheus_text(_scrape(ex.port))
+                name = prometheus_name("sync.latency_ms")
+                if name + "_bucket" in samples:
+                    # parse_prometheus_text already enforced cumulativity
+                    # and +Inf == _count; reaching here IS the assertion
+                    assert name + "_count" in samples
+    finally:
+        stop.set()
+        writer.join(timeout=5)
+
+
+def test_parser_rejects_malformed_expositions():
+    with pytest.raises(ValueError):
+        parse_prometheus_text("not a metric line at all!")
+    with pytest.raises(ValueError, match="label"):
+        # junk inside the label block must not be silently skipped
+        parse_prometheus_text('m{garbage,ok="1"} 3\n')
+    with pytest.raises(ValueError, match="label"):
+        # 'bad-label' embeds a valid-looking 'label="1"' a findall-based
+        # extraction would happily accept
+        parse_prometheus_text('m{bad-label="1"} 3\n')
+    with pytest.raises(ValueError):
+        # decreasing cumulative buckets
+        parse_prometheus_text(
+            'm_bucket{le="1"} 5\nm_bucket{le="2"} 3\nm_bucket{le="+Inf"} 5\nm_count 5\n'
+        )
+    with pytest.raises(ValueError):
+        # +Inf bucket disagrees with _count
+        parse_prometheus_text(
+            'm_bucket{le="1"} 1\nm_bucket{le="+Inf"} 2\nm_count 3\n'
+        )
+
+
+def test_env_port_parsing(monkeypatch):
+    from metrics_tpu.utilities import env
+
+    monkeypatch.setenv("METRICS_TPU_EXPORTER", "9464")
+    env.refresh()
+    assert env.exporter_port() == 9464
+    monkeypatch.setenv("METRICS_TPU_EXPORTER", "not-a-port")
+    env.refresh()
+    assert env.exporter_port() == -1
+    monkeypatch.delenv("METRICS_TPU_EXPORTER")
+    env.refresh()
+    assert env.exporter_port() is None
+
+
+def test_percentile_estimator():
+    h = {"buckets": [1.0, 2.0, 4.0], "counts": [0, 0, 0, 0], "sum": 0.0, "count": 0}
+    assert telemetry_mod.percentile(h, 50) == 0.0
+    h = {"buckets": [1.0, 2.0, 4.0], "counts": [2, 2, 0, 0], "sum": 3.0, "count": 4}
+    # p50 crosses at the end of the first bucket
+    assert telemetry_mod.percentile(h, 50) == pytest.approx(1.0)
+    # p75 lands mid-second-bucket
+    assert 1.0 < telemetry_mod.percentile(h, 75) <= 2.0
+    # overflow mass clamps to the last finite edge
+    h = {"buckets": [1.0, 2.0], "counts": [0, 0, 5], "sum": 50.0, "count": 5}
+    assert telemetry_mod.percentile(h, 99) == 2.0
+    with pytest.raises(ValueError):
+        telemetry_mod.percentile(h, 101)
+
+
+def test_report_shows_histogram_percentiles_and_sorted_keys():
+    obs.enable()
+    tel = obs.get()
+    tel.observe_hist("sync.latency_ms", 1.0, obs.LATENCY_BUCKETS_MS)
+    tel.count("zzz.last", 1)
+    tel.count("aaa.first", 1)
+    report = tel.report()
+    assert "p50=" in report and "p95=" in report and "p99=" in report
+    assert report.index("aaa.first") < report.index("zzz.last")
+
+
+def test_session_gauges_ride_the_exposition(tmp_path):
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.reliability import EvalSession
+
+    session = EvalSession(MeanSquaredError(), tmp_path, checkpoint_every=1)
+    session.step(0, jnp.ones(8), jnp.zeros(8))
+    session.step(1, jnp.ones(8), jnp.zeros(8))
+    text = render_exposition()
+    samples = parse_prometheus_text(text)
+    label = str(session.journal.directory)
+    cursors = {
+        labels["journal"]: v
+        for labels, v in samples["metrics_tpu_session_cursor"]
+    }
+    assert cursors[label] == 1
+    generations = {
+        labels["journal"]: v
+        for labels, v in samples["metrics_tpu_session_generation"]
+    }
+    assert generations[label] >= 1
+    checkpoints = {
+        labels["journal"]: v
+        for labels, v in samples["metrics_tpu_session_checkpoints"]
+    }
+    assert checkpoints[label] == 2
+
+
+def test_snapshot_identity_override_rides_the_exposition():
+    """Offline renderers pass the artifact's identity so the exposition
+    names the process that produced the numbers, not the renderer."""
+    tel = telemetry_mod.Telemetry()
+    tel.counters["engine.dispatches"] = 1
+    text = tel.to_prometheus(identity={"rank": 3, "world_size": 8, "host": "pod-7"})
+    samples = parse_prometheus_text(text)
+    labels = samples["metrics_tpu_identity"][0][0]
+    assert labels == {"rank": "3", "world_size": "8", "host": "pod-7"}
+
+
+def test_explicit_host_change_restarts_the_listener():
+    first = obs.enable_exporter(0)
+    try:
+        other = obs.enable_exporter(first.port, host="0.0.0.0")
+        assert other is not first and other.host == "0.0.0.0"
+        # unspecified binding keeps whatever is armed
+        assert obs.enable_exporter() is other
+    finally:
+        obs.disable_exporter()

@@ -1,0 +1,181 @@
+"""Glossary-drift gate: every telemetry key emitted anywhere in-tree has
+a documented row in `docs/observability.md`, and every documented row
+still matches an emitter — in tier-1, so new keys cannot land
+undocumented and stale rows cannot outlive their keys.
+
+Mechanics: an AST scan over `metrics_tpu/` collects every
+`count()`/`gauge()`/`observe_hist()` call site's key. Literal keys pass
+through; f-string keys canonicalize each interpolated fragment to `*`
+(`f"metric.{name}.{phase}_calls"` → `metric.*.*_calls`). The docs side
+extracts backticked key patterns from the first column of the three
+glossary tables and canonicalizes `<placeholder>` spans the same way
+(`metric.<Name>.<phase>_calls` → `metric.*.*_calls`). The gate is SET
+EQUALITY per kind, both directions.
+
+The exporter's per-tenant exposition families (not registry keys — they
+exist only in the `/metrics` rendering) are pinned separately against
+the "Fleet export" section.
+"""
+import ast
+import functools
+import os
+import re
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu import MeanSquaredError, MetricCohort, observability as obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DOC = os.path.join(REPO, "docs", "observability.md")
+PKG = os.path.join(REPO, "metrics_tpu")
+
+_KINDS = {"count": "counter", "gauge": "gauge", "observe_hist": "histogram"}
+_GLOSSARY_SECTIONS = {
+    "counter": "## Counter glossary",
+    "gauge": "## Gauge glossary",
+    "histogram": "## Histogram glossary",
+}
+_KEY_RE = re.compile(r"^[a-z][a-zA-Z0-9_.*]*\.[a-zA-Z0-9_.*]+$")
+_PLACEHOLDER = "\x00"
+
+
+def _canonical_emitted(node: ast.Call):
+    """Canonical key pattern for one call site, or None when the first
+    argument is not a string-shaped key (e.g. `itertools.count(1)`)."""
+    arg = node.args[0] if node.args else None
+    if arg is None:
+        return None  # e.g. `itertools.count()` — not a telemetry key
+    if isinstance(arg, ast.Constant):
+        return arg.value if isinstance(arg.value, str) else None
+    if isinstance(arg, ast.JoinedStr):
+        joined = "".join(
+            v.value if isinstance(v, ast.Constant) else _PLACEHOLDER
+            for v in arg.values
+        )
+        return ".".join(
+            seg.replace(_PLACEHOLDER, "*") if _PLACEHOLDER in seg else seg
+            for seg in joined.split(".")
+        )
+    return "<dynamic>"
+
+
+@functools.lru_cache(maxsize=1)
+def _emitted_keys():
+    found = {"counter": set(), "gauge": set(), "histogram": set()}
+    dynamic = []
+    for root, dirs, files in os.walk(PKG):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            with open(path) as f:
+                tree = ast.parse(f.read())
+            for node in ast.walk(tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _KINDS
+                ):
+                    continue
+                key = _canonical_emitted(node)
+                if key is None:
+                    continue  # non-string first arg: not a telemetry key
+                if key == "<dynamic>":
+                    dynamic.append(f"{path}:{node.lineno}")
+                    continue
+                if _KEY_RE.match(key):
+                    found[_KINDS[node.func.attr]].add(key)
+    # a fully-dynamic key (a bare variable) cannot be glossary-checked;
+    # the tree has none, and any new one must either become an f-string
+    # with literal structure or earn an explicit exemption HERE
+    assert not dynamic, f"unauditable dynamic telemetry keys: {dynamic}"
+    return found
+
+
+def _documented_keys():
+    with open(DOC) as f:
+        text = f.read()
+    sections = {}
+    for kind, header in _GLOSSARY_SECTIONS.items():
+        assert header in text, f"docs/observability.md lost its '{header}' section"
+        body = text.split(header, 1)[1]
+        # a section ends at the next "## " heading
+        body = body.split("\n## ", 1)[0]
+        keys = set()
+        for line in body.splitlines():
+            if not line.startswith("|"):
+                continue
+            # protect escaped pipes inside code spans, then split cells
+            cells = line.replace("\\|", _PLACEHOLDER).split("|")
+            if len(cells) < 2:
+                continue
+            first = cells[1].replace(_PLACEHOLDER, "\\|")
+            for span in re.findall(r"`([^`]+)`", first):
+                pattern = span.replace("\\|", "|")
+                pattern = re.sub(r"<[^>]*>", "*", pattern)
+                # re-collapse segments that mix a placeholder with text
+                # only when the emitted side cannot see the distinction
+                if _KEY_RE.match(pattern):
+                    keys.add(pattern)
+        sections[kind] = keys
+    return sections
+
+
+def test_every_emitted_key_is_documented_and_vice_versa():
+    emitted = _emitted_keys()
+    documented = _documented_keys()
+    for kind in ("counter", "gauge", "histogram"):
+        missing_rows = emitted[kind] - documented[kind]
+        stale_rows = documented[kind] - emitted[kind]
+        assert not missing_rows, (
+            f"{kind} keys emitted in-tree but undocumented in"
+            f" docs/observability.md: {sorted(missing_rows)}"
+        )
+        assert not stale_rows, (
+            f"documented {kind} rows with no in-tree emitter (stale"
+            f" glossary): {sorted(stale_rows)}"
+        )
+
+
+def test_scan_sees_the_known_anchors():
+    """The scanner itself is load-bearing: if the AST walk silently broke,
+    set equality above could pass on two empty sets. Pin a few anchors."""
+    emitted = _emitted_keys()
+    assert "engine.dispatches" in emitted["counter"]
+    assert "cohort.health_snapshots" in emitted["counter"]
+    assert "exporter.scrapes" in emitted["counter"]
+    assert "metric.*.*_calls" in emitted["counter"]
+    assert "cohort.tenant.stale" in emitted["gauge"]
+    assert "sync.latency_ms" in emitted["histogram"]
+
+
+def test_exporter_tenant_families_are_documented():
+    """Every per-tenant family the export surface renders appears in the
+    Fleet export section (the 'vice versa for exporter keys' half)."""
+    obs.disable()
+    obs.get().reset()
+    try:
+        obs.enable()
+        cohort = MetricCohort(MeanSquaredError(), tenants=2)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray((rng.randint(0, 256, size=(2, 8)) / 256.0).astype(np.float32))
+        cohort(x, x)
+        text = obs.render_exposition()
+    finally:
+        obs.disable()
+        obs.get().reset()
+    # labeled families only: the {cohort=...} rows are the exporter's own
+    # rendering (registry keys are glossary-checked as dotted names above)
+    families = set(
+        re.findall(r"^(metrics_tpu_cohort[a-z_]*)\{", text, flags=re.M)
+    )
+    assert families, "exposition rendered no cohort families"
+    with open(DOC) as f:
+        doc = f.read()
+    undocumented = {f for f in families if f not in doc}
+    assert not undocumented, (
+        f"exporter families missing from docs/observability.md: {sorted(undocumented)}"
+    )

@@ -52,6 +52,13 @@ class VirtualDDPGroup(SyncBackend):
     def world_size(self) -> int:
         return self._world
 
+    @property
+    def rank(self) -> int:
+        # thread-local: each simulated rank thread reads its own index, so
+        # observability's identity stamps (trace snapshots, flight dumps)
+        # carry the virtual rank exactly as a real multi-host rank would
+        return getattr(_RANK, "rank", 0)
+
     def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
         rank = _RANK.rank
         call_id = self._counters.get(rank, 0)

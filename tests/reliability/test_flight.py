@@ -350,3 +350,69 @@ def test_rearmed_recorder_never_overwrites_prior_dumps(tmp_path):
     assert len(files) == 2
     assert json.loads(open(first).read())["events"][0]["kind"] == "first_life"
     assert json.loads(open(second).read())["events"][0]["kind"] == "second_life"
+
+
+def test_keep_last_k_dump_gc(tmp_path):
+    """Keep-last-K directory GC: a flapping fault (or many distinct
+    reasons) cannot fill the disk — only the newest ``keep_dumps`` files
+    survive, deletion happens AFTER the new dump is durable (journal
+    ordering discipline), and only the recorder's own flight-*.json
+    naming is ever touched."""
+    bystander = os.path.join(tmp_path, "not-a-flight-dump.json")
+    with open(bystander, "w") as f:
+        f.write("{}")
+    rec = flight_mod.enable_flight(tmp_path, keep_dumps=3)
+    try:
+        paths = [rec.dump(f"drill-{i}") for i in range(7)]
+    finally:
+        flight_mod.disable_flight()
+    files = _dump_files(tmp_path)
+    assert len(files) == 3
+    assert files == sorted(paths[-3:])
+    assert os.path.exists(bystander)  # foreign files are never GC'd
+    # the in-memory ledger tracks the survivors only
+    assert sorted(rec.dump_paths) == files
+
+
+def test_dump_gc_extends_across_rearms(tmp_path):
+    """A re-armed recorder over an already-full directory keeps honoring
+    the cap: old evidence rotates out, the sequence keeps extending."""
+    with obs.flight_scope(tmp_path) as rec:
+        rec.keep_dumps = 2
+        rec.dump("first")
+        rec.dump("second")
+    with obs.flight_scope(tmp_path) as rec2:
+        rec2.keep_dumps = 2
+        rec2.dump("third")
+    files = _dump_files(tmp_path)
+    assert len(files) == 2
+    names = [os.path.basename(p) for p in files]
+    assert any("second" in n for n in names) and any("third" in n for n in names)
+
+
+def test_dump_carries_identity_stamp(tmp_path):
+    with obs.flight_scope(tmp_path) as rec:
+        rec.record("who_am_i")
+        path = rec.dump("identity-drill")
+    dump = _load_dump(path)
+    assert dump["identity"]["rank"] == 0
+    assert dump["identity"]["world_size"] == 1
+    assert "host" in dump["identity"] and "pid" in dump["identity"]
+
+
+def test_rearm_after_gc_never_reuses_freed_sequence_numbers(tmp_path):
+    """Regression: keep-last-K GC frees LOW sequence numbers; a re-armed
+    recorder must extend the sequence past the newest existing file, or
+    its fresh dump sorts oldest and the next GC pass deletes the newest
+    evidence first (returning a dangling path)."""
+    with obs.flight_scope(tmp_path) as rec:
+        rec.keep_dumps = 2
+        for i in range(3):
+            rec.dump(f"life1-{i}")  # GC leaves 0002, 0003
+    with obs.flight_scope(tmp_path) as rec2:
+        rec2.keep_dumps = 2
+        fresh = rec2.dump("life2")
+    assert os.path.exists(fresh), "the fresh dump must survive its own GC"
+    files = _dump_files(tmp_path)
+    assert len(files) == 2 and fresh in files
+    assert os.path.basename(fresh).startswith("flight-0004-")

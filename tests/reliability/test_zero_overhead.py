@@ -89,11 +89,13 @@ def test_unguarded_engine_programs_carry_no_guard_token():
     col = _collection(compiled=True)
     col(p, t)
     (signature,) = list(col._engine._compiled)
-    names, precisions, guard_token, cohort, _, _ = signature
+    names, precisions, guard_token, cohort, health, _, _ = signature
     assert guard_token is None
     # a plain (non-cohort) step carries no cohort-capacity token: the
     # default program identity is the guard-free, cohort-free one
     assert cohort is None
+    # ...and no health token: per-tenant health is a cohort-only variant
+    assert health is False
     # default metrics sit on the exact tier: the precision slot of the
     # program identity is empty for every member
     assert all(p == () for _, p in precisions)
