@@ -849,6 +849,93 @@ for name, t in [("uniform", target), ("informative", informative)]:
     return out
 
 
+def _bench_hier_sync() -> dict:
+    """Hierarchical vs flat HOST-level sync: the same 512-bin histogram
+    state synced by 8 thread-simulated ranks — once over the flat virtual
+    DDP group, once over a 2-slice x 4-rank two-level topology (exact
+    level-0 / registered-tier level-1, the ``hierarchy.sync_states``
+    default), exact and int8 tiers. Grid-valued states make the exact
+    two-level path's divergence from flat a hard 0.0 (sums are exactly
+    associative), and the int8 leg's abs err is gated by the documented
+    2-slice bound — both wired into the sentinel's BOUND_LEGS."""
+    import time as _t
+
+    import jax.numpy as jnp
+
+    from metrics_tpu import Metric
+    from metrics_tpu.parallel.hierarchy import SyncTopology
+    from metrics_tpu.utilities.distributed import gather_all_tensors
+    from tests.helpers.testers import run_virtual_ddp, run_virtual_hierarchy
+
+    bins, reps, world = 512, 10, 8
+
+    class _Hist(Metric):
+        def __init__(self, precision="exact"):
+            super().__init__()
+            self.add_state(
+                "hist",
+                default=jnp.zeros((bins,)),
+                dist_reduce_fx="sum",
+                sync_precision=precision,
+            )
+
+        def update(self, x):
+            self.hist = self.hist + x
+
+        def compute(self):
+            return self.hist
+
+    def state(rank):
+        rng = np.random.RandomState(rank + 1)
+        return jnp.asarray((rng.randint(0, 1024, size=bins) / 256.0).astype(np.float32))
+
+    exact_world = np.sum([np.asarray(state(r)) for r in range(world)], axis=0)
+
+    def run_leg(runner, precision):
+        synced = {}
+
+        def worker(rank, _):
+            m = _Hist(precision)
+            m.dist_sync_fn = gather_all_tensors
+            m.update(state(rank))
+            base = {k: getattr(m, k) for k in m._defaults}
+            for _ in range(reps):
+                # restore the pre-sync state (incl. zero residual) so every
+                # rep syncs the identical payload
+                for k, v in base.items():
+                    setattr(m, k, v)
+                m._sync_dist()
+            synced[rank] = np.asarray(m.hist)
+
+        t0 = _t.perf_counter()
+        runner(worker)
+        ms = (_t.perf_counter() - t0) * 1e3 / reps
+        return ms, synced
+
+    topo = SyncTopology.regular(2, 4)
+    flat_ms, flat_synced = run_leg(lambda w: run_virtual_ddp(world, w), "exact")
+    hier_ms, hier_synced = run_leg(lambda w: run_virtual_hierarchy(topo, w), "exact")
+    hier8_ms, hier8_synced = run_leg(lambda w: run_virtual_hierarchy(topo, w), "int8")
+
+    exact_err = max(
+        float(np.abs(hier_synced[r] - flat_synced[r]).max()) for r in range(world)
+    )
+    int8_err = max(
+        float(np.abs(hier8_synced[r] - exact_world).max()) for r in range(world)
+    )
+    return {
+        "flat_sync_8rank_host_cpu_ms": round(flat_ms, 3),
+        "hier_sync_2x4_cpu_ms": round(hier_ms, 3),
+        "hier_sync_2x4_int8_cpu_ms": round(hier8_ms, 3),
+        # raw floats (same rationale as binned_abs_err): rounding would
+        # quantize a near-floor error to 0.0 and falsely imply exactness
+        "hier_abs_err": {
+            "hier_exact_512bins": exact_err,
+            "hier_int8_512bins": int8_err,
+        },
+    }
+
+
 # ----------------------------------------------------------------------
 # BASELINE.md config matrix (configs #2, #4, #5): durable bench legs for
 # StatScores/F1 (multiclass + multilabel), the regression pack incl. SSIM
@@ -1255,6 +1342,15 @@ def main() -> None:
         except Exception as err:
             binned_failed = err
             print(f"ERROR: binned sync leg failed ({err!r})", file=sys.stderr)
+        try:
+            # the hierarchical (2 slices x 4 ranks vs flat 8) host-level
+            # leg: deterministic CPU thread world, same loud-failure
+            # contract — its bound legs (hier_abs_err.*) gate the
+            # two-level reduction's exactness in CI
+            result.update(_bench_hier_sync())
+        except Exception as err:
+            binned_failed = binned_failed or err
+            print(f"ERROR: hierarchical sync leg failed ({err!r})", file=sys.stderr)
         print(json.dumps(result))
         if binned_failed is not None:
             # the binned/quantized legs are the POINT of --leg-sync: their
@@ -1287,6 +1383,12 @@ def main() -> None:
     except Exception as err:
         print(f"WARNING: binned sync leg failed ({err!r})", file=sys.stderr)
         binned = {}
+
+    try:
+        hier_legs = _bench_hier_sync()
+    except Exception as err:
+        print(f"WARNING: hierarchical sync leg failed ({err!r})", file=sys.stderr)
+        hier_legs = {}
 
     try:
         forward_legs = _bench_module_forward()
@@ -1371,6 +1473,10 @@ def main() -> None:
         # the O(bins) scalable sync story: histogram states, one psum,
         # with the measured |binned - exact| cost of the approximation
         **binned,
+        # two-level topology-aware host sync (2 slices x 4 ranks vs flat
+        # 8): exact tier bit-identical to flat (hier_abs_err 0.0), int8
+        # at the leader hop within the documented 2-slice bound
+        **hier_legs,
         # library-level hot loop: 4-metric collection forward at 1M×4,
         # eager (fused one-update forward + single-pass kernels + sibling
         # sharing) next to the compiled step engine (ONE donated XLA

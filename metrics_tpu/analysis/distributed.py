@@ -240,6 +240,7 @@ def _merge_replica_states(
     metric,
     per_replica: List[Dict[str, Any]],
     order: Optional[Sequence[int]] = None,
+    precisions: Optional[Dict[str, str]] = None,
 ) -> Tuple[Dict[str, Any], Dict[str, float]]:
     """The cross-replica sync composite on explicit per-replica states:
     stack each non-residual state over the (virtual) world and fold it
@@ -247,9 +248,11 @@ def _merge_replica_states(
     contribution through the real wire codec for states on a quantized
     tier, exactly as ``Metric._sync_dist`` would. Returns the merged
     state dict (residual companions at their defaults) and the per-state
-    documented tolerance (0.0 for exact states)."""
+    documented tolerance (0.0 for exact states). ``precisions`` overrides
+    the metric's registered tiers (``{}`` = force-exact: the hierarchy's
+    level-0 merge)."""
     order = list(order) if order is not None else list(range(len(per_replica)))
-    precisions = metric.sync_precisions()
+    precisions = metric.sync_precisions() if precisions is None else precisions
     residual_names = set(metric._sync_residual_names())
     merged: Dict[str, Any] = {}
     tols: Dict[str, float] = {}
@@ -274,6 +277,39 @@ def _merge_replica_states(
             merged[sname] = red(stacked) if red is not None else stacked
             tols[sname] = 0.0
     return merged, tols
+
+
+def _merge_replica_states_two_level(
+    metric,
+    per_replica: List[Dict[str, Any]],
+    num_slices: int = 2,
+) -> Tuple[Dict[str, Any], Dict[str, float]]:
+    """The HIERARCHICAL sync composite on explicit per-replica states,
+    mirroring ``hierarchy.sync_states`` under the default
+    ``level_precisions=("exact", None)``: replicas group into
+    ``num_slices`` equal slices, each slice folds EXACTLY at level 0 (the
+    ICI hop), and the slice partials merge at level 1 under the state's
+    registered tier (the DCN hop — where int8 + error feedback lives).
+    Returns ``(merged, level1_tols)``; the caller compares against the
+    flat merge within ``flat_tol + level1_tol`` (both paths approximate
+    the same exact sum from different quantization points)."""
+    replicas = len(per_replica)
+    if replicas % num_slices:
+        raise ValueError(
+            f"{replicas} replicas do not partition into {num_slices} equal"
+            " slices — a truncating split would silently drop trailing"
+            " replicas and report bogus divergence"
+        )
+    slice_size = replicas // num_slices
+    partials = [
+        _merge_replica_states(
+            metric,
+            per_replica[s * slice_size : (s + 1) * slice_size],
+            precisions={},
+        )[0]
+        for s in range(num_slices)
+    ]
+    return _merge_replica_states(metric, partials)
 
 
 # ---------------------------------------------------------------------------
@@ -356,6 +392,7 @@ def check_replica_equivalence(
     flagged: set = set()
 
     per_cache = cache.setdefault("per_replica", {})
+    topo_flat: Optional[tuple] = None
     for replicas in REPLICA_COUNTS:
         if replicas in per_cache:
             per = per_cache[replicas]
@@ -378,6 +415,10 @@ def check_replica_equivalence(
             per_cache[replicas] = per
         evidence["replicas"].append(replicas)
         merged, tols = _merge_replica_states(metric, per)
+        if replicas >= 2 and replicas % 2 == 0:
+            # the largest verified EVEN replica count feeds the topology
+            # (two-level, 2-slice) equivalence leg below
+            topo_flat = (replicas, per, merged, tols)
         permuted, _ = _merge_replica_states(
             metric, per, order=list(reversed(range(replicas)))
         )
@@ -471,6 +512,80 @@ def check_replica_equivalence(
                         " registered, reduced state",
                         detail={"replicas": replicas, "err": vdelta},
                     ))
+    # ---- topology equivalence: the two-level (hierarchical) composite
+    # must agree with the flat path on the SAME per-replica states —
+    # bit-identical on the exact tier (grid sums are exactly associative,
+    # so re-bracketing by slice cannot move a bit), within the SUMMED
+    # per-level documented bounds on quantized tiers (flat quantizes R
+    # replica contributions, the hierarchy quantizes num_slices slice
+    # partials at level 1; both approximate the same exact sum)
+    if topo_flat is not None:
+        from metrics_tpu.parallel.hierarchy import two_level_fold
+
+        t_replicas, t_per, flat_merged, flat_tols = topo_flat
+        two_merged, two_tols = _merge_replica_states_two_level(
+            metric, t_per, num_slices=2
+        )
+        t_ev: Dict[str, Any] = {
+            "replicas": t_replicas,
+            "num_slices": 2,
+            "bit_identical": True,
+            "max_state_err": 0.0,
+        }
+        for sname in metric._defaults:
+            if sname in residual_names:
+                continue
+            if two_level_fold(metric._reductions.get(sname)) is None or isinstance(
+                metric._defaults.get(sname), list
+            ):
+                # non-fold reductions (mean/cat/custom/None) and list
+                # states ride the COMPOSED FLAT gather at runtime
+                # (rank-ordered world list): flat semantics by
+                # construction, nothing separate to prove
+                continue
+            a = np.asarray(flat_merged[sname])
+            b = np.asarray(two_merged[sname])
+            tol = flat_tols.get(sname, 0.0) + two_tols.get(sname, 0.0)
+            err = (
+                float(np.abs(a.astype(np.float64) - b.astype(np.float64)).max())
+                if a.size and a.shape == b.shape
+                else (0.0 if a.shape == b.shape else float("inf"))
+            )
+            if a.shape != b.shape:
+                ok = False
+            elif tol > 0.0:
+                # both results land back on the state's dtype; integer
+                # states re-round, so the two roundings may differ by one
+                # grain on top of the analog bound
+                bound = tol + (1.0 if np.issubdtype(a.dtype, np.integer) else 0.0)
+                ok = err <= bound
+                t_ev["bit_identical"] = False
+            else:
+                ok, bit = _exact_state_close(a, b)
+                if not bit:
+                    t_ev["bit_identical"] = False
+            t_ev["max_state_err"] = max(t_ev["max_state_err"], err)
+            key = ("topology", sname)
+            if not ok and key not in flagged:
+                flagged.add(key)
+                tier = precisions.get(sname, "exact")
+                findings.append(Finding(
+                    "MTA005", f"{cls}.{sname}",
+                    f"two-level (2-slice) hierarchical reduction diverges from"
+                    f" the flat path at R={t_replicas}: |flat - hierarchical| ="
+                    f" {err:.4g}"
+                    + (f" (summed per-level {tier} bound {tol:.4g})" if tol else
+                       " (exact tier: must be bit-identical on grid probes)")
+                    + " — moving this metric onto a hierarchical topology"
+                    " changes its answer",
+                    detail={
+                        "replicas": t_replicas,
+                        "num_slices": 2,
+                        "tier": tier,
+                        "err": err,
+                    },
+                ))
+        evidence["topology"] = t_ev
     if not evidence["replicas"]:
         infos.append(
             f"{cls}: MTA005 batch not shardable into"

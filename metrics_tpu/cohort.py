@@ -50,8 +50,9 @@ from metrics_tpu.metric import Metric, _device_owned, _san_allow_ctx
 from metrics_tpu.observability import exporter as _exporter
 from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.parallel import hierarchy as _hier
 from metrics_tpu.parallel import quantize as _quant
-from metrics_tpu.parallel.backend import is_distributed_initialized
+from metrics_tpu.parallel.backend import get_sync_backend, is_distributed_initialized
 from metrics_tpu.reliability import sync as _rsync
 from metrics_tpu.utilities.distributed import gather_all_tensors
 from metrics_tpu.utilities.jit import tpu_jit
@@ -795,6 +796,13 @@ class MetricCohort:
         committed only on collective success. Degradation is atomic across
         the whole cohort — mixed world/local tenants would be silently
         wrong, not degraded."""
+        backend = get_sync_backend()
+        if isinstance(backend, _hier.HierarchicalSyncBackend):
+            # two-level route: one level-0 + one level-1 collective per
+            # STATE for the whole cohort, per-level policy/precision,
+            # per-level atomic degradation (hierarchy.sync_states)
+            self._sync_stacked_hierarchical(backend)
+            return
         telemetry_on = _obs.enabled()
         input_dict: Dict[Tuple[str, str], jax.Array] = {}
         wire_dict: Dict[Tuple[str, str], Any] = {}
@@ -883,6 +891,56 @@ class MetricCohort:
         if not degraded:
             for (name, sname), res in new_residuals.items():
                 self._states[name][sname + "__qres"] = res
+
+    def _sync_stacked_hierarchical(self, backend: "_hier.HierarchicalSyncBackend") -> None:
+        """The cohort sync routed through the two-level engine: still one
+        collective per STATE per level, with the stacked array quantized
+        at the level its tier resolves to and stacked residuals committed
+        only when the lossy level succeeds. Degradation stays atomic
+        across the whole cohort AND per level — a failed leader exchange
+        serves every tenant the slice-local merge."""
+        states: Dict[Tuple[str, str], Any] = {}
+        reductions: Dict[Tuple[str, str], Any] = {}
+        precisions: Dict[Tuple[str, str], str] = {}
+        residuals: Dict[Tuple[str, str], jax.Array] = {}
+        for name, m in self._template.items():
+            res_names = set(m._sync_residual_names())
+            member_prec = getattr(m, "_sync_precisions", {})
+            for sname, red in m._reductions.items():
+                if sname in res_names:
+                    continue
+                key = (name, sname)
+                x = self._states[name][sname]
+                # ALWAYS a copy on this route: an exact level-0 hop
+                # gathers the raw array, and peers hold their gathered
+                # references across this rank's next donated dispatch
+                # (same donation hazard as the flat cohort path)
+                states[key] = jnp.array(x, copy=True)
+                reductions[key] = red
+                if sname in member_prec:
+                    precisions[key] = member_prec[sname]
+                    residuals[key] = self._states[name][sname + "__qres"]
+        if _obs.enabled():
+            tel = _obs.get()
+            payload = sum(_obs.array_nbytes(v) for v in states.values())
+            tel.count("sync.calls")
+            tel.count("cohort.sync_collectives", len(states))
+            tel.count("sync.payload_bytes", payload)
+            tel.observe_hist("sync.payload_bytes", payload, _obs.PAYLOAD_BUCKETS_BYTES)
+            tel.event(
+                "cohort_sync",
+                tenants=len(self),
+                capacity=self._capacity,
+                states=len(states),
+                payload_bytes=payload,
+                hierarchical=True,
+                num_slices=backend.topology.num_slices,
+            )
+        outcome = _hier.sync_states(backend, states, reductions, precisions, residuals)
+        for (name, sname), value in outcome.states.items():
+            self._states[name][sname] = value
+        for (name, sname), res in outcome.residuals.items():
+            self._states[name][sname + "__qres"] = res
 
     # ------------------------------------------------------------------
     # lifecycle / checkpointing

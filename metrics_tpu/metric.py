@@ -39,7 +39,8 @@ from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.observability import trace as _obs_trace
 from metrics_tpu.utilities import env as _env
 from metrics_tpu.parallel import quantize as _quant
-from metrics_tpu.parallel.backend import is_distributed_initialized
+from metrics_tpu.parallel import hierarchy as _hier
+from metrics_tpu.parallel.backend import get_sync_backend, is_distributed_initialized
 from metrics_tpu.reliability import guard as _rguard
 from metrics_tpu.reliability import sync as _rsync
 from metrics_tpu.utilities.checks import shared_canonicalization
@@ -557,6 +558,15 @@ class Metric(ABC):
             )
 
     def _sync_dist_impl(self, dist_sync_fn: Callable = gather_all_tensors) -> None:
+        if dist_sync_fn is gather_all_tensors:
+            # the default gather resolves through the installed backend: a
+            # HierarchicalSyncBackend routes the whole sync through the
+            # two-level engine (per-level policy/precision/degradation). A
+            # caller-supplied custom dist_sync_fn keeps flat semantics —
+            # it owns its own transport.
+            backend = get_sync_backend()
+            if isinstance(backend, _hier.HierarchicalSyncBackend):
+                return self._sync_dist_hierarchical(backend)
         precisions = getattr(self, "_sync_precisions", {})
         residual_names = set(self._sync_residual_names())
         # residual companions never cross the wire: they are LOCAL
@@ -672,6 +682,55 @@ class Metric(ABC):
         if not degraded:
             for name, res in new_residuals.items():
                 setattr(self, name + _SYNC_RESIDUAL_SUFFIX, res)
+
+    def _sync_dist_hierarchical(self, backend: "_hier.HierarchicalSyncBackend") -> None:
+        """Two-level sync through an installed hierarchical backend:
+        level-0 reduction inside the slice, sparse level-1 exchange of one
+        pre-reduced contribution per slice, ``SyncPolicy``/``sync_precision``
+        resolved per level, degradation per level and atomic across the
+        whole state dict (see :mod:`metrics_tpu.parallel.hierarchy`)."""
+        precisions = getattr(self, "_sync_precisions", {})
+        residual_names = set(self._sync_residual_names())
+        input_dict = {
+            attr: getattr(self, attr)
+            for attr in self._reductions
+            if attr not in residual_names
+        }
+        residuals = {
+            name: getattr(self, name + _SYNC_RESIDUAL_SUFFIX) for name in precisions
+        }
+        if _obs.enabled():
+            tel = _obs.get()
+            payload = sum(
+                _obs.array_nbytes(v)
+                for state in input_dict.values()
+                for v in (state if isinstance(state, list) else [state])
+            )
+            tel.count("sync.calls")
+            tel.count("sync.payload_bytes", payload)
+            tel.observe_hist("sync.payload_bytes", payload, _obs.PAYLOAD_BUCKETS_BYTES)
+            tel.event(
+                "sync",
+                metric=type(self).__name__,
+                payload_bytes=payload,
+                hierarchical=True,
+                num_slices=backend.topology.num_slices,
+                quantized_states=len(precisions),
+            )
+        outcome = _hier.sync_states(
+            backend,
+            input_dict,
+            self._reductions,
+            precisions,
+            residuals,
+            group=self.process_group,
+        )
+        for attr, value in outcome.states.items():
+            setattr(self, attr, value)
+        # residuals commit only when the level that consumed them
+        # succeeded — sync_states returns an empty dict on degradation
+        for name, res in outcome.residuals.items():
+            setattr(self, name + _SYNC_RESIDUAL_SUFFIX, res)
 
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)

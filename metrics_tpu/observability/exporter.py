@@ -187,16 +187,39 @@ def _render_sessions() -> List[str]:
     return fam.lines()
 
 
+def _render_quorum() -> List[str]:
+    """Membership of the most recent hierarchical (two-level) exchange:
+    the dropped-pod gauge and quorum size, so an external scraper sees a
+    dropped pod without reading logs. Renders nothing until a
+    :class:`~metrics_tpu.parallel.hierarchy.HierarchicalSyncBackend`
+    exchange has run in this process — and renders regardless of whether
+    telemetry recording is on (the quorum is state, not a counter)."""
+    try:
+        from metrics_tpu.parallel.hierarchy import last_quorum
+
+        q = last_quorum()
+    except Exception:  # noqa: BLE001 — a scrape must answer
+        return []
+    if q is None:
+        return []
+    fam = _GaugeFamilies()
+    label = f'source="{_escape_label(q.source)}"'
+    fam.sample("metrics_tpu_sync_degraded_pods", label, q.dropped_pods)
+    fam.sample("metrics_tpu_sync_quorum_slices", label, len(q.slices_present))
+    fam.sample("metrics_tpu_sync_world_slices", label, q.num_slices)
+    return fam.lines()
+
+
 def render_exposition() -> str:
     """The full ``/metrics`` payload: telemetry registry + cohort health
-    + session gauges, one consistent text exposition. Valid (and useful:
-    the identity line still answers "who is this") even when telemetry
-    recording is disabled."""
+    + session gauges + sync quorum, one consistent text exposition. Valid
+    (and useful: the identity line still answers "who is this") even when
+    telemetry recording is disabled."""
     # auxiliary sources FIRST: cohort.health() refreshes the
     # cohort.tenant.* gauges, and rendering the registry afterwards means
     # one scrape sees both the per-tenant samples and the refreshed
     # aggregate gauges
-    extra = _render_cohorts() + _render_sessions()
+    extra = _render_cohorts() + _render_sessions() + _render_quorum()
     return _telemetry.get().to_prometheus(extra_lines=extra)
 
 
@@ -232,7 +255,19 @@ class MetricsExporter:
                         status, ctype = 500, "text/plain; charset=utf-8"
                 elif self.path.split("?", 1)[0] == "/healthz":
                     ident = _identity.process_identity()
-                    body = json.dumps({"status": "ok", **ident}).encode()
+                    payload = {"status": "ok", **ident}
+                    try:
+                        # liveness probes double as quorum probes: a
+                        # dropped pod is visible from the outside even
+                        # when nothing scrapes /metrics
+                        from metrics_tpu.parallel.hierarchy import last_quorum
+
+                        q = last_quorum()
+                        if q is not None:
+                            payload["sync_quorum"] = q.as_dict()
+                    except Exception:  # noqa: BLE001 — liveness must answer
+                        pass
+                    body = json.dumps(payload).encode()
                     status, ctype = 200, "application/json"
                 else:
                     body = b"not found: try /metrics or /healthz\n"

@@ -6,6 +6,14 @@ from metrics_tpu.parallel.backend import (  # noqa: F401
     is_distributed_initialized,
     set_sync_backend,
 )
+from metrics_tpu.parallel.hierarchy import (  # noqa: F401
+    HierarchicalSyncBackend,
+    HierarchicalSyncOutcome,
+    PodUnreachableError,
+    QuorumSnapshot,
+    SyncTopology,
+    last_quorum,
+)
 from metrics_tpu.parallel.collective import (  # noqa: F401
     masked_cat_sync,
     qsync_state,

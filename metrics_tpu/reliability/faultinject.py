@@ -33,6 +33,10 @@ __all__ = [
     "poison",
     "nonfinite_updates",
     "flaky_sync_backend",
+    "flaky_level",
+    "hung_level",
+    "pod_dropout",
+    "simulated_pods",
     "failing_engine_compile",
     "corrupt_envelope",
     "preempt_at_step",
@@ -168,6 +172,171 @@ def flaky_sync_backend(
     ``slow_calls > 0``, the first ``slow_calls`` gathers instead *succeed
     slowly* (sleep ``delay_s``) — the drill for ``SyncPolicy.timeout_s``."""
     backend = _FlakyBackend(get_sync_backend(), fails, delay_s, exc_type, slow_calls)
+    prev = set_sync_backend(backend)
+    try:
+        yield backend
+    finally:
+        set_sync_backend(prev)
+
+
+# ----------------------------------------------------------------------
+# 2b. level-scoped faults for hierarchical backends
+# ----------------------------------------------------------------------
+def _active_hierarchy():
+    from metrics_tpu.parallel.hierarchy import HierarchicalSyncBackend  # lazy: cycle-free
+
+    backend = get_sync_backend()
+    if not isinstance(backend, HierarchicalSyncBackend):
+        raise RuntimeError(
+            "level-scoped fault injection needs an installed"
+            " HierarchicalSyncBackend (set_sync_backend(...) or"
+            " simulated_pods()); the active backend is"
+            f" {type(backend).__name__}"
+        )
+    return backend
+
+
+@contextmanager
+def _wrap_level(backend: Any, level: int, make_wrapper) -> Iterator[Any]:
+    attr = "level1" if level == 1 else "level0"
+    inner = getattr(backend, attr)
+    wrapper = make_wrapper(inner)
+    setattr(backend, attr, wrapper)
+    try:
+        yield wrapper
+    finally:
+        setattr(backend, attr, inner)
+
+
+@contextmanager
+def flaky_level(
+    level: int = 1,
+    fails: int = 1,
+    delay_s: float = 0.0,
+    exc_type: Type[BaseException] = FaultInjected,
+) -> Iterator[Any]:
+    """Fail the first ``fails`` gathers of exactly ONE level of the
+    installed :class:`~metrics_tpu.parallel.hierarchy.HierarchicalSyncBackend`
+    (then delegate), leaving the other level healthy — the flaky-DCN
+    drill: level-1 retries must not re-run or corrupt the already-good
+    level-0 exchange."""
+    if level not in (0, 1):
+        raise ValueError(f"level must be 0 or 1, got {level}")
+    backend = _active_hierarchy()
+    with _wrap_level(
+        backend, level, lambda inner: _FlakyBackend(inner, fails, delay_s, exc_type)
+    ) as wrapper:
+        yield wrapper
+
+
+@contextmanager
+def hung_level(
+    level: int = 1, delay_s: float = 30.0, calls: int = 1_000_000
+) -> Iterator[Any]:
+    """Make one level's gathers hang (succeed only after ``delay_s``) —
+    the wedged-DCN drill for a per-level ``SyncPolicy.timeout_s``: the
+    abandoned worker machinery must time the level out and degrade it
+    while the other level's result stays exact."""
+    if level not in (0, 1):
+        raise ValueError(f"level must be 0 or 1, got {level}")
+    backend = _active_hierarchy()
+    with _wrap_level(
+        backend,
+        level,
+        lambda inner: _FlakyBackend(inner, fails=0, delay_s=delay_s, slow_calls=calls),
+    ) as wrapper:
+        yield wrapper
+
+
+class _DroppedPodBackend(SyncBackend):
+    """Level-1 transport of a world whose pod ``slice_id`` is gone: every
+    exchange raises :class:`PodUnreachableError` naming it."""
+
+    def __init__(self, inner: SyncBackend, slice_id: int):
+        self.inner = inner
+        self.slice_id = int(slice_id)
+        self.calls = 0
+
+    @property
+    def world_size(self) -> int:
+        return self.inner.world_size
+
+    def gather(self, x: Any, group: Optional[Any] = None) -> List[Any]:
+        from metrics_tpu.parallel.hierarchy import PodUnreachableError  # lazy
+
+        self.calls += 1
+        raise PodUnreachableError(self.slice_id)
+
+
+@contextmanager
+def pod_dropout(slice_id: int) -> Iterator[Any]:
+    """Make pod (slice) ``slice_id`` unreachable at level 1 while level 0
+    stays healthy — the preempted-remote-pod drill. Every level-1
+    exchange raises :class:`~metrics_tpu.parallel.hierarchy.PodUnreachableError`
+    naming the lost pod, so per-level degradation records WHICH pod was
+    dropped in the quorum snapshot."""
+    backend = _active_hierarchy()
+    if not 0 <= int(slice_id) < backend.topology.num_slices:
+        raise ValueError(
+            f"slice_id {slice_id} outside topology with"
+            f" {backend.topology.num_slices} slices"
+        )
+    with _wrap_level(
+        backend, 1, lambda inner: _DroppedPodBackend(inner, slice_id)
+    ) as wrapper:
+        yield wrapper
+
+
+class _MirrorBackend(SyncBackend):
+    """A simulated fleet segment for single-process drills: ``gather``
+    returns the local contribution plus ``world_size - 1`` echoed copies —
+    deterministic "remote" peers whose contributions are bit-identical to
+    this process's own (so a healthy 2-slice sum is exactly 2x local, and
+    a degraded one exactly 1x)."""
+
+    def __init__(self, world: int):
+        self._world = int(world)
+
+    @property
+    def world_size(self) -> int:
+        return self._world
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    def gather(self, x: Any, group: Optional[Any] = None) -> List[Any]:
+        first = jnp.asarray(x)
+        return [first] + [jnp.array(first, copy=True) for _ in range(self._world - 1)]
+
+
+@contextmanager
+def simulated_pods(
+    num_slices: int = 2,
+    slice_size: int = 1,
+    level_precisions: Any = ("exact", None),
+) -> Iterator[Any]:
+    """Install a :class:`~metrics_tpu.parallel.hierarchy.HierarchicalSyncBackend`
+    over a simulated multi-pod fleet in ONE process: this rank is rank 0
+    of slice 0 and every remote peer mirrors its contributions
+    (:class:`_MirrorBackend`). The chaos drills compose on top —
+    ``flaky_level``/``hung_level``/``pod_dropout`` fail one level while
+    the other keeps answering — with exact arithmetic expectations
+    (healthy sum = ``num_slices * slice_size`` × local; level-1-degraded
+    = ``slice_size`` × local; level-0-degraded = local)."""
+    from metrics_tpu.parallel.hierarchy import (  # lazy: cycle-free
+        HierarchicalSyncBackend,
+        SyncTopology,
+    )
+
+    topology = SyncTopology.regular(num_slices, slice_size)
+    backend = HierarchicalSyncBackend(
+        topology,
+        _MirrorBackend(slice_size),
+        _MirrorBackend(num_slices),
+        rank=0,
+        level_precisions=tuple(level_precisions),
+    )
     prev = set_sync_backend(backend)
     try:
         yield backend

@@ -62,6 +62,11 @@ from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.observability import trace as _trace
 from metrics_tpu.parallel.backend import get_sync_backend
+from metrics_tpu.parallel.hierarchy import (
+    HierarchicalSyncBackend,
+    QuorumSnapshot,
+    record_quorum,
+)
 from metrics_tpu.reliability import sync as _rsync
 from metrics_tpu.reliability.checkpoint import load_envelope, save_envelope
 from metrics_tpu.reliability.journal import CheckpointJournal, current_git_sha
@@ -191,6 +196,7 @@ class EvalSession:
             "protective_checkpoints": 0,
             "resumes": 0,
             "resume_rollbacks": 0,
+            "partial_quorum_resumes": 0,
             "deadline_exceeded": 0,
         }
         # enroll: the cursor now rides state_dict/_named_states/envelopes
@@ -441,6 +447,13 @@ class EvalSession:
         backend = get_sync_backend()
         if backend.world_size <= 1:
             return
+        if isinstance(backend, HierarchicalSyncBackend):
+            # two-level agreement: slice first, then leaders — a dead
+            # REMOTE pod cannot deadlock the intra-slice leg, and the
+            # leader leg runs under the level-1 policy (timeout +
+            # partial-quorum degradation)
+            self._agree_on_cursor_hierarchical(backend)
+            return
         gathered = backend.gather(jnp.asarray(self.cursor, dtype=jnp.int32))
         cursors = [int(np.asarray(c)) for c in gathered]
         if len(set(cursors)) == 1:
@@ -472,6 +485,164 @@ class EvalSession:
         else:
             # this rank already sits at the agreement point; others roll back
             self.metric._session_cursor = self.cursor
+
+    def _agree_on_cursor_hierarchical(self, backend: HierarchicalSyncBackend) -> None:
+        """Two-level resume agreement over a hierarchical backend.
+
+        Level 0 (intra-slice) runs FIRST and touches only slice-local
+        links, so a dead remote pod cannot block it; level 1 compares the
+        slice-agreed cursors between the slice leaders under the level-1
+        policy. When the leader exchange fails terminally and degradation
+        is allowed (session ``degraded_ok`` or the level-1 policy's), the
+        session resumes on SLICE-LOCAL agreement with a partial quorum
+        recorded — one dead pod can no longer deadlock every other pod's
+        resume."""
+        topo = backend.topology
+        policy = _rsync.active_policy()
+        p0 = policy.for_level(0) if policy is not None else None
+        p1 = policy.for_level(1) if policy is not None else None
+        g0 = _rsync.apply_sync_policy(backend.gather_level0, policy=p0)
+        g1 = _rsync.apply_sync_policy(backend.gather_level1, policy=p1)
+
+        def _ints(gathered: List[Any]) -> List[int]:
+            return [int(np.asarray(c)) for c in gathered]
+
+        def _common(gathered: List[Any]) -> set:
+            sets = [
+                {int(x) for x in np.asarray(v).ravel() if int(x) >= 0}
+                for v in gathered
+            ]
+            out = sets[0]
+            for s in sets[1:]:
+                out &= s
+            return out
+
+        def _my_avail_vec() -> np.ndarray:
+            return _cursor_vector(self.journal.cursors_on_disk(), self.journal.keep_last)
+
+        # ---- level 0: the slice agrees first (intra-slice traffic only).
+        # The availability exchange runs UNCONDITIONALLY: a slice whose
+        # cursors disagree must not make extra level-0 rounds other slices
+        # skip — over_flat level-0 views are world-wide collectives, and a
+        # divergent schedule would deadlock them.
+        if topo.slice_size > 1:
+            cursors0 = _ints(g0(jnp.asarray(self.cursor, dtype=jnp.int32)))
+            slice_avail = _common(g0(jnp.asarray(_my_avail_vec())))
+            if len(set(cursors0)) != 1:
+                self._resolve_cursor_skew(cursors0, slice_avail, scope="slice")
+        else:
+            vec = _my_avail_vec()
+            slice_avail = {int(x) for x in np.asarray(vec).ravel() if int(x) >= 0}
+        # ---- level 1: leaders compare the slice-agreed cursors. ONLY the
+        # gather calls sit under the broad except: any leader-exchange
+        # failure (policy-wrapped SyncFailedError, or a raw transport
+        # error when no SyncPolicy is installed) routes through the
+        # partial-quorum gate — but skew verdicts and local rollback
+        # failures (SessionResumeError, CheckpointError) are NOT transport
+        # failures and must propagate as themselves, never be demoted to
+        # a partial-quorum resume at a stale cursor.
+        try:
+            cursors1 = _ints(g1(jnp.asarray(self.cursor, dtype=jnp.int32)))
+        except Exception as err:  # noqa: BLE001 — leader exchange down
+            self._partial_quorum_resume(backend, p1, err)
+            return
+        if len(set(cursors1)) != 1:
+            slice_vec = _cursor_vector(sorted(slice_avail), self.journal.keep_last)
+            try:
+                common = _common(g1(jnp.asarray(slice_vec)))
+            except Exception as err:  # noqa: BLE001 — leader exchange down
+                self._partial_quorum_resume(backend, p1, err)
+                return
+            self._resolve_cursor_skew(cursors1, common, scope="world")
+        record_quorum(
+            QuorumSnapshot(
+                world_size=topo.world_size,
+                num_slices=topo.num_slices,
+                slices_present=tuple(range(topo.num_slices)),
+                ranks_present=tuple(range(topo.world_size)),
+                degraded_level=None,
+                source="session",
+            )
+        )
+
+    def _resolve_cursor_skew(self, cursors: List[int], common: set, scope: str) -> None:
+        """Shared skew resolution: roll back to the newest generation the
+        agreement scope still holds, degrade, or fail typed (the flat
+        path's verdict, reused per level)."""
+        target = max(common) if common else None
+        if target is None:
+            msg = (
+                f"replicas resumed with skewed step cursors {cursors} and"
+                f" share no common checkpoint generation to roll back to"
+                f" (agreement scope: {scope})"
+            )
+            if self.degraded_ok:
+                warn_once(
+                    "EvalSession.resume: " + msg + "; continuing on LOCAL"
+                    " accounting (degraded_ok=True) — replicas may disagree"
+                    " on which batches are replays",
+                    key=f"session-skew-degraded:{self.journal.directory}",
+                )
+                return
+            raise SessionResumeError(msg + " (set degraded_ok=True to continue anyway)")
+        if target != self.cursor:
+            self._rollback_to_cursor(target, cursors)
+        else:
+            self.metric._session_cursor = self.cursor
+
+    def _partial_quorum_resume(
+        self, backend: HierarchicalSyncBackend, p1: Any, err: BaseException
+    ) -> None:
+        from metrics_tpu.parallel.hierarchy import _lost_slice_from
+
+        topo = backend.topology
+        sid = backend.slice_id
+        lost = _lost_slice_from(err)
+        quorum = QuorumSnapshot(
+            world_size=topo.world_size,
+            num_slices=topo.num_slices,
+            slices_present=(sid,),
+            ranks_present=tuple(topo.slices[sid]),
+            degraded_level=1,
+            lost_slices=(lost,) if lost is not None else tuple(
+                s for s in range(topo.num_slices) if s != sid
+            ),
+            source="session",
+        )
+        record_quorum(quorum)
+        allowed = self.degraded_ok or (p1 is not None and p1.degraded_ok)
+        if not allowed:
+            raise SessionResumeError(
+                "resume agreement could not reach the other pods"
+                f" ({type(err).__name__}: {err}); set degraded_ok=True on the"
+                " session or the level-1 SyncPolicy to resume on slice-local"
+                " agreement with a partial quorum"
+            ) from err
+        self.stats["partial_quorum_resumes"] += 1
+        # event only — the terminal leader exchange already wrote this
+        # fault's flight dump inside apply_sync_policy
+        _flight.record(
+            "session_partial_quorum",
+            slice=sid,
+            lost=list(quorum.lost_slices),
+            error=f"{type(err).__name__}: {err}",
+        )
+        if _obs.enabled():
+            _obs.get().count("reliability.session_partial_quorum_resumes")
+            _obs.get().event(
+                "session_partial_quorum_resume",
+                slice=sid,
+                lost=list(quorum.lost_slices),
+            )
+        warn_once(
+            "EvalSession.resume: the level-1 leader exchange failed"
+            f" terminally ({type(err).__name__}: {err}); resuming on"
+            " SLICE-LOCAL agreement with a partial quorum"
+            f" (slices_present={list(quorum.slices_present)}). The dropped"
+            " pod's accounting will re-agree when it returns; counter:"
+            " reliability.session_partial_quorum_resumes.",
+            key=f"session-partial-quorum:{self.journal.directory}",
+        )
 
     def _rollback_to_cursor(self, target: int, cursors: List[int]) -> None:
         # direct load of the agreed generation (not the latest). Cursors

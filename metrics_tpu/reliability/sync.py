@@ -45,7 +45,7 @@ import threading
 import time
 from contextlib import contextmanager
 from dataclasses import dataclass
-from typing import Any, Callable, Iterator, Optional
+from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax.numpy as jnp
 
@@ -100,6 +100,15 @@ class SyncPolicy:
             the base so a large ``backoff_s`` is never silently clamped
             into a constant, jitter-free sleep. An explicit ceiling below
             ``backoff_s`` is rejected.
+        levels: optional per-level overrides for hierarchical backends
+            (:mod:`metrics_tpu.parallel.hierarchy`): ``{0: intra-slice
+            policy, 1: inter-pod policy}``. A level without an override
+            uses this policy itself (:meth:`for_level`), so e.g.
+            ``SyncPolicy(levels={1: SyncPolicy(timeout_s=5.0,
+            degraded_ok=True)})`` keeps the fast ICI hop strict while the
+            flaky DCN hop may time out and degrade. Overrides may not
+            nest further levels. Flat (non-hierarchical) syncs ignore
+            this field entirely.
     """
 
     max_retries: int = 2
@@ -108,6 +117,7 @@ class SyncPolicy:
     degraded_ok: bool = False
     jitter: bool = True
     max_backoff_s: Optional[float] = None
+    levels: Optional[Dict[int, "SyncPolicy"]] = None
 
     # host-side tally, useful when telemetry is disabled
     def __post_init__(self):
@@ -123,10 +133,30 @@ class SyncPolicy:
                 f" backoff_s ({self.backoff_s}) — a ceiling below the base"
                 " degenerates every retry into the same clamped sleep"
             )
+        if self.levels is not None:
+            for level, override in self.levels.items():
+                if not isinstance(override, SyncPolicy):
+                    raise TypeError(
+                        f"levels[{level!r}] must be a SyncPolicy, got"
+                        f" {type(override).__name__}"
+                    )
+                if override.levels:
+                    raise ValueError(
+                        "per-level policy overrides may not nest further"
+                        " `levels` — the hierarchy has exactly two levels"
+                    )
         self.stats = {"retries": 0, "degraded": 0, "timeouts": 0}
         # fresh OS-entropy seed per policy object: two policies built from
         # the same (seed-free) config MUST NOT produce identical schedules
         self._rng = random.Random()
+
+    def for_level(self, level: int) -> "SyncPolicy":
+        """The policy governing one hierarchy level: the explicit override
+        when ``levels`` names it, else this policy itself (retry stats of
+        an un-overridden level accumulate on the base policy)."""
+        if not self.levels:
+            return self
+        return self.levels.get(level, self)
 
     def next_backoff(self, prev: Optional[float]) -> float:
         """The sleep before the next retry, given the previous sleep (None
@@ -197,10 +227,17 @@ def _attempt(fn: Callable, args: tuple, kwargs: dict, timeout_s: Optional[float]
     return result["value"]
 
 
-def apply_sync_policy(fn: Callable) -> Callable:
+_USE_ACTIVE = object()
+
+
+def apply_sync_policy(fn: Callable, policy: Any = _USE_ACTIVE) -> Callable:
     """Wrap a gather callable (``fn(x, group=None) -> [x_rank0, ...]``) with
     the active policy's retry/backoff/timeout; returns ``fn`` untouched when
-    no policy is installed (the zero-overhead default).
+    no policy is installed (the zero-overhead default). An explicit
+    ``policy=`` (possibly None) overrides the module-global one — the
+    hierarchical sync engine passes ``active_policy().for_level(L)`` so
+    each level gets its own retry/timeout/degradation contract while
+    reusing this exact abandonable-worker machinery.
 
     On exhaustion the wrapper ALWAYS raises :class:`SyncFailedError` — it
     never degrades a single gather. Degradation must be atomic across a
@@ -215,7 +252,7 @@ def apply_sync_policy(fn: Callable) -> Callable:
     by call order a concurrent retry would pair this rank's gathers with
     the wrong rounds on its peers. Only clean failures retry.
     """
-    policy = _active
+    policy = _active if policy is _USE_ACTIVE else policy
     if policy is None:
         return fn
 

@@ -68,6 +68,13 @@ DEFAULT_LEG_THRESHOLDS: Dict[str, float] = {
     "cohort_forward_1024_cpu_ms": 1.75,
     "cohort_forward_10000_cpu_ms": 1.75,
     "cohort_seq64_cpu_ms": 1.75,
+    # hierarchical (2 slices x 4 ranks) vs flat host-level sync legs:
+    # thread-simulated worlds, so ms noise is real — registered at the
+    # bench default like the other virtual-mesh legs; the DETERMINISTIC
+    # gates for the hierarchy are the hier_abs_err BOUND_LEGS below
+    "flat_sync_8rank_host_cpu_ms": 1.75,
+    "hier_sync_2x4_cpu_ms": 1.75,
+    "hier_sync_2x4_int8_cpu_ms": 1.75,
 }
 
 # absolute bound legs: non-millisecond metrics where the gate is a fixed
@@ -89,6 +96,14 @@ BOUND_LEGS: Dict[str, Tuple[str, float]] = {
     # dispatch (sublinearity = t_10k / (10000 * t_1))
     "cohort_speedup_64": ("min", 5.0),
     "cohort_sublinearity_10k": ("max", 0.25),
+    # two-level topology equivalence (ISSUE 11): the exact tier must be
+    # BIT-identical to the flat path on the grid-valued bench state
+    # (associative sums — any nonzero divergence is a real soundness
+    # regression), and the int8-at-level-1 leg must stay within the
+    # documented 2-slice bound (2 * absmax_partial / 254 = 0.126 for the
+    # bench's value range, with headroom to 0.15)
+    "hier_abs_err.hier_exact_512bins": ("max", 0.0),
+    "hier_abs_err.hier_int8_512bins": ("max", 0.15),
 }
 
 

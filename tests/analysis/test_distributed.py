@@ -82,6 +82,99 @@ def test_registry_exact_tier_is_bit_identical_modulo_log_terms(registry_report):
             assert ev["max_state_err"] == 0.0, (fam, ev)
 
 
+def test_registry_topology_equivalence_proved(registry_report):
+    """The TOPOLOGY leg (ISSUE 11): every engine-eligible family's
+    two-level (2-slice) hierarchical merge is proven against the flat
+    path on the same per-replica states — bit-identical on the exact
+    tier (grid sums are exactly associative, so re-bracketing by slice
+    moves no bit), with zero findings registry-wide."""
+    allowed_ulp_families = {"MeanSquaredLogError"}
+    checked = 0
+    for fam, entry in registry_report["families"].items():
+        if "@" in fam or not entry["engine_eligible"]:
+            continue
+        ev = entry["distributed"]
+        topo = ev.get("topology")
+        assert topo is not None, f"{fam}: topology equivalence never probed"
+        assert topo["replicas"] == 4 and topo["num_slices"] == 2, (fam, topo)
+        if fam not in allowed_ulp_families:
+            assert topo["bit_identical"], (fam, topo)
+            assert topo["max_state_err"] == 0.0, (fam, topo)
+        checked += 1
+    assert checked >= 15
+
+
+def test_quantized_variant_topology_within_per_level_bounds(registry_report):
+    """Quantized variants carry the topology leg too: the hierarchical
+    merge (exact level 0, registered tier at level 1) stays within the
+    SUMMED per-level documented bounds of the flat merge."""
+    for fam, entry in registry_report["families"].items():
+        if "@" not in fam or fam.split("@")[1] == "cohort":
+            continue
+        topo = entry["distributed"].get("topology")
+        assert topo is not None, fam
+        assert entry["findings"] == [], (fam, entry["findings"])
+        # the leg genuinely exercised the lossy path: bit-identity is off
+        assert not topo["bit_identical"], (fam, topo)
+
+
+def test_two_level_merge_matches_flat_bitwise_on_exact_sum():
+    """Direct probe of the merge composite: 4 replicas, 2 slices, exact
+    sum state — the two-level fold must be bit-identical to flat."""
+
+    class _Sum(M.Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("acc", default=jnp.zeros((32,)), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.acc = self.acc + x
+
+        def compute(self):
+            return self.acc
+
+    m = _Sum()
+    rng = np.random.RandomState(9)
+    per = [
+        {"acc": jnp.asarray((rng.randint(0, 1024, size=32) / 256.0).astype(np.float32))}
+        for _ in range(4)
+    ]
+    flat, _ = dist._merge_replica_states(m, per)
+    two, _ = dist._merge_replica_states_two_level(m, per, num_slices=2)
+    np.testing.assert_array_equal(np.asarray(flat["acc"]), np.asarray(two["acc"]))
+
+
+def test_two_level_merge_int8_within_summed_bound():
+    class _QSum(M.Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state(
+                "acc", default=jnp.zeros((256,)), dist_reduce_fx="sum",
+                sync_precision="int8",
+            )
+
+        def update(self, x):
+            self.acc = self.acc + x
+
+        def compute(self):
+            return self.acc
+
+    m = _QSum()
+    rng = np.random.RandomState(10)
+    per = [
+        {
+            "acc": jnp.asarray(rng.rand(256).astype(np.float32) * 4),
+            "acc__qres": jnp.zeros((256,)),
+        }
+        for _ in range(4)
+    ]
+    flat, flat_tols = dist._merge_replica_states(m, per)
+    two, two_tols = dist._merge_replica_states_two_level(m, per, num_slices=2)
+    err = float(np.abs(np.asarray(flat["acc"]) - np.asarray(two["acc"])).max())
+    assert err > 0.0  # different quantization points: genuinely lossy
+    assert err <= flat_tols["acc"] + two_tols["acc"]
+
+
 def test_quantized_variants_audited_and_within_bounds(registry_report):
     """The sync_precision=int8/bf16 variants of eligible families are
     audited as separate programs (engine signatures key on the precision

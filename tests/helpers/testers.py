@@ -21,6 +21,7 @@ import numpy as np
 
 from metrics_tpu import Metric
 from metrics_tpu.parallel.backend import SyncBackend, set_sync_backend
+from metrics_tpu.parallel.hierarchy import HierarchicalSyncBackend, SyncTopology
 
 NUM_PROCESSES = 2
 NUM_BATCHES = 10
@@ -71,6 +72,139 @@ class VirtualDDPGroup(SyncBackend):
 
     def abort(self) -> None:
         self._barrier.abort()
+
+
+class _SliceBarrierTransport(SyncBackend):
+    """Level-0 transport of :class:`VirtualTwoLevelGroup`: barrier-gather
+    among the rank threads of ONE slice (each slice has its own barrier —
+    slices never rendezvous with each other at level 0, exactly like
+    intra-slice ICI)."""
+
+    def __init__(self, topology: SyncTopology):
+        self.topology = topology
+        self._barriers = [
+            threading.Barrier(topology.slice_size) for _ in topology.slices
+        ]
+        self._slots = {}
+        self._counters = {}
+        self._lock = threading.Lock()
+
+    @property
+    def world_size(self) -> int:
+        return self.topology.slice_size
+
+    @property
+    def rank(self) -> int:
+        return self.topology.local_index(getattr(_RANK, "rank", 0))
+
+    def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
+        rank = _RANK.rank
+        sid = self.topology.slice_of(rank)
+        j = self.topology.local_index(rank)
+        with self._lock:
+            call_id = self._counters.get(rank, 0)
+            self._counters[rank] = call_id + 1
+            slot = self._slots.setdefault(
+                (sid, call_id), [None] * self.topology.slice_size
+            )
+        slot[j] = x
+        self._barriers[sid].wait()
+        return list(slot)
+
+
+class _LeaderBarrierTransport(SyncBackend):
+    """Level-1 transport of :class:`VirtualTwoLevelGroup`: each slice's
+    LEADER thread publishes the slice's contribution; every rank receives
+    the slice-ordered list after one world rendezvous (the intra-slice
+    broadcast a real leader exchange ends with)."""
+
+    def __init__(self, topology: SyncTopology):
+        self.topology = topology
+        self._barrier = threading.Barrier(topology.world_size)
+        self._slots = {}
+        self._counters = {}
+        self._lock = threading.Lock()
+
+    @property
+    def world_size(self) -> int:
+        return self.topology.num_slices
+
+    @property
+    def rank(self) -> int:
+        return self.topology.slice_of(getattr(_RANK, "rank", 0))
+
+    def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
+        rank = _RANK.rank
+        sid = self.topology.slice_of(rank)
+        with self._lock:
+            call_id = self._counters.get(rank, 0)
+            self._counters[rank] = call_id + 1
+            slot = self._slots.setdefault(call_id, [None] * self.topology.num_slices)
+        if self.topology.is_leader(rank):
+            slot[sid] = x
+        self._barrier.wait()
+        return list(slot)
+
+
+class VirtualTwoLevelGroup(HierarchicalSyncBackend):
+    """:class:`VirtualDDPGroup`'s two-level sibling: simulated ranks carry
+    a thread-local SLICE ID alongside the rank, level-0 gathers rendezvous
+    per slice, and level-1 exchanges rendezvous the slice leaders — the
+    CPU test vehicle for hierarchical sync (MTA005's virtual mesh, the
+    chaos bed, the bench leg) without hardware."""
+
+    def __init__(self, topology: SyncTopology):
+        super().__init__(
+            topology,
+            _SliceBarrierTransport(topology),
+            _LeaderBarrierTransport(topology),
+            rank=lambda: getattr(_RANK, "rank", 0),
+        )
+
+    def abort(self) -> None:
+        for b in self.level0._barriers:
+            b.abort()
+        self.level1._barrier.abort()
+
+
+def run_virtual_hierarchy(
+    topology: SyncTopology, fn: Callable, *args: Any, **kwargs: Any
+) -> None:
+    """Run ``fn(rank, topology, *args, **kwargs)`` on every simulated rank
+    of a two-level world, with a :class:`VirtualTwoLevelGroup` installed
+    as the package sync backend and ``_RANK.rank``/``_RANK.slice`` set
+    thread-locally per rank."""
+    group = VirtualTwoLevelGroup(topology)
+    set_sync_backend(group)
+    errors: List[Optional[BaseException]] = [None] * topology.world_size
+
+    def worker(rank: int) -> None:
+        _RANK.rank = rank
+        _RANK.slice = topology.slice_of(rank)
+        try:
+            fn(rank, topology, *args, **kwargs)
+        except BaseException as err:  # noqa: BLE001 - re-raised below
+            errors[rank] = err
+            group.abort()
+
+    try:
+        threads = [
+            threading.Thread(target=worker, args=(r,))
+            for r in range(topology.world_size)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        set_sync_backend(None)
+
+    real = [e for e in errors if e is not None and not isinstance(e, threading.BrokenBarrierError)]
+    if real:
+        raise real[0]
+    broken = [e for e in errors if e is not None]
+    if broken:
+        raise broken[0]
 
 
 def run_virtual_ddp(world_size: int, fn: Callable, *args: Any, **kwargs: Any) -> None:

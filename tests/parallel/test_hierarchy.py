@@ -1,0 +1,316 @@
+"""Two-level topology-aware sync: topology/backend semantics and
+bit-exactness of the hierarchical reduction against the flat path.
+
+The thread-simulated :class:`VirtualTwoLevelGroup` (tests/helpers) is the
+CPU stand-in for a 2-pod fleet: level-0 gathers rendezvous per slice,
+level-1 exchanges rendezvous the slice leaders. Chaos coverage (per-level
+retry/degradation/quorum) lives in ``tests/reliability/
+test_hierarchy_chaos.py``.
+"""
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from metrics_tpu import Metric
+from metrics_tpu.parallel.backend import set_sync_backend
+from metrics_tpu.parallel.hierarchy import (
+    HierarchicalSyncBackend,
+    SyncTopology,
+    last_quorum,
+    reset_quorum,
+    two_level_fold,
+)
+from metrics_tpu.utilities.data import dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
+from metrics_tpu.utilities.distributed import gather_all_tensors
+from tests.helpers import seed_all
+from tests.helpers.testers import (
+    VirtualDDPGroup,
+    VirtualTwoLevelGroup,
+    run_virtual_ddp,
+    run_virtual_hierarchy,
+)
+
+seed_all(7)
+
+
+@pytest.fixture(autouse=True)
+def _clean_backend_and_quorum():
+    reset_quorum()
+    yield
+    set_sync_backend(None)
+    reset_quorum()
+
+
+# ---------------------------------------------------------------------------
+# topology
+# ---------------------------------------------------------------------------
+def test_topology_regular_layout():
+    topo = SyncTopology.regular(2, 4)
+    assert topo.world_size == 8
+    assert topo.num_slices == 2 and topo.slice_size == 4
+    assert topo.slices == ((0, 1, 2, 3), (4, 5, 6, 7))
+    assert topo.slice_of(5) == 1 and topo.local_index(5) == 1
+    assert topo.leaders() == (0, 4)
+    assert topo.is_leader(4) and not topo.is_leader(6)
+
+
+def test_topology_rejects_bad_partitions():
+    with pytest.raises(ValueError, match="equal-sized"):
+        SyncTopology([[0, 1, 2], [3]])
+    with pytest.raises(ValueError, match="partition"):
+        SyncTopology([[0, 1], [1, 2]])  # duplicate rank
+    with pytest.raises(ValueError, match="partition"):
+        SyncTopology([[0, 1], [3, 4]])  # hole at rank 2
+    with pytest.raises(ValueError, match="non-empty"):
+        SyncTopology([])
+
+
+def test_topology_noncontiguous_slices_allowed():
+    # rank striping (0,2 | 1,3) is a legal fault-domain layout
+    topo = SyncTopology([[0, 2], [1, 3]])
+    assert topo.slice_of(2) == 0 and topo.slice_of(1) == 1
+    assert topo.leaders() == (0, 1)
+
+
+def test_fold_classification():
+    assert two_level_fold(dim_zero_sum) == "sum"
+    assert two_level_fold(dim_zero_max) == "max"
+    assert two_level_fold(dim_zero_min) == "min"
+    assert two_level_fold(dim_zero_mean) is None  # mean-of-means is unsound
+    assert two_level_fold(dim_zero_cat) is None
+    assert two_level_fold(None) is None
+
+
+def test_backend_validates_level_precisions():
+    topo = SyncTopology.regular(2, 1)
+    group = VirtualTwoLevelGroup(topo)
+    with pytest.raises(ValueError, match="level precision"):
+        HierarchicalSyncBackend(topo, group.level0, group.level1, level_precisions=("exact", "fp4"))
+    with pytest.raises(ValueError, match="exactly two"):
+        HierarchicalSyncBackend(topo, group.level0, group.level1, level_precisions=("exact",))
+
+
+# ---------------------------------------------------------------------------
+# the virtual two-level world
+# ---------------------------------------------------------------------------
+class _Stats(Metric):
+    """sum + max + mean states: a two-level fold pair plus one state that
+    must ride the composed flat path."""
+
+    def __init__(self, precision="exact"):
+        super().__init__()
+        self.add_state("total", default=jnp.zeros((96,)), dist_reduce_fx="sum", sync_precision=precision)
+        self.add_state("peak", default=jnp.zeros(()), dist_reduce_fx="max")
+        self.add_state("level", default=jnp.zeros(()), dist_reduce_fx="mean")
+
+    def update(self, x):
+        self.total = self.total + x
+        self.peak = jnp.maximum(self.peak, x.max())
+        self.level = x.mean()
+
+    def compute(self):
+        return self.total
+
+
+def _rank_batch(rank: int) -> jnp.ndarray:
+    # grid-valued (multiples of 1/256): sums are exactly associative, so
+    # the two-level reduction must be BIT-identical to the flat one
+    rng = np.random.RandomState(100 + rank)
+    return jnp.asarray((rng.randint(0, 512, size=96) / 256.0).astype(np.float32))
+
+
+def test_two_level_exact_bit_identical_to_flat():
+    """2 slices x 2 ranks: every state (fold AND composed-flat) lands
+    bit-identical to the same 4 ranks syncing over a flat backend."""
+    flat_results = {}
+
+    def flat_worker(rank, world):
+        m = _Stats()
+        m.dist_sync_fn = gather_all_tensors
+        m.update(_rank_batch(rank))
+        m._sync_dist()
+        flat_results[rank] = {
+            "total": np.asarray(m.total),
+            "peak": np.asarray(m.peak),
+            "level": np.asarray(m.level),
+        }
+
+    run_virtual_ddp(4, flat_worker)
+
+    hier_results = {}
+
+    def hier_worker(rank, topo):
+        m = _Stats()
+        m.dist_sync_fn = gather_all_tensors
+        m.update(_rank_batch(rank))
+        m._sync_dist()
+        hier_results[rank] = {
+            "total": np.asarray(m.total),
+            "peak": np.asarray(m.peak),
+            "level": np.asarray(m.level),
+        }
+
+    run_virtual_hierarchy(SyncTopology.regular(2, 2), hier_worker)
+
+    for rank in range(4):
+        for key in ("total", "peak", "level"):
+            np.testing.assert_array_equal(
+                hier_results[rank][key], flat_results[rank][key],
+                err_msg=f"rank {rank} state {key}",
+            )
+    q = last_quorum()
+    assert q is not None and q.full and q.degraded_level is None
+
+
+def test_two_level_int8_within_documented_bound():
+    """int8 at level 1 only (default level_precisions): the synced state
+    stays within num_slices * absmax/254 of the exact world sum, and the
+    committed residual is identical across a slice's ranks (they quantize
+    the same slice partial)."""
+    results = {}
+
+    def worker(rank, topo):
+        m = _Stats(precision="int8")
+        m.dist_sync_fn = gather_all_tensors
+        m.update(_rank_batch(rank))
+        m._sync_dist()
+        results[rank] = (np.asarray(m.total), np.asarray(m.total__qres))
+
+    run_virtual_hierarchy(SyncTopology.regular(2, 2), worker)
+
+    exact = sum(np.asarray(_rank_batch(r)) for r in range(4))
+    absmax = max(np.abs(np.asarray(_rank_batch(r))).max() for r in range(4))
+    # 2 slice partials quantized, each within (2*absmax)/254 per element
+    bound = 2 * (2 * absmax) / 254
+    for rank in range(4):
+        got, res = results[rank]
+        assert np.abs(got - exact).max() <= bound
+        assert np.abs(res).max() > 0  # feedback advanced
+    # every rank of one slice commits the SAME residual (same partial)
+    np.testing.assert_array_equal(results[0][1], results[1][1])
+    np.testing.assert_array_equal(results[2][1], results[3][1])
+
+
+def test_composed_flat_gather_is_rank_ordered():
+    """HierarchicalSyncBackend.gather composes the two levels back into
+    the flat rank-ordered contract, even on a striped topology."""
+    seen = {}
+
+    def worker(rank, topo):
+        from metrics_tpu.parallel.backend import get_sync_backend
+
+        out = get_sync_backend().gather(jnp.asarray(float(rank)))
+        seen[rank] = [float(np.asarray(v)) for v in out]
+
+    topo = SyncTopology([[0, 2], [1, 3]])
+    run_virtual_hierarchy(topo, worker)
+    for rank in range(4):
+        assert seen[rank] == [0.0, 1.0, 2.0, 3.0]
+
+
+def test_leader_exchange_is_sparse():
+    """Level-1 rounds carry ONE contribution per slice: the leader
+    transport sees num_slices entries, not world_size."""
+    topo = SyncTopology.regular(2, 2)
+    widths = []
+
+    def worker(rank, topo):
+        from metrics_tpu.parallel.backend import get_sync_backend
+
+        backend = get_sync_backend()
+        out = backend.gather_level1(jnp.asarray(float(backend.slice_id)))
+        widths.append(len(out))
+
+    run_virtual_hierarchy(topo, worker)
+    assert widths == [2, 2, 2, 2]
+
+
+def test_over_flat_composition_matches_direct_transports():
+    """over_flat() on a flat world backend gives the same per-level views
+    (slice members / leaders) a sparse transport pair would."""
+    captured = {}
+
+    def worker(rank, world):
+        from metrics_tpu.parallel.backend import get_sync_backend
+
+        flat = get_sync_backend()
+        topo = SyncTopology.regular(2, 2)
+        hb = HierarchicalSyncBackend.over_flat(topo, flat)
+        l0 = [float(np.asarray(v)) for v in hb.gather_level0(jnp.asarray(float(rank)))]
+        l1 = [float(np.asarray(v)) for v in hb.gather_level1(jnp.asarray(float(rank)))]
+        captured[rank] = (l0, l1)
+
+    run_virtual_ddp(4, worker)
+    assert captured[1][0] == [0.0, 1.0]  # my slice's members
+    assert captured[3][0] == [2.0, 3.0]
+    for rank in range(4):
+        assert captured[rank][1] == [0.0, 2.0]  # one entry per slice (leaders)
+
+
+def test_over_flat_rejects_world_mismatch():
+    with pytest.raises(ValueError, match="world"):
+        HierarchicalSyncBackend.over_flat(
+            SyncTopology.regular(2, 4), VirtualDDPGroup(2)
+        )
+
+
+def test_reduction_none_array_state_stays_stacked():
+    """Flat contract parity: a dist_reduce_fx=None array state syncs to
+    the STACKED (world, ...) array under a hierarchical backend exactly
+    as under a flat one — never a Python list."""
+
+    class NoRed(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("x", default=jnp.zeros((3,)), dist_reduce_fx=None)
+
+        def update(self, v):
+            self.x = v
+
+        def compute(self):
+            return self.x
+
+    out = {}
+
+    def worker(rank, topo):
+        m = NoRed()
+        m.dist_sync_fn = gather_all_tensors
+        m.update(jnp.full((3,), float(rank)))
+        m._sync_dist()
+        out[rank] = np.asarray(m.x)
+
+    run_virtual_hierarchy(SyncTopology.regular(2, 2), worker)
+    for rank in range(4):
+        assert out[rank].shape == (4, 3)
+        np.testing.assert_array_equal(out[rank][:, 0], [0.0, 1.0, 2.0, 3.0])
+
+
+# ---------------------------------------------------------------------------
+# cohort over a hierarchical backend
+# ---------------------------------------------------------------------------
+def test_cohort_sync_routes_through_hierarchy():
+    """A MetricCohort under a hierarchical backend still does one
+    collective per STATE per level, and the stacked states merge across
+    pods (simulated mirror world: exactly 2x the local accumulation)."""
+    from metrics_tpu import MeanSquaredError, MetricCohort
+    from metrics_tpu.reliability import faultinject as fi
+
+    rng = np.random.RandomState(3)
+    p = jnp.asarray((rng.randint(0, 256, size=(2, 16)) / 256.0).astype(np.float32))
+    t = jnp.asarray((rng.randint(0, 256, size=(2, 16)) / 256.0).astype(np.float32))
+    with fi.simulated_pods(num_slices=2):
+        cohort = MetricCohort(MeanSquaredError(), tenants=2)
+        cohort(p, t)
+        local_sse = np.asarray(cohort._states["metric"]["sum_squared_error"])
+        values = cohort.compute()
+        # one world: 2x sum / 2x count = the same per-tenant MSE
+        expect = np.asarray(((p - t) ** 2).mean(axis=1))
+        np.testing.assert_allclose(np.asarray(values), expect, atol=1e-6)
+        # accumulation continues un-synced after compute (flat-path parity)
+        np.testing.assert_array_equal(
+            np.asarray(cohort._states["metric"]["sum_squared_error"]), local_sse
+        )
+    q = last_quorum()
+    assert q is not None and q.full

@@ -1,0 +1,399 @@
+"""Chaos bed for hierarchical fault-domain sync (2-level collectives).
+
+Drills one level at a time against the simulated 2-pod world
+(``faultinject.simulated_pods``: remote peers mirror this process's
+contributions, so every healthy/degraded expectation is EXACT arithmetic):
+
+* flaky level-1 retries then succeeds — bit-identical to a clean
+  hierarchical sync, residual committed exactly once;
+* hung level-1 times out under the level-1 policy — per-level atomic
+  degradation serves the level-0 (slice-local, bit-exact) result, fires
+  ``reliability.sync_level_degraded`` exactly once, dumps exactly one
+  flight record, and commits no residual;
+* pod dropout mid-``EvalSession`` — resume still lands exactly-once on
+  slice-local agreement with a partial quorum recorded;
+* a healthy hierarchical run keeps every ``reliability.*`` counter at
+  zero (the per-level keys count, the failure keys stay silent).
+"""
+import glob
+import json
+import os
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import Metric, reliability
+from metrics_tpu.parallel.hierarchy import last_quorum, reset_quorum
+from metrics_tpu.reliability import EvalSession, SyncPolicy, faultinject as fi
+from metrics_tpu.utilities.distributed import gather_all_tensors
+
+pytestmark = pytest.mark.chaos
+
+_X = (np.random.RandomState(0xA5).randint(0, 512, size=300) / 256.0).astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_quorum():
+    reset_quorum()
+    yield
+    reset_quorum()
+
+
+class QHist(Metric):
+    def __init__(self, precision="int8"):
+        super().__init__()
+        self.add_state(
+            "hist", default=jnp.zeros((300,)), dist_reduce_fx="sum", sync_precision=precision
+        )
+
+    def update(self, x):
+        self.hist = self.hist + x
+
+    def compute(self):
+        return self.hist
+
+
+class SumVec(Metric):
+    """Plain exact sum state for the session drills."""
+
+    def __init__(self, n=8):
+        super().__init__()
+        self.add_state("hist", default=jnp.zeros((n,)), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.hist = self.hist + x
+
+    def compute(self):
+        return self.hist
+
+
+class MixedStats(Metric):
+    """sum + max: degradation must move BOTH to slice scope, never one."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=jnp.zeros((300,)), dist_reduce_fx="sum")
+        self.add_state("peak", default=jnp.zeros(()), dist_reduce_fx="max")
+
+    def update(self, x):
+        self.total = self.total + x
+        self.peak = jnp.maximum(self.peak, x.max())
+
+    def compute(self):
+        return self.total
+
+
+def _filled(cls=QHist, *args):
+    m = cls(*args)
+    m.dist_sync_fn = gather_all_tensors  # force the host sync path
+    m.update(jnp.asarray(_X))
+    return m
+
+
+def _dumps(directory):
+    return sorted(glob.glob(os.path.join(os.fspath(directory), "flight-*.json")))
+
+
+# ---------------------------------------------------------------------------
+# flaky level 1: retry succeeds, no residual double-apply
+# ---------------------------------------------------------------------------
+def test_flaky_level1_retries_then_succeeds_no_residual_double_apply():
+    with fi.simulated_pods(2):
+        clean = _filled()
+        want = np.asarray(clean.compute())
+        want_res = np.asarray(clean.hist__qres)
+        assert np.abs(want_res).max() > 0  # a real residual was committed
+
+        m = _filled()
+        with fi.flaky_level(level=1, fails=2):
+            with reliability.sync_policy_scope(max_retries=2, backoff_s=0.001) as pol:
+                got = np.asarray(m.compute())
+        assert pol.stats["retries"] == 2 and pol.stats["degraded"] == 0
+        # the payload was quantized ONCE before any attempt: retried
+        # exchanges re-send identical bytes, so result AND residual are
+        # bit-identical to the clean run
+        np.testing.assert_array_equal(got, want)
+        np.testing.assert_array_equal(np.asarray(m.hist__qres), want_res)
+    q = last_quorum()
+    assert q is not None and q.full
+
+
+def test_flaky_level0_exhaustion_degrades_to_local_only():
+    with fi.simulated_pods(num_slices=2, slice_size=2):
+        m = _filled()
+        local = np.asarray(m.hist)
+        with fi.flaky_level(level=0, fails=10**6):
+            with reliability.sync_policy_scope(
+                max_retries=1, backoff_s=0.001, degraded_ok=True
+            ) as pol:
+                with warnings.catch_warnings(record=True):
+                    warnings.simplefilter("always")
+                    got = np.asarray(m.compute())
+        assert pol.stats["degraded"] == 1
+        np.testing.assert_array_equal(got, local)  # exact local state
+        assert np.abs(np.asarray(m.hist__qres)).max() == 0.0
+    q = last_quorum()
+    assert q.degraded_level == 0 and q.ranks_present == (0,)
+    # the slice's OTHER rank's contribution is not in the served state:
+    # no slice may be claimed present (quorum_size 0, dropped = all)
+    assert q.slices_present == () and q.dropped_pods == 2
+
+
+def test_level0_degradation_keeps_flat_degraded_contract():
+    """Local-only fallback serves the SAME shapes/types the flat degraded
+    path serves: a dist_reduce_fx=None array state keeps its (1, ...)
+    world axis, a cat list state comes back reduced to an array."""
+
+    class NoRed(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("x", default=jnp.zeros((3,)), dist_reduce_fx=None)
+            self.add_state("xs", default=[], dist_reduce_fx="cat")
+
+        def update(self, v):
+            self.x = v
+            self.xs.append(v)
+
+        def compute(self):
+            return self.x
+
+    with fi.simulated_pods(num_slices=2, slice_size=2):
+        m = NoRed()
+        m.dist_sync_fn = gather_all_tensors
+        m.update(jnp.arange(3.0))
+        with fi.flaky_level(level=0, fails=10**6):
+            with reliability.sync_policy_scope(
+                max_retries=0, backoff_s=0.001, degraded_ok=True
+            ):
+                with warnings.catch_warnings(record=True):
+                    warnings.simplefilter("always")
+                    m._sync_dist()
+        assert np.asarray(m.x).shape == (1, 3)  # stacked world axis kept
+        assert not isinstance(m.xs, list)  # cat reduction applied
+        np.testing.assert_array_equal(np.asarray(m.xs), np.arange(3.0))
+
+
+# ---------------------------------------------------------------------------
+# hung level 1: per-level timeout -> atomic degradation to level 0
+# ---------------------------------------------------------------------------
+def test_hung_level1_times_out_and_degrades_level0_exact(tmp_path):
+    with fi.simulated_pods(2), obs.telemetry_scope(), obs.flight_scope(tmp_path):
+        m = _filled()
+        with fi.hung_level(level=1, delay_s=30.0):
+            policy = SyncPolicy(
+                max_retries=0,
+                levels={1: SyncPolicy(max_retries=0, timeout_s=0.2, degraded_ok=True)},
+            )
+            with reliability.sync_policy_scope(policy):
+                with warnings.catch_warnings(record=True):
+                    warnings.simplefilter("always")
+                    got = np.asarray(m.compute())
+        # level 0 is the fallback: the local slice's EXACT (bit-identical)
+        # accumulation, not a quantized or partially-merged anything
+        np.testing.assert_array_equal(got, _X)
+        # the lossy exchange never finished: residual must not advance
+        assert np.abs(np.asarray(m.hist__qres)).max() == 0.0
+        counters = obs.get().snapshot()["counters"]
+        assert counters.get("reliability.sync_level_degraded") == 1
+        assert "reliability.degraded_syncs" not in counters  # level-scoped, not whole-sync
+        assert policy.levels[1].stats["timeouts"] == 1
+        # exactly ONE flight dump for one injected fault (the terminal
+        # timed-out gather), none for the degradation itself
+        assert len(_dumps(tmp_path)) == 1
+        with open(_dumps(tmp_path)[0]) as f:
+            dump = json.load(f)
+        assert dump["reason"] == "sync_timeout"
+    q = last_quorum()
+    assert q.degraded_level == 1 and q.slices_present == (0,) and q.dropped_pods == 1
+
+
+def test_degradation_is_atomic_across_mixed_states():
+    """No mixed-level partial merge: when level 1 dies, the sum AND the
+    max state BOTH come back at slice scope."""
+    with fi.simulated_pods(2):
+        m = _filled(MixedStats)
+        with fi.pod_dropout(slice_id=1):
+            with reliability.sync_policy_scope(max_retries=0, degraded_ok=True):
+                with warnings.catch_warnings(record=True):
+                    warnings.simplefilter("always")
+                    total = np.asarray(m.compute())
+        np.testing.assert_array_equal(total, _X)  # slice scope, not 2x
+        q = last_quorum()
+        assert q.lost_slices == (1,) and q.slices_present == (0,)
+        # healthy retry afterwards: both states at world scope again
+        m2 = _filled(MixedStats)
+        got = np.asarray(m2.compute())
+        np.testing.assert_array_equal(got, 2 * _X)
+
+
+# ---------------------------------------------------------------------------
+# pod dropout mid-session: exactly-once resume on a partial quorum
+# ---------------------------------------------------------------------------
+def test_pod_dropout_mid_session_resumes_exactly_once_with_quorum(tmp_path):
+    def batch(i):
+        return jnp.asarray(np.full(8, float(i + 1), dtype=np.float32))
+
+    with fi.simulated_pods(2), obs.telemetry_scope():
+        m = SumVec()
+        session = EvalSession(m, tmp_path / "journal", checkpoint_every=1)
+        for i in range(3):
+            session.step(i, batch(i))
+        pre = np.asarray(m.hist)
+
+        # the process "dies"; a fresh replica resumes while pod 1 is gone
+        m2 = SumVec()
+        s2 = EvalSession(m2, tmp_path / "journal", checkpoint_every=1)
+        with fi.pod_dropout(slice_id=1):
+            policy = SyncPolicy(
+                max_retries=0,
+                levels={1: SyncPolicy(max_retries=0, degraded_ok=True)},
+            )
+            with reliability.sync_policy_scope(policy):
+                with warnings.catch_warnings(record=True):
+                    warnings.simplefilter("always")
+                    cursor = s2.resume()
+        assert cursor == 2
+        np.testing.assert_array_equal(np.asarray(m2.hist), pre)  # state restored
+        assert s2.stats["partial_quorum_resumes"] == 1
+        counters = obs.get().snapshot()["counters"]
+        assert counters.get("reliability.session_partial_quorum_resumes") == 1
+        q = last_quorum()
+        assert q.source == "session" and q.degraded_level == 1
+        assert q.slices_present == (0,) and q.lost_slices == (1,)
+
+        # exactly-once: re-fed batches at or below the cursor are no-ops
+        replayed = s2.step(2, batch(2))
+        assert replayed is None
+        np.testing.assert_array_equal(np.asarray(m2.hist), pre)
+        assert s2.stats["replays_skipped"] == 1
+
+
+def test_pod_dropout_resume_degrades_without_a_sync_policy(tmp_path):
+    """EvalSession(degraded_ok=True) alone must protect resume: with NO
+    SyncPolicy installed the dropped pod's raw PodUnreachableError still
+    routes through the partial-quorum gate instead of crashing."""
+    with fi.simulated_pods(2):
+        m = SumVec()
+        session = EvalSession(m, tmp_path / "journal", checkpoint_every=1)
+        session.step(0, jnp.ones(8))
+        m2 = SumVec()
+        s2 = EvalSession(m2, tmp_path / "journal", degraded_ok=True)
+        with fi.pod_dropout(slice_id=1):
+            with warnings.catch_warnings(record=True):
+                warnings.simplefilter("always")
+                cursor = s2.resume()
+        assert cursor == 0
+        assert s2.stats["partial_quorum_resumes"] == 1
+        q = last_quorum()
+        assert q.source == "session" and q.slices_present == (0,)
+
+
+def test_slice_local_skew_resume_does_not_deadlock_over_flat(tmp_path):
+    """Regression: the level-0 availability exchange must run on EVERY
+    slice (unconditionally), because over_flat level-0 views are
+    world-wide collectives — a skewed slice making extra rounds the
+    healthy slice skips would deadlock the whole resume."""
+    import threading
+
+    from metrics_tpu.parallel.backend import set_sync_backend
+    from metrics_tpu.parallel.hierarchy import HierarchicalSyncBackend, SyncTopology
+    from tests.helpers.testers import VirtualDDPGroup, _RANK
+
+    dirs = [tmp_path / f"rank{r}" for r in range(4)]
+    for r in range(4):
+        m = SumVec()
+        s = EvalSession(m, dirs[r], checkpoint_every=1)
+        s.step(0, jnp.ones(8))
+        if r != 1:
+            # rank 1 "died" before checkpointing step 1: slice 0 (ranks
+            # 0,1) resumes internally skewed, slice 1 (ranks 2,3) agreed
+            s.step(1, jnp.ones(8))
+
+    flat = VirtualDDPGroup(4)
+    topo = SyncTopology.regular(2, 2)
+    prev = set_sync_backend(HierarchicalSyncBackend.over_flat(topo, flat))
+    cursors, errors = {}, {}
+
+    def worker(rank):
+        _RANK.rank = rank
+        try:
+            m = SumVec()
+            s = EvalSession(m, dirs[rank])
+            with warnings.catch_warnings(record=True):
+                warnings.simplefilter("always")
+                cursors[rank] = s.resume()
+        except BaseException as err:  # noqa: BLE001 — surfaced below
+            errors[rank] = err
+            flat.abort()
+
+    threads = [
+        threading.Thread(target=worker, args=(r,), daemon=True) for r in range(4)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "resume deadlocked"
+    finally:
+        set_sync_backend(prev)
+    assert not errors, errors
+    # everyone rolled back to the newest generation ALL ranks hold
+    assert cursors == {0: 0, 1: 0, 2: 0, 3: 0}
+
+
+def test_healthy_session_resume_records_full_quorum(tmp_path):
+    with fi.simulated_pods(2):
+        m = SumVec(4)
+        session = EvalSession(m, tmp_path / "journal", checkpoint_every=1)
+        session.checkpoint()
+        m2 = SumVec(4)
+        s2 = EvalSession(m2, tmp_path / "journal")
+        s2.resume()
+        q = last_quorum()
+        assert q is not None and q.full and q.source == "session"
+        assert s2.stats["partial_quorum_resumes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# healthy-run hygiene
+# ---------------------------------------------------------------------------
+def test_healthy_hierarchical_run_zero_failure_counters(tmp_path):
+    with fi.simulated_pods(2), obs.telemetry_scope(), obs.flight_scope(tmp_path):
+        m = _filled()
+        with reliability.sync_policy_scope(max_retries=2, backoff_s=0.001):
+            got = np.asarray(m.compute())
+        np.testing.assert_allclose(got, 2 * _X, atol=2 * np.abs(_X).max() / 127)
+        snap = obs.get().snapshot()
+        bad = {
+            k: v
+            for k, v in snap["counters"].items()
+            if k.startswith("reliability.") and v
+        }
+        assert not bad, f"healthy hierarchical run moved failure counters: {bad}"
+        # the per-level activity keys DID move (one sync, two levels)
+        assert snap["counters"]["sync.level0.calls"] == 1
+        assert snap["counters"]["sync.level1.calls"] == 1
+        assert snap["counters"]["sync.level0.wire_bytes"] > 0
+        assert snap["counters"]["sync.level1.wire_bytes"] > 0
+        assert "sync.level0.ms" in snap["histograms"]
+        assert "sync.level1.ms" in snap["histograms"]
+        assert not _dumps(tmp_path)  # zero flight dumps
+    q = last_quorum()
+    assert q.full and q.dropped_pods == 0
+
+
+def test_level1_wire_is_smaller_than_flat_equivalent():
+    """The point of the hierarchy: int8 slice partials at level 1 ship
+    fewer bytes than the exact state, and only ONE contribution per slice
+    crosses the DCN."""
+    with fi.simulated_pods(2), obs.telemetry_scope():
+        m = _filled()
+        m.compute()
+        counters = obs.get().snapshot()["counters"]
+        logical = counters["sync.payload_bytes"]
+        level1 = counters["sync.level1.wire_bytes"]
+        assert level1 < logical / 3  # int8 + scales vs f32: ~3.9x
