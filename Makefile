@@ -50,16 +50,21 @@ ci:
 	-python scripts/perf_sentinel.py --current bench_current.json
 
 lint:
-	# static analysis gate: passes 1+3 trace every metric family's program
-	# — and its sync_precision=int8/bf16 variants — (accumulator dtypes,
-	# host sync, donation aliasing, reduction soundness, N-replica
-	# distributed equivalence, state lifecycle, donation lifetime), pass 2
-	# lints the source tree for repo invariants incl. stale suppressions;
-	# writes ANALYSIS.json atomically WITH the per-family program
-	# fingerprints the CI drift sentinel diffs against. Also pinned in
-	# tier-1 via tests/analysis/test_lint_clean.py. Rule catalog:
+	# static analysis gate: passes 1+3+4 trace every metric family's
+	# program — and its sync_precision=int8/bf16 + @cohort variants —
+	# (accumulator dtypes, host sync, donation aliasing, reduction
+	# soundness, N-replica distributed equivalence, state lifecycle,
+	# donation lifetime, host-seam budget vs SEAM_BASELINE.json,
+	# two-generation double-buffer safety), pass 2 lints the source tree
+	# for repo invariants incl. thread-shared-state (MTL106) and stale
+	# suppressions; writes ANALYSIS.json atomically WITH the per-family
+	# program fingerprints the CI drift sentinel diffs against, and
+	# refreshes the committed seam baseline (an INTENDED seam change —
+	# e.g. a sync leg folded in-program — lands here and is then gated
+	# against backsliding). Also pinned in tier-1 via
+	# tests/analysis/test_lint_clean.py. Rule catalog:
 	# docs/static_analysis.md
-	python scripts/lint_metrics.py --strict --fingerprints
+	python scripts/lint_metrics.py --strict --fingerprints --refresh-seam-baseline
 
 san:
 	# MetricSan-armed test pass: the runtime sanitizer behind the static

@@ -1,7 +1,7 @@
 """Static analysis for metric programs: catch the bad program before it
 dispatches, not after it corrupts an epoch.
 
-Three passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
+Four passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
 
 * **Pass 1 — program audit** (:mod:`metrics_tpu.analysis.program`):
   abstractly traces each metric's ``update`` and, for engine-eligible
@@ -24,12 +24,23 @@ Three passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
   the documented bound for quantized tiers), that every state's
   reset→update→sync→compute→restore lifecycle is sound (MTA006), and
   that donated-buffer lifetimes survive the compiled step (MTA007).
+* **Pass 4 — concurrency soundness**
+  (:mod:`metrics_tpu.analysis.concurrency`): derives each
+  engine-eligible family's host-seam budget — counted, phase-classified
+  host↔device crossings, gated against the committed
+  ``SEAM_BASELINE.json`` (MTA008) — proves two-generation double-buffer
+  (ping-pong) safety by abstract donation-interleave simulation over the
+  real step program (MTA009, ``evidence["double_buffer"]`` in
+  ANALYSIS.json), and contributes the MTL106 thread-shared-state lint
+  leg to pass 2.
 
 The runtime counterpart is **MetricSan**
 (:mod:`metrics_tpu.analysis.sanitizer`): ``METRICS_TPU_SAN=1`` or
 :func:`san_scope` arms poison-on-donate canaries, a state-write
-interceptor, and single-replica-sync identity checks — each violation
-flight-dumped under the static rule it refutes.
+interceptor, single-replica-sync identity checks, and ThreadSan's
+cross-thread write instrumentation of the statically flagged
+thread-shared attributes — each violation flight-dumped under the
+static rule it refutes.
 
 Suppress a rule at a site with ``# metrics-tpu: allow(<RULE-ID>)``
 (stale allows are themselves flagged, MTL105).
@@ -52,6 +63,15 @@ from metrics_tpu.analysis.distributed import (  # noqa: F401
     check_replica_equivalence,
     fingerprint_jaxpr,
 )
+from metrics_tpu.analysis.concurrency import (  # noqa: F401
+    check_double_buffer,
+    check_host_seam,
+    host_seam_budget,
+    host_seam_sites,
+    load_seam_baseline,
+    register_threadsan_target,
+    thread_shared_model,
+)
 from metrics_tpu.analysis.lint import lint_file, lint_paths  # noqa: F401
 from metrics_tpu.analysis.sanitizer import (  # noqa: F401
     MetricSan,
@@ -72,14 +92,21 @@ __all__ = [
     "audit_metric",
     "audit_registry",
     "check_donation_lifetime",
+    "check_double_buffer",
+    "check_host_seam",
     "check_lifecycle",
     "check_replica_equivalence",
     "disable_san",
     "enable_san",
     "fingerprint_jaxpr",
     "hint_for_watch_key",
+    "host_seam_budget",
+    "host_seam_sites",
     "iter_eqns",
     "lint_file",
     "lint_paths",
+    "load_seam_baseline",
+    "register_threadsan_target",
     "san_scope",
+    "thread_shared_model",
 ]

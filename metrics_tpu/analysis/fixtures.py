@@ -12,6 +12,8 @@ else".
 Never export these from the package root; they exist for the analyzer's
 test bed and for documentation of what each rule means in code.
 """
+import threading
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -23,6 +25,8 @@ __all__ = [
     "CallbackInJit",
     "ComputeMutatesState",
     "DonatedAlias",
+    "DoubleBufferAliaser",
+    "HostReadOfDonated",
     "HostSyncUpdate",
     "MeanWithoutCount",
     "NarrowAccumulator",
@@ -30,8 +34,10 @@ __all__ = [
     "NonIdentityReset",
     "OrphanResidual",
     "ReplicaDependentCount",
+    "SeamRegressor",
     "StaleSuppression",
     "SuppressedNarrowAccumulator",
+    "UnlockedSharedCounter",
     "UnownedLoader",
     "UnscaledInt8Psum",
     "UntouchedStatePassthrough",
@@ -337,6 +343,114 @@ class StaleSuppression(Metric):
 
     def compute(self) -> jax.Array:
         return self.acc
+
+
+class SeamRegressor(Metric):
+    """MTA008: a family whose host-seam budget regressed past its
+    committed baseline. The entry for this class in ``SEAM_BASELINE.json``
+    budgets ONE host-synced state; the class registers THREE — every sync
+    now pays three host collectives, every checkpoint three fetches. The
+    program itself is sound (all states written, sum-reduced, fused), so
+    only the seam gate fires: exactly the regression class the budget
+    exists to catch, a crossing-count creep no other rule sees."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("hits", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("misses", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("weight", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.hits = self.hits + jnp.sum(x)
+        self.misses = self.misses + jnp.sum(1.0 - x)
+        self.weight = self.weight + x.shape[0]
+
+    def compute(self) -> jax.Array:
+        return self.hits / jnp.maximum(self.hits + self.misses, 1.0)
+
+
+class DoubleBufferAliaser(Metric):
+    """MTA009 (generation-alias flavor): ``reset()`` reseeds the
+    registered state from a buffer cached on the instance at construction
+    time. Every post-reset generation then starts on the SAME host-held
+    buffer — once a donated dispatch consumes it, the next ``reset()``
+    resurrects a dead buffer, and two ping-pong generations can never be
+    disjoint. The single-step jaxpr is clean (the merge produces fresh
+    vars), which is exactly why the AST leg of the prover exists."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self._pool = jnp.zeros(())  # the host-cached buffer
+        self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.acc = self.acc + jnp.sum(x)
+
+    def compute(self) -> jax.Array:
+        return self.acc
+
+    def reset(self) -> None:
+        super().reset()
+        self.acc = self._pool  # the alias: every generation shares _pool
+
+
+class HostReadOfDonated(Metric):
+    """MTA009 (host-read flavor): ``compute`` stashes the live state into
+    a plain attribute — a telemetry-gauge-style host reference that
+    outlives the compute. The next donated dispatch kills the buffer; any
+    later read of the stash (an exporter scrape, user code) touches an
+    in-flight donated buffer. MetricSan's poison-on-donate canary only
+    sees it after the buffer dies; the prover refuses the stash at the
+    assignment."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self._last_value = None
+
+    def update(self, x: jax.Array) -> None:
+        self.acc = self.acc + jnp.sum(x)
+
+    def compute(self) -> jax.Array:
+        self._last_value = self.acc  # the escape: a host ref to live state
+        return self.acc
+
+
+class UnlockedSharedCounter:
+    """MTL106 + ThreadSan drill: a background worker and the owning
+    thread both write ``value``; neither holds ``_lock``. The static lint
+    flags both writes (suppressed inline here — the fixture must STAY
+    broken to keep proving the rule; `tests/analysis/test_lint.py` pins
+    the unsuppressed source fires); ThreadSan reproduces the race
+    dynamically — register via
+    ``analysis.register_threadsan_target(UnlockedSharedCounter,
+    ("value",))``, arm MetricSan, and the cross-thread write dumps one
+    ``metricsan_thread_race`` flight record."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def spin(self, n: int = 3) -> None:
+        """Run the worker to completion on a background thread."""
+        worker = threading.Thread(target=self._worker, args=(n,), daemon=True)
+        worker.start()
+        worker.join()
+
+    def _worker(self, n: int) -> None:
+        for _ in range(n):
+            # metrics-tpu: allow(MTL106) — deliberate: the broken fixture
+            self.value = self.value + 1
+
+    def bump(self) -> None:
+        # metrics-tpu: allow(MTL106) — deliberate: the broken fixture
+        self.value = self.value + 1
 
 
 class BlockScaledQuantizedSync(Metric):

@@ -20,6 +20,10 @@ and designed for a zero-false-positive baseline:
 * **MTL104** — ``add_state`` registering an array state without a
   ``dist_reduce_fx`` (list states may omit it: rank-order concat is their
   implied reduction).
+* **MTL106** — unprotected writes to thread-shared instance attributes /
+  module globals (pass 4's lint leg; the analysis itself lives in
+  :mod:`metrics_tpu.analysis.concurrency` and routes findings through
+  this pass's suppression machinery).
 
 Suppression: ``# metrics-tpu: allow(MTL104)`` on the flagged line or the
 line directly above it.
@@ -429,6 +433,13 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
     tree = ast.parse(source, filename=rel_path)
     linter = _Linter(rel_path, source)
     linter.visit(tree)
+    # pass-4 lint leg (MTL106): thread-shared-state analysis — a separate
+    # two-phase walk (spawn-site discovery, then call-graph reachability),
+    # so it lives in analysis/concurrency.py and routes its findings
+    # through the same suppression machinery here
+    from metrics_tpu.analysis.concurrency import thread_findings
+
+    linter.findings.extend(thread_findings(tree, rel_path))
     base_allow = parse_allow_comments(source)
     allow = {line: set(rules) for line, rules in base_allow.items()}
     # provenance: effective (line, rule) -> the comment line that grants it

@@ -166,6 +166,11 @@ class AuditResult:
     # jaxpr digests (ops × dtypes × shapes) of the update and compiled
     # step programs, when fingerprinting was requested
     fingerprints: Optional[Dict[str, Optional[str]]] = None
+    # pass-4 evidence (engine-eligible families only): the host-seam
+    # budget (MTA008 — crossings per serving-loop phase, gated against
+    # SEAM_BASELINE.json) and the double-buffer verdict (MTA009 — the
+    # two-generation ping-pong safety the future async engine gates on)
+    evidence: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -177,6 +182,7 @@ class AuditResult:
             "infos": list(self.infos),
             "distributed": self.distributed,
             "fingerprints": self.fingerprints,
+            "evidence": self.evidence,
         }
 
 
@@ -485,18 +491,18 @@ def _audit_traced_update(metric, args: tuple, kwargs: dict, findings: List[Findi
 
 def _audit_engine_program(
     metric, args: tuple, kwargs: dict, findings: List[Finding]
-) -> Optional[Tuple[Any, int]]:
+) -> Optional[Tuple[Any, int, int]]:
     """Trace the *actual* donated step program (update + batch-local
     compute + merge) and audit it: callbacks (MTA002) and donated-buffer
-    aliasing across outputs (MTA003). Returns ``(closed_jaxpr,
-    n_donated)`` for the downstream donation-lifetime pass, or None when
-    the step does not trace."""
+    aliasing across outputs (MTA003). Returns ``(closed_jaxpr, n_donated,
+    n_state_outputs)`` for the downstream donation-lifetime and
+    double-buffer passes, or None when the step does not trace."""
     from metrics_tpu.engine import CompiledStepEngine
 
     cls = type(metric).__name__
     engine = CompiledStepEngine(metric, observe=False)
     try:
-        closed, _out_shape, n_donated = engine.abstract_step(*args, **kwargs)
+        closed, out_shape, n_donated = engine.abstract_step(*args, **kwargs)
     except Exception as err:  # noqa: BLE001
         kind = _trace_error_kind(err)
         msg = str(err).splitlines()[0] if str(err) else type(err).__name__
@@ -525,7 +531,11 @@ def _audit_engine_program(
             f" program (output positions {positions}): donation double-books"
             " the buffer (state/state or state/batch-value alias)",
         ))
-    return closed, n_donated
+    # the out tree is (new_states, values[, finites]); the state leaves
+    # lead, and they are exactly what _write_back installs and the NEXT
+    # generation donates — the double-buffer prover's donation frontier
+    n_state_outputs = len(jax.tree_util.tree_leaves(out_shape[0]))
+    return closed, n_donated, n_state_outputs
 
 
 def _route_suppressions(
@@ -614,6 +624,7 @@ def audit_metric(
     exactly the named states. Allows that suppress nothing are themselves
     flagged (MTL105).
     """
+    from metrics_tpu.analysis import concurrency as _conc
     from metrics_tpu.analysis import distributed as _dist
     from metrics_tpu.engine import CompiledStepEngine
 
@@ -629,11 +640,11 @@ def audit_metric(
         metric, args, kwargs, findings, result.infos,
         traceable_contract=eager_reason is None,
     )
-    engine_closed, n_donated = None, 0
+    engine_closed, n_donated, n_state_outs = None, 0, 0
     if eager_reason is None:
         traced = _audit_engine_program(metric, args, kwargs, findings)
         if traced is not None:
-            engine_closed, n_donated = traced
+            engine_closed, n_donated, n_state_outs = traced
     elif not any(isinstance(d, list) for d in metric._defaults.values()):
         result.infos.append(f"{cls} runs eager in engines: {eager_reason}")
 
@@ -653,6 +664,19 @@ def audit_metric(
             engine_eligible=eager_reason is None,
             update_closed=update_closed,
         )
+    # pass 4 — concurrency soundness (engine-eligible families: only the
+    # donated serving loop has a host seam and buffer generations)
+    if eager_reason is None:
+        result.evidence = {
+            "host_seam": _conc.check_host_seam(
+                metric, findings, result.infos, step_closed=engine_closed
+            ),
+            "double_buffer": _conc.check_double_buffer(
+                metric, findings, result.infos,
+                step_closed=engine_closed, n_donated=n_donated,
+                n_state_outputs=n_state_outs, engine_eligible=True,
+            ),
+        }
     if fingerprint:
         result.fingerprints = {
             "update": _dist.fingerprint_jaxpr(update_closed) if update_closed is not None else None,
@@ -801,7 +825,9 @@ QUANTIZED_AUDIT_TIERS = ("int8", "bf16")
 _COHORT_AUDIT_CAPACITY = 4
 
 
-def _audit_cohort_variant(metric, args: tuple, fingerprint: bool = False) -> AuditResult:
+def _audit_cohort_variant(
+    metric, args: tuple, fingerprint: bool = False, family: Optional[str] = None
+) -> AuditResult:
     """A slim audit of the vmapped cohort step of an engine-eligible
     family (reported as ``<Family>@cohort``): the per-tenant math is the
     already-audited base program, so what the cohort changes — and what is
@@ -809,8 +835,13 @@ def _audit_cohort_variant(metric, args: tuple, fingerprint: bool = False) -> Aud
     MTA002 (no host callbacks survive the vmap), MTA003 (no buffer aliased
     into two outputs of the stacked donation), MTA007 (no donated stacked
     invar returned unchanged — ping-pong double-buffering must stay
-    structurally possible for cohorts too). ``fingerprint=True`` digests
-    the vmapped step jaxpr for the drift sentinel."""
+    structurally possible for cohorts too), and pass 4: the host-seam
+    budget of the stacked serving loop (MTA008 — one collective per STATE
+    regardless of tenant count, plus the health-fetch crossing) and the
+    two-generation double-buffer verdict on the stacked program (MTA009).
+    ``fingerprint=True`` digests the vmapped step jaxpr for the drift
+    sentinel."""
+    from metrics_tpu.analysis import concurrency as _conc
     from metrics_tpu.analysis import distributed as _dist
     from metrics_tpu.engine import CompiledStepEngine
 
@@ -819,10 +850,12 @@ def _audit_cohort_variant(metric, args: tuple, fingerprint: bool = False) -> Aud
     result = AuditResult(name=cls, engine_eligible=True, eager_reason=None)
     findings: List[Finding] = []
     closed = None
+    n_state_outs = 0
     try:
         closed, _shapes, n_donated = engine.abstract_cohort_step(
             *args, capacity=_COHORT_AUDIT_CAPACITY
         )
+        n_state_outs = len(jax.tree_util.tree_leaves(_shapes[0]))
     except Exception as err:  # noqa: BLE001
         kind = _trace_error_kind(err)
         msg = str(err).splitlines()[0] if str(err) else type(err).__name__
@@ -857,6 +890,17 @@ def _audit_cohort_variant(metric, args: tuple, fingerprint: bool = False) -> Aud
                 " state",
                 detail={"position": pos},
             ))
+    result.evidence = {
+        "host_seam": _conc.check_host_seam(
+            metric, findings, result.infos, family=family or f"{cls}@cohort",
+            step_closed=closed, cohort=True,
+        ),
+        "double_buffer": _conc.check_double_buffer(
+            metric, findings, result.infos,
+            step_closed=closed, n_donated=n_donated if closed is not None else 0,
+            n_state_outputs=n_state_outs, engine_eligible=True,
+        ),
+    }
     if fingerprint:
         result.fingerprints = {
             "cohort_step": _dist.fingerprint_jaxpr(closed) if closed is not None else None,
@@ -866,7 +910,8 @@ def _audit_cohort_variant(metric, args: tuple, fingerprint: bool = False) -> Aud
 
 
 def _audit_quantized_variant(
-    metric, args: tuple, probe_cache: Optional[Dict[str, Any]] = None
+    metric, args: tuple, probe_cache: Optional[Dict[str, Any]] = None,
+    family: Optional[str] = None,
 ) -> AuditResult:
     """A slimmer audit for a ``sync_precision=`` variant of an already-
     audited family: the *update program* is unchanged by the tier (the
@@ -875,8 +920,12 @@ def _audit_quantized_variant(
     state pytree, the step program, and the merge. Audited here: MTA004
     (quantized merge probes), MTA002/MTA003 on the variant's donated step
     (residuals ride the pytree), MTA005 at the tier's documented bound
-    through the real codec, and MTA006 (residual coherence, reset
-    identity, compute purity)."""
+    through the real codec, MTA006 (residual coherence, reset
+    identity, compute purity), and pass 4: the tier's own host-seam
+    budget (MTA008 — the residual companion raises the checkpoint fetch
+    count and the quantized-payload classification differs) and the
+    double-buffer verdict on the variant's step program (MTA009)."""
+    from metrics_tpu.analysis import concurrency as _conc
     from metrics_tpu.analysis import distributed as _dist
     from metrics_tpu.engine import CompiledStepEngine
 
@@ -887,11 +936,11 @@ def _audit_quantized_variant(
     )
     findings: List[Finding] = []
     _audit_reductions(metric, findings)
-    engine_closed, n_donated = None, 0
+    engine_closed, n_donated, n_state_outs = None, 0, 0
     if eager_reason is None:
         traced = _audit_engine_program(metric, args, {}, findings)
         if traced is not None:
-            engine_closed, n_donated = traced
+            engine_closed, n_donated, n_state_outs = traced
         result.distributed = _dist.check_replica_equivalence(
             metric, args, {}, findings, result.infos, probe_cache=probe_cache
         )
@@ -901,6 +950,18 @@ def _audit_quantized_variant(
         engine_closed=engine_closed, n_donated=n_donated,
         engine_eligible=eager_reason is None,
     )
+    if eager_reason is None:
+        result.evidence = {
+            "host_seam": _conc.check_host_seam(
+                metric, findings, result.infos, family=family,
+                step_closed=engine_closed,
+            ),
+            "double_buffer": _conc.check_double_buffer(
+                metric, findings, result.infos,
+                step_closed=engine_closed, n_donated=n_donated,
+                n_state_outputs=n_state_outs, engine_eligible=True,
+            ),
+        }
     _route_suppressions(metric, findings, result, check_staleness=False)
     return result
 
@@ -952,7 +1013,7 @@ def audit_registry(
         note(name, base)
         if cohort and base.engine_eligible:
             note(f"{name}@cohort", _audit_cohort_variant(
-                factory(), args, fingerprint=fingerprints
+                factory(), args, fingerprint=fingerprints, family=f"{name}@cohort"
             ))
         if not quantized:
             continue
@@ -969,19 +1030,38 @@ def audit_registry(
             if CompiledStepEngine._static_ineligibility(variant) is not None:
                 continue  # the tier only matters where the engine compiles
             note(f"{name}@{tier}", _audit_quantized_variant(
-                variant, args, probe_cache=probe_cache
+                variant, args, probe_cache=probe_cache, family=f"{name}@{tier}"
             ))
+    from metrics_tpu.analysis import concurrency as _conc
+    from metrics_tpu.observability import telemetry as _obs
+
     report = {
         "schema": "metrics_tpu.analysis_report",
-        "version": 1,
+        "version": 2,
         "rules": {rid: r.to_dict() for rid, r in sorted(RULES.items())},
         "families": families,
+        # the AST leg of the seam audit: where each host<->device crossing
+        # lives in the LIBRARY's serving-loop host paths (the work-list
+        # for folding a phase in-program); the per-family budgets above
+        # count how often each phase crosses
+        "host_seam_sites": _conc.host_seam_sites(),
         "summary": {
             "families": len(families),
             "findings": totals["findings"],
             "suppressed": totals["suppressed"],
         },
     }
+    if _obs.enabled():
+        # fleet evidence: the registry's total per-sync host collectives +
+        # steady per-dispatch crossings at the last audit — the number the
+        # device-resident serving-loop work exists to drive to zero
+        crossings = 0
+        for entry in families.values():
+            seam = (entry.get("evidence") or {}).get("host_seam") or {}
+            flat = _conc.flatten_seam_budget(seam)
+            crossings += flat.get("per_sync.host_collectives", 0)
+            crossings += flat.get("steady_per_step", 0)
+        _obs.get().gauge("analysis.seam.crossings", crossings)
     if fingerprints:
         report["fingerprints"] = prints
     if write_path is not None:

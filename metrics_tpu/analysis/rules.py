@@ -208,6 +208,50 @@ MTA007 = rule(
     " refused statically.",
 )
 
+MTA008 = rule(
+    "MTA008",
+    "host-seam-regression",
+    "concurrency",
+    "A family's host-seam budget — the counted, phase-classified"
+    " host<->device crossings of its serving loop (callbacks per dispatch,"
+    " per-state host collectives per sync, device fetches per"
+    " compute/checkpoint) — exceeds the committed per-family baseline"
+    " (SEAM_BASELINE.json).",
+    "The device-resident serving-loop work (in-program sync, async"
+    " double-buffered dispatch, streamed checkpoints) is measured in host"
+    " crossings removed. That only means something if the crossings are a"
+    " number, not a hope: pass 4 derives each family's budget from the"
+    " real traced step program plus the host-side call paths, and the"
+    " committed baseline turns any regression — a new callback in a step"
+    " program, a state that starts syncing through the host — into a CI"
+    " finding. Folding a crossing in-program lowers the budget; the"
+    " refreshed baseline then GATES the improvement against backsliding.",
+)
+
+MTA009 = rule(
+    "MTA009",
+    "double-buffer-unsafe",
+    "concurrency",
+    "The two-generation donation interleave is unsound for this family:"
+    " a buffer of generation N aliases one generation N+1 donates (a"
+    " state output that is a donated input, an executable-owned constant,"
+    " or two outputs sharing storage), or host code keeps a reference"
+    " that an in-flight donation kills (a method stashing a registered"
+    " state into a plain attribute, or reseeding a state from a"
+    " host-cached buffer).",
+    "Ping-pong double-buffering — dispatch N+1 enqueued against buffer"
+    " generation B while N is still in flight on generation A — is only"
+    " safe when every dispatch returns a FULLY FRESH state buffer set and"
+    " no host read (guard verdict fetch, health fetch, telemetry gauge,"
+    " stashed reference) can touch a buffer the next generation donates."
+    " Pass 4 proves it per family by abstract two-generation simulation"
+    " over the real step program (evidence['double_buffer'] pins the"
+    " verdict the future async engine gates on) and refuses the host-"
+    " reference escapes statically that MetricSan's poison-on-donate"
+    " canary otherwise only catches after the buffer dies.",
+)
+
+
 # ---------------------------------------------------------------------------
 # pass 2 — repo-invariant lint (AST)
 # ---------------------------------------------------------------------------
@@ -259,6 +303,30 @@ MTL104 = rule(
     " `(world, ...)` array — a silent shape change every downstream"
     " compute misreads. List states flatten in rank order, which IS"
     " concatenation, so `None` is sound there.",
+)
+
+
+MTL106 = rule(
+    "MTL106",
+    "thread-shared-state",
+    "lint",
+    "An instance attribute or module global reachable from more than one"
+    " thread entry point (`Thread(target=...)`, `threading.Timer` bodies,"
+    " `do_GET`-style HTTP handler methods, worker closures) is written"
+    " without holding the owning lock.",
+    "The host side of the serving loop is already multi-threaded — sync"
+    " workers, the exporter's scrape threads, background checkpoint"
+    " streaming next. A shared attribute written lock-free from two"
+    " threads is a data race: torn updates, lost increments, and reads of"
+    " half-constructed state that only reproduce under load. The lint"
+    " infers thread-reachable scopes per module by walking the call graph"
+    " from each spawn site and flags unprotected writes to state both"
+    " sides touch; `__init__` writes are exempt (they happen-before the"
+    " spawn), as is anything under a `with <lock>:` block. The dynamic"
+    " twin is ThreadSan (MetricSan's arm-time instrumentation of the"
+    " flagged attrs), which flight-dumps one `metricsan_thread_race` per"
+    " (class, attr) when a cross-thread unsynchronized write actually"
+    " happens.",
 )
 
 

@@ -31,6 +31,19 @@ production:
   metric class probes every state's reset value against its
   ``dist_reduce_fx`` identity, the dynamic twin of the static check (for
   metrics constructed at run time that no audit ever saw).
+* **ThreadSan: cross-thread write instrumentation (MTL106)** — arm-time
+  ``__setattr__`` instrumentation of the thread-shared attributes the
+  pass-4 lint flags (:func:`metrics_tpu.analysis.concurrency.
+  thread_shared_model`, plus anything registered via
+  :func:`~metrics_tpu.analysis.concurrency.register_threadsan_target`).
+  Every write to a watched attribute records the writer thread and
+  whether the owning lock was held; a write from a second thread with
+  neither write synchronized is a data race, flight-dumped ONCE per
+  (class, attr) as ``metricsan_thread_race`` and counted on
+  ``san.thread.races``. Lock-held detection is conservative toward
+  silence: an ``RLock`` answers ownership exactly; a plain ``Lock``
+  held by ANYONE reads as synchronized, so properly locked code can
+  never false-positive.
 
 Arming: ``METRICS_TPU_SAN=1`` in the environment, :func:`enable_san`,
 or the scoped :func:`san_scope`. Like every observability feature the
@@ -47,6 +60,7 @@ rule), and surfaced as a rate-limited warning — or raised as
 """
 import functools
 import threading
+import weakref
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, List, Mapping, Optional, Tuple
 
@@ -91,6 +105,32 @@ def allow_state_writes() -> Iterator[None]:
         _tls.allow_depth -= 1
 
 
+def _prune_on_collect(san: "MetricSan", obj: Any) -> Optional[Any]:
+    """A weakref whose callback drops the collected object's ThreadSan
+    rows — ``id()`` reuse must never pair a fresh object with a dead
+    object's writer history, and the write map must not grow with every
+    short-lived watched instance. Returns None for non-weakref-able
+    objects (``__slots__`` without ``__weakref__``): their lifetime
+    cannot be tracked soundly, so the caller records NO history for them
+    at all — conservative silence, never a stale-id false pair."""
+    oid = id(obj)
+    san_ref = weakref.ref(san)
+
+    def _prune(_collected: Any) -> None:
+        s = san_ref()
+        if s is None:
+            return
+        with s._lock:
+            s._thread_live.pop(oid, None)
+            for key in [k for k in s._thread_writes if k[0] == oid]:
+                del s._thread_writes[key]
+
+    try:
+        return weakref.ref(obj, _prune)
+    except TypeError:
+        return None
+
+
 class MetricSan:
     """The armed sanitizer: violation log + dedup + reporting policy."""
 
@@ -99,16 +139,28 @@ class MetricSan:
         self.violations: List[Dict[str, Any]] = []
         self._seen: set = set()
         self._identity_probed: set = set()
-        self._lock = threading.Lock()
+        # ThreadSan: (id(obj), attr) -> (writer thread id, lock held?,
+        # cross-thread ownership transitions seen so far)
+        self._thread_writes: Dict[Tuple[int, str], Tuple[int, bool, int]] = {}
+        # keeps id(obj) honest: a finalizer per watched instance prunes
+        # its rows, so dead-object ids cannot leak memory or be recycled
+        # into a fresh object's history (a false cross-thread pair)
+        self._thread_live: Dict[int, Any] = {}
+        # RLock: the _thread_live weakref finalizers may fire from GC in
+        # the middle of a locked section on the same thread
+        self._lock = threading.RLock()
 
-    def violation(self, rule: str, check: str, subject: str, message: str, **context: Any) -> None:
+    def violation(self, rule: str, check: str, subject: str, message: str, **context: Any) -> bool:
         """Record one violation (first occurrence per (rule, check,
         subject)): append to the log, dump the flight window naming the
-        rule, warn once — or raise under ``raise_on_violation``."""
+        rule, warn once — or raise under ``raise_on_violation``. Returns
+        True when this call newly recorded (and dumped) the violation,
+        False when it deduplicated — callers keeping per-dump counters
+        key off the return value."""
         key = (rule, check, subject)
         with self._lock:
             if key in self._seen:
-                return
+                return False
             self._seen.add(key)
             self.violations.append(
                 {"rule": rule, "check": check, "subject": subject,
@@ -122,6 +174,7 @@ class MetricSan:
         if self.raise_on_violation:
             raise MetricSanError(hint)
         warn_once(hint, key=f"metricsan:{check}:{subject}")
+        return True
 
     # ------------------------------------------------------------------
     # the checks (each invoked from one hook; all no-ops when unreachable)
@@ -179,6 +232,71 @@ class MetricSan:
             note = _reduction_identity_violation(red, default, default)
             if note is not None:
                 self.violation("MTA006", "non_identity_reset", f"{cls}.{sname}", note)
+
+    def check_thread_write(
+        self, obj: Any, owner: type, attr: str, lock_attr: Optional[str]
+    ) -> None:
+        """ThreadSan: one watched-attribute write. Records
+        (writer thread, owning-lock-held) per (instance, attr); writes
+        ping-ponging between threads with no write synchronized are a
+        cross-thread data race. Conservative toward silence twice over:
+        an RLock answers ownership exactly while a plain Lock that is
+        merely *locked* (possibly by another thread) still reads as
+        synchronized — properly locked code can never false-positive —
+        and the FIRST cross-thread transition per (instance, attr) is
+        tolerated as an ownership handoff (construct on the main thread,
+        then a single worker owns the attr: the exact single-owner fix
+        the MTL106 message recommends; there is no happens-before graph
+        here, so a one-way handoff must not read as a race). A genuine
+        race interleaves, so it produces a SECOND transition and flags;
+        the deliberate limitation: a write→join→write-back handoff also
+        shows two transitions and still flags."""
+        held = False
+        lock = getattr(obj, lock_attr, None) if lock_attr else None
+        if lock is not None:
+            owned = getattr(lock, "_is_owned", None)
+            if callable(owned):
+                try:
+                    held = bool(owned())
+                except Exception:  # noqa: BLE001 — exotic lock: assume unheld
+                    held = False
+            elif hasattr(lock, "locked"):
+                held = bool(lock.locked())
+        tid = threading.get_ident()
+        key = (id(obj), attr)
+        with self._lock:
+            if id(obj) not in self._thread_live:
+                ref = _prune_on_collect(self, obj)
+                if ref is None:
+                    # lifetime untrackable: recording history under a
+                    # recyclable id could pair a dead object's writer with
+                    # a fresh object — keep no state, report no races
+                    return
+                self._thread_live[id(obj)] = ref
+            prev = self._thread_writes.get(key)
+            transitions = 0 if prev is None else (
+                prev[2] + (1 if prev[0] != tid else 0)
+            )
+            self._thread_writes[key] = (tid, held, transitions)
+        if prev is None or prev[0] == tid or held or prev[1]:
+            return
+        if transitions < 2:
+            return  # first cross-thread transition: ownership handoff
+        recorded = self.violation(
+            "MTL106", "thread_race", f"{owner.__name__}.{attr}",
+            f"cross-thread unsynchronized write: thread {tid} wrote"
+            f" `{attr}` after thread {prev[0]} did, and neither write held"
+            f" the owning lock ({lock_attr!r}) — a data race (torn update /"
+            " lost increment) the static MTL106 lint predicted",
+            attr=attr, lock=lock_attr,
+        )
+        if recorded:
+            # one count per deduped dump — the documented 1:1 contract
+            # with the metricsan_thread_race flight record
+            from metrics_tpu.observability import telemetry as _obs
+
+            if _obs.enabled():
+                _obs.get().count("san.thread.races")
 
     def check_sync_identity(
         self,
@@ -310,6 +428,10 @@ def _install_hooks() -> None:
     from metrics_tpu.engine import CompiledStepEngine
     from metrics_tpu.metric import CompositionalMetric, Metric
 
+    # ThreadSan targets can grow between arms (fixtures/user classes
+    # register at any time): the thread-hook installer is idempotent per
+    # class and runs on EVERY arm, unlike the one-shot metric hooks below
+    _install_thread_hooks()
     if _WRAPPED:  # already installed
         return
     Metric.__setattr__ = _san_setattr
@@ -373,6 +495,89 @@ def _uninstall_hooks() -> None:
         setattr(owner, name, orig)
     if Metric.__dict__.get("__setattr__") is _san_setattr:
         del Metric.__setattr__
+    _uninstall_thread_hooks()
+
+
+# ----------------------------------------------------------------------
+# ThreadSan: arm-time instrumentation of thread-shared attributes
+# ----------------------------------------------------------------------
+# classes instrumented this arm: (cls, original own __setattr__ or None,
+# the frozenset of attrs the installed wrapper watches)
+_THREAD_WRAPPED: List[Tuple[type, Optional[Any], frozenset]] = []
+
+
+def _install_thread_hooks() -> None:
+    """Instrument every ThreadSan target class (the statically inferred
+    thread-shared model plus explicit registrations) with a watched-attr
+    ``__setattr__``. Idempotent per class; fully undone at disarm.
+    Metric subclasses are skipped — they already carry the state-write
+    interceptor, and their donation/thread story is the engine lock's."""
+    try:
+        from metrics_tpu.analysis import concurrency as _conc
+        from metrics_tpu.metric import Metric
+
+        targets = _conc.threadsan_targets()
+    except Exception:  # noqa: BLE001 — import-time arming mid-package-init
+        return
+    watched_total = 0
+    for cls, attrs, lock_attr in targets:
+        if not attrs or issubclass(cls, Metric):
+            continue
+        already = next(
+            (entry for entry in _THREAD_WRAPPED if entry[0] is cls), None
+        )
+        if already is not None:
+            if already[2] == frozenset(attrs):
+                watched_total += len(attrs)
+                continue
+            # the watched set grew since the wrapper was installed
+            # (register_threadsan_target between arms): re-wrap fresh
+            _THREAD_WRAPPED.remove(already)
+            if already[1] is not None:
+                cls.__setattr__ = already[1]  # type: ignore[method-assign]
+            elif "__setattr__" in cls.__dict__:
+                del cls.__setattr__  # type: ignore[misc]
+        orig = cls.__dict__.get("__setattr__")
+        # the write must continue through what the class RESOLVED before
+        # instrumentation — its own __setattr__ if it defines one, else
+        # the INHERITED one (a base class's custom setattr must keep
+        # running while armed, or arming changes program behavior)
+        forward = orig if orig is not None else cls.__setattr__
+        watched = frozenset(attrs)
+
+        def _make(cls=cls, forward=forward, watched=watched, lock_attr=lock_attr):
+            def _threadsan_setattr(self: Any, name: str, value: Any) -> None:
+                san = _active
+                if san is not None and name in watched and _allow_depth() == 0:
+                    san.check_thread_write(self, cls, name, lock_attr)
+                forward(self, name, value)
+
+            return _threadsan_setattr
+
+        cls.__setattr__ = _make()  # type: ignore[method-assign]
+        _THREAD_WRAPPED.append((cls, orig, watched))
+        watched_total += len(attrs)
+    from metrics_tpu.observability import telemetry as _obs
+
+    if _obs.enabled():
+        _obs.get().gauge("san.thread.watched_attrs", watched_total)
+
+
+def _uninstall_thread_hooks() -> None:
+    uninstalled = bool(_THREAD_WRAPPED)
+    while _THREAD_WRAPPED:
+        cls, orig, _watched = _THREAD_WRAPPED.pop()
+        if orig is not None:
+            cls.__setattr__ = orig  # type: ignore[method-assign]
+        elif "__setattr__" in cls.__dict__:
+            del cls.__setattr__  # type: ignore[misc]
+    if uninstalled:
+        # the gauge documents "attrs under instrumentation WHILE ARMED":
+        # zero it on disarm or a post-disarm scrape reports phantom watches
+        from metrics_tpu.observability import telemetry as _obs
+
+        if _obs.enabled():
+            _obs.get().gauge("san.thread.watched_attrs", 0)
 
 
 def enable_san(raise_on_violation: bool = False) -> MetricSan:

@@ -1113,6 +1113,19 @@ class MetricCohort:
         per live capacity bucket)."""
         return self._engine.cache_info()
 
+    def abstract_double_buffer(self, *args: Any, **kwargs: Any):
+        """Trace the two-generation composition of THIS cohort's vmapped
+        step at its current capacity (per-tenant sample inputs; no
+        compile, no dispatch, no state touched) — the cohort spelling of
+        :meth:`CompiledStepEngine.abstract_double_buffer_step`, used by
+        the MTA009 double-buffer prover to certify that dispatch N+1 may
+        enqueue against generation N's stacked outputs while N is in
+        flight. Returns ``(closed_jaxpr, out_shapes, n_donated_leaves,
+        n_state_output_leaves)``."""
+        return self._engine.abstract_double_buffer_step(
+            *args, capacity=self._capacity, **kwargs
+        )
+
     def keys(self):
         return self._template.keys()
 

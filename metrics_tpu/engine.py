@@ -579,6 +579,22 @@ class CompiledStepEngine:
                 f" ({self._eager_names}); there is no cohort step program to trace"
             )
 
+        states, args, kwargs = self._stacked_abstract_inputs(
+            names, args, kwargs, capacity
+        )
+        n_donated = len(jax.tree_util.tree_leaves(states))
+        closed, out_shapes = jax.make_jaxpr(
+            self._make_cohort_step_fn(names, None, observe=False), return_shape=True
+        )(states, args, kwargs)
+        return closed, out_shapes, n_donated
+
+    def _stacked_abstract_inputs(
+        self, names: Tuple[str, ...], args: tuple, kwargs: dict, capacity: int
+    ) -> Tuple[Dict[str, Dict[str, jax.Array]], tuple, dict]:
+        """Per-tenant sample inputs broadcast up the cohort axis, plus the
+        stacked donatable state pytree — the abstract-tracing twin of what
+        :class:`~metrics_tpu.cohort.MetricCohort` feeds a real dispatch."""
+
         def _stack(x):
             if _is_arraylike(x):
                 x = jnp.asarray(x)
@@ -589,13 +605,55 @@ class CompiledStepEngine:
         states = {
             n: {s: _stack(v) for s, v in d.items()} for n, d in base.items()
         }
-        args = tuple(_stack(a) for a in args)
-        kwargs = {k: _stack(v) for k, v in kwargs.items()}
+        return (
+            states,
+            tuple(_stack(a) for a in args),
+            {k: _stack(v) for k, v in kwargs.items()},
+        )
+
+    def abstract_double_buffer_step(
+        self, *args: Any, capacity: Optional[int] = None, **kwargs: Any
+    ):
+        """Trace the TWO-GENERATION composition of the step program
+        abstractly (no compile, no dispatch): generation N runs on the
+        donated state pytree, generation N+1 runs on generation N's state
+        outputs — exactly the interleaving a ping-pong async engine would
+        dispatch, with both generations' host-visible values returned.
+        Returns ``(closed_jaxpr, out_shapes, n_donated_leaves,
+        n_state_output_leaves)``; the state outputs of generation N lead
+        the output tree (they are what ``_write_back`` installs and what
+        generation N+1 donates). This is the static-analysis hook behind
+        the MTA009 double-buffer prover
+        (:func:`metrics_tpu.analysis.concurrency.check_double_buffer`);
+        ``capacity`` traces the vmapped cohort variant instead of the
+        plain step. Like :meth:`abstract_step` it touches no cache, no
+        metric state, and no watchdog accounting."""
+        names = self._compiled_names()
+        if not names:
+            raise ValueError(
+                "every metric in this engine runs eager"
+                f" ({self._eager_names}); there is no step program to trace"
+            )
+        if capacity is None:
+            step = self._make_step_fn(names, None, observe=False)
+            states = self._donatable_states(names)
+        else:
+            step = self._make_cohort_step_fn(names, None, observe=False)
+            states, args, kwargs = self._stacked_abstract_inputs(
+                names, args, kwargs, capacity
+            )
         n_donated = len(jax.tree_util.tree_leaves(states))
+
+        def two_generations(states0, batch0, batch1):
+            new0, vals0 = step(states0, batch0[0], batch0[1])
+            new1, vals1 = step(new0, batch1[0], batch1[1])
+            return new0, vals0, new1, vals1
+
         closed, out_shapes = jax.make_jaxpr(
-            self._make_cohort_step_fn(names, None, observe=False), return_shape=True
-        )(states, args, kwargs)
-        return closed, out_shapes, n_donated
+            two_generations, return_shape=True
+        )(states, (args, kwargs), (args, kwargs))
+        n_state_outputs = len(jax.tree_util.tree_leaves(out_shapes[0]))
+        return closed, out_shapes, n_donated, n_state_outputs
 
     # ------------------------------------------------------------------
     # signature cache

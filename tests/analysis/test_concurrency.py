@@ -1,0 +1,398 @@
+"""Pass 4 — concurrency soundness: the host-seam auditor (MTA008), the
+double-buffer prover (MTA009), the thread-shared-state model behind
+MTL106/ThreadSan, and the registry-wide acceptance pins the async
+serving-loop work gates on."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+from metrics_tpu.analysis import (
+    audit_metric,
+    host_seam_budget,
+    host_seam_sites,
+    load_seam_baseline,
+    register_threadsan_target,
+    thread_shared_model,
+)
+from metrics_tpu.analysis import concurrency as conc
+from metrics_tpu.analysis import fixtures as fx
+
+_X = jnp.linspace(0.0, 1.0, 8)
+
+
+# ---------------------------------------------------------------------------
+# MTA008 — host-seam budgets
+# ---------------------------------------------------------------------------
+def test_seam_budget_counts_states_and_phases():
+    """MSE: two sum states -> two host collectives per sync, two fetches
+    per checkpoint, one value fetch per compute, zero steady crossings on
+    the donated hot path."""
+    m = M.MeanSquaredError()
+    flat = conc.flatten_seam_budget(host_seam_budget(m))
+    assert flat["per_sync.host_collectives"] == 2
+    assert flat["per_sync.quantized_payloads"] == 0
+    assert flat["per_checkpoint.device_fetches"] == 2
+    assert flat["per_compute.device_fetches"] == 1
+    assert flat["steady_per_step"] == 0
+    assert flat["per_dispatch.callbacks"] == 0
+
+
+def test_seam_budget_quantized_tier_reclassifies_payloads_and_residuals():
+    """An int8 tier: same collective count (the wire payload shrinks, not
+    the crossing count), quantized payloads counted, and the __qres
+    residual raises the checkpoint fetch count — it never crosses the
+    wire but it IS checkpointed."""
+    m = M.MeanSquaredError()
+    exact = conc.flatten_seam_budget(host_seam_budget(m))
+    q = M.MeanSquaredError()
+    q.set_sync_precision("int8")
+    flat = conc.flatten_seam_budget(host_seam_budget(q))
+    assert flat["per_sync.host_collectives"] == exact["per_sync.host_collectives"]
+    assert flat["per_sync.quantized_payloads"] == 2
+    assert flat["per_checkpoint.device_fetches"] > exact["per_checkpoint.device_fetches"]
+
+
+def test_seam_budget_cohort_variant_is_tenant_count_independent():
+    """The cohort invariant, as a seam number: one collective per STATE
+    (stacked), plus exactly one health-fetch crossing — none of it scales
+    with tenants."""
+    m = M.MeanSquaredError()
+    flat = conc.flatten_seam_budget(host_seam_budget(m, cohort=True))
+    assert flat["per_sync.host_collectives"] == 2
+    assert flat["per_health.device_fetches"] == 1
+
+
+def test_callbacks_in_step_program_enter_the_dispatch_budget():
+    m = fx.CallbackInJit()
+    from metrics_tpu.engine import CompiledStepEngine
+
+    closed, _, _ = CompiledStepEngine(m, observe=False).abstract_step(_X)
+    flat = conc.flatten_seam_budget(host_seam_budget(m, step_closed=closed))
+    assert flat["per_dispatch.callbacks"] >= 1
+    assert flat["steady_per_step"] >= 1
+
+
+def test_committed_baseline_covers_every_audited_family(registry_report):
+    """Acceptance: every engine-eligible family AND variant namespace has
+    a committed seam budget — a new family cannot ship ungated."""
+    baseline = load_seam_baseline()
+    assert baseline, "SEAM_BASELINE.json missing or empty"
+    measured = {
+        fam: (entry.get("evidence") or {}).get("host_seam")
+        for fam, entry in registry_report["families"].items()
+    }
+    with_seam = {fam for fam, seam in measured.items() if seam}
+    assert with_seam, "no family produced seam evidence"
+    missing = sorted(with_seam - set(baseline))
+    assert missing == [], f"families with no committed seam baseline: {missing}"
+    # and the committed numbers match the measured ones exactly (a lower
+    # measurement means an improvement landed without refreshing the gate)
+    for fam in sorted(with_seam):
+        assert conc.flatten_seam_budget(measured[fam]) == baseline[fam]["budget"], fam
+        assert measured[fam]["states"] == baseline[fam]["states"], fam
+
+
+def test_variant_namespaces_carry_seam_evidence(registry_report):
+    fams = registry_report["families"]
+    assert (fams["MeanSquaredError@cohort"]["evidence"] or {}).get("host_seam")
+    assert (fams["MeanSquaredError@int8"]["evidence"] or {}).get("host_seam")
+    cohort_seam = fams["MeanSquaredError@cohort"]["evidence"]["host_seam"]
+    assert cohort_seam["per_health"]["device_fetches"] == 1
+
+
+def test_seam_regression_fires_mta008_and_counts():
+    """The committed SeamRegressor budget is one synced state; the class
+    registers three — the gate (and the `analysis.seam.regressions`
+    counter) must fire."""
+    from metrics_tpu import observability as obs
+
+    with obs.telemetry_scope() as tel:
+        result = audit_metric(fx.SeamRegressor(), (_X,))
+        assert {f.rule for f in result.findings} == {"MTA008"}
+        assert any(
+            f.detail.get("key") == "per_sync.host_collectives"
+            and f.detail.get("got") == 3
+            and f.detail.get("baseline") == 1
+            for f in result.findings
+        )
+        assert tel.counters.get("analysis.seam.regressions", 0) >= 1
+
+
+def test_unbaselined_families_are_measured_not_gated():
+    """A class absent from the committed baseline gets evidence but no
+    MTA008 finding — the coverage test above is what forces registry
+    families into the file."""
+
+    class _NeverCommitted(M.MeanSquaredError):
+        pass
+
+    result = audit_metric(_NeverCommitted(), (_X, _X))
+    assert result.findings == []
+    assert result.evidence["host_seam"]["per_sync"]["host_collectives"] == 2
+
+
+def test_host_seam_sites_name_the_library_crossings():
+    sites = host_seam_sites()
+    assert sites, "no crossing sites found on the serving-loop host paths"
+    phases = {s["phase"] for s in sites}
+    assert "sync" in phases and "dispatch" in phases
+    kinds = {s["kind"] for s in sites}
+    assert "device_fetch" in kinds
+    for s in sites:
+        assert ":" in s["site"] and s["call"]
+
+
+# ---------------------------------------------------------------------------
+# MTA009 — double-buffer prover
+# ---------------------------------------------------------------------------
+def test_registry_is_double_buffer_safe(registry_report):
+    """THE acceptance pin the async engine gates on: every engine-eligible
+    family — plain, @cohort, and quantized namespaces — is proven
+    two-generation ping-pong safe. No exceptions today; any future
+    exception must be named here and tested."""
+    unsafe = {
+        fam: entry["evidence"]["double_buffer"]
+        for fam, entry in registry_report["families"].items()
+        if (entry.get("evidence") or {}).get("double_buffer")
+        and entry["evidence"]["double_buffer"]["safe"] is not True
+    }
+    assert unsafe == {}, unsafe
+    proved = [
+        fam for fam, entry in registry_report["families"].items()
+        if ((entry.get("evidence") or {}).get("double_buffer") or {}).get("safe") is True
+    ]
+    assert len(proved) >= 60  # 20 eligible bases + 20 cohort + 40 tiers
+
+    def base_name(fam):
+        return fam.split("@", 1)[0]
+
+    eligible_bases = {
+        fam for fam, entry in registry_report["families"].items()
+        if "@" not in fam and entry["engine_eligible"]
+    }
+    assert eligible_bases <= {base_name(f) for f in proved}
+
+
+def test_writeback_ordering_is_generation_monotonic():
+    """The engine's donate->dispatch->write_back extent runs under the
+    engine lock — generations cannot be installed out of order."""
+    assert conc.writeback_generation_monotonic() is True
+
+
+def test_two_generation_composition_is_alias_free_for_plain_engine():
+    """The composed two-generation program (the real interleave a
+    ping-pong engine would dispatch) cross-checks the single-step
+    verdict: zero hazards for a registry family."""
+    engine = M.CompiledStepEngine(M.MeanSquaredError())
+    closed, _shapes, n_donated, n_state = engine.abstract_double_buffer_step(_X, _X)
+    assert n_donated == 2 and n_state == 2
+    assert conc.composed_generation_hazards(closed, n_donated, n_state) == []
+    # abstract: no compile, no cache entry
+    assert engine.cache_info()["compiled_signatures"] == 0
+
+
+def test_two_generation_composition_is_alias_free_for_cohort():
+    cohort = M.MetricCohort(M.MeanSquaredError(), tenants=3)
+    closed, _shapes, n_donated, n_state = cohort.abstract_double_buffer(_X, _X)
+    assert n_donated == 2 and n_state == 2
+    assert conc.composed_generation_hazards(closed, n_donated, n_state) == []
+
+
+def test_double_buffer_fixture_flavors_are_distinct():
+    seed = audit_metric(fx.DoubleBufferAliaser(), (_X,))
+    assert [f.rule for f in seed.findings] == ["MTA009"]
+    assert seed.findings[0].detail["flavor"] == "host_cached_seed"
+    assert seed.evidence["double_buffer"]["safe"] is False
+
+    escape = audit_metric(fx.HostReadOfDonated(), (_X,))
+    assert [f.rule for f in escape.findings] == ["MTA009"]
+    assert escape.findings[0].detail["flavor"] == "state_ref_escape"
+    assert escape.findings[0].subject == "HostReadOfDonated._last_value"
+
+
+def test_mta007_families_fold_into_the_verdict_without_double_diagnosis():
+    """A donation-lifetime defect (MTA007) voids ping-pong: the verdict
+    goes unsafe, but the family gets ONE diagnosis, not an MTA009 echo."""
+    result = audit_metric(fx.UntouchedStatePassthrough(), (_X,))
+    assert {f.rule for f in result.findings} == {"MTA007"}
+    db = result.evidence["double_buffer"]
+    assert db["safe"] is False
+    assert any(h["kind"] == "donation_lifetime" for h in db["hazards"])
+
+
+def test_wrapped_state_reads_are_not_reference_escapes():
+    """`self._cache = jnp.asarray(self.acc) * 2` produces a fresh buffer;
+    only BARE `self.<state>` stashes are refused — the AST leg must stay
+    zero-false-positive over derived values."""
+
+    class _DerivedStash(M.Metric):
+        _fused_forward = True
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.acc = self.acc + jnp.sum(x)
+
+        def compute(self):
+            self._scaled = self.acc * 2.0  # derived: fresh buffer, no alias
+            return self.acc
+
+    result = audit_metric(_DerivedStash(), (_X,))
+    assert result.findings == []
+    assert result.evidence["double_buffer"]["safe"] is True
+
+
+def test_augmented_assignment_is_not_a_reference_escape():
+    """`self._ema += self.acc` computes `target + value` — a fresh buffer
+    both directions (and likewise for reseeding a state via `+=`); only
+    PLAIN bare-state assignments are escapes."""
+
+    class _AugAssigner(M.Metric):
+        _fused_forward = True
+
+        def __init__(self):
+            super().__init__()
+            self._ema = jnp.zeros(())
+            self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.acc = self.acc + jnp.sum(x)
+            self.acc += self._ema  # fresh BinOp result, not a seed
+
+        def compute(self):
+            self._ema += self.acc  # fresh BinOp result, not a stash
+            return self.acc
+
+    result = audit_metric(_AugAssigner(), (_X,))
+    assert result.findings == []
+    assert result.evidence["double_buffer"]["safe"] is True
+
+
+# ---------------------------------------------------------------------------
+# the thread-shared model + runtime target registry
+# ---------------------------------------------------------------------------
+def test_in_tree_thread_shared_model_is_clean():
+    """The package's own threaded modules (sync workers, exporter) share
+    no unlocked instance attributes across threads — the model the lint
+    derives is empty, which IS the clean baseline MTL106 pins."""
+    model = thread_shared_model()
+    for spec in model:
+        assert spec["lock"], (
+            f"thread-shared attrs {spec['attrs']} of {spec['qualname']}"
+            " have no owning lock"
+        )
+
+
+def test_register_threadsan_target_roundtrips():
+    class _Shared:
+        pass
+
+    register_threadsan_target(_Shared, ("other", "value"), "_lock")
+    try:
+        targets = conc.threadsan_targets()
+        match = [t for t in targets if t[0] is _Shared]
+        assert match == [(_Shared, ("other", "value"), "_lock")]
+        # re-registration replaces, never duplicates
+        register_threadsan_target(_Shared, ("value",), "_lock")
+        match = [t for t in conc.threadsan_targets() if t[0] is _Shared]
+        assert match == [(_Shared, ("value",), "_lock")]
+    finally:
+        with conc._TARGET_LOCK:
+            conc._EXTRA_TARGETS[:] = [
+                t for t in conc._EXTRA_TARGETS if t[0] is not _Shared
+            ]
+
+
+def test_explicit_registration_extends_the_static_model():
+    """UnlockedSharedCounter is in the statically inferred model (the
+    fixture module spawns a thread); an explicit registration for the
+    same class must UNION the watched attrs into ONE merged target, so
+    `register_threadsan_target` can always widen instrumentation."""
+    from metrics_tpu.analysis import fixtures as fx
+
+    in_model = [
+        s for s in thread_shared_model()
+        if s["qualname"] == "UnlockedSharedCounter"
+    ]
+    assert in_model and in_model[0]["attrs"] == ("value",)
+    register_threadsan_target(fx.UnlockedSharedCounter, ("extra",), "_lock")
+    try:
+        match = [
+            t for t in conc.threadsan_targets()
+            if t[0] is fx.UnlockedSharedCounter
+        ]
+        assert len(match) == 1  # merged, not duplicated
+        assert set(match[0][1]) == {"value", "extra"}
+        assert match[0][2] == "_lock"
+    finally:
+        with conc._TARGET_LOCK:
+            conc._EXTRA_TARGETS[:] = [
+                t for t in conc._EXTRA_TARGETS
+                if t[0] is not fx.UnlockedSharedCounter
+            ]
+
+
+def test_healthy_run_keeps_pass4_counters_at_zero():
+    """Healthy-run-zero pin for the new counter namespaces: a clean audit
+    plus a properly-locked threaded run moves neither
+    `analysis.seam.regressions` nor `san.thread.races`."""
+    import threading
+
+    from metrics_tpu import observability as obs
+    from metrics_tpu.analysis import san_scope
+
+    class _LockedCounter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.value = 0
+
+        def spin(self):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            t.join()
+
+        def _worker(self):
+            with self._lock:
+                self.value += 1
+
+        def bump(self):
+            with self._lock:
+                self.value += 1
+
+    register_threadsan_target(_LockedCounter, ("value",), "_lock")
+    try:
+        with obs.telemetry_scope() as tel:
+            # the registry is process-global and scope does not clear it:
+            # pin the DELTA this healthy run contributes, not the totals
+            seam0 = tel.counters.get("analysis.seam.regressions", 0)
+            races0 = tel.counters.get("san.thread.races", 0)
+            audit_metric(M.MeanSquaredError(), (_X, _X))
+            with san_scope() as san:
+                c = _LockedCounter()
+                c.spin()
+                c.bump()
+            assert san.violations == []
+            assert tel.counters.get("analysis.seam.regressions", 0) == seam0
+            assert tel.counters.get("san.thread.races", 0) == races0
+    finally:
+        with conc._TARGET_LOCK:
+            conc._EXTRA_TARGETS[:] = [
+                t for t in conc._EXTRA_TARGETS if t[0] is not _LockedCounter
+            ]
+
+
+def test_evidence_rides_the_report_schema(registry_report):
+    """`evidence["host_seam"]` / `evidence["double_buffer"]` are the
+    ANALYSIS.json contract the ROADMAP work reads; eager-only families
+    carry evidence=None (they never donate, so they have no seam to
+    budget and no generations to prove)."""
+    entry = registry_report["families"]["MeanSquaredError"]
+    assert set(entry["evidence"]) == {"host_seam", "double_buffer"}
+    assert entry["evidence"]["double_buffer"]["writeback_locked"] is True
+    eager = registry_report["families"]["AUROC"]
+    assert eager["engine_eligible"] is False and eager["evidence"] is None
+    assert registry_report["version"] == 2
+    assert registry_report["host_seam_sites"]

@@ -24,6 +24,9 @@ EXPECTED = [
     (fx.OrphanResidual, "MTA006"),
     (fx.UntouchedStatePassthrough, "MTA007"),
     (fx.UnownedLoader, "MTA007"),
+    (fx.SeamRegressor, "MTA008"),
+    (fx.DoubleBufferAliaser, "MTA009"),
+    (fx.HostReadOfDonated, "MTA009"),
     (fx.StaleSuppression, "MTL105"),
 ]
 
@@ -219,3 +222,61 @@ def test_stale_suppression_fixture_names_the_stale_rule():
     assert len(result.findings) == 1
     assert "MTA003" in result.findings[0].message
     assert result.suppressed == []
+
+
+def test_seam_regressor_names_the_exceeded_budget():
+    """The MTA008 fixture regresses against its COMMITTED baseline entry
+    (SEAM_BASELINE.json budgets one synced state, the class registers
+    three) — the finding carries the exact key, count, and allowance."""
+    result = audit_metric(fx.SeamRegressor(), _X)
+    assert all(f.rule == "MTA008" for f in result.findings)
+    sync = [
+        f for f in result.findings
+        if f.detail.get("key") == "per_sync.host_collectives"
+    ]
+    assert len(sync) == 1
+    assert sync[0].detail["got"] == 3 and sync[0].detail["baseline"] == 1
+    assert "SEAM_BASELINE.json" in sync[0].message
+
+
+def test_double_buffer_fixtures_void_the_ping_pong_verdict():
+    """Both MTA009 flavors mark the family unsafe in the evidence the
+    future async engine gates on, each naming its hazard kind."""
+    seed = audit_metric(fx.DoubleBufferAliaser(), _X)
+    assert seed.evidence["double_buffer"]["safe"] is False
+    assert any(
+        h["kind"] == "host_cached_seed"
+        for h in seed.evidence["double_buffer"]["hazards"]
+    )
+    escape = audit_metric(fx.HostReadOfDonated(), _X)
+    assert escape.evidence["double_buffer"]["safe"] is False
+    assert any(
+        h["kind"] == "state_ref_escape"
+        for h in escape.evidence["double_buffer"]["hazards"]
+    )
+
+
+def test_unlocked_shared_counter_is_suppressed_in_tree_but_fires_unsuppressed():
+    """The MTL106 fixture class: its in-tree allow comments route the
+    findings to the suppressed bucket (the repo gate stays green, the
+    suppression earns its keep every run); the same source WITHOUT the
+    allows fires — pinned against the real fixtures.py text, so the
+    fixture cannot silently stop being broken."""
+    import inspect
+    import re
+    import textwrap
+
+    from metrics_tpu.analysis.lint import lint_source
+
+    src = "import threading\n" + textwrap.dedent(
+        inspect.getsource(fx.UnlockedSharedCounter)
+    )
+    suppressed = lint_source(src, "fixtures.py")
+    assert {f.rule for f in suppressed if f.suppressed} == {"MTL106"}
+    assert [f for f in suppressed if not f.suppressed] == []
+
+    bare = re.sub(r"#\s*metrics-tpu:\s*allow\(MTL106\)[^\n]*", "", src)
+    live = [f for f in lint_source(bare, "fixtures.py") if not f.suppressed]
+    assert {f.rule for f in live} == {"MTL106"}
+    assert len(live) == 2  # the worker write AND the owner-thread write
+    assert all("value" in f.message for f in live)

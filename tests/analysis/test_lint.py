@@ -454,3 +454,184 @@ def test_one_use_marks_only_its_own_comment():
     assert [f.rule for f in findings] == ["MTL102", "MTL105"]
     assert findings[0].suppressed
     assert findings[1].detail["line"] == 3
+
+
+# ---------------------------------------------------------------------------
+# MTL106 — thread-shared state (pass 4's lint leg)
+# ---------------------------------------------------------------------------
+def test_unlocked_write_to_thread_shared_attr_fires():
+    code = """
+    import threading
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+        def start(self):
+            threading.Thread(target=self._run, daemon=True).start()
+        def _run(self):
+            self.count = self.count + 1
+        def bump(self):
+            self.count = self.count + 1
+    """
+    findings = _lint(code)
+    assert _rules(findings) == ["MTL106", "MTL106"]
+    assert all("count" in f.message for f in findings)
+
+
+def test_locked_writes_to_shared_attr_are_clean():
+    code = """
+    import threading
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+        def start(self):
+            threading.Thread(target=self._run, daemon=True).start()
+        def _run(self):
+            with self._lock:
+                self.count = self.count + 1
+        def bump(self):
+            with self._lock:
+                self.count = self.count + 1
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_init_writes_are_exempt_and_single_side_attrs_are_not_shared():
+    """__init__ happens-before the spawn; an attr only the worker touches
+    has a single owning thread — neither is a race."""
+    code = """
+    import threading
+    class Worker:
+        def __init__(self):
+            self.count = 0
+            self.state = "idle"
+        def start(self):
+            threading.Thread(target=self._run).start()
+        def _run(self):
+            self.progress = 1  # worker-only: single owner
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_reachability_follows_the_call_graph_from_the_spawn_site():
+    """The racy write sits two calls deep below the thread target; the
+    analysis must walk the call graph, not just the target body."""
+    code = """
+    import threading
+    class Worker:
+        def start(self):
+            threading.Thread(target=self._run).start()
+        def _run(self):
+            self._step()
+        def _step(self):
+            self.progress = self.progress + 1
+        def report(self):
+            self.progress = 0
+    """
+    findings = _lint(code)
+    assert _rules(findings) == ["MTL106", "MTL106"]
+
+
+def test_http_handler_methods_are_thread_entry_points():
+    code = """
+    class Handler:
+        def do_GET(self):
+            self.hits = self.hits + 1
+        def reset(self):
+            self.hits = 0
+    """
+    assert _rules(_lint(code)) == ["MTL106", "MTL106"]
+
+
+def test_timer_bodies_and_worker_closures_are_entries():
+    code = """
+    import threading
+    def schedule():
+        def tick():
+            global beats
+            beats = beats + 1
+        threading.Timer(1.0, tick).start()
+    def reset():
+        global beats
+        beats = 0
+    beats = 0
+    """
+    findings = _lint(code)
+    assert _rules(findings) == ["MTL106", "MTL106"]
+    assert all("beats" in f.message for f in findings)
+
+
+def test_locked_global_writes_are_clean():
+    code = """
+    import threading
+    _LOCK = threading.Lock()
+    beats = 0
+    def schedule():
+        def tick():
+            global beats
+            with _LOCK:
+                beats = beats + 1
+        threading.Timer(1.0, tick).start()
+    def reset():
+        global beats
+        with _LOCK:
+            beats = 0
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_threadless_modules_produce_no_mtl106():
+    """No spawn site, no analysis: a module full of unlocked attr writes
+    is single-threaded by construction."""
+    code = """
+    class Plain:
+        def a(self):
+            self.x = 1
+        def b(self):
+            self.x = 2
+    """
+    assert _rules(_lint(code)) == []
+
+
+def test_mtl106_suppression_and_staleness():
+    code = """
+    import threading
+    class Worker:
+        def start(self):
+            threading.Thread(target=self._run).start()
+        def _run(self):
+            self.n = self.n + 1  # metrics-tpu: allow(MTL106)
+        def bump(self):
+            self.n = self.n + 1  # metrics-tpu: allow(MTL106)
+    """
+    findings = _lint(code)
+    assert _rules(findings) == []
+    assert sorted(f.rule for f in findings if f.suppressed) == ["MTL106", "MTL106"]
+    # a stale MTL106 allow is flagged like any other lint allow
+    stale = """
+    x = 1  # metrics-tpu: allow(MTL106)
+    """
+    assert _rules(_lint(stale)) == ["MTL105"]
+
+
+def test_local_shadowing_a_global_is_not_a_shared_touch():
+    """A main-side helper whose LOCAL variable shares a module global's
+    name must not mark the global as main-touched: the thread-side owner
+    of `beats` stays single-owner, no finding."""
+    code = """
+    import threading
+    beats = 0
+    def schedule():
+        def tick():
+            global beats
+            beats = beats + 1
+        threading.Timer(1.0, tick).start()
+    def snapshot(x):
+        beats = x * 2  # a LOCAL, shadowing the module global
+        return beats
+    def loop():
+        for beats in range(3):  # loop target: also a local binding
+            pass
+    """
+    assert _rules(_lint(code)) == []

@@ -298,6 +298,61 @@ def test_hint_names_pass3_rules_for_watch_keys():
     assert hint is not None and "MTA007" in hint and "donation-lifetime" in hint
 
 
+def test_hint_names_pass4_rules_for_watch_keys():
+    """Watchdog and flight-dump attributions must cover the pass-4 rules:
+    a family whose last audit holds MTA008 (seam regression) or MTA009
+    (double-buffer hazard) findings gets a hint naming them."""
+    audit_metric(fx.SeamRegressor(), _X)
+    hint = hint_for_watch_key("engine[SeamRegressor]")
+    assert hint is not None and "MTA008" in hint and "host-seam-regression" in hint
+
+    audit_metric(fx.HostReadOfDonated(), _X)
+    hint = hint_for_watch_key("engine[HostReadOfDonated]")
+    assert hint is not None and "MTA009" in hint and "double-buffer-unsafe" in hint
+
+    audit_metric(fx.DoubleBufferAliaser(), _X)
+    hint = hint_for_watch_key("engine[DoubleBufferAliaser]")
+    assert hint is not None and "MTA009" in hint
+
+
+class _OneCleanState(M.Metric):
+    """A genuinely clean one-state family: its seam budget matches the
+    deliberately-tight committed SeamRegressor baseline exactly, so a
+    same-named audit of THIS class clears the pass-4 hint."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.acc = self.acc + jnp.sum(x)
+
+    def compute(self):
+        return self.acc
+
+
+@pytest.mark.parametrize(
+    "fixture",
+    [fx.SeamRegressor, fx.HostReadOfDonated],
+    ids=["MTA008", "MTA009"],
+)
+def test_hint_name_keying_caveat_extends_to_pass4(fixture):
+    """The name-keyed caveat, re-pinned for the pass-4 rules: a same-named
+    clean class re-audited afterwards clears the hint (latest audit wins),
+    and re-auditing the broken one re-arms it."""
+    audit_metric(fixture(), _X)
+    assert hint_for_watch_key(f"engine[{fixture.__name__}]") is not None
+
+    clean = type(fixture.__name__, (_OneCleanState,), {})
+    audit_metric(clean(), _X)
+    assert hint_for_watch_key(f"engine[{fixture.__name__}]") is None
+
+    audit_metric(fixture(), _X)
+    assert hint_for_watch_key(f"engine[{fixture.__name__}]") is not None
+
+
 def test_hint_name_keying_caveat_latest_audit_wins():
     """The documented caveat, now pinned: the hint lookup is keyed by bare
     class name and reflects the MOST RECENT audit of any class with that

@@ -208,6 +208,192 @@ def test_healthy_single_replica_sync_is_identity(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# ThreadSan — the MTL106 dynamic twin
+# ---------------------------------------------------------------------------
+import threading
+
+from metrics_tpu.analysis import register_threadsan_target
+from metrics_tpu.analysis import concurrency as _conc
+
+
+@pytest.fixture()
+def _threadsan_counter():
+    register_threadsan_target(fx.UnlockedSharedCounter, ("value",), "_lock")
+    yield fx.UnlockedSharedCounter
+    with _conc._TARGET_LOCK:
+        _conc._EXTRA_TARGETS[:] = [
+            t for t in _conc._EXTRA_TARGETS if t[0] is not fx.UnlockedSharedCounter
+        ]
+
+
+def test_thread_race_dumps_exactly_once_naming_mtl106(tmp_path, _threadsan_counter):
+    """The UnlockedSharedCounter drill: the worker thread and the owner
+    thread both write `value` lock-free — one violation, one flight dump,
+    named after the static rule that predicted it. Deterministic: the
+    worker joins before the owner writes, so the cross-thread sequence is
+    guaranteed without a real timing race."""
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                c = fx.UnlockedSharedCounter()
+                c.spin(3)   # worker thread writes, unlocked
+                c.bump()    # owner thread writes, unlocked: the race
+                c.bump()    # second offence: deduped, still one dump
+    assert [v["rule"] for v in san.violations] == ["MTL106"]
+    assert san.violations[0]["subject"] == "UnlockedSharedCounter.value"
+    dumps = _dumps(tmp_path)
+    assert len(dumps) == 1
+    payload = json.loads(open(dumps[0]).read())
+    assert payload["reason"] == "metricsan_thread_race"
+    assert "MTL106" in payload["hint"] and "thread-shared-state" in payload["hint"]
+
+
+def test_thread_race_counter_matches_deduped_dumps(tmp_path, _threadsan_counter):
+    """`san.thread.races` counts once per deduped dump (the documented
+    1:1 contract), not once per racy write observed."""
+    from metrics_tpu import observability as obs
+
+    with obs.telemetry_scope() as tel:
+        before = tel.counters.get("san.thread.races", 0)
+        with _flight.flight_scope(tmp_path):
+            with san_scope() as san:
+                c = fx.UnlockedSharedCounter()
+                c.spin(3)
+                for _ in range(5):
+                    c.bump()  # five racy writes, ONE (class, attr) violation
+        assert len(san.violations) == 1
+        assert tel.counters.get("san.thread.races", 0) - before == 1
+    assert len(_dumps(tmp_path)) == 1
+
+
+def test_instrumentation_preserves_inherited_custom_setattr(tmp_path):
+    """A watched class that INHERITS a custom __setattr__ must keep it
+    while armed — arming may observe writes, never change them."""
+
+    class _Base:
+        def __setattr__(self, name, value):
+            object.__setattr__(self, name, ("tracked", value))
+
+    class _Child(_Base):
+        def __init__(self):
+            object.__setattr__(self, "_lock", threading.Lock())
+
+    register_threadsan_target(_Child, ("value",), "_lock")
+    try:
+        unarmed = _Child()
+        unarmed.value = 0
+        assert unarmed.value == ("tracked", 0)
+        with san_scope() as san:
+            armed = _Child()
+            armed.value = 0
+            assert armed.value == ("tracked", 0)  # base logic still runs
+        assert san.violations == []
+        disarmed = _Child()
+        disarmed.value = 1
+        assert disarmed.value == ("tracked", 1)
+    finally:
+        with _conc._TARGET_LOCK:
+            _conc._EXTRA_TARGETS[:] = [
+                t for t in _conc._EXTRA_TARGETS if t[0] is not _Child
+            ]
+
+
+def test_locked_cross_thread_writes_stay_silent(tmp_path):
+    """The healthy counterpart: both sides write under the owning lock —
+    zero violations, zero dumps (properly locked code can never
+    false-positive: a held Lock reads as synchronized)."""
+
+    class _Locked:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.value = 0
+
+        def spin(self):
+            t = threading.Thread(target=self._worker, daemon=True)
+            t.start()
+            t.join()
+
+        def _worker(self):
+            with self._lock:
+                self.value += 1
+
+        def bump(self):
+            with self._lock:
+                self.value += 1
+
+    register_threadsan_target(_Locked, ("value",), "_lock")
+    try:
+        with _flight.flight_scope(tmp_path):
+            with san_scope() as san:
+                c = _Locked()
+                c.spin()
+                c.bump()
+    finally:
+        with _conc._TARGET_LOCK:
+            _conc._EXTRA_TARGETS[:] = [
+                t for t in _conc._EXTRA_TARGETS if t[0] is not _Locked
+            ]
+    assert san.violations == []
+    assert _dumps(tmp_path) == []
+
+
+def test_single_owner_handoff_is_not_a_race(tmp_path, _threadsan_counter):
+    """Construct on the main thread, then hand the attr to ONE worker
+    that is its sole writer afterwards — the single-owner fix the MTL106
+    message recommends. The first cross-thread transition is an
+    ownership handoff, not a race; only ping-ponging flags."""
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            c = fx.UnlockedSharedCounter()  # __init__ writes on main
+            c.spin(5)  # after the handoff, only the worker writes
+    assert san.violations == []
+    assert _dumps(tmp_path) == []
+
+
+def test_thread_write_map_prunes_collected_objects(_threadsan_counter):
+    """ThreadSan's per-instance write history dies with the instance:
+    id() reuse can never pair a fresh object with a dead object's writer
+    thread, and the map cannot grow with short-lived watched objects."""
+    import gc
+
+    with san_scope() as san:
+        c = fx.UnlockedSharedCounter()
+        c.bump()
+        oid = id(c)
+        assert any(k[0] == oid for k in san._thread_writes)
+        del c
+        gc.collect()
+        assert not any(k[0] == oid for k in san._thread_writes)
+        assert oid not in san._thread_live
+
+
+def test_single_thread_writes_never_race(tmp_path, _threadsan_counter):
+    """One owning thread writing lock-free is not a race — the check
+    requires a SECOND writer thread."""
+    with _flight.flight_scope(tmp_path):
+        with san_scope() as san:
+            c = fx.UnlockedSharedCounter()
+            for _ in range(5):
+                c.bump()
+    assert san.violations == []
+    assert _dumps(tmp_path) == []
+
+
+def test_threadsan_disarm_restores_uninstrumented_classes(_threadsan_counter):
+    """Arm/disarm reversibility extends to ThreadSan: the instrumented
+    `__setattr__` is fully removed, and writes afterwards are plain."""
+    disable_san()  # start disarmed even under `make san` env arming
+    with san_scope():
+        assert "__setattr__" in fx.UnlockedSharedCounter.__dict__
+    assert "__setattr__" not in fx.UnlockedSharedCounter.__dict__
+    c = fx.UnlockedSharedCounter()
+    c.spin(2)
+    c.bump()  # disarmed: the (still broken) fixture runs unobserved
+    assert c.value == 3
+
+
+# ---------------------------------------------------------------------------
 # arming semantics
 # ---------------------------------------------------------------------------
 def test_raise_mode_raises_metricsan_error():
@@ -258,3 +444,35 @@ def test_results_bit_identical_with_and_without_san():
         v2 = m2(_X, _X * 0.5)
     assert np.array_equal(np.asarray(v1), np.asarray(v2))
     assert np.array_equal(np.asarray(m1.compute()), np.asarray(m2.compute()))
+
+
+def test_non_weakrefable_watched_objects_are_silently_untracked(tmp_path):
+    """A __slots__ class (no __weakref__) cannot have its lifetime
+    tracked, so ThreadSan records NO history for it — conservative
+    silence instead of stale-id false pairs — and keeps no per-id state."""
+
+    class _Slotted:
+        __slots__ = ("value", "_lock")
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.value = 0
+
+    register_threadsan_target(_Slotted, ("value",), "_lock")
+    try:
+        with _flight.flight_scope(tmp_path):
+            with san_scope() as san:
+                s = _Slotted()
+                t = threading.Thread(target=lambda: setattr(s, "value", 1))
+                t.start()
+                t.join()
+                s.value = 2
+                s.value = 3  # would be transition 2 if history were kept
+        assert san.violations == []
+        assert san._thread_writes == {} and san._thread_live == {}
+    finally:
+        with _conc._TARGET_LOCK:
+            _conc._EXTRA_TARGETS[:] = [
+                t for t in _conc._EXTRA_TARGETS if t[0] is not _Slotted
+            ]
+    assert _dumps(tmp_path) == []
