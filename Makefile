@@ -1,4 +1,4 @@
-.PHONY: ci lint san test test-tpu test-tpu-suite doctest bench bench-sync bench-cohort sentinel serve-metrics dryrun fuzz fuzz-sharded chaos clean
+.PHONY: ci lint san test test-tpu test-tpu-suite doctest bench bench-sync bench-cohort serve-bench sentinel serve-metrics dryrun fuzz fuzz-sharded chaos clean
 
 ci:
 	# the full CI gate as one machine-runnable target (mirrors
@@ -141,6 +141,37 @@ bench-cohort:
 	tail -n 1 bench_cohort.txt > bench_cohort.json
 	python scripts/perf_sentinel.py --current bench_cohort.json --strict-bounds
 
+serve-bench:
+	# continuous-serving legs (~2 min): steady-state per-step metric
+	# overhead of a live serve loop at 1M rows — blocking forward vs the
+	# async double-buffered pipeline (metrics_tpu/serving/) — plus the
+	# ingest-queue throughput leg. The sentinel gates the deterministic
+	# serving_overhead_ratio bound (async ≤ 0.5× blocking overhead)
+	# strictly; ms legs compare against the committed BENCH_r07.json
+	# round. Then the exporter smoke: telemetry + /metrics armed, a short
+	# IngestQueue drive behind an AsyncServingEngine, ONE scrape saved
+	# and validated via `metrics_exporter.py --check` with the serving
+	# queue-depth gauge required present. Writes SENTINEL_serving.json;
+	# CI uploads bench_serving.json + the scrape as artifacts.
+	METRICS_TPU_FLIGHT=flight-dumps python bench.py --leg-serving | tee bench_serving.txt
+	tail -n 1 bench_serving.txt > bench_serving.json
+	python scripts/perf_sentinel.py --current bench_serving.json --strict-bounds --out SENTINEL_serving.json
+	python -c "import urllib.request, numpy as np; \
+		import metrics_tpu as M, metrics_tpu.observability as obs; \
+		from metrics_tpu.serving import AsyncServingEngine, IngestQueue; \
+		obs.enable(); ex = obs.enable_exporter(0); \
+		cohort = M.MetricCohort(M.Accuracy(), tenants=8); \
+		pipe = AsyncServingEngine(cohort); \
+		q = IngestQueue(pipe, rows_per_step=32, max_buffered_rows=4096); \
+		rng = np.random.RandomState(0); \
+		ids = np.tile(np.arange(8), 32); p = rng.rand(256).astype('float32'); \
+		q.submit(ids, p, (p > 0.5).astype('int32')); pipe.drain(); \
+		t = urllib.request.urlopen(ex.url, timeout=5).read().decode(); \
+		open('metrics_scrape_serving.txt', 'w').write(t); \
+		assert 'metrics_tpu_serving_queue_depth' in t, 'queue-depth gauge missing from scrape'; \
+		pipe.close(); obs.disable_exporter(); print('serving scrape: OK')"
+	python scripts/metrics_exporter.py --check metrics_scrape_serving.txt
+
 sentinel:
 	# perf-regression sentinel, STRICT: fresh bench.py run compared per leg
 	# against the committed BENCH_r0*.json trajectory; exit 1 on any leg
@@ -182,4 +213,5 @@ dryrun:
 clean:
 	rm -rf .pytest_cache .jax_cache flight-dumps bench-traces san-flight-dumps
 	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json bench_cohort.txt bench_cohort.json ANALYSIS_current.json
+	rm -f bench_serving.txt bench_serving.json SENTINEL_serving.json metrics_scrape_serving.txt
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

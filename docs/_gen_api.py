@@ -15,6 +15,7 @@ import metrics_tpu.functional as F
 import metrics_tpu.observability as O
 import metrics_tpu.parallel as P
 import metrics_tpu.reliability as R
+import metrics_tpu.serving as S
 
 
 def _summary(obj) -> str:
@@ -62,6 +63,15 @@ def main() -> None:
     ]
     lines += [f"- **`{n}`** — {d}" for n, d in _classes(R)]
     lines += [f"- **`{n}`** — {d}" for n, d in _functions(R)]
+    lines += ["", "## Continuous serving (`metrics_tpu.serving`)", ""]
+    lines += [
+        "See `docs/serving.md` for the pipeline diagram, barrier"
+        " semantics, the backpressure policy table, and the MTA009"
+        " admission rule.",
+        "",
+    ]
+    lines += [f"- **`{n}`** — {d}" for n, d in _classes(S)]
+    lines += [f"- **`{n}`** — {d}" for n, d in _functions(S)]
     lines += ["", "## Static analysis (`metrics_tpu.analysis`)", ""]
     lines += [
         "See `docs/static_analysis.md` for the rule catalog (MTA001-MTA007,"

@@ -161,6 +161,11 @@ class MetricCohort:
     ``add_tenant(state=...)``.
     """
 
+    # Continuous-serving enrollment (serving/async_engine.py): weakref to
+    # the pipeline whose worker owns this cohort's dispatch stream;
+    # compute() drains it first. None = one attribute check of overhead.
+    _serving_pipeline: Optional[Any] = None
+
     def __init__(
         self,
         metrics: Union[Metric, Mapping[str, Metric], Sequence[Metric], Any],
@@ -759,7 +764,16 @@ class MetricCohort:
         tenant's with ``tenant=``). Under a distributed backend the
         stacked states are synced first — one collective per state for the
         whole cohort — then restored, keeping committed quantization
-        residuals, exactly mirroring ``Metric.compute`` semantics."""
+        residuals, exactly mirroring ``Metric.compute`` semantics.
+
+        On a cohort enrolled in an
+        :class:`~metrics_tpu.serving.AsyncServingEngine`, compute is a
+        **drain barrier**: every staged dispatch folds in first (the
+        same contract as ``MetricCollection.compute``)."""
+        if self._serving_pipeline is not None:
+            pipe = self._serving_pipeline()
+            if pipe is not None:
+                pipe.drain()
         synced_cache = None
         if is_distributed_initialized():
             synced_cache = {
@@ -1092,7 +1106,7 @@ class MetricCohort:
         return {
             k: v
             for k, v in self.__dict__.items()
-            if k not in ("_engine", "_compute_cache")
+            if k not in ("_engine", "_compute_cache", "_serving_pipeline")
         }
 
     def __setstate__(self, state: dict) -> None:

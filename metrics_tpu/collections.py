@@ -140,7 +140,15 @@ class MetricCollection:
         input canonicalization (see
         :func:`~metrics_tpu.utilities.checks.shared_canonicalization`).
         With ``compiled=True`` the whole fan-out runs as one donated XLA
-        dispatch through the step engine instead."""
+        dispatch through the step engine instead.
+
+        Barrier contract: forward returns once the new state *buffers*
+        are installed on the members — with JAX's async dispatch their
+        computation may still be in flight on the device. Reading a
+        value (or ``compute()``) is the synchronization point; under an
+        :class:`~metrics_tpu.serving.AsyncServingEngine` even the
+        install is deferred, and the pipeline's drain barrier is where
+        every staged batch is guaranteed folded in (``docs/serving.md``)."""
         if self.compiled:
             if self._engine is None:
                 from metrics_tpu.engine import CompiledStepEngine
@@ -187,6 +195,18 @@ class MetricCollection:
                 m.update(*args, **m._filter_kwargs(**kwargs))
 
     def compute(self) -> Dict[str, Any]:
+        """Epoch values from every member's (possibly synced) state.
+
+        On a collection enrolled in an
+        :class:`~metrics_tpu.serving.AsyncServingEngine`, compute is a
+        **drain barrier**: every batch the serve loop already staged is
+        folded into state before any member computes — pinned by
+        ``tests/bases/test_serving.py`` (the barrier contract,
+        ``docs/serving.md``)."""
+        if self._serving_pipeline is not None:
+            pipe = self._serving_pipeline()
+            if pipe is not None:
+                pipe.drain()
         return {self._set_prefix(k): m.compute() for k, m in self.items()}
 
     def reset(self) -> None:
@@ -228,7 +248,13 @@ class MetricCollection:
     # unpicklable XLA executables: a copy/pickle drops the engine and lazily
     # rebuilds it against its own metric objects on the next forward
     def __getstate__(self) -> dict:
-        return {k: v for k, v in self.__dict__.items() if k != "_engine"}
+        # serving enrollment is dropped with the engine: a copy serves
+        # its own stream (and a weakref would not pickle anyway)
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ("_engine", "_serving_pipeline")
+        }
 
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(state)
@@ -243,6 +269,12 @@ class MetricCollection:
     # (one cursor for the whole fan-out — members advance in lockstep under
     # one forward), riding state_dict/_named_states exactly as Metric's does
     _session_cursor: Optional[int] = None
+
+    # Continuous-serving enrollment (serving/async_engine.py): weakref to
+    # the pipeline whose worker owns this collection's dispatch stream;
+    # compute() drains it first (the barrier contract). None = one
+    # attribute check of overhead for never-enrolled collections.
+    _serving_pipeline: Optional[Any] = None
 
     def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
         destination = {} if destination is None else destination

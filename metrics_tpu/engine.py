@@ -188,6 +188,13 @@ class CompiledStepEngine:
         # trace/compile bookkeeping for tests and for debugging recompiles:
         # one trace per signature on steady-state shapes
         self.trace_count = 0
+        # generation handoff: advanced by _write_back (and the cohort
+        # dispatch) under self._lock — the monotonic counter that makes
+        # "dispatch N+1 donates generation N's outputs" an observable
+        # fact for the async serving pipeline and the MTA009 prover's
+        # write-back ordering claim (a ping-pong consumer reads it to
+        # pair values with the state generation they describe)
+        self.dispatch_generation = 0
         self._lock = threading.Lock()
         # telemetry: signatures ever compiled (distinguishes a NEW signature
         # from LRU-eviction thrash for the recompilation watchdog) and the
@@ -552,6 +559,7 @@ class CompiledStepEngine:
                 raise
             if telemetry_on and not cache_hit:
                 _obs.get().observe("engine.trace_s", _time.perf_counter() - t0)
+            self.dispatch_generation += 1
         if guard_token is None:
             new_states, values = out
             finites = None
@@ -771,12 +779,18 @@ class CompiledStepEngine:
         return out
 
     def _write_back(self, names: Tuple[str, ...], new_states, values) -> None:
+        """Install generation N+1's state buffers on the metrics. Runs
+        under ``self._lock`` (its caller's extent): the donate→dispatch→
+        write-back sequence is serialized, so generations install in
+        dispatch order — the monotonicity the MTA009 prover AST-verifies
+        and the async serving worker's ping-pong depends on."""
         for name in names:
             m = self._metrics[name]
             for sname, v in new_states[name].items():
                 setattr(m, sname, v)
             m._forward_cache = values.get(name)
             m._computed = None
+        self.dispatch_generation += 1
 
     # ------------------------------------------------------------------
     # the public step
@@ -784,7 +798,16 @@ class CompiledStepEngine:
     def step(self, *args: Any, **kwargs: Any):
         """One forward over the batch: returns what the eager forward would
         (the per-metric dict for a collection, the bare value for a single
-        metric), having advanced every metric's accumulated state."""
+        metric), having installed every metric's new state buffers.
+
+        Barrier contract: "installed" means the attributes point at the
+        freshly merged buffers — with JAX's async dispatch the XLA
+        program may still be executing when step returns; reading a
+        value or state is the synchronization point. One step = one
+        generation (``dispatch_generation`` advances under the engine
+        lock at write-back), which is what lets an async serving worker
+        ping-pong dispatch N+1 against generation N's outputs while N is
+        in flight (``metrics_tpu/serving/``)."""
         # a distributed backend appearing after construction makes the
         # no-sync trace semantics wrong — run everything eager then
         if is_distributed_initialized():

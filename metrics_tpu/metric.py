@@ -150,6 +150,14 @@ class Metric(ABC):
     _session_cursor: Optional[int] = None
     _SESSION_CURSOR_KEY = "__session_cursor__"
 
+    # Continuous-serving enrollment (serving/async_engine.py): a weakref
+    # to the AsyncServingEngine whose worker owns this metric's dispatch
+    # stream, or None (the default — one attribute check of overhead).
+    # While set, compute() drains the pipeline's staged batches first, so
+    # an epoch value can never miss a batch the serve loop already
+    # submitted (the drain-barrier contract; see docs/serving.md).
+    _serving_pipeline: Optional[Any] = None
+
     # provenance of the `_computed` cache (see `_wrap_compute`)
     _computed_batch_local = False
 
@@ -375,7 +383,15 @@ class Metric(ABC):
         canonicalization across the two calls halves that hot-path cost
         while preserving the double-update contract. Metrics flagged
         ``_fused_forward`` skip the second update entirely (one update +
-        a state merge, see :meth:`_forward_fused`)."""
+        a state merge, see :meth:`_forward_fused`).
+
+        Barrier contract: forward returns once the new state buffers are
+        *installed* — not once their math completed; JAX dispatch is
+        asynchronous, and reading a value is the sync point. Under a
+        :class:`~metrics_tpu.serving.AsyncServingEngine` the install
+        itself moves to a worker: ``compute()``/sync/checkpoint are the
+        drain barriers that guarantee every staged batch is folded in
+        (``docs/serving.md``)."""
         if self._fused_forward and self.compute_on_step:
             return self._forward_fused(*args, **kwargs)
         with _obs.metric_scope(self, "forward"), shared_canonicalization():
@@ -758,6 +774,14 @@ class Metric(ABC):
                 return _inner(*args, **kwargs)
 
         def _inner(*args: Any, **kwargs: Any):
+            # serving drain barrier: an async-enrolled metric folds every
+            # staged batch into state before computing (no-op on the
+            # pipeline's own worker — trace-time computes inside the step
+            # must not self-wait)
+            if self._serving_pipeline is not None:
+                pipe = self._serving_pipeline()
+                if pipe is not None:
+                    pipe.drain()
             # the cache carries its provenance: a value computed under
             # batch-local (forward) semantics must never serve an epoch-end
             # compute, or vice versa — e.g. a tolerant batch-local OvR
@@ -831,8 +855,14 @@ class Metric(ABC):
         return deepcopy(self)
 
     def __getstate__(self) -> dict:
-        # drop wrapped bound methods for pickling
-        return {k: v for k, v in self.__dict__.items() if k not in ["update", "compute"]}
+        # drop wrapped bound methods for pickling (and any serving
+        # enrollment — a weakref to a live pipeline is neither picklable
+        # nor meaningful on a copy, which serves its own stream)
+        return {
+            k: v
+            for k, v in self.__dict__.items()
+            if k not in ["update", "compute", "_serving_pipeline"]
+        }
 
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(state)

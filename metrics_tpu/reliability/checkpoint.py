@@ -57,6 +57,7 @@ __all__ = [
     "CheckpointCorruptionError",
     "CheckpointMismatchError",
     "atomic_file",
+    "envelope_from_pairs",
     "save_envelope",
     "load_envelope",
     "write_envelope",
@@ -168,10 +169,33 @@ def save_envelope(obj: Any, persistent_only: bool = False) -> Dict[str, Any]:
         for k, v in source.items()
     }
     complete = set(payload) == {k for k, _ in _named_states(obj)}
+    return _assemble_envelope(payload, type(obj).__name__, complete)
+
+
+def envelope_from_pairs(
+    pairs: List[Tuple[str, Any]], metric_type: str = "snapshot"
+) -> Dict[str, Any]:
+    """Build a validated envelope from pre-captured ``(key, value)``
+    pairs instead of a live metric — the background-checkpoint path
+    (:mod:`metrics_tpu.serving.bgcheckpoint`): the snapshot is taken at
+    a barrier on the serve thread, and THIS call (the device→host fetch
+    plus checksumming) runs later, on the writer. ``metric_type`` is the
+    informational type label the live path records; pass the original
+    object's class name so resumed journals read identically."""
+    payload = {
+        k: ([_np(x) for x in v] if isinstance(v, list) else _np(v))
+        for k, v in pairs
+    }
+    return _assemble_envelope(payload, metric_type, complete=True)
+
+
+def _assemble_envelope(
+    payload: Dict[str, Any], metric_type: str, complete: bool
+) -> Dict[str, Any]:
     return {
         "format": ENVELOPE_FORMAT,
         "schema_version": SCHEMA_VERSION,
-        "metric_type": type(obj).__name__,
+        "metric_type": metric_type,
         "complete": complete,
         "spec": {k: _spec_of(v) for k, v in payload.items()},
         "payload": payload,

@@ -40,6 +40,7 @@ __all__ = [
     "failing_engine_compile",
     "corrupt_envelope",
     "preempt_at_step",
+    "slow_consumer",
     "torn_write",
     "cursor_skew",
     "donation_unsafe_engine",
@@ -431,15 +432,31 @@ def corrupt_envelope(envelope: Dict[str, Any], mode: str = "payload") -> Dict[st
 # 5. durable-session faults (preemption, torn files, cursor skew)
 # ----------------------------------------------------------------------
 @contextmanager
-def preempt_at_step(session: Any, step: int) -> Iterator[Dict[str, int]]:
+def preempt_at_step(
+    session: Any, step: int, during: str = "step"
+) -> Iterator[Dict[str, int]]:
     """SIGKILL-simulate a preemption: while active, the session "dies" —
     raises :class:`Preempted` — the moment it is fed ``step_index >=
     step``, before that batch touches any state. Everything the session
     durably checkpointed before that instant is exactly what a real
     preemption leaves behind; drive recovery by building a FRESH metric +
-    session over the same journal directory and calling ``resume()``."""
+    session over the same journal directory and calling ``resume()``.
+
+    ``during="background_write"`` (requires
+    ``EvalSession(background_checkpoints=True)``) additionally kills the
+    background checkpoint writer **mid-write**: every commit attempted
+    while active tears exactly as a SIGKILL inside ``atomic_file`` would
+    — a truncated ``.tmp`` carcass appears at the next generation path,
+    nothing is renamed into place, the manifest never learns the
+    generation existed (``info["torn_writes"]`` counts them). The drill
+    behind the serving acceptance bed: a preemption mid-async-write must
+    resume bit-identically from the previous committed generation."""
+    if during not in ("step", "background_write"):
+        raise ValueError(
+            f"during must be 'step' or 'background_write', got {during!r}"
+        )
     orig = session.step
-    info = {"preempted_at": -1}
+    info = {"preempted_at": -1, "torn_writes": 0}
 
     def dying(step_index, *args: Any, **kwargs: Any):
         if int(step_index) >= step:
@@ -448,10 +465,86 @@ def preempt_at_step(session: Any, step: int) -> Iterator[Dict[str, int]]:
         return orig(step_index, *args, **kwargs)
 
     session.step = dying
+    bg = getattr(session, "_bg", None)
+    if during == "background_write":
+        if bg is None:
+            raise RuntimeError(
+                "preempt_at_step(during='background_write') needs a session"
+                " constructed with background_checkpoints=True"
+            )
+
+        def torn_commit(job):
+            # the carcass a real mid-write SIGKILL leaves: partial bytes
+            # at <gen>.npz.tmp, target path untouched, manifest untouched
+            records = bg._journal.records()
+            nxt = (int(records[-1]["generation"]) + 1) if records else 1
+            with open(bg._journal._gen_path(nxt) + ".tmp", "wb") as f:
+                f.write(b"PK\x03\x04torn-mid-write")
+            info["torn_writes"] += 1
+            raise Preempted(
+                f"injected preemption mid background write (cursor"
+                f" {job['cursor']})"
+            )
+
+        bg._commit_job = torn_commit
     try:
         yield info
     finally:
         del session.step  # uncover the bound method
+        if during == "background_write":
+            del bg._commit_job
+
+
+@contextmanager
+def slow_consumer(
+    target: Any, delay_s: float = 0.05, calls: int = 1_000_000
+) -> Iterator[Dict[str, int]]:
+    """Make a serving consumer slow: the first ``calls`` dispatches sleep
+    ``delay_s`` before running — the wedged-device / oversubscribed-host
+    drill that fills the admission queue and drives the backpressure
+    policies (``block`` must bound-wait then raise, ``shed_*`` must shed
+    with full accounting).
+
+    ``target`` is an :class:`~metrics_tpu.serving.AsyncServingEngine`
+    (its worker-side dispatch is wrapped) or an
+    :class:`~metrics_tpu.serving.IngestQueue` (its downstream target is
+    wrapped — works whether that is a cohort or a pipeline)."""
+    info = {"delayed": 0}
+
+    if hasattr(target, "_dispatch") and hasattr(target, "drain"):
+        orig_dispatch = target._dispatch
+
+        def slow_dispatch(args, kwargs):
+            if info["delayed"] < calls:
+                info["delayed"] += 1
+                time.sleep(delay_s)
+            return orig_dispatch(args, kwargs)
+
+        target._dispatch = slow_dispatch
+        try:
+            yield info
+        finally:
+            del target._dispatch
+        return
+    if hasattr(target, "_target") and hasattr(target, "submit"):
+        orig_target = target._target
+
+        def slow_call(*args: Any, **kwargs: Any):
+            if info["delayed"] < calls:
+                info["delayed"] += 1
+                time.sleep(delay_s)
+            return orig_target(*args, **kwargs)
+
+        target._target = slow_call
+        try:
+            yield info
+        finally:
+            target._target = orig_target
+        return
+    raise TypeError(
+        "slow_consumer wraps an AsyncServingEngine or an IngestQueue; got"
+        f" {type(target).__name__}"
+    )
 
 
 def torn_write(path: Any, keep_fraction: float = 0.5) -> int:

@@ -169,7 +169,18 @@ class EvalSession:
         keep_last: int = 3,
         step_deadline_s: Optional[float] = None,
         degraded_ok: bool = False,
+        background_checkpoints: bool = False,
     ):
+        """``background_checkpoints=True`` moves the checkpoint write off
+        the step path: :meth:`checkpoint` snapshots the state as
+        device-side copies at the barrier and returns immediately; a
+        daemon writer (:class:`~metrics_tpu.serving.BackgroundCheckpointer`)
+        streams the fetch device→host and commits through the journal's
+        atomic rename — the only sync point, so a preemption mid-write
+        leaves the previous generation intact and resume stays
+        exactly-once. Protective checkpoints (survived failures) remain
+        synchronous — durability cannot wait there. See
+        ``docs/serving.md``."""
         from metrics_tpu.collections import MetricCollection
         from metrics_tpu.metric import Metric
 
@@ -199,6 +210,18 @@ class EvalSession:
             "partial_quorum_resumes": 0,
             "deadline_exceeded": 0,
         }
+        self._bg = None
+        if background_checkpoints:
+            # lazy import: reliability must not pull the serving package
+            # in for the (default) synchronous path
+            from metrics_tpu.serving.bgcheckpoint import BackgroundCheckpointer
+
+            self._bg = BackgroundCheckpointer(self.journal)
+            # the writer thread must not outlive the session: a dropped
+            # session finishes its queued commits and stops the worker
+            # (finalizer holds the CHECKPOINTER, not the session — no
+            # resurrection cycle; close() never raises)
+            weakref.finalize(self, self._bg.close)
         # enroll: the cursor now rides state_dict/_named_states/envelopes
         metric._session_cursor = self.cursor
         self._member_ids = self._collect_member_ids(metric)
@@ -339,17 +362,50 @@ class EvalSession:
     # ------------------------------------------------------------------
     def checkpoint(self, note: Optional[str] = None) -> Dict[str, Any]:
         """Commit the current state (cursor embedded) as a new journal
-        generation; returns the manifest record."""
+        generation; returns the manifest record — or, under
+        ``background_checkpoints=True``, a pending descriptor: the
+        snapshot is taken here (device-side copies, no host transfer) and
+        the fetch+write commits on the background writer, behind the
+        journal's atomic rename (:meth:`flush_checkpoints` is the
+        barrier)."""
         self.metric._session_cursor = self.cursor
         with _trace.span("session.checkpoint", phase="checkpoint", cursor=self.cursor):
-            record = self.journal.commit(
-                save_envelope(self.metric), self.cursor, note=note
-            )
+            if self._bg is not None:
+                from metrics_tpu.serving.bgcheckpoint import snapshot_pairs
+
+                record = self._bg.submit(
+                    snapshot_pairs(self.metric),
+                    type(self.metric).__name__,
+                    self.cursor,
+                    note=note,
+                )
+            else:
+                record = self.journal.commit(
+                    save_envelope(self.metric), self.cursor, note=note
+                )
         self._steps_since_checkpoint = 0
         self.stats["checkpoints"] += 1
         if _obs.enabled():
             _obs.get().count("reliability.session_checkpoints")
         return record
+
+    def flush_checkpoints(self) -> None:
+        """Barrier for ``background_checkpoints=True``: block until every
+        queued snapshot is durably committed, re-raising the first writer
+        error. No-op for synchronous sessions."""
+        if self._bg is not None:
+            self._bg.drain()
+
+    def close(self) -> None:
+        """Flush background checkpoints (re-raising any writer error)
+        and stop the writer thread; later ``checkpoint()`` calls fall
+        back to the synchronous path. No-op for synchronous sessions."""
+        if self._bg is not None:
+            try:
+                self._bg.drain()
+            finally:
+                self._bg.close()
+                self._bg = None
 
     def _protective_checkpoint(self, reason: str) -> None:
         """An out-of-cadence checkpoint after a survived failure: persist
@@ -363,9 +419,23 @@ class EvalSession:
         # must record the state's true coverage, not the stale cursor
         self.metric._session_cursor = cursor
         try:
-            self.journal.commit(
-                save_envelope(self.metric), cursor, note=f"protective: {reason}"
-            )
+            if self._bg is not None:
+                # protective = must-be-durable-NOW: route through the
+                # writer's synchronous seam (drains queued snapshots
+                # first, commits inline under the writer's commit lock —
+                # two writers never interleave a manifest update)
+                from metrics_tpu.serving.bgcheckpoint import snapshot_pairs
+
+                self._bg.commit_sync(
+                    snapshot_pairs(self.metric),
+                    type(self.metric).__name__,
+                    cursor,
+                    note=f"protective: {reason}",
+                )
+            else:
+                self.journal.commit(
+                    save_envelope(self.metric), cursor, note=f"protective: {reason}"
+                )
         finally:
             self.metric._session_cursor = self.cursor if self._inflight is None else cursor
         self.stats["protective_checkpoints"] += 1
@@ -382,6 +452,10 @@ class EvalSession:
         replicas on the cursor, and return it (-1 when the journal is
         empty: a fresh start). After this, re-feed the stream from the
         top — the replay guard makes it exactly-once."""
+        if self._bg is not None:
+            # a mid-life resume must not race the writer over the journal
+            # (fresh-process resumes find an idle writer and pass through)
+            self._bg.drain(raise_errors=False)
         with _trace.span("session.resume", phase="checkpoint"):
             envelope, record, _skipped = self.journal.load_latest_good()
             if envelope is None:

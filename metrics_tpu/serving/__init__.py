@@ -1,0 +1,55 @@
+"""Continuous-serving pipeline: metric overhead per serve step → ~0.
+
+Batch eval tolerates a ``forward()`` that blocks the caller on every
+donated dispatch and a ``checkpoint()`` that synchronously streams state
+to disk; a process measuring *live traffic* does not. This package
+composes the existing layers — the compiled step engine (PR 1), the
+multi-tenant cohort (PR 9), the reliability primitives (PRs 3/4), and the
+observability surface (PRs 2/6/10) — into a non-blocking serving loop, in
+the spirit of Prime Collective's overlap of communication with compute
+(PAPERS.md): keep the device busy while the host stages the next batch.
+
+Three pieces, each off unless constructed (zero overhead for code that
+never imports this package):
+
+* **Async double-buffered dispatch** (:class:`AsyncServingEngine`,
+  :mod:`.async_engine`) — ``forward()`` enqueues the batch and returns; a
+  dedicated worker ping-pongs the donated state between generations so
+  dispatch N+1 is staged while N is in flight. Admission is gated on the
+  MTA009 double-buffer proof (PR 12): families it cannot prove ping-pong
+  safe are refused at enroll time and served on the classic blocking
+  path. ``compute()``/sync/checkpoint are explicit **drain barriers**;
+  dispatch failures resolve through the engine's demote-to-eager +
+  StateGuard last-good machinery and surface at the next barrier.
+* **Streaming admission** (:class:`IngestQueue`, :mod:`.ingest`) — a
+  bounded queue accepting flat ``(tenant_id, rows)`` streams,
+  micro-batching via :func:`~metrics_tpu.cohort.route_rows` into the
+  cohort's capacity buckets, coalescing across tenants, with pluggable
+  backpressure (``block`` / ``shed_oldest`` / ``shed_by_health`` — the
+  latter keyed on the ``cohort.tenant.*`` health gauges).
+* **Background checkpoints** (:class:`BackgroundCheckpointer`,
+  :mod:`.bgcheckpoint`) — envelope fetches stream device→host off a
+  snapshot taken at a barrier, on a daemon worker; the journal's
+  atomic-rename commit is the only sync point, so a preemption
+  mid-async-write leaves the previous generation intact and an
+  :class:`~metrics_tpu.reliability.EvalSession` still resumes
+  exactly-once (``EvalSession(background_checkpoints=True)``).
+
+Telemetry rides the ``serving.*`` namespace (see the glossary in
+``docs/observability.md``); ``docs/serving.md`` has the pipeline diagram,
+the barrier semantics, and the backpressure policy table.
+"""
+from metrics_tpu.serving.async_engine import (  # noqa: F401
+    AsyncServingEngine,
+    ServingAdmissionError,
+)
+from metrics_tpu.serving.bgcheckpoint import BackgroundCheckpointer  # noqa: F401
+from metrics_tpu.serving.ingest import IngestQueue, IngestOverflowError  # noqa: F401
+
+__all__ = [
+    "AsyncServingEngine",
+    "BackgroundCheckpointer",
+    "IngestOverflowError",
+    "IngestQueue",
+    "ServingAdmissionError",
+]

@@ -1,0 +1,425 @@
+"""Async double-buffered dispatch: forward() stops blocking the caller.
+
+The compiled step engine already made the metric step ONE donated XLA
+dispatch; this module moves that dispatch off the serve loop's critical
+path. ``forward()`` stages the batch into a bounded two-slot queue and
+returns immediately; a dedicated daemon worker pops batches and drives the
+underlying forward, so generation N+1 is being staged (routing, donation
+prep, trace-cache lookup) while generation N's program still occupies the
+device — the ping-pong the MTA009 double-buffer prover (PR 12) certified
+structurally safe for every engine-eligible family.
+
+Admission is the prover's verdict made operational:
+
+* at **enroll** time: every member must be engine-eligible, the engine's
+  donate→dispatch→write-back sequence must be generation-monotonic under
+  its lock (:func:`~metrics_tpu.analysis.concurrency
+  .writeback_generation_monotonic`), and no member class may carry an
+  AST-level host-reference hazard (a registered state stashed into a
+  plain attribute, or reseeded from a host-cached buffer — the
+  :func:`~metrics_tpu.analysis.concurrency._host_reference_hazards`
+  flavors). Refused targets are **demoted to the blocking path** (or
+  raise, with ``strict=True``): they still serve, synchronously.
+* at the **first dispatch** (when real inputs exist): the two-generation
+  composed program is traced abstractly and
+  :func:`~metrics_tpu.analysis.concurrency.composed_generation_hazards`
+  must come back empty — the cross-check on the real interleaving. A
+  refuted proof demotes to blocking mid-enrollment, before any async
+  dispatch happens.
+
+Barrier semantics: :meth:`AsyncServingEngine.drain` is the explicit
+barrier — it returns once every staged batch has been folded into state,
+and re-raises the first dispatch error the worker swallowed (the engine's
+demote-to-eager machinery resolves recoverable failures *on the worker*;
+only genuinely failing batches — bad inputs, a dead cohort dispatch —
+surface here). ``compute()``, state_dict/checkpointing, and sync all run
+behind it; enrolling also hooks the target's own ``compute()`` so a
+direct call drains first (see ``MetricCollection.compute``).
+
+Thread discipline: the worker communicates through a ``queue.Queue`` and
+a single condition variable; every shared attribute is written under
+``self._lock`` (the MTL106 thread lint and ThreadSan run over this module
+like any other — the serving threads must come out clean).
+"""
+import queue
+import threading
+import weakref
+from typing import Any, Dict, Optional
+
+import jax
+
+from metrics_tpu.collections import MetricCollection
+from metrics_tpu.engine import CompiledStepEngine, _is_arraylike
+from metrics_tpu.metric import Metric
+from metrics_tpu.observability import flight as _flight
+from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.utilities.prints import warn_once
+
+__all__ = ["AsyncServingEngine", "ServingAdmissionError"]
+
+#: two slots: one batch in flight on the device, one staged on the host —
+#: the literal double buffer. Deeper queues only add staleness between
+#: the serve loop and the metric state; the depth is configurable for the
+#: bench's saturation leg, not for production use.
+_DEFAULT_DEPTH = 2
+
+_SENTINEL = object()  # worker shutdown marker
+
+
+class ServingAdmissionError(ValueError):
+    """The target failed async admission (``strict=True``): a member is
+    not engine-eligible, or the MTA009 double-buffer proof refused it."""
+
+
+def _admission_refusal(target: Any) -> Optional[str]:
+    """Why ``target`` cannot serve asynchronously, or None when the
+    enroll-time legs of the MTA009 admission rule all pass."""
+    from metrics_tpu.analysis.concurrency import (
+        _host_reference_hazards,
+        writeback_generation_monotonic,
+    )
+    from metrics_tpu.cohort import MetricCohort
+
+    if isinstance(target, MetricCohort):
+        members = dict(target.items())
+    elif isinstance(target, MetricCollection):
+        members = dict(target.items())
+    elif isinstance(target, Metric):
+        members = {"metric": target}
+    else:
+        return f"unsupported serving target {type(target).__name__}"
+    if not members:
+        return "target has no member metrics"
+    for name, m in members.items():
+        reason = CompiledStepEngine._static_ineligibility(m)
+        if reason is not None:
+            return f"member {name!r} is not engine-eligible: {reason}"
+        hazards = _host_reference_hazards(type(m), set(m._defaults))
+        if hazards:
+            flavor, method, attr, lineno = hazards[0]
+            return (
+                f"member {name!r} carries an MTA009 host-reference hazard"
+                f" ({flavor}: {type(m).__name__}.{method} line {lineno},"
+                f" attr {attr!r}) — two ping-pong generations would share"
+                " a host-held buffer"
+            )
+    if not writeback_generation_monotonic():
+        return (
+            "the engine's donate->dispatch->write-back sequence is not"
+            " generation-monotonic under its lock (MTA009)"
+        )
+    return None
+
+
+def _per_sample(x: Any) -> Any:
+    """One tenant's sample from a cohort-stacked input leaf (for the
+    abstract two-generation trace, which broadcasts it back up)."""
+    if _is_arraylike(x):
+        return x[0]
+    return x
+
+
+class AsyncServingEngine:
+    """Serve a metric target without blocking the caller on its dispatch.
+
+    Args:
+        target: a :class:`~metrics_tpu.Metric`,
+            :class:`~metrics_tpu.MetricCollection`, or
+            :class:`~metrics_tpu.MetricCohort`. Collections and cohorts
+            dispatch through their own engine; a bare metric gets a
+            dedicated single-metric :class:`CompiledStepEngine`.
+        depth: staged-batch bound (default 2 — the double buffer). The
+            caller blocks only when ``depth`` batches are already
+            outstanding, which is the pipeline's intrinsic backpressure.
+        strict: raise :class:`ServingAdmissionError` on refusal instead
+            of demoting to the blocking path.
+
+    Usage::
+
+        pipe = AsyncServingEngine(MetricCollection([...], compiled=True))
+        for batch in stream:
+            pipe.forward(*batch)      # returns immediately
+        values = pipe.compute()       # drain barrier, then epoch values
+
+    Feed batches ONLY through the pipeline while enrolled — a direct
+    ``target(...)`` call races the worker. ``target.compute()`` stays
+    safe: enrolling hooks it to drain first.
+    """
+
+    def __init__(
+        self,
+        target: Any,
+        depth: int = _DEFAULT_DEPTH,
+        strict: bool = False,
+    ):
+        from metrics_tpu.cohort import MetricCohort
+
+        if int(depth) < 1:
+            raise ValueError(f"depth must be >= 1, got {depth}")
+        self._target = target
+        self._is_cohort = isinstance(target, MetricCohort)
+        self._single = isinstance(target, Metric)
+        self._engine: Optional[CompiledStepEngine] = None
+        if self._single:
+            # a bare metric has no engine of its own; the pipeline owns one
+            self._engine = CompiledStepEngine(target, observe=False)
+        self._depth = int(depth)
+        self._lock = threading.Lock()
+        self._lock_cond = threading.Condition(self._lock)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        self._worker: Optional[threading.Thread] = None
+        self._outstanding = 0
+        self._error: Optional[BaseException] = None
+        self._proof_done = False
+        self._closed = False
+        self.stats: Dict[str, int] = {
+            "dispatches": 0,
+            "blocking_steps": 0,
+            "barriers": 0,
+            "errors": 0,
+        }
+        self._refusal = _admission_refusal(target)
+        if self._refusal is not None:
+            if strict:
+                raise ServingAdmissionError(
+                    f"async admission refused: {self._refusal}"
+                )
+            self._note_demotion("enroll", self._refusal)
+        # enroll: the target's own compute() now drains this pipeline
+        # first (see MetricCollection.compute) — a weakref, so a dropped
+        # pipeline never outlives its garbage collection
+        target._serving_pipeline = weakref.ref(self)
+        if _flight.flight_enabled():
+            _flight.record(
+                "serving_enroll",
+                target=type(target).__name__,
+                is_async=self.is_async,
+                refusal=self._refusal,
+            )
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+    @property
+    def is_async(self) -> bool:
+        """True when batches are served by the background worker; False
+        after an admission refusal demoted this target to the blocking
+        path (``refusal_reason`` says why)."""
+        return self._refusal is None
+
+    @property
+    def refusal_reason(self) -> Optional[str]:
+        return self._refusal
+
+    def _note_demotion(self, stage: str, reason: str) -> None:
+        warn_once(
+            f"AsyncServingEngine: admission refused at {stage}"
+            f" ({reason}); serving {type(self._target).__name__} on the"
+            " BLOCKING path",
+            key=f"serving-demoted:{id(self)}",
+        )
+        if _obs.enabled():
+            _obs.get().count("serving.demotions")
+            _obs.get().event("serving_demotion", stage=stage, reason=reason)
+        if _flight.flight_enabled():
+            _flight.record("serving_demotion", stage=stage, reason=reason)
+
+    def _prove_double_buffer(self, args: tuple, kwargs: dict) -> None:
+        """The traced leg of the MTA009 admission rule, run once with the
+        first real batch: trace the two-generation composed program and
+        require zero cross-generation aliases. Tracing happens on the
+        caller thread, BEFORE the first async dispatch — a refuted proof
+        demotes to blocking while no batch is in flight yet."""
+        from metrics_tpu.analysis.concurrency import composed_generation_hazards
+
+        try:
+            if self._is_cohort:
+                sample_args = tuple(jax.tree_util.tree_map(_per_sample, a) for a in args)
+                sample_kwargs = {
+                    k: jax.tree_util.tree_map(_per_sample, v) for k, v in kwargs.items()
+                }
+                closed, _, n_donated, n_state = self._target.abstract_double_buffer(
+                    *sample_args, **sample_kwargs
+                )
+            else:
+                engine = self._resolve_engine()
+                closed, _, n_donated, n_state = engine.abstract_double_buffer_step(
+                    *args, **kwargs
+                )
+            hazards = composed_generation_hazards(closed, n_donated, n_state)
+        except Exception as err:  # noqa: BLE001 — an untraceable step
+            # cannot be proven ping-pong safe; refuse rather than guess
+            hazards = [{"kind": "untraceable", "error": f"{type(err).__name__}: {err}"}]
+        if hazards:
+            with self._lock:
+                self._refusal = (
+                    "MTA009 two-generation proof refused the composed step"
+                    f" program: {hazards[0]}"
+                )
+                reason = self._refusal
+            self._note_demotion("first dispatch", reason)
+
+    def _resolve_engine(self) -> CompiledStepEngine:
+        if self._engine is not None:
+            return self._engine
+        # a compiled collection builds its engine lazily on first forward;
+        # admission needs it earlier for the abstract trace
+        if self._target._engine is None:
+            self._target._engine = CompiledStepEngine(self._target._metrics)
+        return self._target._engine
+
+    # ------------------------------------------------------------------
+    # the hot path
+    # ------------------------------------------------------------------
+    def forward(self, *args: Any, **kwargs: Any):
+        """Stage one batch. Async-admitted targets: enqueues and returns
+        ``None`` immediately (blocking only when ``depth`` batches are
+        already outstanding); the batch's state lands before the next
+        barrier, and its failure — if any — surfaces there. Refused
+        targets: runs the classic blocking forward and returns its value.
+
+        The batch-local step value is deliberately NOT returned on the
+        async path: fetching it would re-serialize the caller on the very
+        dispatch this pipeline exists to overlap. A serve loop that needs
+        step values wants the blocking path.
+        """
+        if self._closed:
+            raise RuntimeError("AsyncServingEngine is closed")
+        if self._refusal is not None:
+            with self._lock:
+                self.stats["blocking_steps"] += 1
+            return self._dispatch(args, kwargs)
+        if not self._proof_done:
+            # one-time traced admission leg (see _prove_double_buffer);
+            # may demote — re-check and fall through to blocking if so
+            self._prove_double_buffer(args, kwargs)
+            with self._lock:
+                self._proof_done = True
+            if self._refusal is not None:
+                with self._lock:
+                    self.stats["blocking_steps"] += 1
+                return self._dispatch(args, kwargs)
+            self._ensure_worker()
+        with self._lock:
+            self._outstanding += 1
+        if _obs.enabled():
+            _obs.get().gauge("serving.queue.depth", self._queue.qsize() + 1)
+        self._queue.put((args, kwargs))
+        return None
+
+    __call__ = forward
+
+    def _dispatch(self, args: tuple, kwargs: dict):
+        """One underlying forward (both paths; the worker's whole job).
+        Recoverable dispatch failures never escape here — the engine's
+        demote-to-eager + StateGuard last-good machinery resolves them
+        inside the step — so an exception means the BATCH failed."""
+        if self._single:
+            return self._engine.step(*args, **kwargs)
+        return self._target(*args, **kwargs)
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is not None:
+                return
+            worker = threading.Thread(
+                target=self._worker_loop, name="metrics-tpu-serving", daemon=True
+            )
+            self._worker = worker
+        worker.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is _SENTINEL:
+                return
+            args, kwargs = job
+            try:
+                self._dispatch(args, kwargs)
+                with self._lock:
+                    self.stats["dispatches"] += 1
+            except BaseException as err:  # noqa: BLE001 — surfaced at the barrier
+                with self._lock:
+                    self.stats["errors"] += 1
+                    if self._error is None:
+                        self._error = err
+                _flight.dump_on_failure(
+                    "serving_dispatch_failure",
+                    target=type(self._target).__name__,
+                    error=f"{type(err).__name__}: {err}",
+                )
+            finally:
+                if _obs.enabled():
+                    _obs.get().count("serving.dispatches")
+                    _obs.get().gauge("serving.queue.depth", self._queue.qsize())
+                with self._lock_cond:
+                    self._outstanding -= 1
+                    self._lock_cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # barriers
+    # ------------------------------------------------------------------
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        """The explicit barrier: block until every staged batch has been
+        folded into state, then re-raise the first batch error the worker
+        recorded (clearing it — state is intact either way; the engine's
+        recovery machinery already resolved what was recoverable)."""
+        if threading.current_thread() is self._worker:
+            return  # a trace-time compute() inside the step must not self-wait
+        if self._refusal is not None or self._worker is None:
+            return  # blocking path / nothing ever staged: trivially clear
+        with self._lock_cond:
+            if not self._lock_cond.wait_for(
+                lambda: self._outstanding == 0, timeout=timeout_s
+            ):
+                raise TimeoutError(
+                    f"serving drain barrier did not clear {self._outstanding}"
+                    f" outstanding dispatch(es) within {timeout_s}s"
+                )
+            self.stats["barriers"] += 1
+            err, self._error = self._error, None
+        if _obs.enabled():
+            _obs.get().count("serving.barriers")
+        if err is not None:
+            raise err
+
+    def compute(self, *args: Any, **kwargs: Any):
+        """Drain, then the target's epoch ``compute()`` (sync included)."""
+        self.drain()
+        return self._target.compute(*args, **kwargs)
+
+    def state_dict(self, *args: Any, **kwargs: Any) -> dict:
+        """Drain, then the target's ``state_dict`` — checkpoints taken
+        through the pipeline always cover every staged batch."""
+        self.drain()
+        return self._target.state_dict(*args, **kwargs)
+
+    def close(self) -> None:
+        """Drain and stop the worker. Idempotent; the target survives
+        (un-enrolled) and keeps serving on its own blocking path."""
+        if self._closed:
+            return
+        try:
+            self.drain()
+        finally:
+            with self._lock:
+                worker, self._worker = self._worker, None
+                self._closed = True
+            if worker is not None:
+                self._queue.put(_SENTINEL)
+                worker.join(timeout=30.0)
+            if self._target._serving_pipeline is not None and (
+                self._target._serving_pipeline() is self
+            ):
+                self._target._serving_pipeline = None
+
+    @property
+    def target(self) -> Any:
+        return self._target
+
+    def __repr__(self) -> str:
+        mode = "async" if self.is_async else "blocking (refused)"
+        return (
+            f"AsyncServingEngine({type(self._target).__name__}, depth="
+            f"{self._depth}, mode={mode})"
+        )

@@ -1,0 +1,254 @@
+"""Background checkpointing: the journal commit is the only sync point.
+
+A synchronous ``EvalSession.checkpoint()`` fetches every state
+device→host, checksums, serializes, and fsyncs — all while the serve loop
+waits. This module moves everything but the *snapshot* off that path:
+
+1. at the barrier (the caller's thread), every state is **snapshotted as
+   a device-side copy** — an enqueue, not a transfer; the copies are
+   owned buffers, so the engine donating the live state on the very next
+   dispatch cannot touch them;
+2. a daemon worker streams the snapshot device→host, builds the
+   checksummed envelope
+   (:func:`~metrics_tpu.reliability.checkpoint.envelope_from_pairs`), and
+   commits it through :class:`~metrics_tpu.reliability.CheckpointJournal`
+   — whose atomic tmp+fsync+rename is the ONLY synchronization with
+   readers: a preemption anywhere mid-write leaves the previous
+   generation intact (a ``.tmp`` carcass at worst), so resume is
+   exactly-once by the same argument as the synchronous path.
+
+Jobs **coalesce**: the mailbox holds one pending snapshot — a new
+checkpoint submitted while an older one still waits replaces it (newest
+state wins; commits stay cursor-ordered because one worker commits
+sequentially). ``serving.checkpoint.coalesced`` counts replacements.
+
+Failures on the worker (disk full, injected preemption) record one
+flight dump (``background_checkpoint_failure``), park the error, and
+re-raise it at the next :meth:`BackgroundCheckpointer.drain` — the same
+barrier contract as the async dispatch engine.
+"""
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from metrics_tpu.engine import _is_arraylike
+from metrics_tpu.observability import flight as _flight
+from metrics_tpu.observability import telemetry as _obs
+
+__all__ = ["BackgroundCheckpointer"]
+
+
+def snapshot_pairs(obj: Any) -> List[Tuple[str, Any]]:
+    """Device-side snapshot of ``obj._named_states()``: array states
+    become owned device copies (an async enqueue — no host transfer
+    happens here), list ("cat") states become shallow list copies (their
+    element arrays are immutable and never donated — list-state metrics
+    are eager-only by construction)."""
+    pairs = []
+    for key, value in obj._named_states():
+        if isinstance(value, list):
+            pairs.append((key, list(value)))
+        elif _is_arraylike(value):
+            pairs.append((key, jnp.array(value, copy=True)))
+        else:
+            pairs.append((key, value))
+    return pairs
+
+
+class BackgroundCheckpointer:
+    """One daemon writer committing snapshots through a journal.
+
+    Args:
+        journal: the :class:`~metrics_tpu.reliability.CheckpointJournal`
+            this writer owns. ALL commits to that journal while this
+            writer lives should route through it (:meth:`submit` for
+            async, :meth:`commit_sync` for must-be-durable-now paths like
+            protective checkpoints) — the worker serializes them, so two
+            writers can never interleave a manifest update.
+    """
+
+    def __init__(self, journal: Any):
+        self._journal = journal
+        self._lock = threading.Lock()
+        self._lock_cond = threading.Condition(self._lock)
+        # commits hold THIS lock, not the mailbox lock: a submit must
+        # never stall behind an in-flight fetch+fsync (that would
+        # re-serialize the serve loop on the write this class exists to
+        # background)
+        self._commit_lock = threading.Lock()
+        self._pending: Optional[Dict[str, Any]] = None
+        self._busy = False
+        self._error: Optional[BaseException] = None
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+        self.stats: Dict[str, int] = {"commits": 0, "coalesced": 0, "errors": 0}
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+    def submit(
+        self,
+        pairs: List[Tuple[str, Any]],
+        metric_type: str,
+        cursor: int,
+        note: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Queue one snapshot for background commit; returns a pending
+        descriptor (``{"pending": True, "cursor": ...}`` — the generation
+        number exists only once the worker commits). An un-committed
+        older snapshot in the mailbox is replaced (coalesced)."""
+        if self._closed:
+            raise RuntimeError("BackgroundCheckpointer is closed")
+        job = {
+            "pairs": pairs,
+            "metric_type": metric_type,
+            "cursor": int(cursor),
+            "note": note,
+        }
+        with self._lock:
+            if self._pending is not None:
+                self.stats["coalesced"] += 1
+                coalesced = True
+            else:
+                coalesced = False
+            self._pending = job
+            self._lock_cond.notify_all()
+        if coalesced and _obs.enabled():
+            _obs.get().count("serving.checkpoint.coalesced")
+        self._ensure_worker()
+        return {"pending": True, "cursor": int(cursor), "note": note}
+
+    def commit_sync(
+        self,
+        pairs: List[Tuple[str, Any]],
+        metric_type: str,
+        cursor: int,
+        note: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """Drain any queued snapshot, then commit THIS one inline and
+        return its manifest record — for paths where durability cannot
+        wait (protective checkpoints after a survived failure)."""
+        self.drain(raise_errors=False)
+        with self._commit_lock:
+            record = self._commit_job(
+                {
+                    "pairs": pairs,
+                    "metric_type": metric_type,
+                    "cursor": int(cursor),
+                    "note": note,
+                }
+            )
+        with self._lock:
+            self.stats["commits"] += 1
+        if _obs.enabled():
+            _obs.get().count("serving.checkpoint.commits")
+        return record
+
+    # ------------------------------------------------------------------
+    # the worker
+    # ------------------------------------------------------------------
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is not None:
+                return
+            worker = threading.Thread(
+                target=self._worker_loop,
+                name="metrics-tpu-bgcheckpoint",
+                daemon=True,
+            )
+            self._worker = worker
+        worker.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            with self._lock:
+                while self._pending is None and not self._closed:
+                    self._lock_cond.wait()
+                if self._pending is None and self._closed:
+                    return
+                job, self._pending = self._pending, None
+                self._busy = True
+            try:
+                with self._commit_lock:
+                    self._commit_job(job)
+                with self._lock:
+                    self.stats["commits"] += 1
+                if _obs.enabled():
+                    _obs.get().count("serving.checkpoint.commits")
+            except BaseException as err:  # noqa: BLE001 — parked for the barrier
+                with self._lock:
+                    self.stats["errors"] += 1
+                    if self._error is None:
+                        self._error = err
+                _flight.dump_on_failure(
+                    "background_checkpoint_failure",
+                    cursor=job["cursor"],
+                    error=f"{type(err).__name__}: {err}",
+                )
+            finally:
+                with self._lock:
+                    self._busy = False
+                    self._lock_cond.notify_all()
+
+    def _commit_job(self, job: Dict[str, Any]) -> Dict[str, Any]:
+        """Fetch device→host, envelope, journal-commit. Runs under
+        ``_commit_lock`` (worker or ``commit_sync``) so commits
+        serialize; split out
+        as the single seam fault injection patches
+        (:func:`~metrics_tpu.reliability.faultinject.preempt_at_step`
+        with ``during="background_write"`` tears exactly this write)."""
+        from metrics_tpu.reliability.checkpoint import envelope_from_pairs
+
+        envelope = envelope_from_pairs(job["pairs"], metric_type=job["metric_type"])
+        return self._journal.commit(envelope, job["cursor"], note=job["note"])
+
+    # ------------------------------------------------------------------
+    # barriers / lifecycle
+    # ------------------------------------------------------------------
+    def drain(self, timeout_s: Optional[float] = 60.0, raise_errors: bool = True) -> None:
+        """Block until the mailbox is empty and the worker idle; then
+        re-raise (and clear) the first parked commit error.
+        ``raise_errors=False`` (internal callers that must proceed —
+        protective commits, resume) leaves a parked error PARKED: it
+        still surfaces at the next raising barrier, never silently
+        vanishes."""
+        if threading.current_thread() is self._worker:
+            return
+        with self._lock_cond:
+            if not self._lock_cond.wait_for(
+                lambda: self._pending is None and not self._busy,
+                timeout=timeout_s,
+            ):
+                raise TimeoutError(
+                    f"background checkpoint drain did not clear within {timeout_s}s"
+                )
+            if not raise_errors:
+                return
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    def close(self) -> None:
+        """Drain and stop the worker (idempotent; never raises — it runs
+        from finalizers). A parked error stays parked: an explicit
+        pre-close ``drain()`` is where failures surface."""
+        if self._closed:
+            return
+        try:
+            self.drain(raise_errors=False)
+        except Exception:  # noqa: BLE001 — a wedged drain must not break teardown
+            pass
+        finally:
+            with self._lock:
+                self._closed = True
+                worker, self._worker = self._worker, None
+                self._lock_cond.notify_all()
+            if worker is not None:
+                worker.join(timeout=30.0)
+
+    def __repr__(self) -> str:
+        return (
+            f"BackgroundCheckpointer(dir={getattr(self._journal, 'directory', None)!r},"
+            f" commits={self.stats['commits']}, pending={self._pending is not None})"
+        )

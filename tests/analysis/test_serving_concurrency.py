@@ -1,0 +1,86 @@
+"""Concurrency-soundness pins for the serving subsystem (ISSUE 13): the
+new threads must come out of MTL106/ThreadSan clean, the admission rule
+must be the MTA009 prover's verdict made operational, and the engine's
+generation handoff claim must stay AST-verifiable."""
+import ast
+import os
+
+import jax.numpy as jnp
+import pytest
+
+from metrics_tpu.analysis.concurrency import (
+    composed_generation_hazards,
+    thread_findings,
+    thread_shared_model,
+    writeback_generation_monotonic,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+SERVING = os.path.join(REPO, "metrics_tpu", "serving")
+
+_SERVING_MODULES = ["async_engine.py", "ingest.py", "bgcheckpoint.py", "__init__.py"]
+
+
+@pytest.mark.parametrize("fname", _SERVING_MODULES)
+def test_serving_modules_are_mtl106_clean(fname):
+    """The serving workers are REAL thread entry points — the MTL106 walk
+    must model them (not skip them) and find zero unlocked shared
+    writes: every cross-thread attribute sits under a lock extent."""
+    path = os.path.join(SERVING, fname)
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    findings = thread_findings(tree, os.path.relpath(path, REPO))
+    unsuppressed = [f for f in findings if not getattr(f, "suppressed", False)]
+    assert unsuppressed == [], [f.message for f in unsuppressed]
+
+
+def test_serving_workers_enter_the_threadsan_model_with_their_lock():
+    """ThreadSan instruments every class whose attrs cross thread entry
+    points — locked or not — and dynamically verifies the lock
+    discipline. The serving workers must be IN the model (the walk sees
+    the real threads) and each must resolve its owning lock, so arming
+    MetricSan over a serving workload watches the pipeline's plumbing
+    without a single static finding (previous test) or runtime race
+    (``make san``)."""
+    model = thread_shared_model(root=os.path.join(REPO, "metrics_tpu"))
+    serving_entries = {
+        m["qualname"]: m for m in model if "serving" in str(m.get("module", ""))
+    }
+    assert {"AsyncServingEngine", "BackgroundCheckpointer"} <= set(serving_entries)
+    for name, entry in serving_entries.items():
+        assert entry["lock"] == "_lock", (name, entry)
+
+
+def test_admission_is_the_prover_verdict():
+    """The enroll-time refusal and the MTA009 AST leg agree: hazard
+    fixtures refused, registry-clean families admitted — and the traced
+    first-dispatch leg (the composed two-generation program) is hazard-
+    free for an admitted family."""
+    from metrics_tpu import Accuracy, MetricCollection
+    from metrics_tpu.analysis.fixtures import DoubleBufferAliaser, HostReadOfDonated
+    from metrics_tpu.engine import CompiledStepEngine
+    from metrics_tpu.serving.async_engine import _admission_refusal
+
+    assert _admission_refusal(Accuracy()) is None
+    assert _admission_refusal(
+        MetricCollection([Accuracy()], compiled=True)
+    ) is None
+    for cls in (DoubleBufferAliaser, HostReadOfDonated):
+        reason = _admission_refusal(cls())
+        assert reason is not None and "MTA009" in reason
+
+    engine = CompiledStepEngine(Accuracy(), observe=False)
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.rand(16, 4).astype(np.float32))
+    t = jnp.asarray(rng.randint(4, size=16))
+    closed, _, n_donated, n_state = engine.abstract_double_buffer_step(p, t)
+    assert composed_generation_hazards(closed, n_donated, n_state) == []
+
+
+def test_writeback_stays_generation_monotonic_with_the_counter():
+    """The serving PR added the dispatch_generation counter to
+    _write_back; the MTA009 AST verification of the donate→dispatch→
+    write-back lock extent must still hold."""
+    assert writeback_generation_monotonic() is True

@@ -1,0 +1,349 @@
+"""Async-serving acceptance bed (ISSUE 13): the pipeline must be
+invisible in the results — async bit-identical to blocking across
+families (plain collection + cohort + int8 sync tier), admission refuses
+exactly the MTA009-hazard classes, compute() is a drain barrier, and a
+collection never enrolled runs the exact pre-PR program with zero
+``serving.*`` counters and no FINGERPRINTS drift."""
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import (
+    Accuracy,
+    F1,
+    MeanAbsoluteError,
+    MeanSquaredError,
+    MetricCohort,
+    MetricCollection,
+    Precision,
+    R2Score,
+    Recall,
+)
+from metrics_tpu.serving import AsyncServingEngine, ServingAdmissionError
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    obs.disable()
+    obs.get().reset()
+    yield
+    obs.disable()
+    obs.get().reset()
+
+
+def _cls_batches(n=5, seed=0, rows=96):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        p = rng.rand(rows, 4).astype(np.float32)
+        p /= p.sum(1, keepdims=True)
+        out.append((jnp.asarray(p), jnp.asarray(rng.randint(4, size=rows))))
+    return out
+
+
+def _reg_batches(n=5, seed=1, rows=96):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        t = rng.rand(rows).astype(np.float32)
+        out.append((jnp.asarray(t + rng.randn(rows).astype(np.float32) * 0.1), jnp.asarray(t)))
+    return out
+
+
+def _cls_col(**kw):
+    return MetricCollection(
+        [
+            Accuracy(),
+            Precision(num_classes=4, average="macro"),
+            Recall(num_classes=4, average="macro"),
+            F1(num_classes=4, average="macro"),
+        ],
+        compiled=True,
+        **kw,
+    )
+
+
+def _reg_col(**kw):
+    return MetricCollection(
+        [MeanSquaredError(), MeanAbsoluteError(), R2Score()], compiled=True, **kw
+    )
+
+
+def _assert_collections_bitwise(a, b):
+    for key in a.keys():
+        for sname in a[key]._defaults:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(a[key], sname)),
+                np.asarray(getattr(b[key], sname)),
+                err_msg=f"state {key}.{sname}",
+            )
+
+
+# ----------------------------------------------------------------------
+# 1. the parity bed: async == blocking, bitwise
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "make_col,batches",
+    [
+        (_cls_col, _cls_batches()),
+        (_reg_col, _reg_batches()),
+    ],
+    ids=["classification4", "regression3"],
+)
+def test_async_collection_bit_identical_to_blocking(make_col, batches):
+    """7 families across the two parameterizations: every state buffer and
+    every epoch value must match the blocking path BITWISE."""
+    blocking = make_col()
+    for p, t in batches:
+        blocking(p, t)
+    e_blocking = blocking.compute()
+
+    served = make_col()
+    pipe = AsyncServingEngine(served)
+    assert pipe.is_async, pipe.refusal_reason
+    for p, t in batches:
+        assert pipe.forward(p, t) is None  # async path returns no value
+    e_async = pipe.compute()
+
+    for k in e_blocking:
+        np.testing.assert_array_equal(
+            np.asarray(e_blocking[k]), np.asarray(e_async[k]), err_msg=k
+        )
+    _assert_collections_bitwise(blocking, served)
+    assert pipe.stats["dispatches"] == len(batches)
+    assert pipe.stats["errors"] == 0
+    pipe.close()
+
+
+def test_async_cohort_bit_identical_to_blocking():
+    batches = []
+    rng = np.random.RandomState(2)
+    for _ in range(4):
+        p = rng.rand(3, 32, 4).astype(np.float32)
+        p /= p.sum(-1, keepdims=True)
+        batches.append((jnp.asarray(p), jnp.asarray(rng.randint(4, size=(3, 32)))))
+
+    def cohort():
+        return MetricCohort(
+            MetricCollection(
+                [Accuracy(), Precision(num_classes=4, average="macro")]
+            ),
+            tenants=3,
+        )
+
+    blocking = cohort()
+    for p, t in batches:
+        blocking(p, t)
+    e_blocking = blocking.compute()
+
+    served = cohort()
+    pipe = AsyncServingEngine(served)
+    assert pipe.is_async, pipe.refusal_reason
+    for p, t in batches:
+        pipe.forward(p, t)
+    e_async = served.compute()  # the cohort's own compute drains first
+
+    for k in e_blocking:
+        np.testing.assert_array_equal(
+            np.asarray(e_blocking[k]), np.asarray(e_async[k]), err_msg=k
+        )
+    for name in blocking._states:
+        for sname, v in blocking._states[name].items():
+            np.testing.assert_array_equal(
+                np.asarray(v),
+                np.asarray(served._states[name][sname]),
+                err_msg=f"stacked {name}.{sname}",
+            )
+    pipe.close()
+
+
+def test_async_int8_sync_tier_bit_identical_to_blocking():
+    """The quantized tier composes: residual companions ride the async
+    dispatch stream exactly as they ride the blocking one."""
+    batches = _reg_batches(n=4, seed=3)
+    blocking = _reg_col(sync_precision="int8")
+    for p, t in batches:
+        blocking(p, t)
+    e_blocking = blocking.compute()
+
+    served = _reg_col(sync_precision="int8")
+    pipe = AsyncServingEngine(served)
+    assert pipe.is_async, pipe.refusal_reason
+    for p, t in batches:
+        pipe.forward(p, t)
+    e_async = pipe.compute()
+
+    for k in e_blocking:
+        np.testing.assert_array_equal(
+            np.asarray(e_blocking[k]), np.asarray(e_async[k]), err_msg=k
+        )
+    _assert_collections_bitwise(blocking, served)  # incl. __qres residuals
+    res_names = [
+        s for m in served.values() for s in m._sync_residual_names()
+    ]
+    assert res_names, "int8 tier registered no residual companions"
+    pipe.close()
+
+
+# ----------------------------------------------------------------------
+# 2. admission: the MTA009 gate
+# ----------------------------------------------------------------------
+def test_admission_refuses_double_buffer_hazard_classes():
+    from metrics_tpu.analysis.fixtures import DoubleBufferAliaser, HostReadOfDonated
+
+    for cls in (DoubleBufferAliaser, HostReadOfDonated):
+        pipe = AsyncServingEngine(cls())
+        assert not pipe.is_async
+        assert "MTA009" in pipe.refusal_reason
+        # the blocking path still serves (and returns values)
+        v = pipe.forward(jnp.ones(4))
+        assert v is not None
+        assert pipe.stats["blocking_steps"] == 1
+        with pytest.raises(ServingAdmissionError):
+            AsyncServingEngine(cls(), strict=True)
+
+
+def test_admission_refusal_counts_demotion_telemetry():
+    from metrics_tpu.analysis.fixtures import DoubleBufferAliaser
+
+    with obs.telemetry_scope():
+        AsyncServingEngine(DoubleBufferAliaser())
+        assert obs.get().counters.get("serving.demotions", 0) == 1
+
+
+def test_admission_refuses_engine_ineligible_members():
+    from metrics_tpu import PrecisionRecallCurve
+
+    pipe = AsyncServingEngine(PrecisionRecallCurve())  # cat-state: eager-only
+    assert not pipe.is_async
+    assert "engine-eligible" in pipe.refusal_reason
+
+
+# ----------------------------------------------------------------------
+# 3. barriers
+# ----------------------------------------------------------------------
+def test_compute_on_enrolled_collection_drains_staged_batches_first():
+    """The satellite contract: a DIRECT target.compute() while batches
+    are staged must fold every one of them in before computing."""
+    batches = _cls_batches(n=6, seed=4)
+    reference = _cls_col()
+    for p, t in batches:
+        reference(p, t)
+    e_ref = reference.compute()
+
+    served = _cls_col()
+    pipe = AsyncServingEngine(served)
+    for p, t in batches:
+        pipe.forward(p, t)
+    # no explicit drain: compute() itself is the barrier
+    e = served.compute()
+    for k in e_ref:
+        np.testing.assert_array_equal(np.asarray(e_ref[k]), np.asarray(e[k]), err_msg=k)
+    assert pipe.stats["dispatches"] == len(batches)
+    pipe.close()
+
+
+def test_drain_surfaces_bad_batch_error_once_and_keeps_state():
+    """A genuinely bad batch (shape mismatch) fails on the worker; the
+    error surfaces at the next barrier exactly once, earlier batches'
+    state is intact, and the pipeline keeps serving afterwards."""
+    good = _cls_batches(n=2, seed=5)
+    served = _cls_col()
+    pipe = AsyncServingEngine(served)
+    for p, t in good:
+        pipe.forward(p, t)
+    pipe.drain()
+    # mismatched rows: update()'s validation rejects it (trace AND eager)
+    bad_p, bad_t = good[0][0], good[1][1][:-7]
+    pipe.forward(bad_p, bad_t)
+    with pytest.raises(Exception):
+        pipe.drain()
+    assert pipe.stats["errors"] == 1
+    pipe.drain()  # the error was consumed; the barrier is clean now
+
+    reference = _cls_col()
+    for p, t in good:
+        reference(p, t)
+    _assert_collections_bitwise(reference, served)
+
+    pipe.forward(*good[0])  # still serving
+    pipe.drain()
+    assert pipe.stats["dispatches"] == len(good) + 1
+    pipe.close()
+
+
+# ----------------------------------------------------------------------
+# 4. the zero-overhead pin
+# ----------------------------------------------------------------------
+def test_never_enrolled_collection_is_untouched_by_serving():
+    """A collection never enrolled in a pipeline — even with a live
+    pipeline elsewhere in the process — runs bit-identically, compiles
+    the exact pre-PR program signature (no serving token), and generates
+    ZERO serving.* counter activity."""
+    batches = _cls_batches(n=3, seed=6)
+    control = _cls_col()
+    v_control = [control(p, t) for p, t in batches]
+    e_control = control.compute()
+
+    with obs.telemetry_scope():
+        other = _cls_col()
+        pipe = AsyncServingEngine(other)  # the live pipeline elsewhere
+        pipe.forward(*batches[0])
+
+        bystander = _cls_col()
+        v_by = [bystander(p, t) for p, t in batches]
+        e_by = bystander.compute()
+        pipe.close()
+        serving_counters = {
+            k: v for k, v in obs.get().counters.items() if k.startswith("serving.")
+        }
+
+    for va, vb in zip(v_control, v_by):
+        for k in va:
+            np.testing.assert_array_equal(np.asarray(va[k]), np.asarray(vb[k]))
+    for k in e_control:
+        np.testing.assert_array_equal(np.asarray(e_control[k]), np.asarray(e_by[k]))
+    # the bystander never touched the serving namespace...
+    assert bystander._serving_pipeline is None
+    # ...its compiled program identity is the pre-serving 7-tuple with no
+    # serving token (unpacking pins the arity)
+    (signature,) = list(bystander._engine._compiled)
+    names, precisions, guard_token, cohort, health, _treedef, _leaves = signature
+    assert guard_token is None and cohort is None and health is False
+    # ...and the pipeline's own activity is the ONLY serving telemetry
+    assert set(serving_counters) <= {"serving.dispatches", "serving.barriers"}
+
+
+def test_engine_step_fingerprints_match_committed_baseline():
+    """FINGERPRINTS.json no-drift pin for the serving PR: the audited
+    update/step program digests of representative families must equal
+    the committed baseline — the engine change (generation counter) is
+    host-side only and must not perturb any traced program."""
+    from metrics_tpu.analysis.program import audit_metric, registry_cases
+
+    with open(os.path.join(REPO, "FINGERPRINTS.json")) as f:
+        committed = json.load(f)["fingerprints"]
+    cases = {name: (factory, args) for name, factory, args in registry_cases()}
+    for family in ("Accuracy", "MeanSquaredError", "R2Score"):
+        factory, args = cases[family]
+        result = audit_metric(factory(), args, distributed=False, fingerprint=True)
+        assert result.fingerprints["update"] == committed[family]["update"], family
+        assert result.fingerprints["step"] == committed[family]["step"], family
+
+
+def test_dispatch_generation_advances_monotonically():
+    """The engine's generation handoff: one step = one generation,
+    advanced under the engine lock at write-back (what the async worker's
+    ping-pong is sequenced by)."""
+    col = _cls_col()
+    engine_gen = []
+    for p, t in _cls_batches(n=3, seed=7):
+        col(p, t)
+        engine_gen.append(col._engine.dispatch_generation)
+    assert engine_gen == [1, 2, 3]

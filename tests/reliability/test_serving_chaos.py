@@ -1,0 +1,338 @@
+"""Serving chaos bed (ISSUE 13): preemption mid-async-checkpoint resumes
+bit-identical and exactly-once, the admission queue's backpressure
+policies do what their names promise under a slow consumer, and
+shed-by-health never sheds a healthy tenant's rows silently (counter +
+exactly one flight dump per injected fault)."""
+import glob
+import os
+import tempfile
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import (
+    Accuracy,
+    F1,
+    MeanSquaredError,
+    MetricCohort,
+    MetricCollection,
+)
+from metrics_tpu.reliability import EvalSession
+from metrics_tpu.reliability.faultinject import (
+    Preempted,
+    preempt_at_step,
+    slow_consumer,
+)
+from metrics_tpu.serving import AsyncServingEngine, IngestOverflowError, IngestQueue
+
+pytestmark = pytest.mark.chaos
+
+
+def _cls_batches(n=8, seed=0, rows=64):
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(n):
+        p = rng.rand(rows, 4).astype(np.float32)
+        p /= p.sum(1, keepdims=True)
+        out.append((jnp.asarray(p), jnp.asarray(rng.randint(4, size=rows))))
+    return out
+
+
+def _col():
+    return MetricCollection(
+        [Accuracy(), F1(num_classes=4, average="macro")], compiled=True
+    )
+
+
+def _dumps(directory):
+    return sorted(glob.glob(os.path.join(directory, "*.json")))
+
+
+# ----------------------------------------------------------------------
+# 1. preemption mid-background-write: exactly-once, bit-identical
+# ----------------------------------------------------------------------
+def test_preempt_mid_background_write_resumes_bit_identical():
+    batches = _cls_batches(n=8, seed=1)
+    # the uninterrupted twin
+    twin = _col()
+    for p, t in batches:
+        twin(p, t)
+    e_twin = twin.compute()
+
+    with tempfile.TemporaryDirectory() as d, tempfile.TemporaryDirectory() as fd:
+        obs.enable_flight(fd)
+        try:
+            session = EvalSession(
+                _col(), d, checkpoint_every=2, background_checkpoints=True
+            )
+            # steps 0..3 land normally (generations at cursors 1 and 3)
+            for i in range(4):
+                session.step(i, *batches[i])
+            session.flush_checkpoints()
+            committed = [r["cursor"] for r in session.journal.records()]
+            assert committed == [1, 3]
+
+            with preempt_at_step(session, 6, during="background_write") as info:
+                session.step(4, *batches[4])
+                session.step(5, *batches[5])  # cadence fires: commit is TORN
+                session._bg.drain(timeout_s=10.0, raise_errors=False)
+                with pytest.raises(Preempted):
+                    session.step(6, *batches[6])
+            assert info["preempted_at"] == 6
+            assert info["torn_writes"] == 1
+            # the torn write was never visible to readers: a .tmp carcass
+            # exists, the manifest still ends at cursor 3
+            assert any(p.endswith(".tmp") for p in glob.glob(os.path.join(d, "*")))
+            assert [r["cursor"] for r in session.journal.records()] == [1, 3]
+            # exactly ONE flight dump for the injected fault
+            assert len(_dumps(fd)) == 1
+            with open(_dumps(fd)[0]) as f:
+                assert "background_checkpoint_failure" in f.read()
+            del session
+
+            # a fresh process resumes from the last COMMITTED generation
+            # and the replay guard makes the re-fed stream exactly-once
+            resumed = EvalSession(
+                _col(), d, checkpoint_every=2, background_checkpoints=True
+            )
+            cursor = resumed.resume()
+            assert cursor == 3
+            for i, (p, t) in enumerate(batches):
+                resumed.step(i, p, t)
+            assert resumed.stats["replays_skipped"] == 4
+            e_resumed = resumed.compute()
+            for k in e_twin:
+                np.testing.assert_array_equal(
+                    np.asarray(e_twin[k]), np.asarray(e_resumed[k]), err_msg=k
+                )
+            resumed.flush_checkpoints()
+        finally:
+            obs.disable_flight()
+
+
+def test_background_checkpoints_healthy_run_writes_zero_dumps():
+    batches = _cls_batches(n=6, seed=2)
+    with tempfile.TemporaryDirectory() as d, tempfile.TemporaryDirectory() as fd:
+        obs.enable_flight(fd)
+        try:
+            session = EvalSession(
+                _col(), d, checkpoint_every=2, background_checkpoints=True
+            )
+            for i, (p, t) in enumerate(batches):
+                session.step(i, p, t)
+            session.flush_checkpoints()
+            assert session._bg.stats["errors"] == 0
+            assert _dumps(fd) == []
+        finally:
+            obs.disable_flight()
+
+
+# ----------------------------------------------------------------------
+# 2. slow consumer: the backpressure drills
+# ----------------------------------------------------------------------
+def test_slow_consumer_block_policy_bounds_then_raises():
+    """A wedged wave (one tenant never contributes) under policy='block'
+    must bound-wait then raise typed — never hang, never drop."""
+    cohort = MetricCohort(Accuracy(), tenants=2)
+    q = IngestQueue(
+        cohort,
+        rows_per_step=8,
+        max_buffered_rows=16,
+        policy="block",
+        block_timeout_s=0.4,
+    )
+    rng = np.random.RandomState(0)
+    ids = np.zeros(16, dtype=np.int32)  # tenant 0 only: no wave can form
+    p = rng.rand(16).astype(np.float32)
+    q.submit(ids, p, (p > 0.5).astype(np.int32))
+    with pytest.raises(IngestOverflowError):
+        q.submit(ids, p, (p > 0.5).astype(np.int32))
+    assert q.stats["shed_rows"] == 0  # block never loses data
+
+
+def test_slow_consumer_delays_async_dispatches_but_loses_nothing():
+    served = _col()
+    pipe = AsyncServingEngine(served)
+    batches = _cls_batches(n=3, seed=3)
+    pipe.forward(*batches[0])  # admission proof + warm outside the drill
+    pipe.drain()
+    with slow_consumer(pipe, delay_s=0.05) as info:
+        for p, t in batches[1:]:
+            pipe.forward(p, t)
+        pipe.drain()
+    assert info["delayed"] == 2
+    assert pipe.stats["dispatches"] == 3
+    assert pipe.stats["errors"] == 0
+    reference = _col()
+    for p, t in batches:
+        reference(p, t)
+    for key in reference.keys():
+        for sname in reference[key]._defaults:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(reference[key], sname)),
+                np.asarray(getattr(served[key], sname)),
+            )
+    pipe.close()
+
+
+def test_slow_consumer_wraps_ingest_queue_target():
+    cohort = MetricCohort(Accuracy(), tenants=2)
+    q = IngestQueue(cohort, rows_per_step=8, max_buffered_rows=256)
+    rng = np.random.RandomState(1)
+    ids = np.tile(np.array([0, 1], dtype=np.int32), 8)
+    p = rng.rand(16).astype(np.float32)
+    with slow_consumer(q, delay_s=0.02) as info:
+        q.submit(ids, p, (p > 0.5).astype(np.int32))
+    assert info["delayed"] == 1
+    assert q.stats["dispatches"] == 1
+    assert q.buffered_rows == 0
+
+
+# ----------------------------------------------------------------------
+# 3. shed policies: loss is counted, healthy loss is LOUD
+# ----------------------------------------------------------------------
+def test_shed_oldest_counts_rows_and_writes_no_dump():
+    with tempfile.TemporaryDirectory() as fd:
+        obs.enable_flight(fd)
+        try:
+            cohort = MetricCohort(Accuracy(), tenants=2)
+            q = IngestQueue(
+                cohort, rows_per_step=8, max_buffered_rows=16, policy="shed_oldest"
+            )
+            rng = np.random.RandomState(2)
+            ids = np.zeros(16, dtype=np.int32)  # ragged: tenant 0 only
+            p = rng.rand(16).astype(np.float32)
+            q.submit(ids, p, (p > 0.5).astype(np.int32))
+            q.submit(ids, p, (p > 0.5).astype(np.int32))  # sheds the oldest 16
+            assert q.stats["shed_rows"] == 16
+            assert q.stats["shed_healthy_rows"] == 0
+            assert q.buffered_rows == 16
+            assert _dumps(fd) == []  # breadcrumb only, no dump
+        finally:
+            obs.disable_flight()
+
+
+def test_oversize_submission_rejected_before_any_shedding():
+    """A single submission larger than the bound can never be admitted —
+    it must raise up front, not shed other tenants' rows chasing an
+    unreachable target (review fix, pinned)."""
+    cohort = MetricCohort(Accuracy(), tenants=2)
+    q = IngestQueue(
+        cohort, rows_per_step=8, max_buffered_rows=16, policy="shed_oldest"
+    )
+    rng = np.random.RandomState(4)
+    ids = np.zeros(16, dtype=np.int32)
+    p = rng.rand(16).astype(np.float32)
+    q.submit(ids, p, (p > 0.5).astype(np.int32))
+    big = np.zeros(17, dtype=np.int32)
+    bp = rng.rand(17).astype(np.float32)
+    with pytest.raises(ValueError, match="max_buffered_rows"):
+        q.submit(big, bp, (bp > 0.5).astype(np.int32))
+    assert q.stats["shed_rows"] == 0
+    assert q.buffered_rows == 16
+
+
+def test_unknown_tenant_rejected_before_backpressure():
+    """Validation precedes destructive backpressure: a typo'd tenant id
+    must raise with ZERO rows shed or blocked-on (review fix, pinned)."""
+    cohort = MetricCohort(Accuracy(), tenants=2)
+    q = IngestQueue(
+        cohort, rows_per_step=8, max_buffered_rows=16, policy="shed_oldest"
+    )
+    rng = np.random.RandomState(5)
+    ids = np.zeros(16, dtype=np.int32)
+    p = rng.rand(16).astype(np.float32)
+    q.submit(ids, p, (p > 0.5).astype(np.int32))  # buffer at the bound
+    bad = np.full(8, 7, dtype=np.int32)  # slot 7 is not live
+    bp = rng.rand(8).astype(np.float32)
+    with pytest.raises(KeyError):
+        q.submit(bad, bp, (bp > 0.5).astype(np.int32))
+    assert q.stats["shed_rows"] == 0
+    assert q.buffered_rows == 16
+
+
+def test_parked_bg_error_survives_nonraising_drain_until_flush():
+    """A background-commit failure parked on the writer is NOT cleared by
+    a non-raising drain (resume's path); it surfaces at the next raising
+    barrier (review fix, pinned)."""
+    batches = _cls_batches(n=2, seed=6)
+    with tempfile.TemporaryDirectory() as d:
+        session = EvalSession(
+            _col(), d, checkpoint_every=None, background_checkpoints=True
+        )
+        session.step(0, *batches[0])
+
+        def failing_commit(job):
+            raise OSError("injected disk-full")
+
+        session._bg._commit_job = failing_commit
+        try:
+            session.checkpoint()
+            session._bg.drain(timeout_s=10.0, raise_errors=False)  # parked, kept
+        finally:
+            del session._bg._commit_job
+        with pytest.raises(OSError, match="disk-full"):
+            session.flush_checkpoints()
+        session.flush_checkpoints()  # consumed by the raising barrier
+
+
+def test_session_close_stops_writer_and_falls_back_to_sync():
+    batches = _cls_batches(n=3, seed=7)
+    with tempfile.TemporaryDirectory() as d:
+        session = EvalSession(
+            _col(), d, checkpoint_every=1, background_checkpoints=True
+        )
+        session.step(0, *batches[0])
+        session.close()
+        assert session._bg is None
+        session.step(1, *batches[1])  # cadence checkpoint: synchronous now
+        assert [r["cursor"] for r in session.journal.records()][-1] == 1
+
+
+def test_shed_by_health_sheds_poisoned_first_and_healthy_loss_is_loud():
+    with tempfile.TemporaryDirectory() as fd:
+        obs.enable_flight(fd)
+        try:
+            with obs.telemetry_scope():
+                cohort = MetricCohort(MeanSquaredError(), tenants=2, track_health=True)
+                rng = np.random.RandomState(3)
+                # poison tenant 1 in-dispatch: NaN rows -> nonfinite state,
+                # counted by the health accumulators riding the dispatch
+                x = rng.rand(2, 8).astype(np.float32)
+                bad = x.copy()
+                bad[1, 0] = np.nan
+                cohort(jnp.asarray(bad), jnp.asarray(x))
+                health = cohort.health()
+                assert int(health["nonfinite"][1]) > 0  # tenant 1 poisoned
+
+                q = IngestQueue(
+                    cohort,
+                    rows_per_step=8,
+                    max_buffered_rows=16,
+                    policy="shed_by_health",
+                )
+                # fill the buffer with the POISONED tenant's ragged rows
+                ids1 = np.ones(16, dtype=np.int32)
+                p = rng.rand(16).astype(np.float32)
+                q.submit(ids1, p, p)
+                # healthy tenant's rows overflow: the poisoned tenant's
+                # buffer sheds FIRST — no healthy loss, no dump
+                ids0 = np.zeros(16, dtype=np.int32)
+                q.submit(ids0, p, p)
+                assert q.stats["shed_rows"] == 16
+                assert q.stats["shed_healthy_rows"] == 0
+                assert _dumps(fd) == []
+                # now ONLY healthy rows remain buffered; the next overflow
+                # must shed them — loudly: counter + exactly one dump
+                q.submit(ids0, p, p)
+                assert q.stats["shed_healthy_rows"] == 16
+                assert q.stats["shed_rows"] == 32
+                assert len(_dumps(fd)) == 1
+                with open(_dumps(fd)[0]) as f:
+                    assert "ingest_shed_healthy" in f.read()
+                counters = obs.get().counters
+                assert counters.get("serving.ingest.shed_healthy_rows") == 16
+        finally:
+            obs.disable_flight()
