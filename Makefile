@@ -144,33 +144,34 @@ bench-cohort:
 serve-bench:
 	# continuous-serving legs (~2 min): steady-state per-step metric
 	# overhead of a live serve loop at 1M rows — blocking forward vs the
-	# async double-buffered pipeline (metrics_tpu/serving/) — plus the
-	# ingest-queue throughput leg. The sentinel gates the deterministic
+	# async double-buffered pipeline (metrics_tpu/serving/) — now with
+	# p50/p95/p99 tail legs and the cold-process first-dispatch leg
+	# (advisory). The sentinel gates the deterministic
 	# serving_overhead_ratio bound (async ≤ 0.5× blocking overhead)
 	# strictly; ms legs compare against the committed BENCH_r07.json
-	# round. Then the exporter smoke: telemetry + /metrics armed, a short
-	# IngestQueue drive behind an AsyncServingEngine, ONE scrape saved
-	# and validated via `metrics_exporter.py --check` with the serving
-	# queue-depth gauge required present. Writes SENTINEL_serving.json;
-	# CI uploads bench_serving.json + the scrape as artifacts.
+	# round. Then the SLO-observability demo (scripts/serving_demo.py):
+	# telemetry + tracing + cost ledger + /metrics armed over an
+	# IngestQueue → AsyncServingEngine(+ServingSLO) → MetricCohort drive
+	# with one flow-stamped background checkpoint — it writes ONE merged
+	# flow-event Perfetto trace (a chosen batch followable admission →
+	# queue → dispatch → write-back → checkpoint-commit across all three
+	# threads), one live scrape, and the cost-ledger JSON, self-checking
+	# each. The scrape is then re-gated through `metrics_exporter.py
+	# --check` with the serving-SLO/latency/compile families REQUIRED
+	# present. Writes SENTINEL_serving.json; CI uploads
+	# bench_serving.json + the scrape + trace + ledger as artifacts.
 	METRICS_TPU_FLIGHT=flight-dumps python bench.py --leg-serving | tee bench_serving.txt
 	tail -n 1 bench_serving.txt > bench_serving.json
 	python scripts/perf_sentinel.py --current bench_serving.json --strict-bounds --out SENTINEL_serving.json
-	python -c "import urllib.request, numpy as np; \
-		import metrics_tpu as M, metrics_tpu.observability as obs; \
-		from metrics_tpu.serving import AsyncServingEngine, IngestQueue; \
-		obs.enable(); ex = obs.enable_exporter(0); \
-		cohort = M.MetricCohort(M.Accuracy(), tenants=8); \
-		pipe = AsyncServingEngine(cohort); \
-		q = IngestQueue(pipe, rows_per_step=32, max_buffered_rows=4096); \
-		rng = np.random.RandomState(0); \
-		ids = np.tile(np.arange(8), 32); p = rng.rand(256).astype('float32'); \
-		q.submit(ids, p, (p > 0.5).astype('int32')); pipe.drain(); \
-		t = urllib.request.urlopen(ex.url, timeout=5).read().decode(); \
-		open('metrics_scrape_serving.txt', 'w').write(t); \
-		assert 'metrics_tpu_serving_queue_depth' in t, 'queue-depth gauge missing from scrape'; \
-		pipe.close(); obs.disable_exporter(); print('serving scrape: OK')"
-	python scripts/metrics_exporter.py --check metrics_scrape_serving.txt
+	python scripts/serving_demo.py --out metrics_scrape_serving.txt \
+		--trace-out bench-traces --ledger-out cost_ledger.json
+	python scripts/metrics_exporter.py --check metrics_scrape_serving.txt \
+		--require 'metrics_tpu_serving_slo_*' \
+		--require 'metrics_tpu_serving_latency_*' \
+		--require metrics_tpu_serving_queue_depth \
+		--require metrics_tpu_serving_queue_age_ms \
+		--require 'metrics_tpu_engine_compile_*' \
+		--require 'metrics_tpu_engine_program_*'
 
 sentinel:
 	# perf-regression sentinel, STRICT: fresh bench.py run compared per leg
@@ -213,5 +214,5 @@ dryrun:
 clean:
 	rm -rf .pytest_cache .jax_cache flight-dumps bench-traces san-flight-dumps
 	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json bench_cohort.txt bench_cohort.json ANALYSIS_current.json
-	rm -f bench_serving.txt bench_serving.json SENTINEL_serving.json metrics_scrape_serving.txt
+	rm -f bench_serving.txt bench_serving.json SENTINEL_serving.json metrics_scrape_serving.txt cost_ledger.json
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -692,6 +692,15 @@ def _serving_leg() -> None:
     Plus the queue-throughput leg: flat tagged rows through an
     :class:`~metrics_tpu.serving.IngestQueue` into a 64-tenant cohort
     (route_rows micro-batching + coalescing), reported as rows/second.
+
+    ISSUE 14 additions: per-step latency is recorded as a DISTRIBUTION,
+    not just a mean — p50/p95/p99 through the shared fixed-bucket
+    estimator (``obs.percentile`` over ``LATENCY_BUCKETS_MS``, the same
+    estimator the export surface and SLO burn gauges use) — and the very
+    first compiled dispatch of this fresh subprocess is timed as
+    ``serving_cold_first_dispatch_ms``: the trace+compile+dispatch cost
+    every restarted serving process pays before its first answer (the
+    cold-start number ROADMAP item 5's AOT work gates on; advisory).
     """
     import os
 
@@ -708,7 +717,23 @@ def _serving_leg() -> None:
         Precision,
         Recall,
     )
+    from metrics_tpu.observability.telemetry import (
+        LATENCY_BUCKETS_MS,
+        Telemetry,
+        percentile,
+    )
     from metrics_tpu.serving import AsyncServingEngine, IngestQueue
+
+    def _pcts(samples_ms):
+        """p50/p95/p99 of a sample list via the SHARED fixed-bucket
+        estimator (a local Telemetry instance — the global registry
+        stays untouched, preserving the bench's telemetry:null
+        contract)."""
+        tel = Telemetry()
+        for s in samples_ms:
+            tel.observe_hist("leg", s, LATENCY_BUCKETS_MS)
+        h = tel.histograms["leg"]
+        return {q: percentile(h, q) for q in (50, 95, 99)}
 
     n = int(os.environ.get("BENCH_SERVING_N", 1_000_000))
     steps = int(os.environ.get("BENCH_SERVING_STEPS", 12))
@@ -735,9 +760,18 @@ def _serving_leg() -> None:
             for sname in m._defaults:
                 jax.block_until_ready(getattr(m, sname))
 
-    # calibrate: the raw blocking metric cost on this host
+    # calibrate: the raw blocking metric cost on this host. This first
+    # forward is ALSO the cold-first-dispatch measurement: a fresh
+    # process (this subprocess is one) pays trace + compile + dispatch
+    # before its first answer
     blocking = col()
+    t0 = time.perf_counter()
     run_blocking(blocking)  # warm: trace + compile + transfers
+    print(
+        "SERVING_COLD_FIRST_DISPATCH_MS",
+        (time.perf_counter() - t0) * 1e3,
+        flush=True,
+    )
     best = 1e9
     for _ in range(3):
         t0 = time.perf_counter()
@@ -755,28 +789,40 @@ def _serving_leg() -> None:
     print("SERVING_MODEL_MS", model_ms, flush=True)
     print("SERVING_METRIC_MS", metric_ms, flush=True)
 
-    # blocking serve loop
+    # blocking serve loop (per-step samples feed the percentile legs)
     blocking = col()
     run_blocking(blocking)  # warm the fresh collection's program
+    samples = []
     t0 = time.perf_counter()
     for _ in range(steps):
+        t1 = time.perf_counter()
         time.sleep(model_s)
         run_blocking(blocking)
+        samples.append((time.perf_counter() - t1) * 1e3)
     per_step_blocking = (time.perf_counter() - t0) / steps * 1e3
     print("SERVING_BLOCKING_STEP_MS", per_step_blocking, flush=True)
+    for q, v in _pcts(samples).items():
+        print(f"SERVING_BLOCKING_P{q}", v, flush=True)
 
-    # async serve loop (drain barrier INCLUDED in the timed window)
+    # async serve loop (drain barrier INCLUDED in the timed window; the
+    # per-step samples cover sleep + stage — the latency the serve loop
+    # actually experiences per step, the tail the SLO surface watches)
     served = col()
     pipe = AsyncServingEngine(served)
     pipe.forward(probs, target)  # warm: MTA009 proof + trace + compile
     pipe.drain()
+    samples = []
     t0 = time.perf_counter()
     for _ in range(steps):
+        t1 = time.perf_counter()
         time.sleep(model_s)
         pipe.forward(probs, target)
+        samples.append((time.perf_counter() - t1) * 1e3)
     pipe.drain()
     per_step_async = (time.perf_counter() - t0) / steps * 1e3
     print("SERVING_ASYNC_STEP_MS", per_step_async, flush=True)
+    for q, v in _pcts(samples).items():
+        print(f"SERVING_ASYNC_P{q}", v, flush=True)
     pipe.close()
 
     # queue throughput: flat tagged rows -> route_rows waves -> cohort
@@ -825,6 +871,9 @@ def _bench_serving() -> dict:
     step_blocking = float(_marker_values(out, "SERVING_BLOCKING_STEP_MS", "serving")[0])
     step_async = float(_marker_values(out, "SERVING_ASYNC_STEP_MS", "serving")[0])
     rows_per_s = float(_marker_values(out, "SERVING_INGEST_ROWS_PER_S", "serving")[0])
+    cold_ms = float(
+        _marker_values(out, "SERVING_COLD_FIRST_DISPATCH_MS", "serving")[0]
+    )
     overhead_blocking = max(step_blocking - model_ms, 0.0)
     overhead_async = max(step_async - model_ms, 0.0)
     result = {
@@ -835,7 +884,19 @@ def _bench_serving() -> dict:
         "serving_blocking_overhead_ms": round(overhead_blocking, 3),
         "serving_async_overhead_ms": round(overhead_async, 3),
         "serving_ingest_krows_per_s": round(rows_per_s / 1e3, 1),
+        # the cold-start SLO a warm LRU never measures: this fresh
+        # subprocess's first compiled dispatch (trace+compile+run)
+        "serving_cold_first_dispatch_ms": round(cold_ms, 3),
     }
+    # tail-latency legs: the per-step distribution, not just the mean
+    # (estimated through the shared fixed-bucket percentile helper)
+    for q in (50, 95, 99):
+        result[f"serving_blocking_step_p{q}_ms"] = round(
+            float(_marker_values(out, f"SERVING_BLOCKING_P{q}", "serving")[0]), 3
+        )
+        result[f"serving_async_step_p{q}_ms"] = round(
+            float(_marker_values(out, f"SERVING_ASYNC_P{q}", "serving")[0]), 3
+        )
     if overhead_blocking > 0:
         result["serving_overhead_ratio"] = round(
             overhead_async / overhead_blocking, 4

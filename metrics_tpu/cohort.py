@@ -50,6 +50,7 @@ from metrics_tpu.metric import Metric, _device_owned, _san_allow_ctx
 from metrics_tpu.observability import exporter as _exporter
 from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.observability import trace as _trace
 from metrics_tpu.parallel import hierarchy as _hier
 from metrics_tpu.parallel import quantize as _quant
 from metrics_tpu.parallel.backend import get_sync_backend, is_distributed_initialized
@@ -618,14 +619,21 @@ class MetricCohort:
         for m in self._template.values():
             m._to_sync = False
         try:
-            new_states, values, finites, guard, new_health = self._engine.cohort_step(
-                states,
-                stacked_args,
-                stacked_kwargs,
-                capacity=self._capacity,
-                n_tenants=n,
-                health_state=health_state,
-            )
+            # host-side span around the whole vmapped dispatch: carries
+            # the caller's pinned flow (an ingest wave's submission ids),
+            # so a wave into a DIRECT cohort — no async pipeline — still
+            # produces a flow-linked dispatch span on the caller thread
+            with _trace.span(
+                "cohort.forward", phase="dispatch", tenants=n, capacity=self._capacity
+            ):
+                new_states, values, finites, guard, new_health = self._engine.cohort_step(
+                    states,
+                    stacked_args,
+                    stacked_kwargs,
+                    capacity=self._capacity,
+                    n_tenants=n,
+                    health_state=health_state,
+                )
         except Exception:
             self._check_states_alive()
             raise

@@ -51,6 +51,7 @@ import jax.numpy as jnp
 
 from metrics_tpu.functional.regression.sufficient_stats import regression_family_sharing
 from metrics_tpu.metric import Metric
+from metrics_tpu.observability import costledger as _costledger
 from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
 from metrics_tpu.observability import trace as _trace
@@ -514,7 +515,7 @@ class CompiledStepEngine:
                     names, args, kwargs, guard_token, cohort=int(capacity),
                     health=health,
                 )
-                fn, cache_hit = self._get_compiled(
+                fn, cache_hit, cold = self._get_compiled(
                     signature,
                     names,
                     guard_token,
@@ -529,7 +530,18 @@ class CompiledStepEngine:
                 tel.count("cohort.dispatches")
                 if n_tenants is not None:
                     tel.count("cohort.dispatch_tenants", n_tenants)
-                t0 = _time.perf_counter()
+            t0 = _time.perf_counter() if not cache_hit else None
+            # cost-ledger input capture must precede the dispatch: the
+            # dispatch donates these buffers, and the ledger's abstract
+            # re-trace needs their shapes after the real arrays are gone
+            ledger_inputs = None
+            if not cache_hit and _costledger.cost_ledger_enabled():
+                dispatch_args = (
+                    (states, args, kwargs)
+                    if aux is None
+                    else (states, args, kwargs, aux)
+                )
+                ledger_inputs = _costledger.shape_tree(dispatch_args)
             if _flight.flight_enabled():
                 _flight.record(
                     "cohort_dispatch",
@@ -557,8 +569,21 @@ class CompiledStepEngine:
                 if telemetry_on:
                     _obs.get().count("engine.trace_failures")
                 raise
-            if telemetry_on and not cache_hit:
-                _obs.get().observe("engine.trace_s", _time.perf_counter() - t0)
+            if not cache_hit:
+                dt = _time.perf_counter() - t0
+                if telemetry_on:
+                    _obs.get().observe("engine.trace_s", dt)
+                _costledger.note_compile(
+                    self._cohort_watch_key,
+                    "cohort_step",
+                    signature,
+                    dt,
+                    cold,
+                    lambda: self._make_cohort_step_fn(
+                        names, guard_token, observe=False, health=health
+                    ),
+                    ledger_inputs,
+                )
             self.dispatch_generation += 1
         if guard_token is None:
             new_states, values = out
@@ -718,11 +743,16 @@ class CompiledStepEngine:
         names: Tuple[str, ...],
         guard_token: Optional[str] = None,
         maker: Optional[Callable] = None,
-    ) -> Tuple[Callable, bool]:
-        """Returns ``(step_fn, cache_hit)`` for the signature. ``maker``
-        overrides the step-program factory (the cohort path passes
-        :meth:`_make_cohort_step_fn`); plain and cohort programs share one
-        LRU — their signatures differ by the cohort token."""
+    ) -> Tuple[Callable, bool, bool]:
+        """Returns ``(step_fn, cache_hit, cold)`` for the signature.
+        ``maker`` overrides the step-program factory (the cohort path
+        passes :meth:`_make_cohort_step_fn`); plain and cohort programs
+        share one LRU — their signatures differ by the cohort token.
+        ``cold`` (meaningful on misses) classifies the compile for the
+        cost ledger: True for a genuinely NEW signature — the trace +
+        compile a fresh process pays on every restart, the number the
+        ROADMAP's AOT work gates on — False for a re-compile of a
+        signature this process already built (LRU thrash)."""
         hit = self._compiled.get(signature)
         if hit is not None:
             self._compiled.move_to_end(signature)
@@ -730,14 +760,15 @@ class CompiledStepEngine:
                 tel = _obs.get()
                 tel.count("engine.cache_hits")
                 tel.watchdog.note_steady(self._watch_key)
-            return hit, True
+            return hit, True, False
+        cold = signature not in self._seen_signatures
         if _obs.enabled():
             tel = _obs.get()
             tel.count("engine.cache_misses")
             # full signature knowledge lives here: a miss for a signature
             # compiled before is LRU thrash, which the watchdog flags
             # immediately; a genuinely new signature is a legitimate compile
-            tel.watchdog.note_compile(self._watch_key, signature not in self._seen_signatures)
+            tel.watchdog.note_compile(self._watch_key, cold)
         if len(self._seen_signatures) >= 4096:
             self._seen_signatures.clear()  # polymorphic caller: stay bounded
         self._seen_signatures.add(signature)
@@ -748,7 +779,7 @@ class CompiledStepEngine:
                 _obs.get().count("engine.cache_evictions")
                 _obs.get().event("cache_eviction", engine=self._watch_key)
         self._compiled[signature] = fn
-        return fn, False
+        return fn, False, cold
 
     # ------------------------------------------------------------------
     # state pytree plumbing
@@ -828,7 +859,7 @@ class CompiledStepEngine:
                     "engine.cache_lookup", phase="dispatch", engine=self._watch_key
                 ):
                     signature = self._signature(names, args, kwargs, guard_token)
-                    fn, cache_hit = self._get_compiled(signature, names, guard_token)
+                    fn, cache_hit, cold = self._get_compiled(signature, names, guard_token)
                 # guard-active steps donate COPIES so the live attributes
                 # double as a last-good snapshot (restorable if the dispatch
                 # fails after donation); unguarded steps keep the pristine
@@ -842,7 +873,13 @@ class CompiledStepEngine:
                     )
                 if telemetry_on:
                     _obs.get().count("engine.dispatches")
-                    t0 = _time.perf_counter()
+                t0 = _time.perf_counter() if not cache_hit else None
+                # ledger input capture BEFORE the dispatch donates the
+                # state buffers (shape/dtype survive donation; data does
+                # not — see costledger.shape_tree)
+                ledger_inputs = None
+                if not cache_hit and _costledger.cost_ledger_enabled():
+                    ledger_inputs = _costledger.shape_tree((states, args, kwargs))
                 try:
                     with _trace.span(
                         "engine.dispatch",
@@ -925,9 +962,21 @@ class CompiledStepEngine:
                     except Exception:  # noqa: BLE001 — best-effort hook
                         pass
                     return self._finish(out_eager)
-                if telemetry_on and not cache_hit:
-                    # miss executions carry the trace + compile cost
-                    _obs.get().observe("engine.trace_s", _time.perf_counter() - t0)
+                if not cache_hit:
+                    # miss executions carry the trace + compile cost —
+                    # the number the cost ledger records per program
+                    dt = _time.perf_counter() - t0
+                    if telemetry_on:
+                        _obs.get().observe("engine.trace_s", dt)
+                    _costledger.note_compile(
+                        self._watch_key,
+                        "step",
+                        signature,
+                        dt,
+                        cold,
+                        lambda: self._make_step_fn(names, guard_token, observe=False),
+                        ledger_inputs,
+                    )
                 self._write_back(names, new_states, values)
                 if finites is not None:
                     self._apply_guard_verdicts(guard, names, finites)

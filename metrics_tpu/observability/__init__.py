@@ -29,6 +29,14 @@ from metrics_tpu.observability.exporter import (  # noqa: F401
     parse_prometheus_text,
     render_exposition,
 )
+from metrics_tpu.observability.costledger import (  # noqa: F401
+    CostLedger,
+    cost_ledger_enabled,
+    cost_ledger_scope,
+    disable_cost_ledger,
+    enable_cost_ledger,
+    get_ledger,
+)
 from metrics_tpu.observability.flight import (  # noqa: F401
     FlightRecorder,
     disable_flight,
@@ -59,9 +67,12 @@ from metrics_tpu.observability.telemetry import (  # noqa: F401
 from metrics_tpu.observability.trace import (  # noqa: F401
     PHASES,
     TraceRecorder,
+    current_flow,
     disable_tracing,
     enable_tracing,
+    flow_scope,
     get_tracer,
+    next_batch_id,
     step_scope,
     tracing_enabled,
     tracing_scope,
@@ -87,6 +98,9 @@ __all__ = [
     "tracing_scope",
     "get_tracer",
     "step_scope",
+    "flow_scope",
+    "current_flow",
+    "next_batch_id",
     "PHASES",
     "enable_flight",
     "disable_flight",
@@ -95,6 +109,12 @@ __all__ = [
     "get_flight",
     "LATENCY_BUCKETS_MS",
     "PAYLOAD_BUCKETS_BYTES",
+    "CostLedger",
+    "enable_cost_ledger",
+    "disable_cost_ledger",
+    "cost_ledger_enabled",
+    "cost_ledger_scope",
+    "get_ledger",
     "MetricsExporter",
     "enable_exporter",
     "disable_exporter",

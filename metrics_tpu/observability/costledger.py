@@ -1,0 +1,332 @@
+"""Compiled-program cost ledger: what each program cost to build and run.
+
+ROADMAP item 5 (cold-start-free rollouts) gates on evidence the engine
+did not record until now: how many programs a process compiles, which of
+them are *cold* (a genuinely new signature — the trace+compile a fresh
+process pays on every deploy/preemption/autoscale) versus *warm* (a
+re-compile of a signature this process already built once — LRU thrash,
+or a persistent-compilation-cache hit on a real fleet), how long each
+compile took, and what the resulting program costs per dispatch. This
+module is that ledger, in two tiers:
+
+1. **Always-on-with-telemetry counters** (cheap — no extra tracing):
+   every signature-cache miss the engine resolves counts
+   ``engine.compile.cold`` or ``engine.compile.warm``, observes the
+   compile wall time into the ``engine.compile_ms`` histogram
+   (trace + compile + first execution — the cold-first-dispatch latency
+   a restarting fleet actually pays), and mirrors the running totals as
+   ``engine.programs.{cold,warm}`` gauges for the export surface.
+2. **The armed ledger** (``enable_cost_ledger()`` /
+   ``METRICS_TPU_COST_LEDGER=1``): per compiled program — keyed by the
+   PR 8 jaxpr fingerprint (`fingerprint_jaxpr`), so the same digests the
+   drift sentinel (FINGERPRINTS.json) and the future AOT executable
+   cache key on — record compile wall time, warm/cold classification,
+   and XLA ``cost_analysis()`` flops / bytes-accessed from an abstract
+   lowering of the exact program the engine jitted. Read it back with
+   :meth:`CostLedger.report` / :meth:`CostLedger.to_json`; the export
+   surface renders one ``metrics_tpu_engine_program_*`` family set per
+   program, and flight dumps at dispatch-failure sites attach the
+   ledger, so "which program was this process fighting with" rides the
+   same artifact as the failure.
+
+Standing pins: OFF by default; the disarmed state adds nothing to any
+traced/compiled program (the armed state's extra abstract trace/lowering
+never touches the engine's signature cache, trace counters, or the
+watchdog — ``observe=False`` programs); recording never raises into the
+dispatch path.
+"""
+import json
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.utilities.env import cost_ledger_requested
+
+__all__ = [
+    "CostLedger",
+    "enable_cost_ledger",
+    "disable_cost_ledger",
+    "cost_ledger_enabled",
+    "cost_ledger_scope",
+    "get_ledger",
+    "note_compile",
+    "shape_tree",
+]
+
+
+def shape_tree(tree: Any) -> Any:
+    """Donation-proof input capture: array leaves become
+    ``jax.ShapeDtypeStruct`` (shape/dtype only — valid after the real
+    buffers are donated and deleted), everything else passes through.
+    Call BEFORE the dispatch that donates."""
+    import jax
+
+    def _leaf(x: Any) -> Any:
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(_leaf, tree)
+
+
+class CostLedger:
+    """Per-program compile/cost records, keyed by jaxpr fingerprint.
+
+    Thread-safe (the engine notes compiles from whichever thread
+    dispatched — the serve loop, an async serving worker).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # fingerprint -> record
+        self._entries: "Dict[str, Dict[str, Any]]" = {}
+
+    # ------------------------------------------------------------------
+    # recording
+    # ------------------------------------------------------------------
+    def record(
+        self,
+        engine: str,
+        kind: str,
+        signature: tuple,
+        wall_s: float,
+        cold: bool,
+        program: Callable[[], Callable],
+        example_inputs: Optional[tuple],
+    ) -> Optional[str]:
+        """One compiled-signature record: fingerprint the program's
+        jaxpr, cost-analyze its lowering, fold into the per-program
+        entry. Best-effort by contract — any analysis failure degrades
+        to an ``unanalyzable:`` key and never raises into the dispatch
+        path. Returns the entry key."""
+        try:
+            fingerprint, cost = self._analyze(program, example_inputs)
+        except Exception as err:  # noqa: BLE001 — diagnostics must not raise
+            fingerprint, cost = f"unanalyzable:{type(err).__name__}", None
+        with self._lock:
+            e = self._entries.get(fingerprint)
+            if e is None:
+                e = self._entries[fingerprint] = {
+                    "fingerprint": fingerprint,
+                    "engine": engine,
+                    "kind": kind,
+                    "compiles": 0,
+                    "cold_compiles": 0,
+                    "warm_compiles": 0,
+                    "compile_ms_total": 0.0,
+                    "last_compile_ms": 0.0,
+                    "flops": None,
+                    "bytes_accessed": None,
+                    "signatures": set(),
+                    "first_compiled_at": time.time(),
+                }
+            e["compiles"] += 1
+            e["cold_compiles" if cold else "warm_compiles"] += 1
+            e["compile_ms_total"] += wall_s * 1e3
+            e["last_compile_ms"] = wall_s * 1e3
+            e["signatures"].add(hash(signature))
+            if cost is not None:
+                e["flops"], e["bytes_accessed"] = cost
+        return fingerprint
+
+    @staticmethod
+    def _analyze(program, example_inputs):
+        """(fingerprint, (flops, bytes)) for the exact program shape the
+        engine jitted: one abstract trace for the PR 8 jaxpr digest, one
+        lowering for XLA's cost model. Neither compiles, dispatches, or
+        touches any cache/watchdog accounting (observe=False programs,
+        ShapeDtypeStruct inputs)."""
+        import jax
+
+        from metrics_tpu.analysis.distributed import fingerprint_jaxpr
+        from metrics_tpu.utilities.jit import tpu_jit
+
+        if example_inputs is None:
+            raise ValueError("no example inputs captured")
+        fn = program()
+        closed = jax.make_jaxpr(fn)(*example_inputs)
+        fingerprint = fingerprint_jaxpr(closed)
+        cost = None
+        try:
+            lowered = tpu_jit(fn, donate_argnums=(0,)).lower(*example_inputs)
+            analysis = lowered.cost_analysis()
+            if isinstance(analysis, (list, tuple)):
+                analysis = analysis[0] if analysis else {}
+            flops = analysis.get("flops")
+            nbytes = analysis.get("bytes accessed")
+            cost = (
+                None if flops is None else float(flops),
+                None if nbytes is None else float(nbytes),
+            )
+        except Exception:  # noqa: BLE001 — cost model is advisory
+            cost = None
+        return fingerprint, cost
+
+    # ------------------------------------------------------------------
+    # reading
+    # ------------------------------------------------------------------
+    def entries(self) -> List[Dict[str, Any]]:
+        """JSON-shaped records, most-compiled first (``signatures``
+        collapses to its distinct count)."""
+        with self._lock:
+            out = []
+            for e in self._entries.values():
+                rec = dict(e)
+                rec["signatures"] = len(e["signatures"])
+                rec["compile_ms_total"] = round(rec["compile_ms_total"], 3)
+                rec["last_compile_ms"] = round(rec["last_compile_ms"], 3)
+                out.append(rec)
+        out.sort(key=lambda r: (-r["compiles"], r["fingerprint"]))
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        entries = self.entries()
+        return {
+            "format": "metrics_tpu.cost_ledger",
+            "schema_version": 1,
+            "programs": len(entries),
+            "cold_compiles": sum(e["cold_compiles"] for e in entries),
+            "warm_compiles": sum(e["warm_compiles"] for e in entries),
+            "entries": entries,
+        }
+
+    def brief(self) -> Dict[str, Any]:
+        """The compact form flight dumps carry: one row per program."""
+        return {
+            e["fingerprint"][:16]: {
+                "engine": e["engine"],
+                "kind": e["kind"],
+                "compiles": e["compiles"],
+                "cold": e["cold_compiles"],
+                "last_compile_ms": e["last_compile_ms"],
+                "flops": e["flops"],
+                "bytes_accessed": e["bytes_accessed"],
+            }
+            for e in self.entries()
+        }
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.snapshot(), indent=indent, sort_keys=True)
+
+    def report(self) -> str:
+        """Human-readable per-program table."""
+        entries = self.entries()
+        lines = ["metrics_tpu compiled-program cost ledger", "=" * 40]
+        if not entries:
+            lines.append("(no compiles recorded — is the ledger armed?)")
+            return "\n".join(lines)
+        lines.append(
+            f"{'program':<18} {'kind':<12} {'compiles':>8} {'cold':>5}"
+            f" {'last ms':>9} {'Mflops':>9} {'MB acc':>8}  engine"
+        )
+        for e in entries:
+            mflops = "-" if e["flops"] is None else f"{e['flops'] / 1e6:.2f}"
+            mb = (
+                "-"
+                if e["bytes_accessed"] is None
+                else f"{e['bytes_accessed'] / 1e6:.2f}"
+            )
+            lines.append(
+                f"{e['fingerprint'][:16]:<18} {e['kind']:<12}"
+                f" {e['compiles']:>8} {e['cold_compiles']:>5}"
+                f" {e['last_compile_ms']:>9.2f} {mflops:>9} {mb:>8}"
+                f"  {e['engine']}"
+            )
+        lines.append(
+            f"{len(entries)} program(s);"
+            f" cold={sum(e['cold_compiles'] for e in entries)}"
+            f" warm={sum(e['warm_compiles'] for e in entries)}"
+        )
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+# ----------------------------------------------------------------------
+# module-level singleton + enable/disable switch (telemetry's shape)
+# ----------------------------------------------------------------------
+_ledger = CostLedger()
+_enabled = False
+
+
+def get_ledger() -> CostLedger:
+    """The process-local ledger (valid whether or not recording is on)."""
+    return _ledger
+
+
+def cost_ledger_enabled() -> bool:
+    """The ONE check the engine's miss path makes; a plain global read."""
+    return _enabled
+
+
+def enable_cost_ledger() -> CostLedger:
+    """Arm per-program recording (idempotent). The cheap
+    ``engine.compile.*`` counters ride the telemetry switch regardless;
+    arming buys the fingerprint/cost entries (one extra abstract trace +
+    lowering per NEW signature — never on the steady-state path)."""
+    global _enabled
+    _enabled = True
+    return _ledger
+
+
+def disable_cost_ledger() -> None:
+    """Disarm. Recorded entries stay readable via :func:`get_ledger`."""
+    global _enabled
+    _enabled = False
+
+
+@contextmanager
+def cost_ledger_scope(fresh: bool = True) -> Iterator[CostLedger]:
+    """Arm the ledger for a ``with`` block, restoring the prior state on
+    exit; ``fresh=True`` (default) clears it on entry."""
+    global _enabled
+    prior = _enabled
+    ledger = enable_cost_ledger()
+    if fresh:
+        ledger.reset()
+    try:
+        yield ledger
+    finally:
+        _enabled = prior
+
+
+# ----------------------------------------------------------------------
+# the engine hook
+# ----------------------------------------------------------------------
+def note_compile(
+    engine: str,
+    kind: str,
+    signature: tuple,
+    wall_s: float,
+    cold: bool,
+    program: Callable[[], Callable],
+    example_inputs: Optional[tuple],
+) -> None:
+    """Called by the engine once per signature-cache miss, AFTER the
+    first successful execution (``wall_s`` = trace + compile + first
+    run). The cheap half (counters, the compile histogram, the warm/cold
+    gauges) records whenever telemetry is on; the per-program entry only
+    when the ledger is armed."""
+    if _obs.enabled():
+        tel = _obs.get()
+        if cold:
+            tel.count("engine.compile.cold")
+        else:
+            tel.count("engine.compile.warm")
+        tel.observe_hist("engine.compile_ms", wall_s * 1e3, _obs.LATENCY_BUCKETS_MS)
+        # gauge mirrors of the running totals — the warm/cold program
+        # counts ROADMAP item 5 wants on the export surface (counters
+        # render as _total; these render as plain gauges a dashboard can
+        # read without rate() gymnastics)
+        tel.gauge("engine.programs.cold", tel.counters.get("engine.compile.cold", 0))
+        tel.gauge("engine.programs.warm", tel.counters.get("engine.compile.warm", 0))
+    if _enabled:
+        _ledger.record(engine, kind, signature, wall_s, cold, program, example_inputs)
+
+
+if cost_ledger_requested():
+    enable_cost_ledger()

@@ -37,6 +37,7 @@ snapshot rendering); ``make serve-metrics`` runs a live demo.
 import itertools
 import json
 import re
+import sys
 import threading
 import weakref
 from contextlib import contextmanager
@@ -210,16 +211,60 @@ def _render_quorum() -> List[str]:
     return fam.lines()
 
 
+def _render_cost_ledger() -> List[str]:
+    """Per-compiled-program families from the cost ledger (rendered
+    whenever entries exist — like the quorum, the ledger is state, not a
+    counter): compile counts, cold-compile counts, last compile wall
+    time, and XLA cost-model flops / bytes-accessed, labeled by the
+    program's jaxpr-fingerprint prefix."""
+    try:
+        from metrics_tpu.observability import costledger as _cl
+
+        entries = _cl.get_ledger().entries()
+    except Exception:  # noqa: BLE001 — a scrape must answer
+        return []
+    if not entries:
+        return []
+    fam = _GaugeFamilies()
+    for e in entries:
+        label = (
+            f'program="{e["fingerprint"][:16]}",'
+            f'engine="{_escape_label(e["engine"])}",kind="{e["kind"]}"'
+        )
+        fam.sample("metrics_tpu_engine_program_compiles", label, e["compiles"])
+        fam.sample(
+            "metrics_tpu_engine_program_cold_compiles", label, e["cold_compiles"]
+        )
+        fam.sample(
+            "metrics_tpu_engine_program_compile_ms", label, e["last_compile_ms"]
+        )
+        if e.get("flops") is not None:
+            fam.sample("metrics_tpu_engine_program_flops", label, e["flops"])
+        if e.get("bytes_accessed") is not None:
+            fam.sample(
+                "metrics_tpu_engine_program_bytes_accessed",
+                label,
+                e["bytes_accessed"],
+            )
+    return fam.lines()
+
+
 def render_exposition() -> str:
     """The full ``/metrics`` payload: telemetry registry + cohort health
-    + session gauges + sync quorum, one consistent text exposition. Valid
-    (and useful: the identity line still answers "who is this") even when
-    telemetry recording is disabled."""
+    + session gauges + sync quorum + compiled-program cost ledger, one
+    consistent text exposition. Valid (and useful: the identity line
+    still answers "who is this") even when telemetry recording is
+    disabled."""
     # auxiliary sources FIRST: cohort.health() refreshes the
     # cohort.tenant.* gauges, and rendering the registry afterwards means
     # one scrape sees both the per-tenant samples and the refreshed
     # aggregate gauges
-    extra = _render_cohorts() + _render_sessions() + _render_quorum()
+    extra = (
+        _render_cohorts()
+        + _render_sessions()
+        + _render_quorum()
+        + _render_cost_ledger()
+    )
     return _telemetry.get().to_prometheus(extra_lines=extra)
 
 
@@ -265,6 +310,22 @@ class MetricsExporter:
                         q = last_quorum()
                         if q is not None:
                             payload["sync_quorum"] = q.as_dict()
+                    except Exception:  # noqa: BLE001 — liveness must answer
+                        pass
+                    try:
+                        # serving-SLO verdict: a sustained latency breach
+                        # flips the probe to "degraded" so an external
+                        # health checker reacts without scraping
+                        # histograms. sys.modules gate, not an import —
+                        # a process that never constructed a ServingSLO
+                        # must not pull the serving package in here.
+                        slo_mod = sys.modules.get("metrics_tpu.serving.slo")
+                        if slo_mod is not None:
+                            verdict = slo_mod.healthz_payload()
+                            if verdict is not None:
+                                payload["serving_slo"] = verdict
+                                if verdict.get("breaching"):
+                                    payload["status"] = "degraded"
                     except Exception:  # noqa: BLE001 — liveness must answer
                         pass
                     body = json.dumps(payload).encode()

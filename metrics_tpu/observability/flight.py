@@ -147,6 +147,7 @@ class FlightRecorder:
             "dumped_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "events": events,
             "telemetry": _telemetry_snapshot(),
+            "cost_ledger": _cost_ledger_brief(),
         }
         slug = _REASON_RE.sub("-", reason).strip("-") or "failure"
         os.makedirs(self.directory, exist_ok=True)
@@ -242,6 +243,21 @@ def _telemetry_snapshot() -> Optional[Dict[str, Any]]:
         return None
     snap = _obs.get().snapshot()
     return {"counters": snap["counters"], "gauges": snap["gauges"]}
+
+
+def _cost_ledger_brief() -> Optional[Dict[str, Any]]:
+    """The compiled-program cost ledger riding the dump when armed —
+    dispatch-failure dumps then name which programs this process built
+    and what they cost, next to the failure they frame. None (schema-
+    stable) when the ledger is off or empty; never raises."""
+    try:
+        from metrics_tpu.observability import costledger as _cl
+
+        if not _cl.cost_ledger_enabled():
+            return None
+        return _cl.get_ledger().brief() or None
+    except Exception:  # noqa: BLE001 — diagnostics must not crash the dump
+        return None
 
 
 # ----------------------------------------------------------------------

@@ -54,17 +54,34 @@ __all__ = [
     "get_tracer",
     "span",
     "instant",
+    "complete_span",
     "step_scope",
     "advance_step",
     "current_step",
+    "next_batch_id",
+    "flow_scope",
+    "current_flow",
     "spans_to_perfetto",
 ]
 
 # the canonical phase-attribution set: where inside a metric step work can
 # go. "dispatch" covers the engine's host-side step machinery (cache
-# lookup, donation, the XLA dispatch itself); "other" is the explicit
-# bucket for spans that predate a phase assignment.
-PHASES = ("canonicalize", "update", "compute", "sync", "checkpoint", "dispatch", "other")
+# lookup, donation, the XLA dispatch itself); "queue" is time a staged
+# batch spends between admission and its worker pop (the continuous-
+# serving pipeline); "ingest" is streaming-admission work (buffering,
+# wave assembly, routing); "other" is the explicit bucket for spans that
+# predate a phase assignment.
+PHASES = (
+    "canonicalize",
+    "update",
+    "compute",
+    "sync",
+    "checkpoint",
+    "dispatch",
+    "queue",
+    "ingest",
+    "other",
+)
 
 _DEFAULT_MAX_SPANS = 8192
 
@@ -103,9 +120,17 @@ class TraceRecorder:
 
     @contextmanager
     def span(
-        self, name: str, phase: str = "other", step: Optional[int] = None, **attrs: Any
+        self,
+        name: str,
+        phase: str = "other",
+        step: Optional[int] = None,
+        flow: Any = None,
+        **attrs: Any,
     ) -> Iterator[None]:
-        """Record one nested span around a ``with`` block."""
+        """Record one nested span around a ``with`` block. ``flow`` (an
+        explicit batch id / tuple of batch ids, else whatever
+        :func:`flow_scope` pinned on this thread) links the span into a
+        cross-thread causal chain rendered as Perfetto flow arrows."""
         sid = next(self._ids)
         stack = self._stack()
         parent = stack[-1] if stack else None
@@ -116,37 +141,79 @@ class TraceRecorder:
         finally:
             dur = time.perf_counter_ns() - t0
             stack.pop()
-            self._commit(
-                {
-                    "name": name,
-                    "phase": phase if phase in PHASES else "other",
-                    "step": current_step() if step is None else int(step),
-                    "ts_us": (t0 - self._origin_ns) / 1e3,
-                    "dur_us": dur / 1e3,
-                    "tid": threading.get_ident() & 0xFFFF,
-                    "id": sid,
-                    "parent": parent,
-                    "args": attrs,
-                }
-            )
-
-    def instant(
-        self, name: str, phase: str = "other", step: Optional[int] = None, **attrs: Any
-    ) -> None:
-        """Record one zero-duration point event."""
-        self._commit(
-            {
+            record = {
                 "name": name,
                 "phase": phase if phase in PHASES else "other",
                 "step": current_step() if step is None else int(step),
-                "ts_us": (time.perf_counter_ns() - self._origin_ns) / 1e3,
-                "dur_us": None,
+                "ts_us": (t0 - self._origin_ns) / 1e3,
+                "dur_us": dur / 1e3,
                 "tid": threading.get_ident() & 0xFFFF,
-                "id": next(self._ids),
-                "parent": None,
+                "id": sid,
+                "parent": parent,
                 "args": attrs,
             }
-        )
+            flow_ids = _normalize_flow(flow if flow is not None else current_flow())
+            if flow_ids:
+                record["flow"] = list(flow_ids)
+            self._commit(record)
+
+    def instant(
+        self,
+        name: str,
+        phase: str = "other",
+        step: Optional[int] = None,
+        flow: Any = None,
+        **attrs: Any,
+    ) -> None:
+        """Record one zero-duration point event."""
+        record = {
+            "name": name,
+            "phase": phase if phase in PHASES else "other",
+            "step": current_step() if step is None else int(step),
+            "ts_us": (time.perf_counter_ns() - self._origin_ns) / 1e3,
+            "dur_us": None,
+            "tid": threading.get_ident() & 0xFFFF,
+            "id": next(self._ids),
+            "parent": None,
+            "args": attrs,
+        }
+        flow_ids = _normalize_flow(flow if flow is not None else current_flow())
+        if flow_ids:
+            record["flow"] = list(flow_ids)
+        self._commit(record)
+
+    def complete_span(
+        self,
+        name: str,
+        phase: str = "other",
+        *,
+        t0_ns: int,
+        t1_ns: int,
+        step: Optional[int] = None,
+        flow: Any = None,
+        **attrs: Any,
+    ) -> None:
+        """Commit one already-finished span from raw ``perf_counter_ns``
+        stamps — for intervals no single ``with`` block can wrap, e.g. the
+        queue-wait leg between a batch's admission on the submitter thread
+        and its pop on the serving worker. No nesting (parent is None);
+        the committing thread's tid is stamped, so a queue-wait span
+        renders on the worker track immediately before its dispatch."""
+        record = {
+            "name": name,
+            "phase": phase if phase in PHASES else "other",
+            "step": current_step() if step is None else int(step),
+            "ts_us": (int(t0_ns) - self._origin_ns) / 1e3,
+            "dur_us": max(0, int(t1_ns) - int(t0_ns)) / 1e3,
+            "tid": threading.get_ident() & 0xFFFF,
+            "id": next(self._ids),
+            "parent": None,
+            "args": attrs,
+        }
+        flow_ids = _normalize_flow(flow if flow is not None else current_flow())
+        if flow_ids:
+            record["flow"] = list(flow_ids)
+        self._commit(record)
 
     # ------------------------------------------------------------------
     # reading / export
@@ -159,7 +226,10 @@ class TraceRecorder:
         with self._lock:
             return {
                 "format": "metrics_tpu.trace",
-                "schema_version": 1,
+                # v2: spans may carry a "flow" list of batch ids (the
+                # causal cross-thread chain); absent on spans recorded
+                # outside any flow, so v1 consumers keep working
+                "schema_version": 2,
                 "identity": _identity.process_identity(),
                 "max_spans": self.max_spans,
                 "dropped": self.dropped,
@@ -206,6 +276,17 @@ def spans_to_perfetto(
     attrs ride in ``args`` so Perfetto's query/selection UI can group by
     step; the phase is the event category (``cat``).
 
+    Spans carrying a ``flow`` list (batch ids issued by
+    :func:`next_batch_id` and threaded via :func:`flow_scope`) are linked
+    by synthesized **flow events** (``ph: "s"/"t"/"f"``): per batch id,
+    one start at the chronologically first flow-carrying span, steps
+    through the middles, a finish (binding to the enclosing slice,
+    ``bp: "e"``) at the last — the arrows that make one admitted batch
+    followable across the submitter, worker, and checkpoint-writer
+    threads. Flow ids are namespaced per process track (``pid:batch``),
+    so merged multi-rank timelines never join two ranks' unrelated
+    batches.
+
     ``identity`` (a :func:`~metrics_tpu.observability.identity
     .process_identity` stamp) names the process track ``metrics_tpu
     rank R/W`` and keys it on the rank, so several ranks' conversions
@@ -231,8 +312,13 @@ def spans_to_perfetto(
             "args": {"name": pname},
         }
     ]
+    # flow anchors: per batch id, the (ts, mid-span bind point, tid) of
+    # every flow-carrying COMPLETE span (instants cannot anchor arrows)
+    flow_points: Dict[Any, List[Dict[str, Any]]] = {}
     for s in spans:
         args = {"step": s.get("step"), "rank": rank}
+        if s.get("flow"):
+            args["batch"] = list(s["flow"])
         args.update(s.get("args") or {})
         ev: Dict[str, Any] = {
             "name": s["name"],
@@ -248,7 +334,35 @@ def spans_to_perfetto(
         else:
             ev["ph"] = "X"
             ev["dur"] = round(float(s["dur_us"]), 3)
+            for fid in s.get("flow") or ():
+                flow_points.setdefault(fid, []).append(
+                    {
+                        "ts": ev["ts"],
+                        # bind inside the slice so the arrow attaches to
+                        # THIS span, not an adjacent one on the track
+                        "bind_ts": round(ev["ts"] + ev["dur"] / 2.0, 3),
+                        "tid": ev["tid"],
+                    }
+                )
         events.append(ev)
+    for fid, points in sorted(flow_points.items(), key=lambda kv: str(kv[0])):
+        if len(points) < 2:
+            continue  # an arrow needs two ends
+        points.sort(key=lambda p: p["ts"])
+        for i, p in enumerate(points):
+            ev = {
+                "name": "batch",
+                "cat": "flow",
+                "id": f"{pid}:{fid}",
+                "pid": pid,
+                "tid": p["tid"],
+                "ts": p["bind_ts"],
+                "ph": "s" if i == 0 else ("f" if i == len(points) - 1 else "t"),
+                "args": {"batch": fid},
+            }
+            if ev["ph"] == "f":
+                ev["bp"] = "e"
+            events.append(ev)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -265,6 +379,28 @@ _enabled = False
 _auto_step = 0
 _auto_step_lock = threading.Lock()
 _step_tls = threading.local()
+
+# causal batch identity: a process-wide monotone id issued once per
+# admitted batch/wave (the continuous-serving pipeline), threaded through
+# every span the batch touches (flow_scope / span(flow=)) and rendered as
+# Perfetto flow arrows. Separate from the step counter: a step numbers a
+# dispatch GENERATION, a batch id names one admitted unit of work — an
+# ingest wave coalescing several submissions carries several batch ids
+# into one generation.
+_batch_seq = 0
+_batch_lock = threading.Lock()
+_flow_tls = threading.local()
+
+
+def _normalize_flow(flow: Any) -> Optional[tuple]:
+    """Canonical tuple-of-ints form for a flow spec (an int, an iterable
+    of ints, or None)."""
+    if flow is None:
+        return None
+    if isinstance(flow, int):
+        return (flow,)
+    ids = tuple(int(f) for f in flow)
+    return ids or None
 
 
 def get_tracer() -> TraceRecorder:
@@ -347,13 +483,51 @@ def advance_step() -> int:
 def step_scope(step_index: int) -> Iterator[None]:
     """Pin the step index for every span/event recorded in the block (the
     :class:`~metrics_tpu.reliability.EvalSession` wraps each forward so
-    spans carry the durable step cursor, not the raw dispatch count)."""
+    spans carry the durable step cursor, not the raw dispatch count — and
+    the async serving worker wraps each staged batch's dispatch so spans
+    carry the batch's OWN generation, allocated at admission, not
+    whatever the shared counter reads by the time the worker runs)."""
     prev = getattr(_step_tls, "pinned", None)
     _step_tls.pinned = int(step_index)
     try:
         yield
     finally:
         _step_tls.pinned = prev
+
+
+# ----------------------------------------------------------------------
+# causal batch attribution (flows)
+# ----------------------------------------------------------------------
+def next_batch_id() -> int:
+    """Issue one monotone batch id (process-wide, thread-safe). The
+    serving pipeline stamps every admitted batch/wave with one; spans
+    recorded under its :func:`flow_scope` link into one Perfetto flow."""
+    global _batch_seq
+    with _batch_lock:
+        _batch_seq += 1
+        return _batch_seq
+
+
+def current_flow() -> Optional[tuple]:
+    """The batch ids pinned on this thread by :func:`flow_scope` (None
+    outside any flow)."""
+    return getattr(_flow_tls, "flow", None)
+
+
+@contextmanager
+def flow_scope(flow: Any) -> Iterator[None]:
+    """Pin a batch-id flow for every span/event recorded in the block:
+    the submitter pins it while staging, the worker re-pins the staged
+    batch's ids around its dispatch, the checkpoint writer around its
+    commit — one causal chain across all three threads. ``flow`` is an
+    int or an iterable of ints (a coalesced wave carries every submission
+    id it folded); ``None`` is accepted and pins nothing."""
+    prev = getattr(_flow_tls, "flow", None)
+    _flow_tls.flow = _normalize_flow(flow)
+    try:
+        yield
+    finally:
+        _flow_tls.flow = prev
 
 
 # ----------------------------------------------------------------------
@@ -374,6 +548,13 @@ def instant(name: str, phase: str = "other", **attrs: Any) -> None:
     """A point event when tracing is enabled; no-op otherwise."""
     if _enabled:
         _recorder.instant(name, phase=phase, **attrs)
+
+
+def complete_span(name: str, phase: str = "other", **kwargs: Any) -> None:
+    """Commit an already-finished span (see
+    :meth:`TraceRecorder.complete_span`); no-op when tracing is off."""
+    if _enabled:
+        _recorder.complete_span(name, phase=phase, **kwargs)
 
 
 if trace_requested():

@@ -45,6 +45,7 @@ from metrics_tpu.serving.async_engine import (  # noqa: F401
 )
 from metrics_tpu.serving.bgcheckpoint import BackgroundCheckpointer  # noqa: F401
 from metrics_tpu.serving.ingest import IngestQueue, IngestOverflowError  # noqa: F401
+from metrics_tpu.serving.slo import ServingSLO  # noqa: F401
 
 __all__ = [
     "AsyncServingEngine",
@@ -52,4 +53,5 @@ __all__ = [
     "IngestOverflowError",
     "IngestQueue",
     "ServingAdmissionError",
+    "ServingSLO",
 ]

@@ -43,7 +43,10 @@ like any other — the serving threads must come out clean).
 """
 import queue
 import threading
+import time
 import weakref
+from collections import deque
+from contextlib import nullcontext
 from typing import Any, Dict, Optional
 
 import jax
@@ -53,6 +56,7 @@ from metrics_tpu.engine import CompiledStepEngine, _is_arraylike
 from metrics_tpu.metric import Metric
 from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.observability import trace as _trace
 from metrics_tpu.utilities.prints import warn_once
 
 __all__ = ["AsyncServingEngine", "ServingAdmissionError"]
@@ -151,7 +155,13 @@ class AsyncServingEngine:
         target: Any,
         depth: int = _DEFAULT_DEPTH,
         strict: bool = False,
+        slo: Optional[Any] = None,
     ):
+        """``slo`` attaches a declarative
+        :class:`~metrics_tpu.serving.ServingSLO`: every staged/served
+        batch re-evaluates its burn gauges against the pipeline's own
+        latency histograms (``serving.latency.*``) and queue-age gauge —
+        see docs/observability.md, "Serving SLOs"."""
         from metrics_tpu.cohort import MetricCohort
 
         if int(depth) < 1:
@@ -172,6 +182,15 @@ class AsyncServingEngine:
         self._error: Optional[BaseException] = None
         self._proof_done = False
         self._closed = False
+        self._slo = slo
+        # queue-age tracking: perf_counter_ns admission stamps of batches
+        # staged but not yet popped by the worker (appended at forward,
+        # popped at dequeue — both under self._lock); the oldest stamp's
+        # age is the serving.queue.age_ms gauge beside the depth gauge
+        self._stage_stamps: "deque[int]" = deque()
+        # the most recent staged batch's flow ids (causal batch trace);
+        # what a checkpoint descriptor taken now should reference
+        self._last_flow: Optional[tuple] = None
         self.stats: Dict[str, int] = {
             "dispatches": 0,
             "blocking_steps": 0,
@@ -286,9 +305,7 @@ class AsyncServingEngine:
         if self._closed:
             raise RuntimeError("AsyncServingEngine is closed")
         if self._refusal is not None:
-            with self._lock:
-                self.stats["blocking_steps"] += 1
-            return self._dispatch(args, kwargs)
+            return self._blocking_forward(args, kwargs)
         if not self._proof_done:
             # one-time traced admission leg (see _prove_double_buffer);
             # may demote — re-check and fall through to blocking if so
@@ -296,18 +313,81 @@ class AsyncServingEngine:
             with self._lock:
                 self._proof_done = True
             if self._refusal is not None:
-                with self._lock:
-                    self.stats["blocking_steps"] += 1
-                return self._dispatch(args, kwargs)
+                return self._blocking_forward(args, kwargs)
             self._ensure_worker()
+        # step + flow identity are allocated AT ADMISSION, on the caller
+        # thread, and ride the queue entry: the worker pins both around
+        # the dispatch (step_scope/flow_scope), so every span this batch
+        # produces carries the batch's OWN generation — not whatever the
+        # process-wide counter reads by the time a span commits (the
+        # worker advances it out-of-band; see the async step-attribution
+        # regression test in tests/bases/test_serving.py)
+        tracing = _trace.tracing_enabled()
+        step = flow = None
+        if tracing or _flight.flight_enabled():
+            step = _trace.advance_step()
+        if tracing:
+            # an ingest wave dispatching through this pipeline pins its
+            # submission ids via flow_scope — adopt them; a direct
+            # forward is its own admitted batch and gets a fresh id
+            flow = _trace.current_flow() or (_trace.next_batch_id(),)
+        t_stage_ns = time.perf_counter_ns()
         with self._lock:
             self._outstanding += 1
+            self._stage_stamps.append(t_stage_ns)
+            self._last_flow = flow
+            age_ms = (t_stage_ns - self._stage_stamps[0]) / 1e6
         if _obs.enabled():
-            _obs.get().gauge("serving.queue.depth", self._queue.qsize() + 1)
-        self._queue.put((args, kwargs))
+            tel = _obs.get()
+            tel.gauge("serving.queue.depth", self._queue.qsize() + 1)
+            tel.gauge("serving.queue.age_ms", age_ms)
+        if self._slo is not None:
+            # submitter-side evaluation, BEFORE the potentially-blocking
+            # enqueue below: with a wedged worker the queue fills and
+            # put() never returns — the queue-age target must breach on
+            # the admission attempts that still get this far
+            self._slo.evaluate()
+        # the stage span covers the enqueue itself: a full queue blocks
+        # here (intrinsic backpressure), and that wait must be visible on
+        # the submitter track, linked to the batch by its flow id
+        with _trace.span("serving.stage", phase="queue", step=step, flow=flow):
+            self._queue.put((args, kwargs, step, flow, t_stage_ns))
         return None
 
     __call__ = forward
+
+    def _blocking_forward(self, args: tuple, kwargs: dict):
+        """The demoted path: one synchronous dispatch on the caller
+        thread — latency still observed (dispatch == e2e; there is no
+        queue leg) so a demoted pipeline keeps its SLO surface."""
+        with self._lock:
+            self.stats["blocking_steps"] += 1
+        t0_ns = time.perf_counter_ns()
+        out = self._dispatch(args, kwargs)
+        if _obs.enabled():
+            dt_ms = (time.perf_counter_ns() - t0_ns) / 1e6
+            tel = _obs.get()
+            tel.observe_hist(
+                "serving.latency.dispatch_ms", dt_ms, _obs.LATENCY_BUCKETS_MS
+            )
+            tel.observe_hist(
+                "serving.latency.e2e_ms", dt_ms, _obs.LATENCY_BUCKETS_MS
+            )
+        if self._slo is not None:
+            self._slo.evaluate()
+        return out
+
+    @property
+    def last_flow(self) -> Optional[tuple]:
+        """Flow (batch) ids of the most recently staged batch — what a
+        checkpoint snapshot descriptor taken now should reference
+        (``BackgroundCheckpointer.submit(..., flow=pipe.last_flow)``)."""
+        with self._lock:
+            return self._last_flow
+
+    @property
+    def slo(self) -> Optional[Any]:
+        return self._slo
 
     def _dispatch(self, args: tuple, kwargs: dict):
         """One underlying forward (both paths; the worker's whole job).
@@ -333,11 +413,65 @@ class AsyncServingEngine:
             job = self._queue.get()
             if job is _SENTINEL:
                 return
-            args, kwargs = job
+            args, kwargs, step, flow, t_stage_ns = job
+            t_pop_ns = time.perf_counter_ns()
+            with self._lock:
+                if self._stage_stamps:
+                    self._stage_stamps.popleft()
+                age_ms = (
+                    (t_pop_ns - self._stage_stamps[0]) / 1e6
+                    if self._stage_stamps
+                    else 0.0
+                )
+            telemetry_on = _obs.enabled()
+            if telemetry_on:
+                tel = _obs.get()
+                tel.observe_hist(
+                    "serving.latency.queue_wait_ms",
+                    (t_pop_ns - t_stage_ns) / 1e6,
+                    _obs.LATENCY_BUCKETS_MS,
+                )
+                tel.gauge("serving.queue.age_ms", age_ms)
+            # pin the batch's OWN generation + flow for every span the
+            # dispatch produces (engine.cache_lookup/donate/dispatch
+            # included): advance_step inside returns the pinned step, so
+            # the worker never double-advances the shared counter
+            step_cm = _trace.step_scope(step) if step is not None else nullcontext()
+            flow_cm = _trace.flow_scope(flow) if flow is not None else nullcontext()
             try:
-                self._dispatch(args, kwargs)
+                with step_cm, flow_cm:
+                    if _trace.tracing_enabled():
+                        # the queue leg as a completed span on this track,
+                        # immediately before its dispatch
+                        _trace.complete_span(
+                            "serving.queue_wait",
+                            phase="queue",
+                            t0_ns=t_stage_ns,
+                            t1_ns=t_pop_ns,
+                        )
+                    with _trace.span("serving.dispatch", phase="dispatch"):
+                        self._dispatch(args, kwargs)
+                    # write-back is installed by the time _dispatch
+                    # returns (engine lock extent) — the point the batch's
+                    # state became visible, and the e2e measurement point
+                    _trace.instant("serving.writeback", phase="dispatch")
+                t_done_ns = time.perf_counter_ns()
                 with self._lock:
                     self.stats["dispatches"] += 1
+                if telemetry_on:
+                    tel = _obs.get()
+                    tel.observe_hist(
+                        "serving.latency.dispatch_ms",
+                        (t_done_ns - t_pop_ns) / 1e6,
+                        _obs.LATENCY_BUCKETS_MS,
+                    )
+                    tel.observe_hist(
+                        "serving.latency.e2e_ms",
+                        (t_done_ns - t_stage_ns) / 1e6,
+                        _obs.LATENCY_BUCKETS_MS,
+                    )
+                if self._slo is not None:
+                    self._slo.evaluate()
             except BaseException as err:  # noqa: BLE001 — surfaced at the barrier
                 with self._lock:
                     self.stats["errors"] += 1

@@ -28,6 +28,8 @@ re-raise it at the next :meth:`BackgroundCheckpointer.drain` — the same
 barrier contract as the async dispatch engine.
 """
 import threading
+import time
+from contextlib import nullcontext
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
@@ -35,6 +37,7 @@ import jax.numpy as jnp
 from metrics_tpu.engine import _is_arraylike
 from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.observability import trace as _trace
 
 __all__ = ["BackgroundCheckpointer"]
 
@@ -93,19 +96,20 @@ class BackgroundCheckpointer:
         metric_type: str,
         cursor: int,
         note: Optional[str] = None,
+        flow: Any = None,
     ) -> Dict[str, Any]:
         """Queue one snapshot for background commit; returns a pending
-        descriptor (``{"pending": True, "cursor": ...}`` — the generation
-        number exists only once the worker commits). An un-committed
-        older snapshot in the mailbox is replaced (coalesced)."""
+        descriptor (``{"pending": True, "cursor": ..., "flow": ...}`` —
+        the generation number exists only once the worker commits). An
+        un-committed older snapshot in the mailbox is replaced
+        (coalesced). ``flow`` names the causal batch id(s) the snapshot
+        covers (e.g. ``AsyncServingEngine.last_flow``); defaults to the
+        submitting thread's pinned flow, rides the descriptor, and links
+        the writer's commit span into the batch's Perfetto flow —
+        admission→...→checkpoint-commit becomes one arrow chain."""
         if self._closed:
             raise RuntimeError("BackgroundCheckpointer is closed")
-        job = {
-            "pairs": pairs,
-            "metric_type": metric_type,
-            "cursor": int(cursor),
-            "note": note,
-        }
+        job = self._make_job(pairs, metric_type, cursor, note, flow)
         with self._lock:
             if self._pending is not None:
                 self.stats["coalesced"] += 1
@@ -117,7 +121,28 @@ class BackgroundCheckpointer:
         if coalesced and _obs.enabled():
             _obs.get().count("serving.checkpoint.coalesced")
         self._ensure_worker()
-        return {"pending": True, "cursor": int(cursor), "note": note}
+        return {
+            "pending": True,
+            "cursor": int(cursor),
+            "note": note,
+            "flow": job["flow"],
+        }
+
+    @staticmethod
+    def _make_job(pairs, metric_type, cursor, note, flow) -> Dict[str, Any]:
+        if flow is None:
+            flow = _trace.current_flow()
+        return {
+            "pairs": pairs,
+            "metric_type": metric_type,
+            "cursor": int(cursor),
+            "note": note,
+            "flow": list(flow) if flow else None,
+            # admission stamp for serving.latency.checkpoint_commit_ms:
+            # submit→durable-commit is the freshness lag an operator
+            # actually experiences (coalescing and a busy writer included)
+            "t_submit_ns": time.perf_counter_ns(),
+        }
 
     def commit_sync(
         self,
@@ -125,20 +150,15 @@ class BackgroundCheckpointer:
         metric_type: str,
         cursor: int,
         note: Optional[str] = None,
+        flow: Any = None,
     ) -> Dict[str, Any]:
         """Drain any queued snapshot, then commit THIS one inline and
         return its manifest record — for paths where durability cannot
         wait (protective checkpoints after a survived failure)."""
         self.drain(raise_errors=False)
+        job = self._make_job(pairs, metric_type, cursor, note, flow)
         with self._commit_lock:
-            record = self._commit_job(
-                {
-                    "pairs": pairs,
-                    "metric_type": metric_type,
-                    "cursor": int(cursor),
-                    "note": note,
-                }
-            )
+            record = self._observed_commit(job)
         with self._lock:
             self.stats["commits"] += 1
         if _obs.enabled():
@@ -171,7 +191,7 @@ class BackgroundCheckpointer:
                 self._busy = True
             try:
                 with self._commit_lock:
-                    self._commit_job(job)
+                    self._observed_commit(job)
                 with self._lock:
                     self.stats["commits"] += 1
                 if _obs.enabled():
@@ -190,6 +210,31 @@ class BackgroundCheckpointer:
                 with self._lock:
                     self._busy = False
                     self._lock_cond.notify_all()
+
+    def _observed_commit(self, job: Dict[str, Any]) -> Dict[str, Any]:
+        """One committed job with its observability epilogue: the commit
+        runs under the job's flow scope (the writer-thread end of the
+        batch's causal chain — a ``checkpoint.commit`` span Perfetto's
+        flow arrows terminate on), and success observes
+        ``serving.latency.checkpoint_commit_ms`` from the job's
+        submit stamp — coalescing wait and writer busyness included.
+        Caller holds ``_commit_lock``."""
+        flow = job.get("flow")
+        flow_cm = _trace.flow_scope(flow) if flow else nullcontext()
+        with flow_cm:
+            with _trace.span(
+                "checkpoint.commit", phase="checkpoint", cursor=job["cursor"]
+            ):
+                record = self._commit_job(job)
+        if _obs.enabled():
+            t0 = job.get("t_submit_ns")
+            if t0 is not None:
+                _obs.get().observe_hist(
+                    "serving.latency.checkpoint_commit_ms",
+                    (time.perf_counter_ns() - t0) / 1e6,
+                    _obs.LATENCY_BUCKETS_MS,
+                )
+        return record
 
     def _commit_job(self, job: Dict[str, Any]) -> Dict[str, Any]:
         """Fetch device→host, envelope, journal-commit. Runs under

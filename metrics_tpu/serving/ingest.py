@@ -51,6 +51,7 @@ import jax.numpy as jnp
 from metrics_tpu.cohort import MetricCohort, route_rows
 from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
+from metrics_tpu.observability import trace as _trace
 
 __all__ = ["IngestQueue", "IngestOverflowError"]
 
@@ -129,8 +130,12 @@ class IngestQueue:
         # while a dispatch runs) so two concurrent submitters can never
         # drive the cohort's forward concurrently or reorder waves
         self._wave_lock = threading.Lock()
-        # per-tenant FIFO of (arrival_seq, [row-chunk per input position]);
-        # chunks keep arrival order so shedding drops the OLDEST rows
+        # per-tenant FIFO of (arrival_seq, [row-chunk per input position],
+        # flow): chunks keep arrival order so shedding drops the OLDEST
+        # rows; `flow` is the submission's causal batch id (None when
+        # tracing was off at admission) — it rides every chunk so the
+        # wave that eventually dispatches those rows can link itself to
+        # the submissions it folded (Perfetto flow arrows)
         self._buffers: Dict[int, deque] = {}
         self._seq = 0
         self._buffered_rows = 0
@@ -193,15 +198,24 @@ class IngestQueue:
                 f"submission names tenants {unknown} not live in the cohort"
                 f" (live: {sorted(live)})"
             )
-        self._make_room(n)
-        with self._lock:
-            for tid in unique_ids:
-                mask = tenant_ids == tid
-                chunk = [a[mask] for a in rows]
-                self._buffers.setdefault(int(tid), deque()).append((self._seq, chunk))
-                self._seq += 1
-            self._buffered_rows += n
-            self.stats["admitted_rows"] += n
+        # one causal batch id per admitted submission: the ingest chunk
+        # is where the admission→...→checkpoint chain starts, so the id
+        # is issued HERE and rides the buffered chunks into the wave
+        flow = _trace.next_batch_id() if _trace.tracing_enabled() else None
+        # the module-level span helper is the enabled gate (null context
+        # when tracing is off — same idiom as every other call site)
+        with _trace.span("ingest.submit", phase="ingest", flow=flow, rows=n):
+            self._make_room(n)
+            with self._lock:
+                for tid in unique_ids:
+                    mask = tenant_ids == tid
+                    chunk = [a[mask] for a in rows]
+                    self._buffers.setdefault(int(tid), deque()).append(
+                        (self._seq, chunk, flow)
+                    )
+                    self._seq += 1
+                self._buffered_rows += n
+                self.stats["admitted_rows"] += n
         if _obs.enabled():
             _obs.get().count("serving.ingest.admitted_rows", n)
             _obs.get().gauge("serving.ingest.buffered_rows", self._buffered_rows)
@@ -242,7 +256,7 @@ class IngestQueue:
             for tid in order:
                 buf = self._buffers.get(tid)
                 while buf and shed < need:
-                    _, chunk = buf.popleft()
+                    _, chunk, _ = buf.popleft()
                     k = int(chunk[0].shape[0])
                     shed += k
                     overflow.append((tid, k))
@@ -322,7 +336,7 @@ class IngestQueue:
         k = None
         for tid in live:
             have = sum(
-                int(c[0].shape[0]) for _, c in self._buffers.get(tid, ())
+                int(c[0].shape[0]) for _, c, _ in self._buffers.get(tid, ())
             )
             steps = have // B
             k = steps if k is None else min(k, steps)
@@ -333,24 +347,26 @@ class IngestQueue:
             m *= 2
         return m
 
-    def _take_rows(self, tid: int, count: int) -> List[Tuple[int, List[np.ndarray]]]:
+    def _take_rows(self, tid: int, count: int) -> List[Tuple[int, List[np.ndarray], Any]]:
         """Pop exactly ``count`` buffered rows for one tenant (splitting a
-        chunk when needed); returns ``(arrival_seq, chunk_arrays)`` pairs
-        so the wave can be rebuilt in arrival order. Caller holds the
-        lock."""
-        out: List[Tuple[int, List[np.ndarray]]] = []
+        chunk when needed); returns ``(arrival_seq, chunk_arrays, flow)``
+        triples so the wave can be rebuilt in arrival order and linked to
+        the submissions it folded. A split chunk keeps its flow id on
+        both halves (the submission's rows ride two waves — both waves
+        are causally downstream of it). Caller holds the lock."""
+        out: List[Tuple[int, List[np.ndarray], Any]] = []
         buf = self._buffers[tid]
         remaining = count
         while remaining > 0:
-            seq, chunk = buf[0]
+            seq, chunk, flow = buf[0]
             k = int(chunk[0].shape[0])
             if k <= remaining:
                 buf.popleft()
-                out.append((seq, chunk))
+                out.append((seq, chunk, flow))
                 remaining -= k
             else:
-                out.append((seq, [a[:remaining] for a in chunk]))
-                buf[0] = (seq, [a[remaining:] for a in chunk])
+                out.append((seq, [a[:remaining] for a in chunk], flow))
+                buf[0] = (seq, [a[remaining:] for a in chunk], flow)
                 remaining = 0
         return out
 
@@ -383,18 +399,31 @@ class IngestQueue:
         across tenants, exactly as the stream delivered it) with DENSE
         tenant positions (live slots need not be contiguous); route_rows
         then does the real routing work — one stable argsort + gather per
-        array — into the stacked layout."""
+        array — into the stacked layout. The wave pins the flow ids of
+        every submission it folded (``flow_scope``), so the routing span,
+        the downstream dispatch, and — through an async pipeline — the
+        eventual write-back all link back to their ingest chunks."""
         pos = {tid: i for i, tid in enumerate(live)}
-        pieces: List[Tuple[int, int, List[np.ndarray]]] = []
+        pieces: List[Tuple[int, int, List[np.ndarray], Any]] = []
         for tid in live:
-            for seq, chunk in per_tenant[tid]:
-                pieces.append((seq, pos[tid], chunk))
+            for seq, chunk, flow in per_tenant[tid]:
+                pieces.append((seq, pos[tid], chunk, flow))
         pieces.sort(key=lambda p: p[0])
+        flows = tuple(sorted({p[3] for p in pieces if p[3] is not None}))
+        # flow_scope(None) pins nothing; the span helper is a null
+        # context when tracing is off — one code path, per-wave cost
+        with _trace.flow_scope(flows or None), _trace.span(
+            "ingest.wave", phase="ingest", tenants=len(live), batches=len(flows)
+        ):
+            self._route_and_dispatch(pieces, live)
+        return 1
+
+    def _route_and_dispatch(self, pieces, live) -> None:
         flat_ids = np.concatenate(
-            [np.full(c[0].shape[0], p, dtype=np.int32) for _, p, c in pieces]
+            [np.full(c[0].shape[0], p, dtype=np.int32) for _, p, c, _ in pieces]
         )
         flat_arrays = [
-            np.concatenate([c[i] for _, _, c in pieces], axis=0)
+            np.concatenate([c[i] for _, _, c, _ in pieces], axis=0)
             for i in range(self._n_arrays)
         ]
         routed = route_rows(
@@ -408,7 +437,6 @@ class IngestQueue:
             _obs.get().count("serving.ingest.dispatches")
             _obs.get().gauge("serving.ingest.buffered_rows", self._buffered_rows)
         self._target(*routed)
-        return 1
 
     def flush(self) -> int:
         """Dispatch every ready wave now; returns the number of rows still

@@ -23,6 +23,7 @@ __all__ = [
     "trace_requested",
     "flight_dir",
     "exporter_port",
+    "cost_ledger_requested",
     "refresh",
     "san_enabled",
     "san_requested",
@@ -59,6 +60,7 @@ def _read() -> Dict[str, object]:
         "flight": (os.environ.get("METRICS_TPU_FLIGHT") or "").strip() or None,
         "exporter": _parse_port(os.environ.get("METRICS_TPU_EXPORTER")),
         "san": parse_flag(os.environ.get("METRICS_TPU_SAN")),
+        "cost_ledger": parse_flag(os.environ.get("METRICS_TPU_COST_LEDGER")),
     }
 
 
@@ -87,6 +89,13 @@ def flight_dir() -> Optional[str]:
     """``METRICS_TPU_FLIGHT=<dir>``: enable the failure flight recorder at
     import with ``<dir>`` as the dump directory (None = disabled)."""
     return _flags["flight"]
+
+
+def cost_ledger_requested() -> bool:
+    """``METRICS_TPU_COST_LEDGER``: arm the compiled-program cost ledger
+    at import (equivalent to
+    ``metrics_tpu.observability.enable_cost_ledger()``)."""
+    return _flags["cost_ledger"]
 
 
 def exporter_port() -> Optional[int]:

@@ -115,6 +115,16 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--check", help="validate a Prometheus text exposition file ('-' = stdin)"
     )
+    ap.add_argument(
+        "--require",
+        action="append",
+        default=[],
+        metavar="FAMILY",
+        help="with --check: additionally require this metric family in the"
+        " exposition (repeatable; a trailing '*' matches any family with"
+        " the prefix — e.g. metrics_tpu_serving_slo_*). The CI scrape"
+        " gate uses this to pin the serving-SLO families present.",
+    )
     args = ap.parse_args(argv)
 
     if args.check is not None:
@@ -126,8 +136,25 @@ def main(argv=None) -> int:
         except ValueError as err:
             print(f"INVALID exposition: {err}", file=sys.stderr)
             return 1
-        print(f"valid Prometheus text format: {len(samples)} metric families")
+        missing = []
+        for req in args.require:
+            if req.endswith("*"):
+                ok = any(name.startswith(req[:-1]) for name in samples)
+            else:
+                ok = req in samples
+            if not ok:
+                missing.append(req)
+        if missing:
+            print(
+                f"INVALID exposition: required families missing: {missing}",
+                file=sys.stderr,
+            )
+            return 1
+        extra = f", {len(args.require)} required families present" if args.require else ""
+        print(f"valid Prometheus text format: {len(samples)} metric families{extra}")
         return 0
+    if args.require:
+        ap.error("--require only applies with --check")
 
     if args.snapshot is not None:
         with open(args.snapshot) as f:

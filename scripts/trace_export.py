@@ -6,7 +6,12 @@ Accepts either artifact the observability layer writes:
 * a **native trace dump** (``TraceRecorder.snapshot()`` / ``to_json()``,
   format marker ``metrics_tpu.trace``) — spans become complete
   (``ph: "X"``) trace events with phase categories and step args, on a
-  process track named after the dump's rank identity;
+  process track named after the dump's rank identity. Spans carrying
+  causal batch ids (schema v2 ``flow`` lists — the continuous-serving
+  pipeline's admission→dispatch→checkpoint chains) additionally emit
+  Perfetto flow events (``ph: "s"/"t"/"f"`` arrows), namespaced per
+  process track so merged multi-rank timelines never join two ranks'
+  unrelated batches;
 * a **flight-recorder dump** (``metrics_tpu.flight_dump``) — the event
   ring becomes instant events on a synthetic timeline (events carry
   relative seconds, not span timestamps), so the last-N-steps window

@@ -1,0 +1,190 @@
+"""Compiled-program cost ledger (ISSUE 14): warm/cold compile counters
+ride the telemetry switch, the armed ledger records per-program
+fingerprints + compile wall time + XLA cost analysis, the export surface
+renders one family set per program, and flight dumps carry the ledger —
+all zero-overhead and entry-free when disarmed."""
+import json
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import Accuracy, MeanSquaredError, MetricCollection
+from metrics_tpu.engine import CompiledStepEngine
+from metrics_tpu.observability import costledger as cl
+
+
+@pytest.fixture(autouse=True)
+def _pristine():
+    def reset():
+        obs.disable()
+        obs.get().reset()
+        cl.disable_cost_ledger()
+        cl.get_ledger().reset()
+
+    reset()
+    yield
+    reset()
+
+
+def _batch(rows=32, seed=0):
+    rng = np.random.RandomState(seed)
+    p = rng.rand(rows, 4).astype(np.float32)
+    p /= p.sum(1, keepdims=True)
+    return jnp.asarray(p), jnp.asarray(rng.randint(4, size=rows))
+
+
+# ----------------------------------------------------------------------
+# 1. the cheap tier: counters/histogram/gauges with telemetry alone
+# ----------------------------------------------------------------------
+def test_cold_compiles_count_and_fill_the_compile_histogram():
+    with obs.telemetry_scope() as tel:
+        col = MetricCollection([Accuracy()], compiled=True)
+        p, t = _batch()
+        col(p, t)  # one NEW signature: cold
+        col(p, t)  # cache hit: no compile at all
+        assert tel.counters.get("engine.compile.cold") == 1
+        assert "engine.compile.warm" not in tel.counters
+        assert tel.snapshot()["histograms"]["engine.compile_ms"]["count"] == 1
+        assert tel.gauges["engine.programs.cold"] == 1
+        assert tel.gauges["engine.programs.warm"] == 0
+
+
+def test_lru_thrash_recompiles_classify_warm():
+    """cache_size=1 + two alternating signatures: the third step
+    re-compiles a signature this process already built — that is a WARM
+    compile (the path a persistent compilation cache would serve for
+    free), not a cold one."""
+    engine = CompiledStepEngine(Accuracy(), cache_size=1)
+    a = _batch(rows=16, seed=1)
+    b = _batch(rows=24, seed=2)
+    with obs.telemetry_scope() as tel:
+        engine.step(*a)  # cold
+        engine.step(*b)  # cold (evicts a)
+        engine.step(*a)  # warm: seen before, thrashed out
+        assert tel.counters["engine.compile.cold"] == 2
+        assert tel.counters["engine.compile.warm"] == 1
+        assert tel.gauges["engine.programs.warm"] == 1
+        assert tel.snapshot()["histograms"]["engine.compile_ms"]["count"] == 3
+
+
+def test_disarmed_ledger_records_no_entries_and_disabled_telemetry_nothing():
+    col = MetricCollection([Accuracy()], compiled=True)
+    p, t = _batch(seed=3)
+    col(p, t)
+    assert obs.get().counters == {}
+    assert cl.get_ledger().entries() == []
+
+
+# ----------------------------------------------------------------------
+# 2. the armed ledger
+# ----------------------------------------------------------------------
+def test_armed_ledger_records_fingerprint_wall_time_and_cost():
+    with obs.cost_ledger_scope() as ledger:
+        col = MetricCollection([Accuracy()], compiled=True)
+        p, t = _batch(seed=4)
+        col(p, t)
+        col(p, t)  # cache hit: no new entry
+        entries = ledger.entries()
+        assert len(entries) == 1
+        (e,) = entries
+        assert e["kind"] == "step" and e["compiles"] == 1 and e["cold_compiles"] == 1
+        assert e["engine"] == "engine[Accuracy]"
+        # a PR 8 jaxpr fingerprint (fingerprint_jaxpr's 16-hex digest)
+        assert len(e["fingerprint"]) == 16
+        int(e["fingerprint"], 16)
+        assert e["last_compile_ms"] > 0
+        # XLA's cost model resolved on this backend
+        assert e["flops"] is not None and e["flops"] > 0
+        assert e["bytes_accessed"] is not None and e["bytes_accessed"] > 0
+        assert e["signatures"] == 1
+
+
+def test_same_program_from_two_engines_folds_into_one_entry():
+    with obs.cost_ledger_scope() as ledger:
+        p, t = _batch(seed=5)
+        MetricCollection([Accuracy()], compiled=True)(p, t)
+        MetricCollection([Accuracy()], compiled=True)(p, t)
+        entries = ledger.entries()
+        assert len(entries) == 1  # identical program => one fingerprint
+        assert entries[0]["compiles"] == 2
+        # per-process cold both times: each engine's signature set is new
+        assert entries[0]["cold_compiles"] == 2
+
+
+def test_cohort_programs_enter_the_ledger_as_cohort_kind():
+    from metrics_tpu import MetricCohort
+
+    with obs.cost_ledger_scope() as ledger:
+        cohort = MetricCohort(MeanSquaredError(), tenants=2)
+        x = jnp.asarray(np.random.RandomState(6).rand(2, 16).astype(np.float32))
+        cohort(x, x)
+        (e,) = ledger.entries()
+        assert e["kind"] == "cohort_step"
+        assert e["engine"].endswith("@cohort")
+
+
+def test_report_and_json_shapes():
+    with obs.cost_ledger_scope() as ledger:
+        p, t = _batch(seed=7)
+        MetricCollection([Accuracy()], compiled=True)(p, t)
+        text = ledger.report()
+        assert "cost ledger" in text and "engine[Accuracy]" in text
+        snap = json.loads(ledger.to_json())
+        assert snap["format"] == "metrics_tpu.cost_ledger"
+        assert snap["programs"] == 1 and snap["cold_compiles"] == 1
+    # empty + disarmed report stays valid
+    cl.get_ledger().reset()
+    assert "no compiles recorded" in cl.get_ledger().report()
+
+
+def test_exposition_renders_per_program_families_when_entries_exist():
+    with obs.telemetry_scope(), cl.cost_ledger_scope():
+        p, t = _batch(seed=8)
+        MetricCollection([Accuracy()], compiled=True)(p, t)
+        text = obs.render_exposition()
+        assert "metrics_tpu_engine_program_compiles" in text
+        assert "metrics_tpu_engine_program_cold_compiles" in text
+        assert "metrics_tpu_engine_program_compile_ms" in text
+        assert "metrics_tpu_engine_program_flops" in text
+        obs.parse_prometheus_text(text)  # structurally valid
+    # no entries -> no per-program families (the registry's
+    # engine.programs.* gauges are separate and may remain)
+    cl.get_ledger().reset()
+    assert "metrics_tpu_engine_program_compiles" not in obs.render_exposition()
+
+
+def test_flight_dumps_attach_the_ledger_when_armed(tmp_path):
+    with obs.flight_scope(tmp_path / "dumps") as rec:
+        with cl.cost_ledger_scope():
+            p, t = _batch(seed=9)
+            MetricCollection([Accuracy()], compiled=True)(p, t)
+            path = rec.dump("drill")
+            with open(path) as f:
+                dump = json.load(f)
+            assert dump["cost_ledger"], "armed ledger must ride the dump"
+            (row,) = dump["cost_ledger"].values()
+            assert row["engine"] == "engine[Accuracy]" and row["compiles"] == 1
+        # disarmed: the field stays present (schema) but null
+        path = rec.dump("drill-off")
+        with open(path) as f:
+            assert json.load(f)["cost_ledger"] is None
+
+
+def test_ledger_never_perturbs_results_or_program_identity():
+    """Bit-identical results, identical signature count, no extra engine
+    traces with the ledger armed — the ledger's abstract trace/lowering
+    is invisible to the engine."""
+    p, t = _batch(seed=10)
+    plain = MetricCollection([Accuracy()], compiled=True)
+    v_plain = np.asarray(plain(p, t)["Accuracy"])
+    info_plain = plain._engine.cache_info()
+
+    with cl.cost_ledger_scope():
+        armed = MetricCollection([Accuracy()], compiled=True)
+        v_armed = np.asarray(armed(p, t)["Accuracy"])
+        info_armed = armed._engine.cache_info()
+    np.testing.assert_array_equal(v_plain, v_armed)
+    assert info_plain["compiled_signatures"] == info_armed["compiled_signatures"]
+    assert info_plain["trace_count"] == info_armed["trace_count"]

@@ -35,13 +35,21 @@ from metrics_tpu.observability.telemetry import prometheus_name
 
 @pytest.fixture(autouse=True)
 def _pristine():
-    obs.disable()
-    obs.get().reset()
-    obs.disable_exporter()
+    def clean():
+        obs.disable()
+        obs.get().reset()
+        obs.disable_exporter()
+        # a ServingSLO leaked from another module's test frame must not
+        # flip this module's /healthz probes to degraded
+        import sys
+
+        slo_mod = sys.modules.get("metrics_tpu.serving.slo")
+        if slo_mod is not None:
+            slo_mod._ACTIVE.clear()
+
+    clean()
     yield
-    obs.disable()
-    obs.get().reset()
-    obs.disable_exporter()
+    clean()
 
 
 def _scrape(port: int, path: str = "/metrics") -> str:
@@ -341,3 +349,57 @@ def test_explicit_host_change_restarts_the_listener():
         assert obs.enable_exporter() is other
     finally:
         obs.disable_exporter()
+
+
+# ----------------------------------------------------------------------
+# live serving pipeline: scrapes racing an active async wave stream
+# (ISSUE 14 satellite — a scrape mid-wave must return a consistent
+# snapshot, never a half-rendered family)
+# ----------------------------------------------------------------------
+def test_scrape_loop_racing_a_live_async_serving_pipeline():
+    from metrics_tpu import MetricCohort
+    from metrics_tpu.serving import AsyncServingEngine, IngestQueue, ServingSLO
+
+    obs.enable()
+    cohort = MetricCohort(Accuracy(), tenants=4)
+    slo = ServingSLO(e2e_p99_ms=60_000.0, max_queue_age_ms=60_000.0)
+    pipe = AsyncServingEngine(cohort, slo=slo)
+    q = IngestQueue(pipe, rows_per_step=8, max_buffered_rows=1 << 14)
+    rng = np.random.RandomState(0)
+    ids = np.tile(np.arange(4), 8)
+
+    stop = threading.Event()
+    submit_errors = []
+
+    def feeder():
+        try:
+            while not stop.is_set():
+                p = rng.rand(32).astype(np.float32)
+                q.submit(ids, p, (p > 0.5).astype(np.int32))
+        except Exception as err:  # noqa: BLE001 — surfaced in the assert
+            submit_errors.append(err)
+
+    with obs.exporter_scope(0) as ex:
+        feed = threading.Thread(target=feeder)
+        feed.start()
+        try:
+            scrapes = [_scrape(ex.port) for _ in range(12)]
+        finally:
+            stop.set()
+            feed.join(timeout=30)
+        pipe.drain()
+        final = _scrape(ex.port)
+    assert submit_errors == []
+    # EVERY scrape — whatever instant mid-wave it landed on — parses with
+    # all histogram invariants intact (one locked snapshot per render)
+    for text in scrapes + [final]:
+        parse_prometheus_text(text)
+    # the post-drain scrape carries the whole serving surface
+    assert "metrics_tpu_serving_queue_depth" in final
+    assert "metrics_tpu_serving_queue_age_ms" in final
+    assert "metrics_tpu_serving_latency_e2e_ms_bucket" in final
+    assert "metrics_tpu_serving_latency_queue_wait_ms_bucket" in final
+    assert "metrics_tpu_serving_slo_e2e_burn" in final
+    assert "metrics_tpu_serving_slo_queue_age_burn" in final
+    assert "metrics_tpu_engine_compile_cold_total" in final
+    pipe.close()

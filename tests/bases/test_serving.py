@@ -30,11 +30,18 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 
 @pytest.fixture(autouse=True)
 def _clean_telemetry():
-    obs.disable()
-    obs.get().reset()
+    def clean():
+        obs.disable()
+        obs.get().reset()
+        # drop lingering ServingSLO registrations: a breaching SLO kept
+        # alive by a test frame must not degrade a LATER test's /healthz
+        from metrics_tpu.serving import slo as slo_mod
+
+        slo_mod._ACTIVE.clear()
+
+    clean()
     yield
-    obs.disable()
-    obs.get().reset()
+    clean()
 
 
 def _cls_batches(n=5, seed=0, rows=96):
@@ -335,6 +342,292 @@ def test_engine_step_fingerprints_match_committed_baseline():
         result = audit_metric(factory(), args, distributed=False, fingerprint=True)
         assert result.fingerprints["update"] == committed[family]["update"], family
         assert result.fingerprints["step"] == committed[family]["step"], family
+
+
+# ----------------------------------------------------------------------
+# 5. serving SLO observability (ISSUE 14): causal flows, step
+#    attribution under async serving, latency histograms, SLOs
+# ----------------------------------------------------------------------
+@pytest.fixture()
+def _tracing():
+    from metrics_tpu.observability import trace as trace_mod
+
+    obs.enable_tracing(max_spans=trace_mod._DEFAULT_MAX_SPANS)
+    obs.get_tracer().reset()
+    yield obs.get_tracer()
+    obs.disable_tracing()
+    obs.get_tracer().reset()
+
+
+def test_async_step_attribution_uses_the_batch_generation(_tracing):
+    """The regression pin for async step attribution: the submitter
+    allocates each batch's generation AT ADMISSION and the worker pins it
+    (step_scope) around the dispatch — so a batch staged as generation N
+    is stamped N on EVERY span (stage + dispatch), even when the worker
+    runs it after later generations were already allocated. Before the
+    fix, submitter-side spans read the shared dispatch counter, which the
+    worker advances out-of-band: spans for generation N could stamp N±1."""
+    import threading
+
+    served = _cls_col()
+    pipe = AsyncServingEngine(served)
+    batches = _cls_batches(n=3, seed=8)
+    pipe.forward(*batches[0])  # warm: MTA009 proof + trace + compile
+    pipe.drain()
+
+    gate = threading.Event()
+    real_dispatch = pipe._dispatch
+
+    def slow_dispatch(args, kwargs):
+        gate.wait(timeout=30)
+        return real_dispatch(args, kwargs)
+
+    pipe._dispatch = slow_dispatch
+    tracer = _tracing
+    tracer.reset()
+    from metrics_tpu.observability import trace as trace_mod
+
+    before = trace_mod.current_step()
+    pipe.forward(*batches[1])  # generation before+1; worker blocks in it
+    pipe.forward(*batches[2])  # generation before+2, staged behind it
+    # submitter-side spans already committed carry each batch's OWN
+    # generation — not whatever the counter reads now
+    stage_steps = [
+        s["step"] for s in tracer.spans if s["name"] == "serving.stage"
+    ]
+    assert stage_steps == [before + 1, before + 2]
+    gate.set()
+    pipe.drain()
+    # worker-side spans: each batch's dispatch stamped its own generation
+    for name in ("serving.queue_wait", "serving.dispatch"):
+        steps = sorted(
+            s["step"] for s in tracer.spans if s["name"] == name
+        )
+        assert steps == [before + 1, before + 2], name
+    # the engine spans under the worker's step_scope agree
+    engine_steps = sorted(
+        s["step"] for s in tracer.spans if s["name"] == "engine.dispatch"
+    )
+    assert engine_steps == [before + 1, before + 2]
+    pipe.close()
+
+
+def test_serving_latency_histograms_and_queue_age_gauge():
+    """Every served batch observes the three pipeline legs into the
+    fixed-bucket histograms, and the queue-age gauge exists beside the
+    depth gauge."""
+    batches = _cls_batches(n=4, seed=9)
+    with obs.telemetry_scope():
+        served = _cls_col()
+        pipe = AsyncServingEngine(served)
+        for p, t in batches:
+            pipe.forward(p, t)
+        pipe.drain()
+        hists = obs.get().snapshot()["histograms"]
+        for leg in (
+            "serving.latency.queue_wait_ms",
+            "serving.latency.dispatch_ms",
+            "serving.latency.e2e_ms",
+        ):
+            assert hists[leg]["count"] == len(batches), leg
+        # e2e covers the queue leg: its mass can never undercut dispatch
+        assert hists["serving.latency.e2e_ms"]["sum"] >= (
+            hists["serving.latency.dispatch_ms"]["sum"]
+        )
+        gauges = obs.get().gauges
+        assert "serving.queue.age_ms" in gauges
+        assert "serving.queue.depth" in gauges
+        pipe.close()
+
+
+def test_blocking_demoted_pipeline_keeps_the_latency_surface():
+    from metrics_tpu.analysis.fixtures import DoubleBufferAliaser
+
+    with obs.telemetry_scope():
+        pipe = AsyncServingEngine(DoubleBufferAliaser())
+        pipe.forward(jnp.ones(4))
+        hists = obs.get().snapshot()["histograms"]
+        assert hists["serving.latency.e2e_ms"]["count"] == 1
+        assert hists["serving.latency.dispatch_ms"]["count"] == 1
+        assert "serving.latency.queue_wait_ms" not in hists  # no queue leg
+
+
+def test_serving_slo_burn_gauges_breach_and_one_dump_per_excursion(tmp_path):
+    """A breaching SLO: burn gauges > 1, ONE serving_slo_breach flight
+    dump after `sustain` consecutive breaching evaluations (not one per
+    step), re-armed only after recovery."""
+    from metrics_tpu.serving import ServingSLO
+
+    batches = _cls_batches(n=6, seed=10)
+    with obs.telemetry_scope(), obs.flight_scope(tmp_path / "dumps") as rec:
+        slo = ServingSLO(e2e_p99_ms=1e-6, sustain=2)  # unmeetable target
+        pipe = AsyncServingEngine(_cls_col(), slo=slo)
+        for p, t in batches:
+            pipe.forward(p, t)
+        pipe.drain()
+        assert slo.breaching
+        assert obs.get().gauges["serving.slo.e2e_burn"] > 1.0
+        assert obs.get().counters["serving.slo.breaches"] == 1
+        breach_dumps = [p for p in rec.dump_paths if "serving_slo_breach" in p]
+        assert len(breach_dumps) == 1  # sustained excursion = ONE dump
+        # recovery re-arms: a generous target clears the verdict...
+        slo.e2e_p99_ms = 1e9
+        slo.evaluate()
+        assert not slo.breaching
+        # ...and the next sustained excursion dumps exactly once more
+        slo.e2e_p99_ms = 1e-6
+        for p, t in batches[:3]:
+            pipe.forward(p, t)
+        pipe.drain()
+        breach_dumps = [p for p in rec.dump_paths if "serving_slo_breach" in p]
+        assert len(breach_dumps) == 2
+        pipe.close()
+
+
+def test_slo_queue_age_breaches_with_a_wedged_worker(tmp_path):
+    """The review regression pin: the submitter evaluates the SLO BEFORE
+    the potentially-blocking enqueue — with the worker wedged and the
+    queue full, the queue-age target must still flip to breaching on the
+    admission attempts that reach the pipeline."""
+    import threading
+    import time
+
+    from metrics_tpu.serving import ServingSLO
+
+    batches = _cls_batches(n=4, seed=14)
+    with obs.telemetry_scope(), obs.flight_scope(tmp_path / "dumps") as rec:
+        slo = ServingSLO(max_queue_age_ms=1e-6, sustain=1)
+        pipe = AsyncServingEngine(_cls_col(), depth=1, slo=slo)
+        pipe.forward(*batches[0])  # warm (proof + compile)
+        pipe.drain()
+
+        gate = threading.Event()
+        real_dispatch = pipe._dispatch
+
+        def wedged(args, kwargs):
+            gate.wait(timeout=30)
+            return real_dispatch(args, kwargs)
+
+        pipe._dispatch = wedged
+        pipe.forward(*batches[1])  # worker picks it up and wedges
+        time.sleep(0.05)
+        pipe.forward(*batches[2])  # fills the depth-1 queue
+        # this admission blocks in put() — but its PRE-put evaluation
+        # must already have seen the aging queue and breached
+        blocked = threading.Thread(target=pipe.forward, args=batches[3])
+        blocked.start()
+        deadline = time.time() + 10
+        while not slo.breaching and time.time() < deadline:
+            time.sleep(0.01)
+        assert slo.breaching, "wedged worker never breached the queue-age SLO"
+        assert obs.get().gauges["serving.slo.queue_age_burn"] > 1.0
+        gate.set()
+        blocked.join(timeout=30)
+        pipe.drain()
+        # the dump write is asynchronous w.r.t. the breaching flag flip
+        deadline = time.time() + 10
+        while (
+            not any("serving_slo_breach" in p for p in rec.dump_paths)
+            and time.time() < deadline
+        ):
+            time.sleep(0.01)
+        assert any("serving_slo_breach" in p for p in rec.dump_paths)
+        pipe.close()
+
+
+def test_serving_slo_quiet_when_telemetry_off(tmp_path):
+    from metrics_tpu.serving import ServingSLO
+
+    slo = ServingSLO(e2e_p99_ms=1e-6, max_queue_age_ms=1e-6, sustain=1)
+    with obs.flight_scope(tmp_path / "dumps") as rec:
+        pipe = AsyncServingEngine(_cls_col(), slo=slo)
+        for p, t in _cls_batches(n=2, seed=11):
+            pipe.forward(p, t)
+        pipe.drain()
+        pipe.close()
+    assert slo.evaluate() is None  # nothing to evaluate against
+    assert not slo.breaching
+    assert rec.dump_paths == []
+
+
+def test_healthz_reports_degraded_on_slo_breach():
+    import json
+    import urllib.request
+
+    from metrics_tpu.serving import ServingSLO
+
+    with obs.telemetry_scope():
+        slo = ServingSLO(e2e_p99_ms=1e-6, sustain=1, name="pytest-slo")
+        pipe = AsyncServingEngine(_cls_col(), slo=slo)
+        for p, t in _cls_batches(n=2, seed=12):
+            pipe.forward(p, t)
+        pipe.drain()
+        assert slo.breaching
+        with obs.exporter_scope(0) as ex:
+            url = f"http://{ex.host}:{ex.port}/healthz"
+            payload = json.loads(urllib.request.urlopen(url, timeout=5).read())
+        assert payload["status"] == "degraded"
+        verdicts = {s["name"]: s for s in payload["serving_slo"]["slos"]}
+        assert verdicts["pytest-slo"]["breaching"]
+        assert verdicts["pytest-slo"]["burns"]["e2e"] > 1.0
+        pipe.close()
+
+
+def test_batch_followable_admission_to_checkpoint_commit(tmp_path, _tracing):
+    """The tentpole acceptance pin: one admitted submission's batch id
+    links the ingest chunk, the wave, the staged queue entry, the
+    dispatch + write-back on the worker thread, and the background
+    checkpoint commit on the writer thread — one Perfetto flow with a
+    start and a finish, crossing ≥ 3 distinct threads."""
+    from metrics_tpu.reliability.journal import CheckpointJournal
+    from metrics_tpu.serving import BackgroundCheckpointer, IngestQueue
+    from metrics_tpu.serving.bgcheckpoint import snapshot_pairs
+
+    cohort = MetricCohort(Accuracy(), tenants=2)
+    pipe = AsyncServingEngine(cohort)
+    q = IngestQueue(pipe, rows_per_step=4, max_buffered_rows=1024)
+    rng = np.random.RandomState(13)
+    ids = np.tile(np.arange(2), 4)
+    p = rng.rand(8).astype(np.float32)
+    q.submit(ids, p, (p > 0.5).astype(np.int32))
+    pipe.drain()
+    flow = pipe.last_flow
+    assert flow is not None and len(flow) == 1
+    journal = CheckpointJournal(tmp_path / "journal")
+    bg = BackgroundCheckpointer(journal)
+    descriptor = bg.submit(
+        snapshot_pairs(cohort), "MetricCohort", cursor=1, flow=flow
+    )
+    assert descriptor["flow"] == list(flow)
+    bg.drain()
+    bg.close()
+
+    tracer = _tracing
+    fid = flow[0]
+    by_name = {}
+    for s in tracer.spans:
+        if fid in (s.get("flow") or ()):
+            by_name.setdefault(s["name"], []).append(s)
+    for name in (
+        "ingest.submit",
+        "ingest.wave",
+        "serving.stage",
+        "serving.queue_wait",
+        "serving.dispatch",
+        "checkpoint.commit",
+    ):
+        assert name in by_name, (name, sorted(by_name))
+    # the chain crosses the submitter, worker, and writer threads
+    tids = {s["tid"] for spans in by_name.values() for s in spans}
+    assert len(tids) >= 3
+    blob = tracer.to_perfetto()
+    phs = [
+        e["ph"]
+        for e in blob["traceEvents"]
+        if e.get("cat") == "flow" and e["args"].get("batch") == fid
+    ]
+    assert phs[0] == "s" and phs[-1] == "f" and len(phs) >= 3
+    pipe.close()
 
 
 def test_dispatch_generation_advances_monotonically():

@@ -181,6 +181,116 @@ def test_unknown_phase_falls_back_to_other():
 
 
 # ----------------------------------------------------------------------
+# 2b. causal batch flows (ISSUE 14)
+# ----------------------------------------------------------------------
+def test_next_batch_id_is_monotone_and_thread_safe():
+    import threading
+
+    seen = []
+    lock = threading.Lock()
+
+    def grab():
+        ids = [trace_mod.next_batch_id() for _ in range(50)]
+        with lock:
+            seen.extend(ids)
+
+    threads = [threading.Thread(target=grab) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(seen) == len(set(seen)) == 200  # unique across threads
+
+
+def test_flow_scope_pins_flow_onto_spans_and_instants():
+    rec = trace_mod.enable_tracing()
+    rec.reset()
+    bid = trace_mod.next_batch_id()
+    assert trace_mod.current_flow() is None
+    with trace_mod.flow_scope(bid):
+        assert trace_mod.current_flow() == (bid,)
+        with rec.span("work", phase="dispatch"):
+            pass
+        rec.instant("mark")
+        # an explicit flow= wins over the pinned scope
+        with rec.span("other", phase="dispatch", flow=(bid + 1000,)):
+            pass
+    assert trace_mod.current_flow() is None
+    work, mark, other = rec.spans
+    assert work["flow"] == [bid]
+    assert mark["flow"] == [bid]
+    assert other["flow"] == [bid + 1000]
+
+
+def test_flow_scope_accepts_id_tuples_and_nests():
+    with trace_mod.flow_scope((3, 1, 2)):
+        assert trace_mod.current_flow() == (3, 1, 2)
+        with trace_mod.flow_scope(None):  # pins nothing, masks the outer
+            assert trace_mod.current_flow() is None
+        assert trace_mod.current_flow() == (3, 1, 2)
+
+
+def test_complete_span_commits_a_finished_interval():
+    import time
+
+    rec = trace_mod.TraceRecorder()
+    t0 = time.perf_counter_ns()
+    t1 = t0 + 2_000_000  # 2 ms
+    rec.complete_span("queue_wait", phase="queue", t0_ns=t0, t1_ns=t1, step=7, flow=4)
+    (s,) = rec.spans
+    assert s["phase"] == "queue" and s["step"] == 7 and s["flow"] == [4]
+    assert abs(s["dur_us"] - 2000.0) < 1.0
+
+
+def test_perfetto_flow_events_link_spans_across_threads():
+    """One batch id across two threads must render as s → (t...) → f
+    flow events bound inside the flow-carrying complete spans — the
+    arrows that make a batch followable across the serving threads."""
+    import threading
+
+    rec = trace_mod.enable_tracing()
+    rec.reset()
+    bid = trace_mod.next_batch_id()
+    with rec.span("submit", phase="queue", flow=bid):
+        pass
+
+    def worker():
+        with trace_mod.flow_scope(bid):
+            with rec.span("dispatch", phase="dispatch"):
+                pass
+            with rec.span("writeback", phase="dispatch"):
+                pass
+
+    th = threading.Thread(target=worker)
+    th.start()
+    th.join()
+    blob = rec.to_perfetto()
+    flow_events = [e for e in blob["traceEvents"] if e.get("cat") == "flow"]
+    assert [e["ph"] for e in flow_events] == ["s", "t", "f"]
+    # ids are namespaced per process track; the finish binds enclosing
+    assert all(e["id"] == flow_events[0]["id"] for e in flow_events)
+    assert str(blob["traceEvents"][0]["pid"]) in str(flow_events[0]["id"])
+    assert flow_events[-1]["bp"] == "e"
+    # the chain crosses thread tracks: submit on one tid, dispatch on another
+    assert len({e["tid"] for e in flow_events}) == 2
+    # batch ids also ride span args for the query UI
+    spans = [e for e in blob["traceEvents"] if e["ph"] == "X"]
+    assert all(e["args"].get("batch") == [bid] for e in spans)
+
+
+def test_single_span_flow_emits_no_dangling_arrow():
+    rec = trace_mod.TraceRecorder()
+    with rec.span("only", phase="queue", flow=9):
+        pass
+    blob = spans_to_perfetto_of(rec)
+    assert [e for e in blob["traceEvents"] if e.get("cat") == "flow"] == []
+
+
+def spans_to_perfetto_of(rec):
+    return trace_mod.spans_to_perfetto(list(rec.spans))
+
+
+# ----------------------------------------------------------------------
 # 3. bounded ring buffer
 # ----------------------------------------------------------------------
 def test_ring_buffer_drops_oldest_and_counts():
@@ -249,9 +359,10 @@ def test_snapshot_json_roundtrip():
             pass
     snap = json.loads(tracer.to_json())
     assert snap["format"] == "metrics_tpu.trace"
-    assert snap["schema_version"] == 1
+    assert snap["schema_version"] == 2  # v2: optional per-span "flow" list
     assert len(snap["spans"]) == 1
     assert snap["spans"][0]["args"] == {"k": 1}
+    assert "flow" not in snap["spans"][0]  # no flow pinned: field absent
 
 
 # ----------------------------------------------------------------------
