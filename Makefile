@@ -50,21 +50,23 @@ ci:
 	-python scripts/perf_sentinel.py --current bench_current.json
 
 lint:
-	# static analysis gate: passes 1+3+4 trace every metric family's
+	# static analysis gate: passes 1+3+4+5 trace every metric family's
 	# program — and its sync_precision=int8/bf16 + @cohort variants —
 	# (accumulator dtypes, host sync, donation aliasing, reduction
 	# soundness, N-replica distributed equivalence, state lifecycle,
 	# donation lifetime, host-seam budget vs SEAM_BASELINE.json,
-	# two-generation double-buffer safety), pass 2 lints the source tree
-	# for repo invariants incl. thread-shared-state (MTL106) and stale
+	# two-generation double-buffer safety, overflow/absorption horizons +
+	# measured cancellation error budgets + scale-equivariance vs
+	# NUMERICS_BASELINE.json), pass 2 lints the source tree for repo
+	# invariants incl. thread-shared-state (MTL106) and stale
 	# suppressions; writes ANALYSIS.json atomically WITH the per-family
 	# program fingerprints the CI drift sentinel diffs against, and
-	# refreshes the committed seam baseline (an INTENDED seam change —
-	# e.g. a sync leg folded in-program — lands here and is then gated
-	# against backsliding). Also pinned in tier-1 via
-	# tests/analysis/test_lint_clean.py. Rule catalog:
-	# docs/static_analysis.md
-	python scripts/lint_metrics.py --strict --fingerprints --refresh-seam-baseline
+	# refreshes both committed baselines (seam: intended crossing DROPS;
+	# numerics: horizons up / budgets down only — both refuse a red
+	# audit, so a regression must be fixed or hand-edited in review).
+	# Also pinned in tier-1 via tests/analysis/test_lint_clean.py.
+	# Rule catalog: docs/static_analysis.md
+	python scripts/lint_metrics.py --strict --fingerprints --refresh-seam-baseline --refresh-numerics-baseline
 
 san:
 	# MetricSan-armed test pass: the runtime sanitizer behind the static
@@ -213,6 +215,6 @@ dryrun:
 
 clean:
 	rm -rf .pytest_cache .jax_cache flight-dumps bench-traces san-flight-dumps
-	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json bench_cohort.txt bench_cohort.json ANALYSIS_current.json
+	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json bench_cohort.txt bench_cohort.json ANALYSIS_current.json numerics_evidence.json
 	rm -f bench_serving.txt bench_serving.json SENTINEL_serving.json metrics_scrape_serving.txt cost_ledger.json
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -74,9 +74,10 @@ def main() -> None:
     lines += [f"- **`{n}`** — {d}" for n, d in _functions(S)]
     lines += ["", "## Static analysis (`metrics_tpu.analysis`)", ""]
     lines += [
-        "See `docs/static_analysis.md` for the rule catalog (MTA001-MTA007,"
-        " MTL101-MTL105), suppression syntax, the `make lint` gate, the"
-        " program-fingerprint drift sentinel, and the MetricSan runtime"
+        "See `docs/static_analysis.md` for the rule catalog (MTA001-MTA012,"
+        " MTL101-MTL106), suppression syntax, the `make lint` gate, the"
+        " committed baselines (SEAM_BASELINE.json, NUMERICS_BASELINE.json),"
+        " the program-fingerprint drift sentinel, and the MetricSan runtime"
         " sanitizer (`METRICS_TPU_SAN=1` / `san_scope()` / `make san`).",
         "",
     ]

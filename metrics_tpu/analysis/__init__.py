@@ -1,7 +1,7 @@
 """Static analysis for metric programs: catch the bad program before it
 dispatches, not after it corrupts an epoch.
 
-Four passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
+Five passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
 
 * **Pass 1 — program audit** (:mod:`metrics_tpu.analysis.program`):
   abstractly traces each metric's ``update`` and, for engine-eligible
@@ -33,6 +33,18 @@ Four passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
   real step program (MTA009, ``evidence["double_buffer"]`` in
   ANALYSIS.json), and contributes the MTL106 thread-shared-state lint
   leg to pass 2.
+* **Pass 5 — numerical soundness**
+  (:mod:`metrics_tpu.analysis.numerics`): derives per-state
+  overflow/ulp-absorption horizons in rows by interval abstract
+  interpretation of each family's update program under declared
+  per-batch input domains (MTA010), detects cancellation-shaped
+  subtractions in compute jaxprs and measures every family's relative
+  error on adversarial ill-conditioned probes against an fp64 oracle
+  (MTA011), and metamorphically checks declared scale-invariant/
+  -equivariant families to the bit under power-of-two rescaling
+  (MTA012) — all gated against the committed ``NUMERICS_BASELINE.json``
+  (refresh tightens only, refuses red). The runtime twin is
+  ``StateGuard(overflow_margin=...)``.
 
 The runtime counterpart is **MetricSan**
 (:mod:`metrics_tpu.analysis.sanitizer`): ``METRICS_TPU_SAN=1`` or
@@ -72,6 +84,14 @@ from metrics_tpu.analysis.concurrency import (  # noqa: F401
     register_threadsan_target,
     thread_shared_model,
 )
+from metrics_tpu.analysis.numerics import (  # noqa: F401
+    check_numerics,
+    equivariance_verdict,
+    eval_jaxpr_intervals,
+    load_numerics_baseline,
+    measure_error_budget,
+    state_horizons,
+)
 from metrics_tpu.analysis.lint import lint_file, lint_paths  # noqa: F401
 from metrics_tpu.analysis.sanitizer import (  # noqa: F401
     MetricSan,
@@ -95,9 +115,12 @@ __all__ = [
     "check_double_buffer",
     "check_host_seam",
     "check_lifecycle",
+    "check_numerics",
     "check_replica_equivalence",
     "disable_san",
     "enable_san",
+    "equivariance_verdict",
+    "eval_jaxpr_intervals",
     "fingerprint_jaxpr",
     "hint_for_watch_key",
     "host_seam_budget",
@@ -105,8 +128,11 @@ __all__ = [
     "iter_eqns",
     "lint_file",
     "lint_paths",
+    "load_numerics_baseline",
     "load_seam_baseline",
+    "measure_error_budget",
     "register_threadsan_target",
     "san_scope",
+    "state_horizons",
     "thread_shared_model",
 ]

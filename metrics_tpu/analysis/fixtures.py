@@ -23,11 +23,14 @@ from metrics_tpu.metric import Metric
 __all__ = [
     "BlockScaledQuantizedSync",
     "CallbackInJit",
+    "CancellingVariance",
     "ComputeMutatesState",
     "DonatedAlias",
     "DoubleBufferAliaser",
+    "EpsilonThresholdAUROC",
     "HostReadOfDonated",
     "HostSyncUpdate",
+    "Int32RowCounter",
     "MeanWithoutCount",
     "NarrowAccumulator",
     "NonCommutativeMerge",
@@ -451,6 +454,88 @@ class UnlockedSharedCounter:
     def bump(self) -> None:
         # metrics-tpu: allow(MTL106) — deliberate: the broken fixture
         self.value = self.value + 1
+
+
+class Int32RowCounter(Metric):
+    """MTA010: an int32 row counter. Sound in every per-step sense — the
+    program is clean, the reduction is a psum-able sum, replicas agree —
+    and it saturates after 2³¹ rows, about 25 minutes at the measured
+    1.40 Mrows/s serving rate. The interval pass bounds its per-row
+    increment at exactly 1 and derives a horizon far below the 2⁴⁰-row
+    fleet floor."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("rows", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.rows = self.rows + jnp.asarray(x.shape[0], jnp.int32)
+        self.acc = self.acc + jnp.sum(x)
+
+    def compute(self) -> jax.Array:
+        return self.acc / jnp.maximum(self.rows.astype(jnp.float32), 1.0)
+
+
+class CancellingVariance(Metric):
+    """MTA011: variance via E[x²]−E[x]² — the catastrophic-cancellation
+    shape. Structurally detected (both subtraction operands descend from
+    accumulated sums) AND measured: on mean-shifted probes the f32 result
+    loses every significant digit against the fp64 oracle, blowing the
+    deliberately-tight budget committed for this class in
+    ``NUMERICS_BASELINE.json`` — exactly how a conditioning regression in
+    a real family would fail the gate."""
+
+    _fused_forward = True
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("sum_x", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_x2", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x: jax.Array) -> None:
+        self.sum_x = self.sum_x + jnp.sum(x)
+        self.sum_x2 = self.sum_x2 + jnp.sum(x * x)
+        self.count = self.count + jnp.asarray(x.shape[0], jnp.float32)
+
+    def compute(self) -> jax.Array:
+        n = jnp.maximum(self.count, 1.0)
+        mean = self.sum_x / n
+        return self.sum_x2 / n - mean * mean  # the cancellation
+
+
+class EpsilonThresholdAUROC(Metric):
+    """MTA012: a rank metric (declared scale-invariant in the pass-5
+    equivariance table) hiding an ABSOLUTE epsilon: scores below 1e-3 are
+    snapped to zero before ranking. At scale 1.0 every oracle test
+    passes; rescale the same scores by 2⁻¹⁰ (exact in IEEE floats) and
+    different scores cross the epsilon, the tie structure changes, and
+    the result drifts — the metamorphic probe catches what no
+    fixed-scale test can."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("scores", default=[], dist_reduce_fx=None)
+
+    def update(self, x: jax.Array) -> None:
+        self.scores.append(x)
+
+    def compute(self) -> jax.Array:
+        s = jnp.concatenate([jnp.reshape(v, (-1,)) for v in self.scores])
+        target = (s > jnp.median(s)).astype(jnp.float32)
+        s = jnp.where(jnp.abs(s) < 1e-3, 0.0, s)  # the hidden epsilon
+        # pairwise Mann-Whitney AUROC (ties contribute 1/2): when the
+        # epsilon collapses scores to ties, strict wins become halves and
+        # the value drifts — exactly what the metamorphic probe measures
+        wins = (s[:, None] > s[None, :]).astype(jnp.float32)
+        ties = (s[:, None] == s[None, :]).astype(jnp.float32)
+        pair = target[:, None] * (1.0 - target[None, :])
+        n_pairs = jnp.sum(pair)
+        u = jnp.sum(pair * (wins + 0.5 * ties))
+        return u / jnp.maximum(n_pairs, 1.0)
 
 
 class BlockScaledQuantizedSync(Metric):

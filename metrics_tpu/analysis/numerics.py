@@ -1,0 +1,1382 @@
+"""Pass 5 — numerical-soundness prover: overflow horizons, cancellation
+detection, and a committed per-family error-budget baseline.
+
+Passes 1–4 prove properties of one *step*: its program shape, its
+cross-replica merge, its buffer lifetimes. The serving stack now runs
+millions of rows per process lifetime (the async pipeline sustains
+1.40 Mrows/s), which makes *state lifetime* the numerical hazard nothing
+per-step can see: an int32 row counter that is fine in a unit test
+saturates after 2³¹ rows (~25 minutes at fleet rate), an f32 running sum
+silently stops absorbing increments after enough traffic, and an
+E[x²]−E[x]² compute loses every significant digit the moment the data is
+mean-shifted. This pass makes each of those a measured, committed,
+CI-gated number:
+
+* **MTA010 — overflow/saturation horizon.** Interval arithmetic over the
+  family's traced update jaxpr (recursing through pjit/scan/cond
+  sub-jaxprs, the same walker discipline as pass 1), given the family's
+  *declared per-batch input domains*, yields a per-state max per-step
+  increment — and therefore a per-state horizon in ROWS: steps-until-
+  int-overflow for integer accumulators, steps-until-ulp-absorption for
+  float ones (the point after which ``acc + x == acc`` even for the
+  family's largest per-step contribution, ``2^(mantissa+1)`` steps at the
+  declared serving batch shape). Horizons below the fleet floor (default
+  2⁴⁰ rows) flag; every horizon is recorded in the committed
+  ``NUMERICS_BASELINE.json`` so a dtype narrowing — int32→int16,
+  f32→bf16 — is a *gated regression* even when it stays above the floor.
+* **MTA011 — catastrophic cancellation.** Structural leg: a taint walk
+  over the compute jaxpr marks every value descended from an accumulated
+  (sum/mean-reduced) state and flags subtraction (or ``a + (-b)``) of two
+  accumulated-descended values — the E[x²]−E[x]² shape the shared
+  regression sufficient-stats deliberately risk. Measured leg: every
+  family's update→compute composite is evaluated on adversarial
+  ill-conditioned probes (mean-shifted data at 1e6 scale, 1e−6 spreads)
+  against an fp64 oracle fed the *identical f32-cast inputs* (so the
+  budget isolates computation error, not input quantization), and the
+  observed relative error is committed per family to the baseline. A
+  refactor that worsens conditioning fails the gate even when the jaxpr
+  shape is unchanged.
+* **MTA012 — scale/shift-equivariance probe.** Concrete metamorphic
+  check against the declared equivariance class: scale-invariant metrics
+  (AUROC, average precision, retrieval ranks, R²) must be BIT-stable
+  under power-of-two input rescaling (×2, ×2⁻¹⁰ — exact in IEEE floats,
+  so any drift is a hidden absolute-epsilon threshold or premature
+  rounding, not legitimate rounding); scale-equivariant ones (MSE ×s²,
+  MAE ×s) must transform exactly.
+
+The committed baseline follows ``SEAM_BASELINE.json`` semantics: entries
+are name-keyed with a recorded state inventory (a different configuration
+of the same class is measured, not gated), ``--refresh-numerics-baseline``
+refuses to rewrite over a red audit, only auto-commits *improvements*
+(horizons up, budgets down), prunes retired families, and preserves the
+deliberately-tight fixture entries named in ``"fixtures"``. The runtime
+counterpart is ``StateGuard(overflow_margin=...)``
+(:mod:`metrics_tpu.reliability.guard`): warn once + count when an integer
+accumulator actually crosses within ``2^margin`` of its horizon.
+"""
+import json
+import math
+import os
+import threading
+import warnings
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from metrics_tpu.analysis.rules import Finding
+from metrics_tpu.utilities.data import (
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+)
+
+__all__ = [
+    "DEFAULT_FLEET_FLOOR_ROWS",
+    "DEFAULT_SERVING_ROWS_PER_STEP",
+    "EQUIVARIANCE",
+    "FAMILY_DOMAINS",
+    "Interval",
+    "NUMERICS_BASELINE_FILENAME",
+    "build_numerics_entry",
+    "cancellation_sites",
+    "check_numerics",
+    "equivariance_verdict",
+    "eval_jaxpr_intervals",
+    "load_numerics_baseline",
+    "measure_error_budget",
+    "min_horizon_rows",
+    "state_horizons",
+]
+
+#: the fleet-scale horizon floor, in rows: any state whose horizon is
+#: below this is reachable within a process lifetime at serving rates
+#: (2^40 rows ≈ 9 days at the measured 1.40 Mrows/s) and flags MTA010
+DEFAULT_FLEET_FLOOR_ROWS = 2 ** 40
+
+#: the declared serving batch shape, in rows per dispatched step — the
+#: 1M-row bench shape. Float ulp-absorption horizons scale linearly with
+#: it: batch-summed accumulation absorbs whole-step contributions, so a
+#: bigger batch pushes absorption out proportionally (f32 at 2^20
+#: rows/step absorbs at 2^44 rows; the same state fed row-at-a-time dies
+#: at 2^24)
+DEFAULT_SERVING_ROWS_PER_STEP = 2 ** 20
+
+#: cap on the committed relative-error budget: 1.0 means "all significant
+#: digits lost" — beyond that, magnitudes are platform noise
+ERROR_BUDGET_CAP = 1.0
+
+#: the committed per-family numerics baseline at the repo root (next to
+#: SEAM_BASELINE.json); refreshed by ``scripts/lint_metrics.py
+#: --refresh-numerics-baseline`` (what ``make lint`` runs)
+NUMERICS_BASELINE_FILENAME = "NUMERICS_BASELINE.json"
+
+_INF = float("inf")
+
+
+# ---------------------------------------------------------------------------
+# interval arithmetic over jaxprs
+# ---------------------------------------------------------------------------
+@dataclass(frozen=True)
+class Interval:
+    """A closed scalar interval ``[lo, hi]`` abstracting every element of
+    an array. ``TOP`` (``[-inf, inf]``) is the unknown-value element."""
+
+    lo: float
+    hi: float
+
+    def __post_init__(self):
+        if self.lo > self.hi:  # normalize inverted constructions
+            lo, hi = self.hi, self.lo
+            object.__setattr__(self, "lo", lo)
+            object.__setattr__(self, "hi", hi)
+
+    @property
+    def is_top(self) -> bool:
+        return self.lo == -_INF and self.hi == _INF
+
+    def union(self, other: "Interval") -> "Interval":
+        return Interval(min(self.lo, other.lo), max(self.hi, other.hi))
+
+
+TOP = Interval(-_INF, _INF)
+_BOOL = Interval(0.0, 1.0)
+
+
+def _iv_add(a: Interval, b: Interval) -> Interval:
+    return Interval(a.lo + b.lo, a.hi + b.hi)
+
+
+def _iv_sub(a: Interval, b: Interval) -> Interval:
+    return Interval(a.lo - b.hi, a.hi - b.lo)
+
+
+def _iv_mul(a: Interval, b: Interval) -> Interval:
+    prods = []
+    for x in (a.lo, a.hi):
+        for y in (b.lo, b.hi):
+            p = x * y
+            # 0 * inf is nan under IEEE; the product of a zero bound and an
+            # unbounded one is bounded by the OTHER corner products
+            prods.append(0.0 if math.isnan(p) else p)
+    return Interval(min(prods), max(prods))
+
+
+def _iv_div(a: Interval, b: Interval) -> Interval:
+    if b.lo <= 0.0 <= b.hi:
+        return TOP  # divisor interval spans zero: unbounded quotient
+    recips = Interval(1.0 / b.hi, 1.0 / b.lo)
+    return _iv_mul(a, recips)
+
+
+def _iv_neg(a: Interval) -> Interval:
+    return Interval(-a.hi, -a.lo)
+
+
+def _iv_abs(a: Interval) -> Interval:
+    if a.lo >= 0:
+        return a
+    if a.hi <= 0:
+        return _iv_neg(a)
+    return Interval(0.0, max(-a.lo, a.hi))
+
+
+def _mono(fn: Callable[[float], float]) -> Callable[[Interval], Interval]:
+    """Lift a monotone-increasing scalar function to intervals; domain
+    errors at a bound widen that side to ±inf rather than crash."""
+
+    def apply(a: Interval) -> Interval:
+        def at(x: float, side: float) -> float:
+            try:
+                v = fn(x)
+            except (ValueError, OverflowError):
+                return side
+            return side if math.isnan(v) else v
+
+        return Interval(at(a.lo, -_INF), at(a.hi, _INF))
+
+    return apply
+
+
+_IV_LOG = _mono(math.log)
+_IV_LOG1P = _mono(math.log1p)
+_IV_EXP = _mono(math.exp)
+_IV_SQRT = _mono(lambda x: math.sqrt(x) if x >= 0 else float("nan"))
+_IV_TANH = _mono(math.tanh)
+
+
+def _iv_int_pow(a: Interval, y: int) -> Interval:
+    if y == 0:
+        return Interval(1.0, 1.0)
+    if y < 0:
+        return _iv_div(Interval(1.0, 1.0), _iv_int_pow(a, -y))
+    out = a
+    for _ in range(y - 1):
+        out = _iv_mul(out, a)
+    if y % 2 == 0:
+        out = _iv_abs(out)  # even powers are nonnegative; tighten
+        out = Interval(0.0 if a.lo <= 0 <= a.hi else out.lo, out.hi)
+    return out
+
+
+def _reduced_count(eqn: Any) -> int:
+    """Number of elements folded together by a reduction equation."""
+    shape = tuple(getattr(eqn.invars[0].aval, "shape", ()) or ())
+    axes = eqn.params.get("axes")
+    if axes is None:
+        return int(np.prod(shape)) if shape else 1
+    k = 1
+    for ax in axes:
+        if 0 <= ax < len(shape):
+            k *= int(shape[ax])
+    return max(k, 1)
+
+
+def _const_interval(value: Any) -> Interval:
+    arr = np.asarray(value)
+    if arr.size == 0:
+        return Interval(0.0, 0.0)
+    if arr.dtype == bool:
+        return _BOOL
+    try:
+        return Interval(float(arr.min()), float(arr.max()))
+    except (TypeError, ValueError):
+        return TOP
+
+
+def eval_jaxpr_intervals(
+    closed: Any,
+    in_intervals: Sequence[Interval],
+    unhandled: Optional[Set[str]] = None,
+) -> List[Interval]:
+    """Propagate element-wise value intervals through a (Closed)Jaxpr,
+    recursing into pjit/scan/cond sub-jaxprs; returns one
+    :class:`Interval` per output variable. Unknown primitives produce
+    ``TOP`` (sound, never wrong — just loose) and are recorded in
+    ``unhandled`` for evidence."""
+    if hasattr(closed, "jaxpr"):
+        jaxpr, consts = closed.jaxpr, list(getattr(closed, "consts", ()))
+    else:
+        jaxpr, consts = closed, []
+    if unhandled is None:
+        unhandled = set()
+    env: Dict[Any, Interval] = {}
+    for var, const in zip(jaxpr.constvars, consts):
+        env[var] = _const_interval(const)
+    for var in jaxpr.constvars:
+        env.setdefault(var, TOP)
+    for var, iv in zip(jaxpr.invars, in_intervals):
+        env[var] = iv
+
+    def read(v: Any) -> Interval:
+        if type(v).__name__ == "Literal":
+            return _const_interval(v.val)
+        return env.get(v, TOP)
+
+    for eqn in jaxpr.eqns:
+        ins = [read(v) for v in eqn.invars]
+        outs = _eval_eqn(eqn, ins, unhandled)
+        for var, iv in zip(eqn.outvars, outs):
+            env[var] = iv
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _recurse_sub(eqn: Any, ins: List[Interval], unhandled: Set[str]) -> Optional[List[Interval]]:
+    """Recurse into the single sub-jaxpr of a call-like equation (pjit,
+    closed_call, custom_jvp/vjp, remat), mapping the call's inputs onto
+    the sub-jaxpr's invars positionally from the right (leading call
+    operands may be hoisted consts)."""
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        sub = eqn.params.get(key)
+        if sub is None:
+            continue
+        inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+        n = len(inner.invars)
+        mapped = (ins[-n:] if n and len(ins) >= n else ins) or []
+        if len(mapped) < n:
+            mapped = mapped + [TOP] * (n - len(mapped))
+        return eval_jaxpr_intervals(sub, mapped, unhandled)
+    return None
+
+
+def _eval_eqn(eqn: Any, ins: List[Interval], unhandled: Set[str]) -> List[Interval]:
+    name = eqn.primitive.name
+    n_out = len(eqn.outvars)
+
+    def all_out(iv: Interval) -> List[Interval]:
+        return [iv] * n_out
+
+    # --- structural / call primitives -------------------------------------
+    if name in ("pjit", "closed_call", "core_call", "xla_call", "remat",
+                "custom_jvp_call", "custom_vjp_call", "checkpoint"):
+        out = _recurse_sub(eqn, ins, unhandled)
+        if out is not None and len(out) == n_out:
+            return out
+        return all_out(TOP)
+    if name == "cond":
+        branches = eqn.params.get("branches") or ()
+        merged: Optional[List[Interval]] = None
+        for br in branches:
+            out = eval_jaxpr_intervals(br, ins[1:], unhandled)
+            merged = out if merged is None else [
+                a.union(b) for a, b in zip(merged, out)
+            ]
+        if merged is not None and len(merged) == n_out:
+            return merged
+        return all_out(TOP)
+    if name == "scan":
+        sub = eqn.params.get("jaxpr")
+        n_consts = int(eqn.params.get("num_consts", 0))
+        n_carry = int(eqn.params.get("num_carry", 0))
+        if sub is None:
+            return all_out(TOP)
+        consts_iv = ins[:n_consts]
+        carry = ins[n_consts:n_consts + n_carry]
+        xs = ins[n_consts + n_carry:]
+        ys: List[Interval] = []
+        for _ in range(3):  # bounded fixed-point iteration, then widen
+            out = eval_jaxpr_intervals(sub, consts_iv + carry + xs, unhandled)
+            new_carry, ys = out[:n_carry], out[n_carry:]
+            widened = [c.union(nc) for c, nc in zip(carry, new_carry)]
+            if widened == carry:
+                break
+            carry = widened
+        else:
+            carry = [TOP] * n_carry
+            out = eval_jaxpr_intervals(sub, consts_iv + carry + xs, unhandled)
+            ys = out[n_carry:]
+        return (carry + ys)[:n_out] if n_carry + len(ys) == n_out else all_out(TOP)
+    if name == "while":
+        unhandled.add(name)
+        return all_out(TOP)
+
+    # --- arithmetic -------------------------------------------------------
+    if name in ("add", "add_any"):
+        return all_out(_iv_add(ins[0], ins[1]))
+    if name == "sub":
+        return all_out(_iv_sub(ins[0], ins[1]))
+    if name == "mul":
+        if (
+            len(eqn.invars) == 2
+            and type(eqn.invars[0]).__name__ != "Literal"
+            and eqn.invars[0] is eqn.invars[1]
+        ):
+            # x*x of the SAME variable is a square: nonnegative, which a
+            # bare product interval cannot see
+            return all_out(_iv_int_pow(ins[0], 2))
+        return all_out(_iv_mul(ins[0], ins[1]))
+    if name == "div":
+        return all_out(_iv_div(ins[0], ins[1]))
+    if name == "neg":
+        return all_out(_iv_neg(ins[0]))
+    if name == "abs":
+        return all_out(_iv_abs(ins[0]))
+    if name == "sign":
+        return all_out(Interval(-1.0, 1.0))
+    if name == "max":
+        return all_out(Interval(max(ins[0].lo, ins[1].lo), max(ins[0].hi, ins[1].hi)))
+    if name == "min":
+        return all_out(Interval(min(ins[0].lo, ins[1].lo), min(ins[0].hi, ins[1].hi)))
+    if name == "exp":
+        return all_out(_IV_EXP(ins[0]))
+    if name == "log":
+        return all_out(_IV_LOG(ins[0]))
+    if name == "log1p":
+        return all_out(_IV_LOG1P(ins[0]))
+    if name == "sqrt":
+        return all_out(_IV_SQRT(_iv_abs(ins[0])))
+    if name == "tanh":
+        return all_out(_IV_TANH(ins[0]))
+    if name == "logistic":
+        return all_out(Interval(0.0, 1.0))
+    if name == "integer_pow":
+        return all_out(_iv_int_pow(ins[0], int(eqn.params.get("y", 1))))
+    if name == "floor":
+        return all_out(Interval(ins[0].lo - 1.0, ins[0].hi))
+    if name in ("round", "nearbyint"):
+        # round-to-nearest moves a value by at most 0.5 in EITHER
+        # direction (round(0.6) = 1 > 0.6): widen both bounds
+        return all_out(Interval(ins[0].lo - 1.0, ins[0].hi + 1.0))
+    if name == "ceil":
+        return all_out(Interval(ins[0].lo, ins[0].hi + 1.0))
+    if name == "clamp":
+        lo_iv, x, hi_iv = ins[0], ins[1], ins[2]
+        # clamp is monotone in x: map both bounds through it (an
+        # intersection formula inverts when x is disjoint from the range)
+        return all_out(Interval(
+            min(max(x.lo, lo_iv.lo), hi_iv.hi),
+            min(max(x.hi, lo_iv.lo), hi_iv.hi),
+        ))
+    if name == "square":
+        return all_out(_iv_int_pow(ins[0], 2))
+
+    # --- comparisons / logic ---------------------------------------------
+    if name in ("eq", "ne", "lt", "le", "gt", "ge", "and", "or", "not", "xor",
+                "is_finite", "reduce_and", "reduce_or"):
+        return all_out(_BOOL)
+
+    # --- shape-only -------------------------------------------------------
+    if name in ("broadcast_in_dim", "reshape", "transpose", "squeeze",
+                "expand_dims", "rev", "copy", "stop_gradient", "slice",
+                "dynamic_slice", "gather", "convert_element_type",
+                "reduce_precision", "real", "device_put", "sharding_constraint",
+                "select_and_scatter_add"):
+        return all_out(ins[0] if ins else TOP)
+    if name == "concatenate":
+        merged = ins[0]
+        for iv in ins[1:]:
+            merged = merged.union(iv)
+        return all_out(merged)
+    if name == "pad":
+        return all_out(ins[0].union(ins[1]) if len(ins) > 1 else ins[0])
+    if name in ("select_n", "select"):
+        merged: Optional[Interval] = None
+        for iv in ins[1:]:
+            merged = iv if merged is None else merged.union(iv)
+        return all_out(merged if merged is not None else TOP)
+    if name == "iota":
+        shape = tuple(eqn.params.get("shape", ()) or ())
+        dim = int(eqn.params.get("dimension", 0))
+        size = int(shape[dim]) if shape and 0 <= dim < len(shape) else 1
+        return all_out(Interval(0.0, float(max(size - 1, 0))))
+    if name == "sort":
+        return list(ins)[:n_out] if len(ins) >= n_out else all_out(TOP)
+    if name == "top_k":
+        outs = [ins[0], TOP]
+        shape = tuple(getattr(eqn.invars[0].aval, "shape", ()) or ())
+        if shape:
+            outs[1] = Interval(0.0, float(max(int(shape[-1]) - 1, 0)))
+        return outs[:n_out] if n_out <= 2 else all_out(TOP)
+    if name in ("argmax", "argmin"):
+        shape = tuple(getattr(eqn.invars[0].aval, "shape", ()) or ())
+        hi = float(max(int(np.prod(shape)) - 1, 0)) if shape else 0.0
+        return all_out(Interval(0.0, hi))
+
+    # --- reductions / contractions ----------------------------------------
+    if name == "reduce_sum":
+        k = _reduced_count(eqn)
+        return all_out(Interval(k * ins[0].lo, k * ins[0].hi))
+    if name == "cumsum":
+        shape = tuple(getattr(eqn.invars[0].aval, "shape", ()) or ())
+        ax = int(eqn.params.get("axis", 0))
+        k = int(shape[ax]) if shape and 0 <= ax < len(shape) else 1
+        return all_out(Interval(k * ins[0].lo, k * ins[0].hi))
+    if name in ("reduce_max", "reduce_min", "cummax", "cummin"):
+        return all_out(ins[0])
+    if name == "dot_general":
+        dims = eqn.params.get("dimension_numbers")
+        k = 1
+        try:
+            (lhs_c, _), _ = dims
+            lshape = tuple(eqn.invars[0].aval.shape)
+            for ax in lhs_c:
+                k *= int(lshape[ax])
+        except Exception:  # noqa: BLE001 — fall back to a loose bound
+            k = max(int(np.prod(tuple(getattr(eqn.invars[0].aval, "shape", ()) or ()))), 1)
+        p = _iv_mul(ins[0], ins[1])
+        return all_out(Interval(k * p.lo, k * p.hi))
+    if name in ("scatter-add", "scatter_add"):
+        o, u = ins[0], ins[-1]
+        k = max(int(np.prod(tuple(getattr(eqn.invars[-1].aval, "shape", ()) or ()))), 1)
+        return all_out(Interval(o.lo + k * min(u.lo, 0.0), o.hi + k * max(u.hi, 0.0)))
+    if name in ("scatter", "scatter-max", "scatter-min", "scatter-mul"):
+        return all_out(ins[0].union(ins[-1]))
+
+    unhandled.add(name)
+    return all_out(TOP)
+
+
+# ---------------------------------------------------------------------------
+# declared per-batch input domains
+# ---------------------------------------------------------------------------
+#: declared element domains per family, one ``(lo, hi)`` per positional
+#: update argument. ``"unbounded"`` marks arguments whose serving-time
+#: values are mean-shifted/large-scale (the regression family) — these get
+#: the mean-shifted MTA011 probe; bounded float args get the near-tie
+#: spread probe instead. Families absent here derive a default from their
+#: sample batch (floats → [0, 1], ints → observed range).
+UNBOUNDED = (-1.0e6, 1.0e6)
+FAMILY_DOMAINS: Dict[str, Tuple[Tuple[float, float], ...]] = {
+    "MeanSquaredError": (UNBOUNDED, UNBOUNDED),
+    "MeanAbsoluteError": (UNBOUNDED, UNBOUNDED),
+    "MeanSquaredLogError": ((0.0, 1.0e6), (0.0, 1.0e6)),
+    "R2Score": (UNBOUNDED, UNBOUNDED),
+    "ExplainedVariance": (UNBOUNDED, UNBOUNDED),
+    "PSNR": ((0.0, 1.0), (0.0, 1.0)),
+    "Hinge": ((-16.0, 16.0), (0.0, 3.0)),
+    "AUC": ((0.0, 1.0), (0.0, 1.0)),
+}
+
+
+def _leaf_domains(family: str, args: tuple, kwargs: dict) -> List[Interval]:
+    """One declared :class:`Interval` per batch-input leaf, in the tree
+    order the update program was traced with."""
+    declared = FAMILY_DOMAINS.get(family)
+    per_arg: List[Optional[Interval]] = []
+    for i, a in enumerate(args):
+        if declared is not None and i < len(declared):
+            per_arg.append(Interval(*declared[i]))
+        else:
+            per_arg.append(None)
+    out: List[Interval] = []
+    flat_args, _ = jax.tree_util.tree_flatten(tuple(args))
+    # args are positional trees; walk arg-by-arg so each arg's leaves share
+    # its declared domain
+    for i, a in enumerate(args):
+        leaves = jax.tree_util.tree_leaves(a)
+        for leaf in leaves:
+            iv = per_arg[i]
+            if iv is None:
+                iv = _default_leaf_domain(leaf)
+            out.append(iv)
+    for leaf in jax.tree_util.tree_leaves(kwargs):
+        out.append(_default_leaf_domain(leaf))
+    assert len(out) == len(flat_args) + len(jax.tree_util.tree_leaves(kwargs))
+    return out
+
+
+def _default_leaf_domain(leaf: Any) -> Interval:
+    dt = getattr(leaf, "dtype", None)
+    if dt is None:
+        return _const_interval(leaf)
+    if jnp.issubdtype(dt, jnp.floating):
+        return Interval(0.0, 1.0)
+    if dt == jnp.bool_:
+        return _BOOL
+    arr = np.asarray(leaf)
+    if arr.size == 0:
+        return Interval(0.0, 0.0)
+    return Interval(float(arr.min()), float(arr.max()))
+
+
+def _rows_per_batch(args: tuple) -> int:
+    for leaf in jax.tree_util.tree_leaves(tuple(args)):
+        shape = tuple(getattr(leaf, "shape", ()) or ())
+        if shape:
+            return max(int(shape[0]), 1)
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# MTA010 — per-state horizons
+# ---------------------------------------------------------------------------
+_SUMLIKE = (dim_zero_sum,)
+_BOUNDED_REDUCTIONS = {dim_zero_mean: "mean", dim_zero_min: "min", dim_zero_max: "max"}
+
+
+def _array_update_closed(metric, args: tuple, kwargs: dict) -> Optional[Tuple[Any, List[str]]]:
+    """The update traced as ``array_states -> new array_states`` (sorted
+    key order on both sides — jax flattens dicts sorted, so invar/outvar
+    positions are unambiguous; list states enter as fresh ``[]`` and are
+    not returned). None when the update does not trace."""
+    from metrics_tpu.analysis.program import _update_program
+
+    defaults = metric._defaults
+    array_names = sorted(k for k, d in defaults.items() if not isinstance(d, list))
+    list_names = [k for k, d in defaults.items() if isinstance(d, list)]
+    run = _update_program(metric)
+
+    def fn(array_states, a, kw):
+        full = {**{k: [] for k in list_names}, **array_states}
+        out = run(full, a, kw)
+        return {k: out[k] for k in array_names}
+
+    states = {k: defaults[k] for k in array_names}
+    try:
+        closed = jax.make_jaxpr(fn)(states, args, kwargs)
+    except Exception:  # noqa: BLE001 — untraceable update: horizons unbounded
+        return None
+    return closed, array_names
+
+
+def state_horizons(
+    metric,
+    args: tuple,
+    kwargs: dict,
+    family: Optional[str] = None,
+    rows_per_step: int = DEFAULT_SERVING_ROWS_PER_STEP,
+) -> Dict[str, Dict[str, Any]]:
+    """Per-state overflow/absorption horizons in ROWS, derived by interval
+    abstract interpretation of the traced update program under the
+    family's declared per-batch input domains.
+
+    Kinds: ``int-overflow`` (rows until an integer accumulator saturates
+    at the declared per-row rate — exact accumulation, batch-size
+    independent), ``float-ulp-absorption`` (rows until ``acc + x == acc``
+    for the family's largest per-step contribution at the declared
+    serving batch shape — ``2^(mantissa+1) × rows_per_step``),
+    ``extremal``/``mean``/``static``/``cat`` (value-bounded or
+    non-accumulating: no horizon), ``residual-exempt`` (error-feedback
+    companions: library-managed, reset on every commit). ``rows: None``
+    means unbounded/no horizon."""
+    family = family or type(metric).__name__
+    defaults = metric._defaults
+    residuals = set(
+        metric._sync_residual_names() if hasattr(metric, "_sync_residual_names") else ()
+    )
+    reductions = getattr(metric, "_reductions", {})
+    horizons: Dict[str, Dict[str, Any]] = {}
+
+    out_ivs: Dict[str, Interval] = {}
+    unhandled: Set[str] = set()
+    traced = _array_update_closed(metric, args, kwargs)
+    if traced is not None:
+        closed, array_names = traced
+        # state inputs get point intervals at their reset defaults, so for
+        # additive updates the output interval minus the default IS the
+        # per-step increment bound; batch inputs get the family's declared
+        # per-batch domain
+        state_ivs = [_const_interval(defaults[k]) for k in array_names]
+        in_ivs = state_ivs + _leaf_domains(family, args, kwargs)
+        jaxpr = closed.jaxpr
+        if len(in_ivs) == len(jaxpr.invars):
+            try:
+                outs = eval_jaxpr_intervals(closed, in_ivs, unhandled)
+            except Exception:  # noqa: BLE001 — analysis must never crash the audit
+                outs = []
+            if len(outs) == len(array_names):
+                out_ivs = dict(zip(array_names, outs))
+
+    n_rows = _rows_per_batch(args)
+    for name, default in defaults.items():
+        if isinstance(default, list):
+            horizons[name] = {"kind": "cat", "rows": None}
+            continue
+        if name in residuals:
+            horizons[name] = {"kind": "residual-exempt", "rows": None}
+            continue
+        red = reductions.get(name)
+        if red in _BOUNDED_REDUCTIONS:
+            horizons[name] = {"kind": _BOUNDED_REDUCTIONS[red], "rows": None}
+            continue
+        dt = jnp.asarray(default).dtype
+        d_iv = _const_interval(default)
+        out_iv = out_ivs.get(name)
+        inc = _iv_sub(out_iv, d_iv) if out_iv is not None else None
+        entry: Dict[str, Any] = {
+            "dtype": str(dt),
+            "per_step_increment": (
+                None if inc is None else [_json_num(inc.lo), _json_num(inc.hi)]
+            ),
+        }
+        if jnp.issubdtype(dt, jnp.integer):
+            entry["kind"] = "int-overflow"
+            if inc is None:
+                entry["rows"] = None
+                entry["note"] = "update did not trace; increment unbounded"
+            else:
+                up_rate = max(inc.hi, 0.0) / n_rows
+                dn_rate = max(-inc.lo, 0.0) / n_rows
+                info = jnp.iinfo(dt)
+                if math.isinf(up_rate) or math.isinf(dn_rate):
+                    # a TOP increment (unhandled primitive, zero-spanning
+                    # divisor): saturation cannot be bounded away — flag at
+                    # horizon 0 rather than certify an unknown
+                    entry["rows"] = 0.0
+                    entry["note"] = "increment unbounded by the declared domain"
+                elif up_rate == 0.0 and dn_rate == 0.0:
+                    entry["kind"] = "static"
+                    entry["rows"] = None
+                else:
+                    rows = _INF
+                    if up_rate > 0:
+                        rows = min(rows, (float(info.max) - d_iv.hi) / up_rate)
+                    if dn_rate > 0:
+                        rows = min(rows, (d_iv.lo - float(info.min)) / dn_rate)
+                    entry["rows"] = float(rows)
+        elif jnp.issubdtype(dt, jnp.floating):
+            accumulates = inc is None or inc.lo != 0.0 or inc.hi != 0.0
+            if not accumulates:
+                entry["kind"] = "static"
+                entry["rows"] = None
+            else:
+                # absorption: after 2^(mantissa+1) steps at the declared
+                # serving batch shape, even the LARGEST per-step
+                # contribution satisfies acc + x == acc (partial ulp loss
+                # begins earlier; the MTA011 measured budget covers the
+                # conditioning side)
+                p = int(jnp.finfo(dt).nmant) + 1
+                entry["kind"] = "float-ulp-absorption"
+                entry["rows"] = float(2 ** p) * float(rows_per_step)
+        else:
+            entry["kind"] = "static"
+            entry["rows"] = None
+        horizons[name] = entry
+    if unhandled:
+        horizons["__approximated__"] = {
+            "kind": "note", "rows": None,
+            "unhandled_primitives": sorted(unhandled),
+        }
+    return horizons
+
+
+def _json_num(x: float) -> Optional[float]:
+    return None if math.isinf(x) or math.isnan(x) else float(x)
+
+
+# ---------------------------------------------------------------------------
+# MTA011 — cancellation: structural taint + measured budget
+# ---------------------------------------------------------------------------
+_ACCUMULATED = (dim_zero_sum, dim_zero_mean)
+
+
+def _compute_closed(metric) -> Optional[Tuple[Any, List[str]]]:
+    """The compute program traced abstractly as a function of the array
+    states, plus the state-leaf order; None when compute does not trace
+    (eager-only families: list states, host densification)."""
+    from metrics_tpu.metric import _san_allow_ctx
+
+    # sorted: jax flattens the states dict in sorted key order, so the
+    # traced invars align with this list positionally
+    names = sorted(k for k, d in metric._defaults.items() if not isinstance(d, list))
+    if len(names) != len(metric._defaults):
+        return None  # list states: compute concatenates on the host
+
+    def fn(states):
+        saved = metric._snapshot_state()
+        try:
+            with _san_allow_ctx():
+                for k, v in states.items():
+                    setattr(metric, k, v)
+                metric._computed = None
+                return metric.compute()
+        finally:
+            metric._restore_state(saved)
+            metric._computed = None
+
+    states = {k: metric._defaults[k] for k in names}
+    try:
+        closed = jax.make_jaxpr(fn)(states)
+    except Exception:  # noqa: BLE001 — untraceable compute: structural leg skipped
+        return None
+    return closed, names
+
+
+def cancellation_sites(metric) -> Optional[List[Dict[str, Any]]]:
+    """Structural MTA011 leg: subtractions (``sub``, or ``add`` of a
+    negated value) whose BOTH operands descend from accumulated
+    (sum/mean-reduced) states, found by a taint walk over the compute
+    jaxpr (recursing into pjit sub-jaxprs). Returns the site list, or
+    None when compute does not trace."""
+    traced = _compute_closed(metric)
+    if traced is None:
+        return None
+    closed, names = traced
+    reductions = getattr(metric, "_reductions", {})
+    residuals = set(
+        metric._sync_residual_names() if hasattr(metric, "_sync_residual_names") else ()
+    )
+    tainted_roots = [
+        reductions.get(n) in _ACCUMULATED and n not in residuals for n in names
+    ]
+    sites: List[Dict[str, Any]] = []
+
+    def walk(jaxpr: Any, taint_in: List[bool]) -> List[bool]:
+        taint: Dict[Any, bool] = {}
+        negated: Dict[Any, bool] = {}
+        for var, t in zip(jaxpr.invars, taint_in):
+            taint[var] = t
+        for var in jaxpr.constvars:
+            taint[var] = False
+
+        def tainted(v: Any) -> bool:
+            return type(v).__name__ != "Literal" and taint.get(v, False)
+
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            in_taints = [tainted(v) for v in eqn.invars]
+            sub = None
+            for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                sub = eqn.params.get(key)
+                if sub is not None:
+                    break
+            if sub is not None and name not in ("scan", "while", "cond"):
+                inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+                n = len(inner.invars)
+                mapped = in_taints[-n:] if n and len(in_taints) >= n else in_taints
+                if len(mapped) < n:
+                    mapped = mapped + [False] * (n - len(mapped))
+                out_taints = walk(inner, mapped)
+                if len(out_taints) != len(eqn.outvars):
+                    out_taints = [any(in_taints)] * len(eqn.outvars)
+            else:
+                is_sub = False
+                if name == "sub" and in_taints[0] and in_taints[1]:
+                    is_sub = True
+                elif name in ("add", "add_any") and all(in_taints):
+                    if any(
+                        negated.get(v, False)
+                        for v in eqn.invars
+                        if type(v).__name__ != "Literal"
+                    ):
+                        is_sub = True
+                if is_sub:
+                    sites.append({
+                        "primitive": name,
+                        "shape": str(getattr(eqn.outvars[0].aval, "shape", ())),
+                    })
+                # comparisons launder magnitude information; their outputs
+                # are {0,1} and cannot cancel catastrophically
+                clears = name in ("eq", "ne", "lt", "le", "gt", "ge",
+                                  "and", "or", "not", "xor", "sign", "is_finite")
+                out_taints = [False if clears else any(in_taints)] * len(eqn.outvars)
+            for var, t in zip(eqn.outvars, out_taints):
+                taint[var] = t
+                if name == "neg" and in_taints and in_taints[0]:
+                    negated[var] = True
+        return [tainted(v) for v in jaxpr.outvars]
+
+    jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    # invars are the tree leaves of the states dict (one per array state,
+    # registration order)
+    walk(jaxpr, tainted_roots[: len(jaxpr.invars)])
+    return sites
+
+
+def _adversarial_probes(
+    family: str, args: tuple, seed: int = 0x1CE
+) -> List[Tuple[str, tuple]]:
+    """Ill-conditioned probe batches shaped like ``args``. Unbounded float
+    args get mean-shifted data (shift 1e6, unit spread — the variance
+    killer) and a tiny-scale leg (1e-6 — underflow/absolute-epsilon);
+    bounded float args get a near-tie spread around the domain midpoint
+    (0.5 ± 1e-6). All float probes are cast to f32 FIRST — the fp64
+    oracle consumes the identical f32 values, so the measured budget is
+    computation error, not input quantization."""
+    declared = FAMILY_DOMAINS.get(family)
+    rng = np.random.RandomState(seed)
+
+    def build(mode: str) -> tuple:
+        out = []
+        for i, a in enumerate(args):
+            if not (hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)):
+                out.append(a)
+                continue
+            shape = tuple(np.asarray(a).shape)
+            r = rng.rand(*shape) if shape else rng.rand()
+            lo, hi = (declared[i] if declared is not None and i < len(declared)
+                      else (0.0, 1.0))
+            unbounded = (hi - lo) > 1e3
+            if mode == "shifted" and unbounded:
+                vals = 1.0e6 + (r - 0.5) * 2.0 if lo < 0 else 1.0e6 + r
+            elif mode == "tiny" and unbounded:
+                vals = (r - 0.5) * 2.0e-6 if lo < 0 else r * 1.0e-6
+            else:
+                # bounded domain: near-tie spread at the midpoint
+                vals = 0.5 + (r - 0.5) * 2.0e-6
+                if np.ndim(vals) >= 2 and bool((np.asarray(a) >= 0).all()):
+                    rowsum = np.asarray(a).sum(axis=-1)
+                    if np.allclose(rowsum, 1.0, atol=1e-3):
+                        vals = vals / vals.sum(axis=-1, keepdims=True)
+            out.append(jnp.asarray(np.asarray(vals, dtype=np.float32)))
+        return tuple(out)
+
+    return [("shifted", build("shifted")), ("tiny", build("tiny"))]
+
+
+def measure_error_budget(
+    metric, args: tuple, family: Optional[str] = None
+) -> Optional[Dict[str, Any]]:
+    """Measured MTA011 leg: the family's update→compute composite
+    evaluated on adversarial ill-conditioned probes in f32 against an
+    fp64 oracle fed the identical f32-cast inputs; returns the observed
+    worst relative error (capped at :data:`ERROR_BUDGET_CAP`) with the
+    per-probe breakdown, or None when the family cannot be measured."""
+    from jax.experimental import enable_x64
+
+    from metrics_tpu.analysis.distributed import _compute_on_states, _states_after_update
+
+    family = family or type(metric).__name__
+    per_probe: Dict[str, float] = {}
+    worst = 0.0
+    measured = False
+    for probe_name, probe in _adversarial_probes(family, args):
+        try:
+            v32 = _compute_on_states(
+                metric, _states_after_update(metric, probe, {})
+            )
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with enable_x64():
+                    probe64 = tuple(
+                        jnp.asarray(np.asarray(a, dtype=np.float64))
+                        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+                        else a
+                        for a in probe
+                    )
+                    v64 = _compute_on_states(
+                        metric, _states_after_update(metric, probe64, {})
+                    )
+        except Exception:  # noqa: BLE001 — a probe outside the family's domain
+            continue
+        rel = _relative_error(v32, v64)
+        if rel is None:
+            continue
+        measured = True
+        per_probe[probe_name] = rel
+        worst = max(worst, rel)
+    if not measured:
+        return None
+    return {
+        "budget": min(worst, ERROR_BUDGET_CAP),
+        "per_probe": per_probe,
+        "oracle": "float64",
+    }
+
+
+def _relative_error(v32: Any, v64: Any) -> Optional[float]:
+    l32 = [np.asarray(x, dtype=np.float64) for x in jax.tree_util.tree_leaves(v32)]
+    l64 = [np.asarray(x, dtype=np.float64) for x in jax.tree_util.tree_leaves(v64)]
+    if len(l32) != len(l64):
+        return None
+    worst = 0.0
+    seen = False
+    for a, b in zip(l32, l64):
+        if a.shape != b.shape or not a.size:
+            continue
+        ok = np.isfinite(a) & np.isfinite(b)
+        if not ok.any():
+            continue
+        seen = True
+        denom = np.maximum(np.abs(b[ok]), 1e-12)
+        worst = max(worst, float((np.abs(a[ok] - b[ok]) / denom).max()))
+    return worst if seen else None
+
+
+def committed_budget_ceiling(observed: float) -> float:
+    """The value the baseline commits for an observed budget: the next
+    power of two above 4× the observation (headroom for FMA/platform
+    drift), floored at 2⁻²⁴ and capped at :data:`ERROR_BUDGET_CAP` —
+    deterministic, and still sensitive to a genuine conditioning
+    regression (anything worse than ~8× the committed measurement)."""
+    if observed <= 0.0:
+        return 2.0 ** -24
+    ceil = 2.0 ** math.ceil(math.log2(max(observed * 4.0, 2.0 ** -24)))
+    return min(ceil, ERROR_BUDGET_CAP)
+
+
+# ---------------------------------------------------------------------------
+# MTA012 — scale/shift-equivariance probes
+# ---------------------------------------------------------------------------
+#: declared equivariance classes, keyed by family/class name. ``scale_args``
+#: are the update-argument positions the probe rescales; ``factor_exp`` is
+#: the exponent k with compute(s·x) == s^k · compute(x) (k = 0:
+#: scale-invariant). Scales are powers of two, so IEEE multiplication is
+#: EXACT and the expected transform is checked BITWISE — any drift is a
+#: hidden absolute-epsilon threshold or premature rounding. Families whose
+#: canonicalization is legitimately scale-dependent (0.5 probability
+#: thresholds, fixed [0, 1] bin edges, rowsum-based input-format
+#: classification, PSNR's fixed data_range, MSLE's log1p) are deliberately
+#: absent.
+EQUIVARIANCE: Dict[str, Dict[str, Any]] = {
+    "AUROC": {"scale_args": (0,), "scales": (0.5, 2.0 ** -10), "factor_exp": 0},
+    "AveragePrecision": {"scale_args": (0,), "scales": (0.5, 2.0 ** -10), "factor_exp": 0},
+    "RetrievalMAP": {"scale_args": (1,), "scales": (0.5, 2.0 ** -10), "factor_exp": 0},
+    "RetrievalMRR": {"scale_args": (1,), "scales": (0.5, 2.0 ** -10), "factor_exp": 0},
+    "RetrievalPrecision": {"scale_args": (1,), "scales": (0.5, 2.0 ** -10), "factor_exp": 0},
+    "RetrievalRecall": {"scale_args": (1,), "scales": (0.5, 2.0 ** -10), "factor_exp": 0},
+    "R2Score": {"scale_args": (0, 1), "scales": (2.0, 0.5), "factor_exp": 0},
+    "ExplainedVariance": {"scale_args": (0, 1), "scales": (2.0, 0.5), "factor_exp": 0},
+    "MeanSquaredError": {"scale_args": (0, 1), "scales": (2.0, 0.5), "factor_exp": 2},
+    "MeanAbsoluteError": {"scale_args": (0, 1), "scales": (2.0, 0.5), "factor_exp": 1},
+    # the MTA012 fixture: declared scale-invariant, hides an absolute
+    # epsilon — the probe must catch it (tests/analysis pins it)
+    "EpsilonThresholdAUROC": {"scale_args": (0,), "scales": (0.5, 2.0 ** -10), "factor_exp": 0},
+}
+
+
+def equivariance_verdict(
+    metric, args: tuple, family: Optional[str] = None
+) -> Optional[Dict[str, Any]]:
+    """Concrete metamorphic MTA012 check against the declared class;
+    None when the family declares no equivariance. The verdict carries
+    every probed scale with its bitwise result."""
+    from metrics_tpu.analysis.distributed import _compute_on_states, _states_after_update
+
+    family = family or type(metric).__name__
+    spec = EQUIVARIANCE.get(family)
+    if spec is None:
+        return None
+    try:
+        base = _compute_on_states(metric, _states_after_update(metric, args, {}))
+    except Exception:  # noqa: BLE001
+        return {"kind": _kind(spec), "checked": False, "error": "base evaluation failed"}
+    base_leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(base)]
+    results: List[Dict[str, Any]] = []
+    stable = True
+    for scale in spec["scales"]:
+        scaled_args = tuple(
+            jnp.asarray(np.asarray(a) * np.float32(scale))
+            if i in spec["scale_args"] else a
+            for i, a in enumerate(args)
+        )
+        try:
+            got = _compute_on_states(
+                metric, _states_after_update(metric, scaled_args, {})
+            )
+        except Exception as err:  # noqa: BLE001
+            results.append({"scale": scale, "bit_stable": False,
+                            "error": f"{type(err).__name__}"})
+            stable = False
+            continue
+        factor = float(scale) ** int(spec["factor_exp"])
+        got_leaves = [np.asarray(x) for x in jax.tree_util.tree_leaves(got)]
+        ok = len(got_leaves) == len(base_leaves)
+        delta = 0.0
+        if ok:
+            for g, b in zip(got_leaves, base_leaves):
+                expected = (
+                    b if spec["factor_exp"] == 0
+                    else np.asarray(b, dtype=g.dtype) * g.dtype.type(factor)
+                    if g.dtype.kind == "f" else b
+                )
+                if g.shape != np.asarray(expected).shape or not np.array_equal(
+                    g, expected, equal_nan=True
+                ):
+                    ok = False
+                    with np.errstate(all="ignore"):
+                        d = np.abs(
+                            np.asarray(g, dtype=np.float64)
+                            - np.asarray(expected, dtype=np.float64)
+                        )
+                        delta = float(np.nanmax(d)) if d.size else float("inf")
+                    break
+        results.append({
+            "scale": scale, "factor": factor, "bit_stable": ok,
+            **({} if ok else {"max_delta": delta}),
+        })
+        stable = stable and ok
+    return {"kind": _kind(spec), "checked": True, "bit_stable": stable,
+            "scales": results}
+
+
+def _kind(spec: Dict[str, Any]) -> str:
+    return "scale-invariant" if spec["factor_exp"] == 0 else "scale-equivariant"
+
+
+# ---------------------------------------------------------------------------
+# the committed baseline
+# ---------------------------------------------------------------------------
+def _repo_root() -> str:
+    import metrics_tpu
+
+    return os.path.dirname(os.path.dirname(os.path.abspath(metrics_tpu.__file__)))
+
+
+_BASELINE_CACHE: Dict[str, Optional[Dict[str, Any]]] = {}
+_BASELINE_LOCK = threading.Lock()
+
+
+def load_numerics_baseline(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """The committed per-family numerics entries (``family -> entry``), or
+    None when no baseline is committed. Cached per path."""
+    path = path or os.path.join(_repo_root(), NUMERICS_BASELINE_FILENAME)
+    with _BASELINE_LOCK:
+        if path not in _BASELINE_CACHE:
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    _BASELINE_CACHE[path] = json.load(fh).get("entries") or {}
+            except (OSError, ValueError):
+                _BASELINE_CACHE[path] = None
+        return _BASELINE_CACHE[path]
+
+
+def build_numerics_entry(evidence: Dict[str, Any]) -> Dict[str, Any]:
+    """The committed-baseline entry derived from one family's fresh
+    ``evidence["numerics"]``: the state inventory, every numeric horizon,
+    and the error-budget ceiling."""
+    horizons = {
+        name: {"kind": h.get("kind"), "rows": h.get("rows")}
+        for name, h in (evidence.get("horizons") or {}).items()
+        if not name.startswith("__")
+    }
+    entry: Dict[str, Any] = {
+        "states": sorted(horizons),
+        "horizons": horizons,
+    }
+    cancel = evidence.get("cancellation") or {}
+    budget = cancel.get("budget")
+    entry["error_budget"] = (
+        None if budget is None else committed_budget_ceiling(float(budget))
+    )
+    return entry
+
+
+def min_horizon_rows(
+    evidence_by_family: Optional[Dict[str, Any]]
+) -> Optional[float]:
+    """The shortest FINITE horizon, in rows, across a mapping of
+    ``evidence["numerics"]`` dicts — the registry's first state to
+    numerically exhaust. None when nothing carries a numeric horizon.
+    The one fold behind the ``analysis.numerics.horizon_min`` gauge, the
+    lint summary line, and CI's numerics_evidence.json."""
+    worst: Optional[float] = None
+    for ev in (evidence_by_family or {}).values():
+        for h in ((ev or {}).get("horizons") or {}).values():
+            rows = h.get("rows") if isinstance(h, dict) else None
+            if rows is not None:
+                worst = float(rows) if worst is None else min(worst, float(rows))
+    return worst
+
+
+def tighten_baseline(
+    baseline: Dict[str, Any], fresh: Dict[str, Dict[str, Any]]
+) -> Tuple[Dict[str, Any], List[str]]:
+    """Merge a green audit's fresh entries into the committed baseline,
+    IMPROVEMENTS ONLY: horizons never drop, error budgets never grow, a
+    committed-unbounded horizon stays unbounded. Fixture entries named in
+    ``baseline["fixtures"]`` keep their deliberately-tight committed
+    values; retired/renamed families are pruned (returned second). A
+    worsening never reaches this merge — the refresh path refuses a red
+    audit, and a worsening IS a red audit."""
+    old_entries = baseline.get("entries", {}) or {}
+    keep = set(baseline.get("fixtures", []) or [])
+    entries: Dict[str, Any] = {
+        fam: old_entries[fam] for fam in sorted(keep) if fam in old_entries
+    }
+    for fam, fresh_entry in sorted(fresh.items()):
+        if fam in entries:
+            continue  # a fixture name: the committed gate wins
+        old = old_entries.get(fam)
+        entry = dict(fresh_entry)
+        if old is not None and old.get("states") == fresh_entry.get("states"):
+            horizons: Dict[str, Any] = {}
+            for name, h in (fresh_entry.get("horizons") or {}).items():
+                oh = (old.get("horizons") or {}).get(name)
+                rows = h.get("rows")
+                if oh is not None:
+                    o_rows = oh.get("rows")
+                    if o_rows is None:
+                        rows = None
+                    elif rows is not None:
+                        rows = max(float(o_rows), float(rows))
+                    else:
+                        rows = None  # fresh unbounded: an improvement
+                horizons[name] = {**h, "rows": rows}
+            entry["horizons"] = horizons
+            ob = old.get("error_budget")
+            fb = fresh_entry.get("error_budget")
+            if ob is not None and fb is not None:
+                entry["error_budget"] = min(float(ob), float(fb))
+            elif fb is None:
+                entry["error_budget"] = ob
+        entries[fam] = entry
+    pruned = sorted(set(old_entries) - set(entries))
+    out = dict(baseline)
+    out["entries"] = entries
+    return out, pruned
+
+
+def check_numerics(
+    metric,
+    findings: List[Finding],
+    infos: List[str],
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    family: Optional[str] = None,
+    baseline: Optional[Dict[str, Any]] = None,
+    cache: Optional[Dict[str, Any]] = None,
+    floor_rows: float = DEFAULT_FLEET_FLOOR_ROWS,
+    rows_per_step: int = DEFAULT_SERVING_ROWS_PER_STEP,
+) -> Dict[str, Any]:
+    """Pass 5 over one metric: derive horizons (MTA010), cancellation
+    sites + measured budget (MTA011), and the equivariance verdict
+    (MTA012); gate horizons and budget against the committed baseline.
+    Returns the ``evidence["numerics"]`` dict.
+
+    ``cache`` (shared per family root across the @cohort/@int8/@bf16
+    variant audits) carries the measured budget, equivariance verdict and
+    base horizons — the variant namespaces share the family's math, so
+    only their state inventory (residual companions) differs."""
+    cls = type(metric).__name__
+    family = family or cls
+    kwargs = dict(kwargs or {})
+    cache = cache if cache is not None else {}
+
+    root_key = "numerics:root"
+    if root_key in cache:
+        root = cache[root_key]
+        base_horizons = dict(root["horizons"])
+        # variant inventories add residual companions (and never remove a
+        # base state); recompute only the states the base audit didn't see
+        horizons: Dict[str, Dict[str, Any]] = {}
+        residuals = set(
+            metric._sync_residual_names() if hasattr(metric, "_sync_residual_names") else ()
+        )
+        for name, default in metric._defaults.items():
+            if name in base_horizons:
+                horizons[name] = base_horizons[name]
+            elif name in residuals:
+                horizons[name] = {"kind": "residual-exempt", "rows": None}
+            else:
+                horizons[name] = {"kind": "cat" if isinstance(default, list) else "static",
+                                  "rows": None}
+        cancellation = root["cancellation"]
+        equivariance = root["equivariance"]
+    else:
+        try:
+            horizons = state_horizons(
+                metric, args, kwargs, family=family, rows_per_step=rows_per_step,
+            )
+        except Exception:  # noqa: BLE001 — analysis must never crash the audit
+            horizons = {}
+        sites: Optional[List[Dict[str, Any]]]
+        try:
+            sites = cancellation_sites(metric)
+        except Exception:  # noqa: BLE001
+            sites = None
+        try:
+            measured = measure_error_budget(metric, args, family=cls)
+        except Exception:  # noqa: BLE001
+            measured = None
+        cancellation = {
+            "sites": sites,
+            **(measured or {"budget": None}),
+        }
+        if sites is None:
+            infos.append(
+                f"{cls}: MTA011 structural leg skipped — compute does not"
+                " trace (eager-only family); measured budget still applies"
+            )
+        try:
+            equivariance = equivariance_verdict(metric, args, family=cls)
+        except Exception:  # noqa: BLE001
+            equivariance = None
+        cache[root_key] = {
+            "horizons": {k: v for k, v in horizons.items() if not k.startswith("__")},
+            "cancellation": cancellation,
+            "equivariance": equivariance,
+        }
+
+    evidence: Dict[str, Any] = {
+        "horizons": horizons,
+        "cancellation": cancellation,
+        "equivariance": equivariance,
+        "floor_rows": float(floor_rows),
+        "rows_per_step": int(rows_per_step),
+    }
+
+    # --- MTA010: fleet floor ---------------------------------------------
+    # one defect, one diagnosis: a float accumulator narrower than its
+    # input is MTA001's finding (whether or not this audit ran that pass —
+    # the slim variant audits deliberately skip it), and its short
+    # absorption horizon is the same defect seen from the lifetime side
+    mta001_states = {
+        f.subject.split(".", 1)[1]
+        for f in findings
+        if f.rule == "MTA001" and "." in f.subject
+    }
+    from metrics_tpu.analysis.program import _widest_float_input
+
+    widest = _widest_float_input(args, kwargs)
+    if widest is not None:
+        for name, default in metric._defaults.items():
+            if isinstance(default, list):
+                continue
+            dt = jnp.asarray(default).dtype
+            if (
+                jnp.issubdtype(dt, jnp.floating)
+                and jnp.dtype(dt).itemsize < jnp.dtype(widest).itemsize
+            ):
+                mta001_states.add(name)
+    for name, h in horizons.items():
+        if name.startswith("__"):
+            continue
+        rows = h.get("rows")
+        if rows is None or rows >= floor_rows:
+            continue
+        if name in mta001_states:
+            # one defect, one diagnosis: a narrowed/drifting accumulator's
+            # short horizon IS the MTA001 finding
+            infos.append(
+                f"{cls}.{name}: horizon {rows:.3g} rows below the fleet floor"
+                " — already diagnosed as MTA001 (narrow accumulator)"
+            )
+            continue
+        findings.append(Finding(
+            "MTA010", f"{cls}.{name}",
+            f"{h.get('kind')} horizon is {rows:.4g} rows — below the fleet"
+            f" floor of {float(floor_rows):.4g} rows: this accumulator"
+            " saturates (or stops absorbing increments) within a serving"
+            " process lifetime. Widen the state dtype, or suppress with a"
+            " written rationale and arm StateGuard(overflow_margin=...) as"
+            " the runtime mitigation",
+            detail={"state": name, "rows": rows, "floor": float(floor_rows),
+                    "kind": h.get("kind")},
+        ))
+
+    # --- MTA012 (baseline-independent: the declared class either holds
+    # bitwise or it does not) ------------------------------------------------
+    _equivariance_findings(cls, equivariance, findings)
+
+    # --- the committed-baseline gate ---------------------------------------
+    base = load_numerics_baseline() if baseline is None else baseline
+    entry = (base or {}).get(family)
+    if entry is None:
+        return evidence
+    fresh_states = sorted(
+        k for k in horizons if not k.startswith("__")
+    )
+    recorded = entry.get("states")
+    if recorded is not None and list(recorded) != fresh_states:
+        infos.append(
+            f"{cls}: committed numerics baseline for {family!r} records states"
+            f" {list(recorded)} but this configuration registers"
+            f" {fresh_states}; measured, not gated"
+        )
+        return evidence
+    for name, committed in (entry.get("horizons") or {}).items():
+        c_rows = committed.get("rows")
+        f_rows = (horizons.get(name) or {}).get("rows")
+        if c_rows is None:
+            continue
+        if f_rows is None:
+            continue  # unbounded now: an improvement
+        if name in mta001_states:
+            continue  # the narrowing is MTA001's diagnosis
+        if f_rows < float(c_rows):
+            findings.append(Finding(
+                "MTA010", f"{cls}.{name}",
+                f"horizon regression: {f_rows:.4g} rows vs the committed"
+                f" baseline of {float(c_rows):.4g} — a dtype narrowing or a"
+                " larger per-step increment shortened this state's life."
+                " If intended, hand-edit this family's entry in"
+                " NUMERICS_BASELINE.json and justify it in review"
+                " (`make lint` only auto-refreshes IMPROVEMENTS)",
+                detail={"state": name, "rows": f_rows, "baseline": float(c_rows)},
+            ))
+    c_budget = entry.get("error_budget")
+    f_budget = cancellation.get("budget")
+    if c_budget is not None and f_budget is not None and float(f_budget) > float(c_budget):
+        findings.append(Finding(
+            "MTA011", cls,
+            f"measured cancellation error budget blown: observed relative"
+            f" error {float(f_budget):.4g} on the adversarial probes vs the"
+            f" committed budget of {float(c_budget):.4g} — a refactor"
+            " worsened this family's conditioning (the E[x²]−E[x]² class of"
+            " loss), even if the program shape is unchanged. If the new"
+            " formulation is intended, hand-edit the committed budget and"
+            " justify it in review",
+            detail={"observed": float(f_budget), "baseline": float(c_budget),
+                    "sites": len(cancellation.get("sites") or [])},
+        ))
+
+    return evidence
+
+
+def _equivariance_findings(cls: str, equivariance, findings: List[Finding]) -> None:
+    if equivariance is not None and equivariance.get("checked") and not equivariance.get("bit_stable"):
+        bad = [r for r in equivariance["scales"] if not r.get("bit_stable")]
+        findings.append(Finding(
+            "MTA012", cls,
+            f"declared {equivariance['kind']} family is not bit-stable under"
+            f" power-of-two input rescaling (failing scales:"
+            f" {[r['scale'] for r in bad]}): a hidden absolute-epsilon"
+            " threshold or premature rounding makes the result depend on"
+            " the input's SCALE, not its order statistics",
+            detail={"failing": bad},
+        ))

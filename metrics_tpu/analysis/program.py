@@ -677,6 +677,16 @@ def audit_metric(
                 n_state_outputs=n_state_outs, engine_eligible=True,
             ),
         }
+    # pass 5 — numerical soundness (every family: eager-only accumulators
+    # saturate just as surely as compiled ones)
+    from metrics_tpu.analysis import numerics as _num
+
+    evidence = result.evidence if result.evidence is not None else {}
+    evidence["numerics"] = _num.check_numerics(
+        metric, findings, result.infos, args=args, kwargs=kwargs,
+        cache=_probe_cache,
+    )
+    result.evidence = evidence
     if fingerprint:
         result.fingerprints = {
             "update": _dist.fingerprint_jaxpr(update_closed) if update_closed is not None else None,
@@ -826,7 +836,8 @@ _COHORT_AUDIT_CAPACITY = 4
 
 
 def _audit_cohort_variant(
-    metric, args: tuple, fingerprint: bool = False, family: Optional[str] = None
+    metric, args: tuple, fingerprint: bool = False, family: Optional[str] = None,
+    probe_cache: Optional[Dict[str, Any]] = None,
 ) -> AuditResult:
     """A slim audit of the vmapped cohort step of an engine-eligible
     family (reported as ``<Family>@cohort``): the per-tenant math is the
@@ -890,6 +901,8 @@ def _audit_cohort_variant(
                 " state",
                 detail={"position": pos},
             ))
+    from metrics_tpu.analysis import numerics as _num
+
     result.evidence = {
         "host_seam": _conc.check_host_seam(
             metric, findings, result.infos, family=family or f"{cls}@cohort",
@@ -899,6 +912,10 @@ def _audit_cohort_variant(
             metric, findings, result.infos,
             step_closed=closed, n_donated=n_donated if closed is not None else 0,
             n_state_outputs=n_state_outs, engine_eligible=True,
+        ),
+        "numerics": _num.check_numerics(
+            metric, findings, result.infos, args=args,
+            family=family or f"{cls}@cohort", cache=probe_cache,
         ),
     }
     if fingerprint:
@@ -962,6 +979,14 @@ def _audit_quantized_variant(
                 n_state_outputs=n_state_outs, engine_eligible=True,
             ),
         }
+    from metrics_tpu.analysis import numerics as _num
+
+    evidence = result.evidence if result.evidence is not None else {}
+    evidence["numerics"] = _num.check_numerics(
+        metric, findings, result.infos, args=args,
+        family=family, cache=probe_cache,
+    )
+    result.evidence = evidence
     _route_suppressions(metric, findings, result, check_staleness=False)
     return result
 
@@ -1013,7 +1038,8 @@ def audit_registry(
         note(name, base)
         if cohort and base.engine_eligible:
             note(f"{name}@cohort", _audit_cohort_variant(
-                factory(), args, fingerprint=fingerprints, family=f"{name}@cohort"
+                factory(), args, fingerprint=fingerprints,
+                family=f"{name}@cohort", probe_cache=probe_cache,
             ))
         if not quantized:
             continue
@@ -1037,7 +1063,7 @@ def audit_registry(
 
     report = {
         "schema": "metrics_tpu.analysis_report",
-        "version": 2,
+        "version": 3,
         "rules": {rid: r.to_dict() for rid, r in sorted(RULES.items())},
         "families": families,
         # the AST leg of the seam audit: where each host<->device crossing
@@ -1062,6 +1088,23 @@ def audit_registry(
             crossings += flat.get("per_sync.host_collectives", 0)
             crossings += flat.get("steady_per_step", 0)
         _obs.get().gauge("analysis.seam.crossings", crossings)
+        # numerics evidence: the registry's shortest finite horizon (rows)
+        # and the count of unsuppressed pass-5 findings (zero on a healthy
+        # run — the glossary pins both)
+        from metrics_tpu.analysis import numerics as _num
+
+        horizon_min = _num.min_horizon_rows({
+            fam: (entry.get("evidence") or {}).get("numerics")
+            for fam, entry in families.items()
+        })
+        numerics_findings = sum(
+            1 for entry in families.values() for f in entry["findings"]
+            if f.get("rule") in ("MTA010", "MTA011", "MTA012")
+        )
+        if horizon_min is not None:
+            _obs.get().gauge("analysis.numerics.horizon_min", horizon_min)
+        if numerics_findings:
+            _obs.get().count("analysis.numerics.findings", numerics_findings)
     if fingerprints:
         report["fingerprints"] = prints
     if write_path is not None:

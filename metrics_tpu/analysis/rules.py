@@ -252,6 +252,69 @@ MTA009 = rule(
 )
 
 
+MTA010 = rule(
+    "MTA010",
+    "overflow-horizon",
+    "numerics",
+    "An accumulator's overflow/saturation horizon — rows until an integer"
+    " state saturates, or a float state stops absorbing its own per-step"
+    " increment (ulp absorption) — is below the fleet floor (default 2^40"
+    " rows), or regressed below its committed NUMERICS_BASELINE.json"
+    " horizon (a gated dtype narrowing).",
+    "State lifetime, not step cost, is the serving-scale hazard: an int32"
+    " row counter is fine in every unit test and saturates after 2^31 rows"
+    " — about 25 minutes at the measured 1.40 Mrows/s — while an f32"
+    " running sum silently absorbs-to-nothing long before any NaN appears."
+    " Pass 5 derives each state's max per-step increment by interval"
+    " abstract interpretation of the traced update program under declared"
+    " per-batch input domains, converts it to a horizon in rows, gates it"
+    " against the fleet floor AND the committed per-state baseline, and"
+    " records every horizon so a dtype narrowing is a reviewed regression,"
+    " not a silent one. StateGuard(overflow_margin=...) is the runtime"
+    " counterpart (warn + count when an integer accumulator actually"
+    " approaches its horizon).",
+)
+
+MTA011 = rule(
+    "MTA011",
+    "catastrophic-cancellation",
+    "numerics",
+    "Subtraction of two accumulated-sum-descended values of like"
+    " sign/magnitude in a compute program (the E[x²]−E[x]² shape), with"
+    " the family's measured relative error on adversarial ill-conditioned"
+    " probes exceeding its committed per-family error budget"
+    " (NUMERICS_BASELINE.json).",
+    "Sufficient-statistics computes deliberately trade conditioning for a"
+    " single fused pass: variance from Σx² and (Σx)² loses ~2·log10(shift)"
+    " digits on mean-shifted data. That trade must be a MEASURED, committed"
+    " number: the structural taint walk finds the cancellation-shaped"
+    " subtractions, the measured leg evaluates each family on mean-shifted"
+    " (1e6) and tiny-scale (1e-6) probes against an fp64 oracle fed the"
+    " identical f32-cast inputs, and the observed budget is committed per"
+    " family — so a refactor that worsens conditioning fails the gate even"
+    " when the jaxpr shape is unchanged.",
+)
+
+MTA012 = rule(
+    "MTA012",
+    "scale-equivariance-broken",
+    "numerics",
+    "A declared scale-invariant metric (AUROC, average precision,"
+    " retrieval ranks, R²) is not BIT-stable under power-of-two input"
+    " rescaling, or a declared scale-equivariant one (MSE ×s², MAE ×s)"
+    " does not transform exactly.",
+    "Power-of-two rescaling is exact in IEEE arithmetic: it commutes"
+    " bitwise with every add/sub/mul/div/sqrt in the program and preserves"
+    " every comparison. A metric that should only depend on the ORDER"
+    " statistics of its inputs (ranking metrics) or transform by a known"
+    " exact factor (quadratic/linear losses) can therefore be checked"
+    " metamorphically to the last bit — any drift is a hidden"
+    " absolute-epsilon threshold, premature rounding, or a"
+    " scale-dependent branch, exactly the class of bug that passes every"
+    " oracle test at scale 1.0 and mis-scores real traffic at 1e-3.",
+)
+
+
 # ---------------------------------------------------------------------------
 # pass 2 — repo-invariant lint (AST)
 # ---------------------------------------------------------------------------

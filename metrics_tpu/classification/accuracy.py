@@ -42,8 +42,12 @@ class Accuracy(Metric):
             dist_sync_fn=dist_sync_fn,
         )
 
-        self.add_state("correct", default=jnp.asarray(0), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        # f32 counters, not int32: the per-batch counts are exact ints and
+        # f32 accumulation keeps them exact to 2^24 steps, while an int32
+        # accumulator saturates at 2^31 ROWS — inside one serving-process
+        # lifetime (MTA010; horizon pinned in NUMERICS_BASELINE.json)
+        self.add_state("correct", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
 
         if not 0 < threshold < 1:
             raise ValueError(f"The `threshold` should be a float in the (0,1) interval, got {threshold}")

@@ -40,8 +40,10 @@ class HammingDistance(Metric):
             dist_sync_fn=dist_sync_fn,
         )
 
-        self.add_state("correct", default=jnp.asarray(0), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        # f32 counters: an int32 count saturates at 2^31 rows — reachable
+        # in-process at serving rates (MTA010, NUMERICS_BASELINE.json)
+        self.add_state("correct", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
 
         if not 0 < threshold < 1:
             raise ValueError("The `threshold` should lie in the (0,1) interval.")

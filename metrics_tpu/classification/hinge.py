@@ -57,7 +57,8 @@ class Hinge(Metric):
         )
 
         self.add_state("measure", default=jnp.asarray(0.0), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        # f32 row counter: int32 saturates at 2^31 rows (MTA010 horizon)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
 
         if multiclass_mode not in (None, MulticlassMode.CRAMMER_SINGER, MulticlassMode.ONE_VS_ALL):
             raise ValueError(

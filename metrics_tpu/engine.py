@@ -1013,6 +1013,13 @@ class CompiledStepEngine:
         for name in names:
             flag = host_flags.get(name)
             guard.stats["checks"] += 1
+            # opt-in integer-saturation early warning (MTA010's runtime
+            # counterpart): the written-back states are concrete here, so
+            # the fused near-limit check can run without touching the
+            # donated dispatch; no-op unless guard.overflow_margin is set
+            guard.maybe_warn_overflow(
+                self._metrics[name], context=f"compiled step ({name})"
+            )
             if flag is None or bool(flag):
                 continue
             guard.handle_violation(

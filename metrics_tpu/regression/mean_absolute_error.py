@@ -39,7 +39,8 @@ class MeanAbsoluteError(Metric):
             dist_sync_fn=dist_sync_fn,
         )
         self.add_state("sum_abs_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+        # f32 row counter: int32 saturates at 2^31 rows (MTA010 horizon)
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
 
     def update(self, preds: jax.Array, target: jax.Array) -> None:
         """Update state with predictions and targets."""

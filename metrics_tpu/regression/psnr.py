@@ -65,7 +65,8 @@ class PSNR(Metric):
 
         if dim is None:
             self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
-            self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+            # f32 row counter: int32 saturates at 2^31 rows (MTA010)
+            self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
         else:
             self.add_state("sum_squared_error", default=[])
             self.add_state("total", default=[])

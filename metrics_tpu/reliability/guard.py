@@ -42,6 +42,7 @@ Usage::
         metric(preds, target)
 """
 import functools
+import weakref
 from contextlib import contextmanager
 from typing import Any, Dict, Iterator, Optional, Union
 
@@ -103,22 +104,47 @@ class StateGuard:
 
     Args:
         policy: ``"raise"`` | ``"warn"`` | ``"quarantine"`` (see module docs).
+        overflow_margin: opt-in integer-saturation early warning — the
+            runtime counterpart of the static MTA010 overflow-horizon rule
+            (``docs/static_analysis.md``, pass 5). When set, every guarded
+            check also verifies that no integer accumulator has crossed
+            within ``2**overflow_margin`` of its dtype limit; a crossing
+            warns ONCE per ``(metric, state)`` and counts
+            ``reliability.guard_overflow_warns`` — the same
+            mirror-the-static-rule pattern as MetricSan's poison-on-donate
+            canary mirroring MTA007. The default (None) adds zero work.
 
     Attributes:
         stats: host-side tally (works with telemetry disabled):
-            ``checks``, ``violations``, ``quarantined``.
+            ``checks``, ``violations``, ``quarantined``, ``overflow_warns``.
     """
 
-    def __init__(self, policy: str = "raise"):
+    def __init__(self, policy: str = "raise", overflow_margin: Optional[int] = None):
         if policy not in POLICIES:
             raise ValueError(f"guard policy must be one of {POLICIES}, got {policy!r}")
+        if overflow_margin is not None and not (
+            isinstance(overflow_margin, int) and 0 <= overflow_margin <= 62
+        ):
+            raise ValueError(
+                f"overflow_margin must be an int in [0, 62] or None, got {overflow_margin!r}"
+            )
         self.policy = policy
-        self.stats: Dict[str, int] = {"checks": 0, "violations": 0, "quarantined": 0}
+        self.overflow_margin = overflow_margin
+        self.stats: Dict[str, int] = {
+            "checks": 0, "violations": 0, "quarantined": 0, "overflow_warns": 0,
+        }
         # one telemetry EVENT per metric class (watchdog-style one-shot
         # verdict): under "warn" the kept-poisoned state re-flags on every
         # later batch, and per-violation events would flood the bounded
         # event log, evicting unrelated entries. Counters keep the tally.
         self._event_keys: set = set()
+        # state names already warned near-overflow, PER METRIC INSTANCE
+        # (weak keys: two live ConfusionMatrix objects each get their own
+        # warning — a class-keyed set would silence the second accumulator
+        # while it saturates); non-weakref-able metrics fall back to an
+        # id-keyed set held only for this guard's lifetime
+        self._overflow_seen: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+        self._overflow_seen_ids: Dict[int, set] = {}
 
     # ------------------------------------------------------------------
     # host-side (eager) path
@@ -159,10 +185,65 @@ class StateGuard:
         if any(_is_traced(v) for v in _state_leaves(metric)):
             return True  # engine path: checked in-program
         self.stats["checks"] += 1
+        self.maybe_warn_overflow(metric, context)
         if bool(states_finite_scalar(metric)):
             return True
         self.handle_violation(metric, last_good, context)
         return False
+
+    # ------------------------------------------------------------------
+    # integer-saturation early warning (MTA010's runtime counterpart)
+    # ------------------------------------------------------------------
+    def maybe_warn_overflow(self, metric: Any, context: str) -> None:
+        """Opt-in ``overflow_margin`` check riding the fused state
+        inspection: when any INTEGER accumulator has crossed within
+        ``2**overflow_margin`` of its dtype limit (either direction),
+        warn once per ``(metric, state)`` and count
+        ``reliability.guard_overflow_warns``. No-op when the margin is
+        unset, when states are tracers (the compiled engine calls this
+        from its concrete host epilogue instead), and after the one-shot
+        warning fired. Cost when armed: one fused min/max reduction over
+        the integer states per guarded check."""
+        margin = self.overflow_margin
+        if margin is None:
+            return
+        name = type(metric).__name__
+        slack = 1 << margin
+        try:
+            seen = self._overflow_seen.setdefault(metric, set())
+        except TypeError:  # non-weakref-able metric (slots): id-keyed fallback
+            seen = self._overflow_seen_ids.setdefault(id(metric), set())
+        for sname in metric._defaults:
+            val = getattr(metric, sname)
+            leaves = val if isinstance(val, list) else [val]
+            for v in leaves:
+                dt = getattr(v, "dtype", None)
+                if dt is None or not jnp.issubdtype(dt, jnp.integer):
+                    continue
+                if _is_traced(v):
+                    return  # engine path: checked post-writeback instead
+                if sname in seen:
+                    continue
+                info = jnp.iinfo(dt)
+                near = jnp.logical_or(
+                    jnp.max(v) >= info.max - slack,
+                    jnp.min(v) <= info.min + slack,
+                )
+                if not bool(near):
+                    continue
+                seen.add(sname)
+                self.stats["overflow_warns"] += 1
+                if _obs.enabled():
+                    _obs.get().count("reliability.guard_overflow_warns")
+                warn_once(
+                    f"StateGuard: integer accumulator {name}.{sname} ({dt}) is"
+                    f" within 2^{margin} of its dtype limit (during {context});"
+                    " it will saturate and silently corrupt every later"
+                    " compute. Widen the state dtype or reset/checkpoint the"
+                    " metric — see the MTA010 horizon for this state in"
+                    " NUMERICS_BASELINE.json (docs/static_analysis.md, pass 5).",
+                    key=f"guard-overflow:{name}.{sname}:{id(metric)}",
+                )
 
     # ------------------------------------------------------------------
     # policy application (shared with the engine's host-side epilogue)

@@ -8,23 +8,31 @@ Usage::
         --diff-fingerprints FINGERPRINTS.json # CI drift sentinel (advisory)
     make lint                                 # the CI spelling (strict)
 
-Pass 1 + pass 3 + pass 4 (:func:`metrics_tpu.analysis.audit_registry`)
+Passes 1 + 3 + 4 + 5 (:func:`metrics_tpu.analysis.audit_registry`)
 trace every metric family's program — and its ``sync_precision=
 "int8"/"bf16"`` and ``@cohort`` variants — and audit accumulator dtypes,
 host sync, donation aliasing, reduction soundness, N-replica distributed
 equivalence, state-lifecycle soundness, donation lifetimes, the
 host-seam budget (MTA008, gated against the committed
-``SEAM_BASELINE.json``), and two-generation double-buffer safety
-(MTA009). Pass 2 (:func:`metrics_tpu.analysis.lint_paths`) lints the
-``metrics_tpu`` source tree for the repo invariants (MTL101-MTL106).
-``--strict`` folds every pass — pass 4 included — into the exit code.
+``SEAM_BASELINE.json``), two-generation double-buffer safety (MTA009),
+and numerical soundness: per-state overflow/ulp-absorption horizons
+(MTA010), cancellation structure + measured error budgets (MTA011), and
+scale-equivariance probes (MTA012) — gated against the committed
+``NUMERICS_BASELINE.json``. Pass 2
+(:func:`metrics_tpu.analysis.lint_paths`) lints the ``metrics_tpu``
+source tree for the repo invariants (MTL101-MTL106). ``--strict`` folds
+every pass into the exit code.
 
 ``--refresh-seam-baseline`` rewrites the committed ``SEAM_BASELINE.json``
 from the fresh audit (registry families only; fixture entries like
 ``SeamRegressor`` keep their deliberately-tight committed budgets) — run
 it when a seam change is INTENDED, e.g. after folding a sync leg
 in-program lowers a family's crossing count, so the improvement is gated
-against backsliding.
+against backsliding. ``--refresh-numerics-baseline`` does the same for
+``NUMERICS_BASELINE.json``, IMPROVEMENTS only (horizons up, budgets
+down); both refuse to rewrite over a red or partial audit, so a
+regression must be fixed — or the baseline hand-edited in review — never
+laundered by a rerun.
 
 ``--fingerprints`` adds per-family jaxpr digests (ops × dtypes × shapes
 × static params of the update and compiled-step programs) to the report
@@ -102,6 +110,53 @@ def _diff_fingerprints(current: dict, committed, committed_path: str) -> int:
     return drift
 
 
+def refresh_numerics_baseline(
+    path: str,
+    numerics_entries: dict,
+    findings: int,
+    partial: bool,
+) -> str:
+    """Apply (or refuse) one ``--refresh-numerics-baseline`` request and
+    return the human-readable outcome line. The refusal ladder mirrors
+    the seam baseline's: partial audits would prune-and-ungate skipped
+    namespaces, red audits would launder a regression, and a missing file
+    means bootstrap-by-hand (the committed file carries the fixture
+    gates). A permitted refresh is IMPROVEMENTS ONLY (horizons up,
+    budgets down) via :func:`metrics_tpu.analysis.numerics.tighten_baseline`."""
+    from metrics_tpu.analysis.numerics import build_numerics_entry, tighten_baseline
+    from metrics_tpu.reliability.journal import atomic_write_json
+
+    if partial:
+        return (
+            "numerics baseline NOT refreshed: --no-cohort/--no-quantized"
+            " audits are partial; refresh requires the full variant namespace"
+        )
+    if findings:
+        return (
+            "numerics baseline NOT refreshed: the audit reported"
+            f" {findings} unsuppressed finding(s); fix them (or hand-edit"
+            " NUMERICS_BASELINE.json for an intended horizon/budget change)"
+            " and re-run"
+        )
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            baseline = json.load(fh)
+    except (OSError, ValueError) as err:
+        return (
+            f"numerics baseline NOT refreshed: {path} is missing or"
+            f" unreadable ({err}); restore the committed file (git checkout)"
+            " before refreshing"
+        )
+    fresh = {fam: build_numerics_entry(ev) for fam, ev in numerics_entries.items()}
+    baseline, pruned = tighten_baseline(baseline, fresh)
+    atomic_write_json(path, baseline)
+    return (
+        f"refreshed {path} ({len(fresh)} registry entries"
+        + (f"; pruned {pruned}" if pruned else "")
+        + ")"
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--strict", action="store_true",
@@ -132,12 +187,20 @@ def main(argv=None) -> int:
                          " from this run's budgets (registry families only;"
                          " fixture entries are preserved). Default path:"
                          " SEAM_BASELINE.json")
+    ap.add_argument("--refresh-numerics-baseline", nargs="?",
+                    const="NUMERICS_BASELINE.json", default=None, metavar="PATH",
+                    help="tighten the committed per-family numerics baseline"
+                         " from this run's evidence (IMPROVEMENTS only:"
+                         " horizons up, error budgets down; registry families"
+                         " only, fixture entries preserved, retired families"
+                         " pruned; refuses a red or partial audit). Default"
+                         " path: NUMERICS_BASELINE.json")
     args = ap.parse_args(argv)
 
     from metrics_tpu.analysis import audit_registry, lint_paths
     from metrics_tpu.reliability.journal import atomic_write_json
 
-    report = {"schema": "metrics_tpu.analysis_report", "version": 2}
+    report = {"schema": "metrics_tpu.analysis_report", "version": 3}
     unsuppressed = 0
     fingerprints = args.fingerprints or args.diff_fingerprints is not None
 
@@ -187,6 +250,30 @@ def main(argv=None) -> int:
             f"pass 4 (concurrency): {len(seam_families)} seam budgets,"
             f" {db_safe} families double-buffer safe,"
             f" {len(audit.get('host_seam_sites', []))} library crossing sites"
+        )
+        from metrics_tpu.analysis.numerics import min_horizon_rows
+
+        numerics_entries = {
+            fam: (entry.get("evidence") or {}).get("numerics")
+            for fam, entry in audit["families"].items()
+            if (entry.get("evidence") or {}).get("numerics")
+        }
+        horizon_min = min_horizon_rows(numerics_entries)
+        budgets_measured = 0
+        cancel_flagged = 0
+        for ev in numerics_entries.values():
+            cancel = ev.get("cancellation") or {}
+            if cancel.get("budget") is not None:
+                budgets_measured += 1
+            if cancel.get("sites"):
+                cancel_flagged += 1
+        print(
+            f"pass 5 (numerics): {len(numerics_entries)} entries,"
+            f" min horizon {horizon_min:.4g} rows,"
+            f" {budgets_measured} measured error budgets,"
+            f" {cancel_flagged} cancellation-shaped computes"
+            if horizon_min is not None else
+            f"pass 5 (numerics): {len(numerics_entries)} entries"
         )
         for fam, entry in audit["families"].items():
             for f in entry["findings"]:
@@ -263,6 +350,20 @@ def main(argv=None) -> int:
                     + (f"; pruned {pruned}" if pruned else "")
                     + ")"
                 )
+        if args.refresh_numerics_baseline is not None:
+            npath = args.refresh_numerics_baseline
+            if npath == "NUMERICS_BASELINE.json":
+                # the bare default names the COMMITTED baseline at the repo
+                # root regardless of CWD; an explicit path stays caller-relative
+                npath = os.path.join(
+                    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "NUMERICS_BASELINE.json",
+                )
+            print(refresh_numerics_baseline(
+                npath, numerics_entries,
+                findings=audit["summary"]["findings"],
+                partial=args.no_cohort or args.no_quantized,
+            ))
         if args.diff_fingerprints is not None:
             _diff_fingerprints(
                 report.get("fingerprints", {}), committed, args.diff_fingerprints

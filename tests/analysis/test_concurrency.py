@@ -385,14 +385,16 @@ def test_healthy_run_keeps_pass4_counters_at_zero():
 
 
 def test_evidence_rides_the_report_schema(registry_report):
-    """`evidence["host_seam"]` / `evidence["double_buffer"]` are the
-    ANALYSIS.json contract the ROADMAP work reads; eager-only families
-    carry evidence=None (they never donate, so they have no seam to
-    budget and no generations to prove)."""
+    """`evidence["host_seam"]` / `evidence["double_buffer"]` /
+    `evidence["numerics"]` are the ANALYSIS.json contract the ROADMAP
+    work reads; eager-only families carry only the numerics leg (they
+    never donate, so they have no seam to budget and no generations to
+    prove — but their accumulators saturate like anyone else's)."""
     entry = registry_report["families"]["MeanSquaredError"]
-    assert set(entry["evidence"]) == {"host_seam", "double_buffer"}
+    assert set(entry["evidence"]) == {"host_seam", "double_buffer", "numerics"}
     assert entry["evidence"]["double_buffer"]["writeback_locked"] is True
     eager = registry_report["families"]["AUROC"]
-    assert eager["engine_eligible"] is False and eager["evidence"] is None
-    assert registry_report["version"] == 2
+    assert eager["engine_eligible"] is False
+    assert set(eager["evidence"]) == {"numerics"}
+    assert registry_report["version"] == 3
     assert registry_report["host_seam_sites"]

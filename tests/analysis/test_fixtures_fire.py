@@ -27,6 +27,9 @@ EXPECTED = [
     (fx.SeamRegressor, "MTA008"),
     (fx.DoubleBufferAliaser, "MTA009"),
     (fx.HostReadOfDonated, "MTA009"),
+    (fx.Int32RowCounter, "MTA010"),
+    (fx.CancellingVariance, "MTA011"),
+    (fx.EpsilonThresholdAUROC, "MTA012"),
     (fx.StaleSuppression, "MTL105"),
 ]
 
@@ -237,6 +240,41 @@ def test_seam_regressor_names_the_exceeded_budget():
     assert len(sync) == 1
     assert sync[0].detail["got"] == 3 and sync[0].detail["baseline"] == 1
     assert "SEAM_BASELINE.json" in sync[0].message
+
+
+def test_int32_row_counter_names_state_horizon_and_floor():
+    """The MTA010 fixture's finding carries the exact horizon (2^31 rows
+    for a 1-per-row int32 counter), the fleet floor it breaches, and the
+    remediation pair (widen, or suppress + StateGuard(overflow_margin))."""
+    result = audit_metric(fx.Int32RowCounter(), _X)
+    f, = result.findings
+    assert f.subject == "Int32RowCounter.rows"
+    assert f.detail["kind"] == "int-overflow"
+    assert abs(f.detail["rows"] - 2 ** 31) < 2 ** 10
+    assert f.detail["floor"] == float(2 ** 40)
+    assert "overflow_margin" in f.message
+
+
+def test_cancelling_variance_blows_its_committed_budget():
+    """The MTA011 fixture is structurally flagged AND measured: its
+    NUMERICS_BASELINE.json entry commits a 2^-20 budget, the adversarial
+    probes observe ~1.0 (everything lost), and the finding names both."""
+    result = audit_metric(fx.CancellingVariance(), _X)
+    f, = result.findings
+    assert f.rule == "MTA011"
+    assert f.detail["observed"] > f.detail["baseline"]
+    assert f.detail["sites"] >= 1
+    assert "NUMERICS_BASELINE.json" in f.message or "budget" in f.message
+    ev = result.evidence["numerics"]["cancellation"]
+    assert ev["sites"] and ev["sites"][0]["primitive"] == "sub"
+
+
+def test_epsilon_threshold_auroc_names_the_failing_scale():
+    result = audit_metric(fx.EpsilonThresholdAUROC(), _X)
+    f, = result.findings
+    assert f.rule == "MTA012"
+    assert any(r["scale"] == 2.0 ** -10 for r in f.detail["failing"])
+    assert "scale-invariant" in f.message
 
 
 def test_double_buffer_fixtures_void_the_ping_pong_verdict():

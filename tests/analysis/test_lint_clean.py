@@ -59,10 +59,11 @@ def test_suppressions_are_rare_and_deliberate():
 def test_report_schema_is_stable(registry_report):
     report = registry_report
     assert report["schema"] == "metrics_tpu.analysis_report"
-    assert report["version"] == 2  # v2: pass 4 (evidence + host_seam_sites)
+    assert report["version"] == 3  # v3: pass 5 (evidence["numerics"])
     assert set(report["rules"]) == {
         "MTA001", "MTA002", "MTA003", "MTA004",
         "MTA005", "MTA006", "MTA007", "MTA008", "MTA009",
+        "MTA010", "MTA011", "MTA012",
         "MTL101", "MTL102", "MTL103", "MTL104", "MTL105", "MTL106",
     }
     for entry in report["families"].values():

@@ -1,0 +1,495 @@
+"""Pass-5 acceptance bed: interval arithmetic, horizons, the cancellation
+budget, equivariance probes, and the committed NUMERICS_BASELINE.json
+gate semantics (tighten-only refresh, refuses-red, prune-keeps-fixtures).
+
+The fixture-trips-exactly pins live in test_fixtures_fire.py; this file
+pins the MACHINERY and the in-tree fixes (the promoted f32 counters'
+before/after horizons, the suppressed int32 families' recorded ones).
+"""
+import importlib.util
+import json
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu as M
+from metrics_tpu.analysis import fixtures as fx
+from metrics_tpu.analysis import audit_metric
+from metrics_tpu.analysis.numerics import (
+    DEFAULT_FLEET_FLOOR_ROWS,
+    DEFAULT_SERVING_ROWS_PER_STEP,
+    Interval,
+    check_numerics,
+    committed_budget_ceiling,
+    eval_jaxpr_intervals,
+    load_numerics_baseline,
+    measure_error_budget,
+    state_horizons,
+    tighten_baseline,
+)
+from metrics_tpu.analysis.rules import Finding
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_X = (jnp.linspace(0.0, 1.0, 8),)
+
+
+# ---------------------------------------------------------------------------
+# interval interpreter
+# ---------------------------------------------------------------------------
+def _ivs(fn, *in_ivs, args):
+    closed = jax.make_jaxpr(fn)(*args)
+    return eval_jaxpr_intervals(closed, list(in_ivs))
+
+
+def test_interval_add_mul_sum():
+    out, = _ivs(
+        lambda x: jnp.sum(x * 2.0 + 1.0),
+        Interval(0.0, 1.0),
+        args=(jnp.zeros((8,)),),
+    )
+    assert out.lo == pytest.approx(8.0)   # 8 × (0·2 + 1)
+    assert out.hi == pytest.approx(24.0)  # 8 × (1·2 + 1)
+
+
+def test_interval_division_by_zero_spanning_interval_is_top():
+    out, = _ivs(
+        lambda x, y: x / jnp.sum(y),
+        Interval(0.0, 1.0), Interval(-1.0, 1.0),
+        args=(jnp.zeros(()), jnp.zeros((4,))),
+    )
+    assert out.lo == -math.inf and out.hi == math.inf
+
+
+def test_interval_recurses_into_pjit():
+    inner = jax.jit(lambda x: jnp.sum(x * x))
+    out, = _ivs(
+        lambda x: inner(x) + 1.0,
+        Interval(-2.0, 2.0),
+        args=(jnp.zeros((4,)),),
+    )
+    # 4 elements, each square in [0, 4] (even power tightens to >= 0)
+    assert out.lo == pytest.approx(1.0)
+    assert out.hi == pytest.approx(17.0)
+
+
+def test_interval_cond_takes_branch_union():
+    def fn(x):
+        return jax.lax.cond(x[0] > 0, lambda v: v * 2.0, lambda v: v - 10.0, x)
+
+    out, = _ivs(fn, Interval(0.0, 1.0), args=(jnp.zeros((3,)),))
+    assert out.lo == pytest.approx(-10.0)
+    assert out.hi == pytest.approx(2.0)
+
+
+def test_interval_dot_general_scales_by_contraction():
+    out, = _ivs(
+        lambda a, b: a @ b,
+        Interval(0.0, 1.0), Interval(0.0, 1.0),
+        args=(jnp.zeros((5,)), jnp.zeros((5,))),
+    )
+    assert out.hi == pytest.approx(5.0)
+
+
+def test_inverted_interval_construction_swaps_not_collapses():
+    iv = Interval(5.0, 3.0)
+    assert (iv.lo, iv.hi) == (3.0, 5.0)
+
+
+def test_clamp_of_disjoint_interval_maps_bounds_through():
+    """clamp is monotone in x: an operand entirely below the clamp range
+    must yield the range's floor exactly — not an inverted/lossy
+    intersection (review-pinned soundness regression)."""
+    out, = _ivs(
+        lambda x: jax.lax.clamp(0.0, x, 1.0),
+        Interval(-5.0, -4.0),
+        args=(jnp.zeros((3,)),),
+    )
+    assert (out.lo, out.hi) == (0.0, 0.0)
+    out, = _ivs(
+        lambda x: jax.lax.clamp(0.0, x, 1.0),
+        Interval(-1.0, 0.5),
+        args=(jnp.zeros((3,)),),
+    )
+    assert (out.lo, out.hi) == (0.0, 0.5)
+
+
+def test_min_horizon_rows_helper_handles_empty_and_none():
+    from metrics_tpu.analysis.numerics import min_horizon_rows
+
+    assert min_horizon_rows({}) is None
+    assert min_horizon_rows(None) is None
+    assert min_horizon_rows({
+        "A": {"horizons": {"s": {"rows": 10.0}, "t": {"rows": None}}},
+        "B": None,
+        "C": {"horizons": {"u": {"rows": 3.0}}},
+    }) == 3.0
+
+
+def test_unknown_primitive_is_top_not_crash():
+    def fn(x):
+        return jax.lax.while_loop(lambda v: v[0] < 3, lambda v: v + 1, x)
+
+    unhandled = set()
+    closed = jax.make_jaxpr(fn)(jnp.zeros((2,)))
+    out, = eval_jaxpr_intervals(closed, [Interval(0.0, 1.0)], unhandled)
+    assert out.lo == -math.inf and "while" in unhandled
+
+
+# ---------------------------------------------------------------------------
+# horizons
+# ---------------------------------------------------------------------------
+def test_int32_row_counter_horizon_is_two_to_31():
+    h = state_horizons(fx.Int32RowCounter(), _X, {})
+    rows = h["rows"]
+    assert rows["kind"] == "int-overflow"
+    assert rows["rows"] == pytest.approx(2 ** 31, rel=1e-6)
+    assert rows["rows"] < DEFAULT_FLEET_FLOOR_ROWS
+    # the f32 companion absorbs only after 2^24 serving steps
+    assert h["acc"]["kind"] == "float-ulp-absorption"
+    assert h["acc"]["rows"] == pytest.approx(2 ** 24 * DEFAULT_SERVING_ROWS_PER_STEP)
+
+
+@pytest.mark.parametrize("factory,args", [
+    (M.Accuracy, None),
+    (M.HammingDistance, "binary"),
+    (M.Hinge, "hinge"),
+    (M.MeanSquaredError, "reg"),
+    (M.MeanAbsoluteError, "reg"),
+    (M.MeanSquaredLogError, "reg"),
+    (lambda: M.PSNR(data_range=1.0), "reg"),
+    (M.R2Score, "reg"),
+], ids=["Accuracy", "Hamming", "Hinge", "MSE", "MAE", "MSLE", "PSNR", "R2"])
+def test_promoted_counters_horizon_before_after(factory, args):
+    """The PR's in-tree fix, pinned per family: every promoted row counter
+    is f32 now (horizon 2^44 rows at the declared serving batch — above
+    the fleet floor) where the int32 `before` twin saturated at 2^31 rows
+    — below it. Int32RowCounter IS the before-twin, audited alongside."""
+    rng = np.random.RandomState(0)
+    n = 16
+    if args == "reg":
+        a = (jnp.asarray(rng.rand(n).astype(np.float32)),
+             jnp.asarray(rng.rand(n).astype(np.float32)))
+    elif args == "binary":
+        a = (jnp.asarray(rng.rand(n).astype(np.float32)),
+             jnp.asarray(rng.randint(2, size=n)))
+    elif args == "hinge":
+        a = (jnp.asarray(rng.randn(n).astype(np.float32)),
+             jnp.asarray(rng.randint(2, size=n)))
+    else:
+        p = rng.rand(n, 4).astype(np.float32)
+        a = (jnp.asarray(p / p.sum(1, keepdims=True)),
+             jnp.asarray(rng.randint(4, size=n)))
+    m = factory()
+    total = m._defaults["total"]
+    assert jnp.issubdtype(total.dtype, jnp.floating), "promoted counter regressed to int"
+    h = state_horizons(m, a, {})
+    assert h["total"]["kind"] == "float-ulp-absorption"
+    assert h["total"]["rows"] >= DEFAULT_FLEET_FLOOR_ROWS
+    # the before-twin: the same counter in int32 dies below the floor
+    before = state_horizons(fx.Int32RowCounter(), _X, {})["rows"]["rows"]
+    assert before < DEFAULT_FLEET_FLOOR_ROWS < h["total"]["rows"]
+
+
+def test_suppressed_int32_families_record_subfloor_horizons():
+    """StatScores/confmat families stay int32 by documented choice: the
+    finding is suppressed (class-body allow with rationale) but the
+    horizon is RECORDED in the committed baseline for review."""
+    base = load_numerics_baseline()
+    assert base is not None
+    for fam, state in (("StatScores", "tp"), ("ConfusionMatrix", "confmat"),
+                       ("MatthewsCorrcoef", "confmat"), ("CohenKappa", "confmat")):
+        h = base[fam]["horizons"][state]
+        assert h["kind"] == "int-overflow"
+        assert h["rows"] < DEFAULT_FLEET_FLOOR_ROWS
+    r = audit_metric(M.StatScores(reduce="micro"),
+                     (jnp.asarray([0.9, 0.1]), jnp.asarray([1, 0])))
+    assert not [f for f in r.findings if f.rule == "MTA010"]
+    assert any(f.rule == "MTA010" for f in r.suppressed)
+
+
+def test_macro_statscores_tn_horizon_is_shorter():
+    """The interval pass is per-STATE: macro tn accumulates ~(C−1) counts
+    per row, so its recorded horizon is genuinely shorter than tp's."""
+    base = load_numerics_baseline()
+    assert base["StatScores"]["horizons"]["tn"]["rows"] < \
+        base["StatScores"]["horizons"]["tp"]["rows"]
+
+
+# ---------------------------------------------------------------------------
+# cancellation: structure + measured budget
+# ---------------------------------------------------------------------------
+def test_cancelling_variance_structural_site_and_blown_budget():
+    m = fx.CancellingVariance()
+    r = audit_metric(m, _X)
+    assert {f.rule for f in r.findings} == {"MTA011"}
+    ev = r.evidence["numerics"]["cancellation"]
+    assert ev["sites"], "the E[x²]−E[x]² subtraction must be structurally visible"
+    assert ev["budget"] == 1.0  # capped: all significant digits lost
+
+
+def test_in_tree_sufficient_stats_families_carry_measured_budgets(registry_report):
+    """R2Score/ExplainedVariance deliberately risk the cancellation shape;
+    the audit must SEE it (structural sites) and commit an honest measured
+    budget rather than flag — the gate is the committed number."""
+    for fam in ("R2Score", "ExplainedVariance"):
+        ev = registry_report["families"][fam]["evidence"]["numerics"]
+        assert ev["cancellation"]["sites"], fam
+        assert ev["cancellation"]["budget"] is not None
+    base = load_numerics_baseline()
+    assert base["ExplainedVariance"]["error_budget"] is not None
+
+
+def test_budget_gate_fires_on_conditioning_regression():
+    """A worsened measured budget vs the committed entry is an MTA011
+    finding even when the structure is unchanged."""
+    m = fx.CancellingVariance()
+    measured = measure_error_budget(m, _X)
+    committed = {
+        "CancellingVariance": {
+            "states": ["count", "sum_x", "sum_x2"],
+            "horizons": {},
+            "error_budget": measured["budget"] / 8.0 if measured["budget"] else 1e-9,
+        }
+    }
+    findings, infos = [], []
+    check_numerics(m, findings, infos, args=_X, baseline=committed)
+    assert any(f.rule == "MTA011" for f in findings)
+
+
+def test_budget_ceiling_is_deterministic_power_of_two():
+    assert committed_budget_ceiling(3e-8) == 2.0 ** math.ceil(math.log2(1.2e-7))
+    assert committed_budget_ceiling(0.9) == 1.0  # capped
+    assert committed_budget_ceiling(0.0) == 2.0 ** -24
+
+
+def test_fp64_oracle_isolates_computation_error():
+    """A plain sum has no cancellation: its measured budget on the same
+    adversarial probes stays at f32-epsilon scale."""
+    measured = measure_error_budget(fx.SeamRegressor(), _X)
+    assert measured is not None
+    assert measured["budget"] < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# equivariance
+# ---------------------------------------------------------------------------
+def test_declared_invariant_families_are_bit_stable(registry_report):
+    checked = 0
+    for fam in ("AUROC", "AveragePrecision", "R2Score", "ExplainedVariance",
+                "RetrievalMAP", "RetrievalMRR", "MeanSquaredError",
+                "MeanAbsoluteError"):
+        eq = registry_report["families"][fam]["evidence"]["numerics"]["equivariance"]
+        assert eq is not None and eq["checked"], fam
+        assert eq["bit_stable"], (fam, eq)
+        checked += 1
+    assert checked == 8
+
+
+def test_epsilon_threshold_fixture_fails_only_at_tiny_scale():
+    r = audit_metric(fx.EpsilonThresholdAUROC(), _X)
+    assert {f.rule for f in r.findings} == {"MTA012"}
+    eq = r.evidence["numerics"]["equivariance"]
+    by_scale = {s["scale"]: s["bit_stable"] for s in eq["scales"]}
+    assert by_scale[0.5] is True          # above the epsilon: invisible
+    assert by_scale[2.0 ** -10] is False  # below it: the tie structure shifts
+
+
+# ---------------------------------------------------------------------------
+# the committed baseline: coverage + gate + refresh semantics
+# ---------------------------------------------------------------------------
+def test_every_audited_entry_has_a_committed_baseline_entry(registry_report):
+    """A new family cannot ship ungated: every plain/@cohort/@int8/@bf16
+    entry the registry audits must have a NUMERICS_BASELINE.json entry
+    with horizons for every state and a measured error budget."""
+    base = load_numerics_baseline()
+    assert base is not None
+    missing = [fam for fam in registry_report["families"] if fam not in base]
+    assert missing == [], missing
+    for fam, entry in registry_report["families"].items():
+        ev = (entry["evidence"] or {}).get("numerics") or {}
+        fresh_states = sorted(k for k in (ev.get("horizons") or {})
+                              if not k.startswith("__"))
+        assert base[fam]["states"] == fresh_states, fam
+        assert "error_budget" in base[fam], fam
+
+
+def test_registry_numerics_is_clean(registry_report):
+    """Acceptance: pass 5 over all ~89 entries, zero unsuppressed
+    MTA010/MTA011/MTA012 findings after the in-tree fixes."""
+    assert registry_report["summary"]["families"] >= 89
+    live = [
+        f for entry in registry_report["families"].values()
+        for f in entry["findings"]
+        if f["rule"] in ("MTA010", "MTA011", "MTA012")
+    ]
+    assert live == [], live
+
+
+def test_horizon_regression_vs_baseline_is_gated():
+    m = fx.Int32RowCounter()
+    committed = {
+        "Int32RowCounter": {
+            "states": ["acc", "rows"],
+            "horizons": {"rows": {"kind": "int-overflow", "rows": 2.0 ** 62}},
+            "error_budget": 1.0,
+        }
+    }
+    findings, infos = [], []
+    check_numerics(m, findings, infos, args=_X, baseline=committed,
+                   floor_rows=1.0)  # floor disarmed: isolate the regression gate
+    msgs = [f for f in findings if f.rule == "MTA010"]
+    assert msgs and "regression" in msgs[0].message
+
+
+def test_changed_state_inventory_is_measured_not_gated():
+    m = fx.Int32RowCounter()
+    committed = {
+        "Int32RowCounter": {
+            "states": ["somebody_else"],
+            "horizons": {"rows": {"kind": "int-overflow", "rows": 2.0 ** 62}},
+            "error_budget": 1e-12,
+        }
+    }
+    findings, infos = [], []
+    check_numerics(m, findings, infos, args=_X, baseline=committed,
+                   floor_rows=1.0)
+    assert findings == []
+    assert any("measured, not gated" in i for i in infos)
+
+
+def _entry(rows, budget, states=("s",)):
+    return {
+        "states": sorted(states),
+        "horizons": {s: {"kind": "int-overflow", "rows": rows} for s in states},
+        "error_budget": budget,
+    }
+
+
+def test_tighten_baseline_is_improvements_only():
+    baseline = {"fixtures": ["CancellingVariance"], "entries": {
+        "Fam": _entry(100.0, 0.25),
+        "CancellingVariance": _entry(50.0, 2.0 ** -20),
+        "Retired": _entry(1.0, 1.0),
+    }}
+    fresh = {
+        "Fam": _entry(200.0, 0.5),  # horizon improved, budget worsened
+        "CancellingVariance": _entry(9999.0, 1.0),  # fixtures never move
+    }
+    out, pruned = tighten_baseline(baseline, fresh)
+    assert out["entries"]["Fam"]["horizons"]["s"]["rows"] == 200.0
+    assert out["entries"]["Fam"]["error_budget"] == 0.25  # never grows
+    assert out["entries"]["CancellingVariance"] == baseline["entries"]["CancellingVariance"]
+    assert pruned == ["Retired"] and "Retired" not in out["entries"]
+
+
+def test_tighten_baseline_committed_unbounded_stays_unbounded():
+    baseline = {"fixtures": [], "entries": {
+        "Fam": {"states": ["s"], "horizons": {"s": {"kind": "static", "rows": None}},
+                "error_budget": None},
+    }}
+    fresh = {"Fam": _entry(5.0, 0.5)}
+    out, _ = tighten_baseline(baseline, fresh)
+    assert out["entries"]["Fam"]["horizons"]["s"]["rows"] is None
+
+
+def _load_lint_metrics():
+    spec = importlib.util.spec_from_file_location(
+        "lint_metrics_under_test", os.path.join(_REPO, "scripts", "lint_metrics.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_refresh_refuses_red_partial_and_missing(tmp_path):
+    """The refusal ladder: red audit, partial audit, and a missing
+    committed file all leave the baseline byte-identical."""
+    lm = _load_lint_metrics()
+    path = tmp_path / "NUMERICS_BASELINE.json"
+    committed = {"schema": "metrics_tpu.numerics_baseline", "version": 1,
+                 "fixtures": [], "entries": {"Fam": _entry(100.0, 0.25)}}
+    path.write_text(json.dumps(committed))
+    ev = {"horizons": {"s": {"kind": "int-overflow", "rows": 500.0}},
+          "cancellation": {"budget": 0.01, "sites": []}, "equivariance": None}
+
+    msg = lm.refresh_numerics_baseline(str(path), {"Fam": ev}, findings=3, partial=False)
+    assert "NOT refreshed" in msg and "3 unsuppressed" in msg
+    assert json.loads(path.read_text()) == committed
+
+    msg = lm.refresh_numerics_baseline(str(path), {"Fam": ev}, findings=0, partial=True)
+    assert "NOT refreshed" in msg and "partial" in msg
+    assert json.loads(path.read_text()) == committed
+
+    missing = tmp_path / "nope.json"
+    msg = lm.refresh_numerics_baseline(str(missing), {"Fam": ev}, findings=0, partial=False)
+    assert "NOT refreshed" in msg and not missing.exists()
+
+    # and the green path round-trips: tighten + prune
+    msg = lm.refresh_numerics_baseline(str(path), {"Fam": ev}, findings=0, partial=False)
+    assert msg.startswith("refreshed")
+    after = json.loads(path.read_text())
+    assert after["entries"]["Fam"]["horizons"]["s"]["rows"] == 500.0
+
+
+# ---------------------------------------------------------------------------
+# suppression plumbing + watchdog hint
+# ---------------------------------------------------------------------------
+def test_stale_mta010_allow_is_flagged_mtl105():
+    class CleanWithStaleNumericsAllow(M.Metric):
+        # metrics-tpu: allow(MTA010) — STALE on purpose for this test
+        _fused_forward = True
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("acc", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+        def update(self, x):
+            self.acc = self.acc + jnp.sum(x)
+
+        def compute(self):
+            return self.acc
+
+    r = audit_metric(CleanWithStaleNumericsAllow(), _X)
+    assert {f.rule for f in r.findings} == {"MTL105"}
+    assert "MTA010" in r.findings[0].message
+
+
+def test_hint_for_watch_key_covers_numerics_rules():
+    from metrics_tpu.analysis.program import hint_for_watch_key
+
+    audit_metric(fx.Int32RowCounter(), _X)
+    hint = hint_for_watch_key("Int32RowCounter")
+    assert hint is not None and "MTA010" in hint and "overflow-horizon" in hint
+
+
+# ---------------------------------------------------------------------------
+# docs drift gate: the performance.md error-budget table mirrors the baseline
+# ---------------------------------------------------------------------------
+def test_performance_doc_error_budget_table_matches_baseline():
+    """Drift-gated like the observability glossary: every ROOT family in
+    the committed baseline has a row in docs/performance.md's measured
+    error-budget table with the committed value, and no stale rows."""
+    doc = open(os.path.join(_REPO, "docs", "performance.md")).read()
+    start = doc.index("<!-- numerics-error-budget-table -->")
+    end = doc.index("<!-- /numerics-error-budget-table -->")
+    rows = {}
+    for line in doc[start:end].splitlines():
+        if line.startswith("|") and "`" in line:
+            cells = [c.strip() for c in line.strip("|").split("|")]
+            if len(cells) >= 2 and cells[0].startswith("`"):
+                rows[cells[0].strip("`")] = cells[1].strip("`")
+    base = load_numerics_baseline()
+    roots = {fam: e for fam, e in base.items() if "@" not in fam
+             and fam != "CancellingVariance"}
+    assert set(rows) == set(roots), (
+        set(rows) ^ set(roots),
+        "regenerate the table: entries and doc rows must match 1:1",
+    )
+    for fam, committed in roots.items():
+        budget = committed.get("error_budget")
+        want = "n/a" if budget is None else f"{budget:.3g}"
+        assert rows[fam] == want, (fam, rows[fam], want)

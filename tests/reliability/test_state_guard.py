@@ -282,3 +282,114 @@ def test_keyboard_interrupt_inside_guarded_compiled_step_keeps_last_good_state()
         col(*doubled)
     total = int(np.asarray(col["MeanSquaredError"].total))
     assert total == batches[0][0].size + doubled[0].size
+
+
+# ----------------------------------------------------------------------
+# overflow_margin: MTA010's runtime counterpart
+# ----------------------------------------------------------------------
+def test_overflow_margin_validation():
+    with pytest.raises(ValueError, match="overflow_margin"):
+        StateGuard("warn", overflow_margin=-1)
+    with pytest.raises(ValueError, match="overflow_margin"):
+        StateGuard("warn", overflow_margin=2.5)
+    assert StateGuard("warn", overflow_margin=0).overflow_margin == 0
+
+
+def test_overflow_margin_warns_once_and_counts():
+    """An int accumulator within 2^margin of its dtype limit warns ONCE
+    per (metric, state), counts reliability.guard_overflow_warns, and
+    keeps state untouched (early warning, not a policy action)."""
+    from metrics_tpu import ConfusionMatrix
+
+    obs.enable()
+    guard = install_guard(StateGuard("warn", overflow_margin=10))
+    try:
+        m = ConfusionMatrix(num_classes=2)
+        m.confmat = jnp.asarray([[2**31 - 512, 0], [0, 0]], jnp.int32)
+        before = m.confmat
+        p, t = jnp.asarray([0.9, 0.1]), jnp.asarray([1, 0])
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            m.update(p, t)
+            m.update(p, t)  # second crossing: counted set dedupes
+        msgs = [str(w.message) for w in caught if "integer accumulator" in str(w.message)]
+        assert len(msgs) == 1
+        assert "ConfusionMatrix.confmat" in msgs[0] and "2^10" in msgs[0]
+        assert guard.stats["overflow_warns"] == 1
+        assert obs.get().counters.get("reliability.guard_overflow_warns") == 1
+        assert m.confmat[0, 0] > before[0, 0]  # state advanced normally
+    finally:
+        uninstall_guard()
+
+
+def test_overflow_margin_healthy_run_is_silent_and_costless():
+    """Far from the limit: no warning, no counter — and the default
+    (overflow_margin=None) guard never even inspects integer states."""
+    obs.enable()
+    guard = install_guard(StateGuard("quarantine", overflow_margin=8))
+    try:
+        from metrics_tpu import ConfusionMatrix
+
+        m = ConfusionMatrix(num_classes=2)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            for _ in range(3):
+                m.update(jnp.asarray([0.9, 0.1]), jnp.asarray([1, 0]))
+        assert not [w for w in caught if "integer accumulator" in str(w.message)]
+        assert guard.stats["overflow_warns"] == 0
+        assert "reliability.guard_overflow_warns" not in obs.get().counters
+    finally:
+        uninstall_guard()
+    assert StateGuard("warn").overflow_margin is None  # default: opt-in only
+
+
+def test_overflow_margin_rides_the_compiled_engine_epilogue():
+    """The engine path checks the written-back states host-side (states
+    are tracers in-program): a near-limit accumulator inside a compiled
+    collection still warns exactly once."""
+    from metrics_tpu import ConfusionMatrix
+
+    obs.enable()
+    guard = install_guard(StateGuard("warn", overflow_margin=12))
+    try:
+        col = MetricCollection([ConfusionMatrix(num_classes=2)], compiled=True)
+        p, t = jnp.asarray([0.9, 0.1, 0.2, 0.8]), jnp.asarray([1, 0, 0, 1])
+        col(p, t)  # healthy first dispatch
+        cm = col["ConfusionMatrix"]
+        cm.confmat = jnp.asarray([[2**31 - 2048, 0], [0, 0]], jnp.int32)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            col(p, t)
+            col(p, t)
+        msgs = [str(w.message) for w in caught if "integer accumulator" in str(w.message)]
+        assert len(msgs) <= 1  # warn_once key is process-global
+        assert guard.stats["overflow_warns"] == 1
+    finally:
+        uninstall_guard()
+
+
+def test_overflow_margin_warns_per_instance_not_per_class():
+    """Two instances of the same class each get their own warning/count:
+    a class-keyed dedupe would let the SECOND accumulator saturate
+    silently (review-pinned)."""
+    from metrics_tpu import ConfusionMatrix
+
+    obs.enable()
+    guard = install_guard(StateGuard("warn", overflow_margin=10))
+    try:
+        p, t = jnp.asarray([0.9, 0.1]), jnp.asarray([1, 0])
+        near = jnp.asarray([[2**31 - 512, 0], [0, 0]], jnp.int32)
+        a, b = ConfusionMatrix(num_classes=2), ConfusionMatrix(num_classes=2)
+        a.confmat = near
+        a.update(p, t)
+        assert guard.stats["overflow_warns"] == 1
+        b.update(p, t)  # healthy instance: silent
+        assert guard.stats["overflow_warns"] == 1
+        b.confmat = near
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            b.update(p, t)
+        assert guard.stats["overflow_warns"] == 2
+        assert any("integer accumulator" in str(w.message) for w in caught)
+    finally:
+        uninstall_guard()
