@@ -1,4 +1,4 @@
-.PHONY: ci lint san test test-tpu test-tpu-suite doctest bench bench-sync bench-cohort serve-bench sentinel serve-metrics dryrun fuzz fuzz-sharded chaos clean
+.PHONY: ci lint san test test-tpu test-tpu-suite doctest bench bench-sync bench-cohort bench-fleet serve-bench sentinel serve-metrics dryrun fuzz fuzz-sharded chaos clean
 
 ci:
 	# the full CI gate as one machine-runnable target (mirrors
@@ -143,6 +143,18 @@ bench-cohort:
 	tail -n 1 bench_cohort.txt > bench_cohort.json
 	python scripts/perf_sentinel.py --current bench_cohort.json --strict-bounds
 
+bench-fleet:
+	# elastic-fleet legs (~1 min): rendezvous placement churn when a
+	# third shard joins a 10k-tenant map (fleet_churn_ratio_10k <= 0.45,
+	# strict: minimal-churn HRW moves ~1/3 of keys) plus the advisory
+	# live-migration cost in ms/tenant through the two-phase
+	# prepare -> in_flight -> pre_commit -> pre_gc handoff. Writes
+	# SENTINEL_fleet.json; CI uploads bench_fleet.json + the chaos
+	# flight dumps as artifacts.
+	METRICS_TPU_FLIGHT=flight-dumps python bench.py --leg-fleet | tee bench_fleet.txt
+	tail -n 1 bench_fleet.txt > bench_fleet.json
+	python scripts/perf_sentinel.py --current bench_fleet.json --strict-bounds --out SENTINEL_fleet.json
+
 serve-bench:
 	# continuous-serving legs (~2 min): steady-state per-step metric
 	# overhead of a live serve loop at 1M rows — blocking forward vs the
@@ -217,4 +229,5 @@ clean:
 	rm -rf .pytest_cache .jax_cache flight-dumps bench-traces san-flight-dumps
 	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json bench_cohort.txt bench_cohort.json ANALYSIS_current.json numerics_evidence.json
 	rm -f bench_serving.txt bench_serving.json SENTINEL_serving.json metrics_scrape_serving.txt cost_ledger.json
+	rm -f bench_fleet.txt bench_fleet.json SENTINEL_fleet.json
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

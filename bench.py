@@ -673,6 +673,86 @@ def _bench_cohort() -> dict:
     return result
 
 
+def _fleet_leg() -> None:
+    """``--leg-fleet-child``: rebalance cost of the elastic fleet.
+
+    Two figures. (1) **Placement churn at 10k tenants**: assign 10k keys
+    across 2 shards, add a third, and count the keys whose rendezvous
+    home changed. HRW's minimal-churn property says ~1/3; the sentinel
+    bounds the ratio at ≤ 0.45 — a regression here means the placement
+    hash lost the property that makes elastic membership affordable.
+    (2) **Migration ms/tenant**: wall time of full two-phase handoffs
+    (drain + envelope + wire codec + target import + two journal
+    commits) over a batch of tenants between two live shards, after one
+    warm-up move. Advisory — it tracks the dominant cost of a rebalance
+    at fleet scale."""
+    import os
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.fleet import FleetPlacement, FleetShard, MigrationCoordinator
+
+    n = int(os.environ.get("BENCH_FLEET_TENANTS", 10_000))
+    place = FleetPlacement(["shard-0", "shard-1"])
+    homes = [place.assign(k) for k in range(n)]
+    t0 = time.perf_counter()
+    place.add_shard("shard-2")
+    moved = sum(1 for k in range(n) if place.assign(k) != homes[k])
+    reassign_ms = (time.perf_counter() - t0) * 1e3
+    print("FLEET_CHURN", moved / n)
+    print("FLEET_REASSIGN_10K_MS", reassign_ms)
+
+    moves = int(os.environ.get("BENCH_FLEET_MOVES", 24))
+    root = tempfile.mkdtemp(prefix="bench-fleet-")
+    src = FleetShard("src", MeanSquaredError(), os.path.join(root, "src"))
+    dst = FleetShard("dst", MeanSquaredError(), os.path.join(root, "dst"))
+    keys = list(range(moves + 1))
+    src.add_tenants(keys)
+    rng = np.random.RandomState(0)
+    preds = rng.rand(len(keys), 64).astype(np.float32)
+    target = rng.rand(len(keys), 64).astype(np.float32)
+    src.submit_wave(0, keys, preds, target)
+    src.checkpoint()
+    coord = MigrationCoordinator(FleetPlacement(["src", "dst"]), [src, dst])
+    coord.migrate(keys[0], "dst")  # warm-up: first checkpoints + programs
+    t0 = time.perf_counter()
+    for k in keys[1:]:
+        coord.migrate(k, "dst")
+    per_tenant_ms = (time.perf_counter() - t0) / moves * 1e3
+    print("FLEET_MIGRATION_MS_PER_TENANT", per_tenant_ms)
+
+
+def _bench_fleet() -> dict:
+    """Parent assembly of the fleet legs (CPU-forced subprocess, same
+    pattern as the other legs): the sentinel-bounded
+    ``fleet_churn_ratio_10k`` (≤ 0.45) plus the advisory placement
+    rescan time and per-tenant migration cost."""
+    import os
+    import subprocess
+
+    here = os.path.abspath(__file__)
+    proc = subprocess.run(
+        [sys.executable, here, "--leg-fleet-child"],
+        capture_output=True, text=True, timeout=1800, cwd=os.path.dirname(here),
+    )
+    out = _leg_stdout(proc, "fleet")
+    return {
+        "fleet_churn_ratio_10k": round(
+            float(_marker_values(out, "FLEET_CHURN", "fleet")[0]), 4
+        ),
+        "fleet_reassign_10k_ms": round(
+            float(_marker_values(out, "FLEET_REASSIGN_10K_MS", "fleet")[0]), 3
+        ),
+        "fleet_migration_ms_per_tenant": round(
+            float(_marker_values(out, "FLEET_MIGRATION_MS_PER_TENANT", "fleet")[0]), 3
+        ),
+    }
+
+
 def _serving_leg() -> None:
     """``--leg-serving-child``: steady-state per-step metric overhead of a
     live serve loop, blocking vs async pipeline, at 1M rows.
@@ -1525,6 +1605,30 @@ def main() -> None:
         return
     if "--leg-serving-child" in sys.argv:
         _serving_leg()
+        return
+    if "--leg-fleet-child" in sys.argv:
+        _fleet_leg()
+        return
+    if "--leg-fleet" in sys.argv:
+        # fleet legs only (make bench-fleet): rebalance cost at 10k
+        # tenants — placement-churn ratio (sentinel-bounded ≤ 0.45) and
+        # two-phase migration ms/tenant. Same one-JSON-line contract,
+        # platform pinned "cpu" (the legs are CPU-forced by design).
+        result = {
+            "metric": "fleet legs only (bench.py --leg-fleet)",
+            "platform": "cpu",
+        }
+        fleet_failed = None
+        try:
+            result.update(_bench_fleet())
+        except Exception as err:
+            fleet_failed = err
+            print(f"ERROR: fleet leg failed ({err!r})", file=sys.stderr)
+        print(json.dumps(result))
+        if fleet_failed is not None:
+            # the churn ratio IS the point of --leg-fleet; a missing leg
+            # would make the sentinel's bound gate vacuously green
+            raise SystemExit(1)
         return
     if "--leg-serving" in sys.argv:
         # continuous-serving legs only (make serve-bench): steady-state

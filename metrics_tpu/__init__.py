@@ -57,6 +57,7 @@ from metrics_tpu import observability  # noqa: F401, E402
 from metrics_tpu import reliability  # noqa: F401, E402
 from metrics_tpu import analysis  # noqa: F401, E402
 from metrics_tpu import serving  # noqa: F401, E402
+from metrics_tpu import fleet  # noqa: F401, E402
 from metrics_tpu.wrappers import BootStrapper  # noqa: F401, E402
 from metrics_tpu.retrieval import (  # noqa: F401, E402
     RetrievalMAP,

@@ -277,28 +277,41 @@ class MetricCohort:
         return cohort
 
     def _extract_states(self, source: Any) -> Dict[str, Dict[str, jax.Array]]:
-        """Per-member state rows from a template-shaped collection/metric,
-        validated against the template's structure."""
+        """Per-member state rows from a template-shaped collection/metric —
+        or a raw nested ``{member: {state: array}}`` mapping (the fleet's
+        migration import: a decoded envelope payload has no live Metric to
+        hang the arrays on) — validated against the template's structure."""
         if isinstance(source, Metric):
-            members: Dict[str, Metric] = {"metric": source}
+            raw: Dict[str, Dict[str, Any]] = {
+                "metric": {s: getattr(source, s) for s in source._defaults}
+            }
+        elif isinstance(source, Mapping) and all(
+            isinstance(v, Mapping) for v in source.values()
+        ):
+            # raw rows travel as host numpy from an envelope; _device_owned
+            # gives the cohort its own device copies (donation safety)
+            raw = {k: {s: _device_owned(v) for s, v in d.items()} for k, d in source.items()}
         else:
-            members = dict(source.items())
-        if set(members) != set(self._template):
+            raw = {
+                name: {s: getattr(m, s) for s in m._defaults}
+                for name, m in dict(source.items()).items()
+            }
+        if set(raw) != set(self._template):
             raise ValueError(
                 f"structure mismatch: cohort members {sorted(self._template)} !="
-                f" source members {sorted(members)}"
+                f" source members {sorted(raw)}"
             )
         out: Dict[str, Dict[str, jax.Array]] = {}
         for name, tm in self._template.items():
-            sm = members[name]
-            if set(sm._defaults) != set(tm._defaults):
+            d = raw[name]
+            if set(d) != set(tm._defaults):
                 raise ValueError(
-                    f"member {name!r} state mismatch: {sorted(sm._defaults)} !="
+                    f"member {name!r} state mismatch: {sorted(d)} !="
                     f" {sorted(tm._defaults)}"
                 )
             out[name] = {}
             for sname, default in tm._defaults.items():
-                v = jnp.asarray(getattr(sm, sname))
+                v = jnp.asarray(d[sname])
                 if v.shape != jnp.shape(default) or v.dtype != jnp.asarray(default).dtype:
                     raise ValueError(
                         f"member {name}.{sname}: shape/dtype {v.shape}/{v.dtype}"
@@ -366,6 +379,33 @@ class MetricCohort:
             self._adopt_state(slot, self._extract_states(state))
         self._note_membership()
         return slot
+
+    def add_tenants(self, n: int) -> List[int]:
+        """Admit ``n`` default-state tenants at once; returns their slot
+        ids. The bulk twin of :meth:`add_tenant` for fleet-scale admission
+        (10k tenants): one capacity grow and a handful of vectorized
+        resets instead of ``n × states`` single-slot device writes. Safe
+        to skip the per-slot re-default because freed slots are already
+        re-defaulted at removal and grown slots are born at defaults."""
+        if n <= 0:
+            return []
+        need = len(self) + int(n)
+        if need > self._capacity:
+            self._grow(bucket_capacity(need))
+        slots = [int(s) for s in np.flatnonzero(~self._active)[: int(n)]]
+        idx = np.asarray(slots)
+        self._guard_verdicts[idx] = 0
+        if self._health is not None:
+            h = self._health
+            self._health = {
+                "rows_seen": h["rows_seen"].at[idx].set(0),
+                "updates": h["updates"].at[idx].set(0),
+                "last_step": h["last_step"].at[idx].set(-1),
+                "nonfinite": h["nonfinite"].at[idx].set(0),
+            }
+        self._active[idx] = True
+        self._note_membership()
+        return slots
 
     def remove_tenant(self, tenant: int, return_state: bool = False):
         """Evict tenant ``tenant``. With ``return_state=True`` the

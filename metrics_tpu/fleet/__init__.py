@@ -1,0 +1,42 @@
+"""Elastic fleet: tenant→shard placement, live migration, resharding.
+
+A single :class:`~metrics_tpu.cohort.MetricCohort` makes N tenants one
+process's property; this package makes them a *fleet's*. Three layers,
+each usable alone:
+
+* :mod:`~metrics_tpu.fleet.placement` — :class:`FleetPlacement`,
+  minimal-churn rendezvous hashing with a live-move override table so
+  streams follow their tenant mid-migration;
+* :mod:`~metrics_tpu.fleet.migration` — :class:`FleetShard` (cohort +
+  journal + tenant bookkeeping) and :class:`MigrationCoordinator`, the
+  two-phase, chaos-proven exactly-once handoff built on checksummed
+  :func:`tenant_envelope` transfers;
+* :mod:`~metrics_tpu.fleet.rebalancer` — :class:`FleetRebalancer`,
+  capacity-driven split/merge and quorum-driven evacuation, expressed
+  entirely as batches of ordinary migrations.
+
+See docs/reliability.md ("Elastic fleet") for the handoff state machine
+and the rebalancing playbook, and ``tests/reliability/test_fleet_chaos.py``
+for the kill-at-every-phase proof.
+"""
+from metrics_tpu.fleet.migration import (
+    TENANT_ENVELOPE_FORMAT,
+    FleetShard,
+    MigrationCoordinator,
+    adopt_into,
+    open_tenant_envelope,
+    tenant_envelope,
+)
+from metrics_tpu.fleet.placement import FleetPlacement
+from metrics_tpu.fleet.rebalancer import FleetRebalancer
+
+__all__ = [
+    "TENANT_ENVELOPE_FORMAT",
+    "FleetPlacement",
+    "FleetRebalancer",
+    "FleetShard",
+    "MigrationCoordinator",
+    "adopt_into",
+    "open_tenant_envelope",
+    "tenant_envelope",
+]

@@ -1,0 +1,167 @@
+"""Capacity-driven resharding and quorum-driven evacuation.
+
+The rebalancer closes the loop between what the fleet OBSERVES — per-
+tenant load from :meth:`MetricCohort.health`, admission pressure from
+the ingest queue, slice liveness from the hierarchical sync's
+:class:`~metrics_tpu.parallel.hierarchy.QuorumSnapshot` — and what the
+placement SAYS: it computes the moves that converge the fleet onto the
+rendezvous assignment and drives each one through the coordinator's
+exactly-once handoff. There is deliberately no second protocol here: a
+rebalance, a split, a merge and an evacuation are all just batches of
+ordinary migrations, so every crash-safety property the chaos bed proves
+for one handoff holds mid-rebalance for free.
+
+Playbook (see docs/reliability.md "Elastic fleet"):
+
+* **split** a hot shard — add a spare shard to the placement; rendezvous
+  hashing re-homes ~1/N of every shard's tenants onto it; ``converge()``
+  moves them.
+* **merge** a cold shard — remove it from the placement; only ITS
+  tenants re-home (scattered across the survivors); ``converge()``
+  drains it empty.
+* **evacuate** a dying slice — same as merge, but triggered from the
+  last :class:`QuorumSnapshot`'s ``lost_slices``/``lost_ranks`` instead
+  of a load signal, for every shard hosted on the dead slice.
+"""
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from metrics_tpu.observability import telemetry as _obs
+
+__all__ = ["FleetRebalancer"]
+
+
+class FleetRebalancer:
+    """Load/liveness-driven convergence of shards onto the placement.
+
+    Args:
+        coordinator: the fleet's
+            :class:`~metrics_tpu.fleet.MigrationCoordinator`.
+        shard_slices: optional ``{shard_name: slice_id}`` map tying each
+            shard to the hierarchy slice hosting it — required only for
+            :meth:`evacuate`.
+        hot_rows: mean rows-seen-per-tenant above which
+            :meth:`should_split` flags a shard (load observed by the
+            cohort's in-dispatch health accumulators).
+        hot_buffered_rows: ingest-queue backlog above which a shard is
+            flagged regardless of rows-seen (admission pressure).
+    """
+
+    def __init__(
+        self,
+        coordinator: Any,
+        shard_slices: Optional[Dict[str, int]] = None,
+        hot_rows: float = 1e6,
+        hot_buffered_rows: int = 1 << 16,
+    ):
+        self.coordinator = coordinator
+        self.shard_slices = dict(shard_slices or {})
+        self.hot_rows = float(hot_rows)
+        self.hot_buffered_rows = int(hot_buffered_rows)
+
+    # ------------------------------------------------------------------
+    # planning + convergence
+    # ------------------------------------------------------------------
+    def plan(self) -> Tuple[List[Tuple[int, str, str]], float]:
+        """``(moves, churn_ratio)`` to converge the live fleet onto the
+        placement's rendezvous assignment."""
+        keys_by_shard = {
+            name: shard.tenants()
+            for name, shard in self.coordinator.shards.items()
+        }
+        return self.coordinator.placement.rebalance_plan(keys_by_shard)
+
+    def converge(self, max_moves: Optional[int] = None) -> int:
+        """Migrate every off-home tenant to its assigned shard (up to
+        ``max_moves``); returns moves performed. Each move is one full
+        exactly-once handoff — a kill mid-converge strands at most the
+        single in-flight txn, which :meth:`MigrationCoordinator.recover`
+        finishes or aborts."""
+        moves, _churn = self.plan()
+        done = 0
+        for key, src, dst in moves:
+            if max_moves is not None and done >= int(max_moves):
+                break
+            self.coordinator.migrate(key, dst, src_name=src)
+            done += 1
+        if done:
+            if _obs.enabled():
+                _obs.get().count("fleet.rebalances")
+        return done
+
+    # ------------------------------------------------------------------
+    # load triggers
+    # ------------------------------------------------------------------
+    def pressure(self, shard_name: str) -> Dict[str, float]:
+        """The shard's load signals: tenant count, mean rows-seen per
+        tenant (0 before any health-armed dispatch), and queue backlog."""
+        shard = self.coordinator.shards[shard_name]
+        rows_mean = 0.0
+        health = shard.cohort.health()
+        if health is not None and len(health.get("rows_seen", ())):
+            rows = health["rows_seen"]
+            rows_mean = float(sum(int(r) for r in rows)) / max(1, len(rows))
+        buffered = int(shard.queue.buffered_rows) if shard.queue is not None else 0
+        return {
+            "tenants": float(len(shard)),
+            "rows_seen_mean": rows_mean,
+            "buffered_rows": float(buffered),
+        }
+
+    def should_split(self, shard_name: str) -> bool:
+        p = self.pressure(shard_name)
+        return (
+            p["rows_seen_mean"] >= self.hot_rows
+            or p["buffered_rows"] >= self.hot_buffered_rows
+        )
+
+    def should_merge(self, shard_name: str) -> bool:
+        """A shard with no tenants and no backlog is pure overhead."""
+        p = self.pressure(shard_name)
+        return p["tenants"] == 0 and p["buffered_rows"] == 0
+
+    # ------------------------------------------------------------------
+    # the playbook verbs
+    # ------------------------------------------------------------------
+    def split(self, spare: Any, max_moves: Optional[int] = None) -> int:
+        """Bring ``spare`` (a constructed, empty :class:`FleetShard`)
+        into the fleet and converge — rendezvous hashing spreads ~1/N of
+        the existing tenants onto it, relieving every hot shard at once."""
+        self.coordinator.shards[spare.name] = spare
+        self.coordinator.placement.add_shard(spare.name)
+        return self.converge(max_moves=max_moves)
+
+    def merge(self, cold_name: str, max_moves: Optional[int] = None) -> int:
+        """Retire ``cold_name``: drop it from the placement, converge (its
+        tenants scatter to their new homes), then detach the empty shard
+        from the coordinator."""
+        cold_name = str(cold_name)
+        self.coordinator.placement.remove_shard(cold_name)
+        moved = self.converge(max_moves=max_moves)
+        shard = self.coordinator.shards.get(cold_name)
+        if shard is not None and len(shard) == 0:
+            self.coordinator.shards.pop(cold_name)
+        return moved
+
+    def evacuate(self, quorum: Optional[Any] = None, max_moves: Optional[int] = None) -> int:
+        """Merge away every shard hosted on a slice the last (or given)
+        :class:`QuorumSnapshot` reports lost; returns moves performed.
+        No-op when the quorum is full or no shard maps to a lost slice."""
+        if quorum is None:
+            from metrics_tpu.parallel.hierarchy import last_quorum
+
+            quorum = last_quorum()
+        if quorum is None or not quorum.lost_slices:
+            return 0
+        lost = set(quorum.lost_slices)
+        doomed = [
+            name
+            for name, slice_id in self.shard_slices.items()
+            if slice_id in lost and name in self.coordinator.shards
+        ]
+        moved = 0
+        for name in doomed:
+            moved += self.merge(name, max_moves=max_moves)
+        if doomed:
+            if _obs.enabled():
+                _obs.get().count("fleet.evacuations")
+        return moved

@@ -61,6 +61,7 @@ __all__ = [
     "exporter_scope",
     "get_exporter",
     "register_cohort",
+    "register_fleet",
     "render_exposition",
     "parse_prometheus_text",
 ]
@@ -81,6 +82,20 @@ def register_cohort(cohort: Any) -> int:
     cid = next(_COHORT_SEQ)
     _COHORTS[cid] = cohort
     return cid
+
+
+_FLEET_SEQ = itertools.count()
+_FLEETS: "weakref.WeakValueDictionary[int, Any]" = weakref.WeakValueDictionary()
+
+
+def register_fleet(coordinator: Any) -> int:
+    """Enroll a :class:`~metrics_tpu.fleet.MigrationCoordinator` as a
+    scrape source (called by its constructor; weak reference — a dropped
+    fleet disappears from the exposition). Returns the stable ``fleet=``
+    label value."""
+    fid = next(_FLEET_SEQ)
+    _FLEETS[fid] = coordinator
+    return fid
 
 
 # ----------------------------------------------------------------------
@@ -151,6 +166,44 @@ def _render_cohorts() -> List[str]:
                     )
         except Exception as err:  # noqa: BLE001 — a scrape must answer
             fam.degrade(f"cohort {cid} health", err)
+    return fam.lines()
+
+
+def _render_fleet() -> List[str]:
+    """Placement + migration families for every live fleet coordinator:
+    the placement-map generation (per fleet) and migration/in-flight
+    tallies (per shard). Gauges all — ``migrations_total`` is
+    monotonically increasing by construction (per-shard in+out
+    completions), but rendered from reconstructed state, not a scraped
+    counter registry."""
+    fam = _GaugeFamilies()
+    for fid in sorted(_FLEETS.keys()):
+        coord = _FLEETS.get(fid)
+        if coord is None:
+            continue
+        try:
+            flabel = f'fleet="{fid}"'
+            fam.sample(
+                "metrics_tpu_fleet_placement_generation",
+                flabel,
+                coord.placement.generation,
+            )
+            in_flight = coord.in_flight_by_shard()
+            migrations = coord.migrations_by_shard()
+            for name in sorted(coord.shards):
+                slabel = f'{flabel},shard="{_escape_label(name)}"'
+                fam.sample(
+                    "metrics_tpu_fleet_migrations_total",
+                    slabel,
+                    migrations.get(name, 0),
+                )
+                fam.sample(
+                    "metrics_tpu_fleet_tenants_in_flight",
+                    slabel,
+                    in_flight.get(name, 0),
+                )
+        except Exception as err:  # noqa: BLE001 — a scrape must answer
+            fam.degrade(f"fleet {fid}", err)
     return fam.lines()
 
 
@@ -261,6 +314,7 @@ def render_exposition() -> str:
     # aggregate gauges
     extra = (
         _render_cohorts()
+        + _render_fleet()
         + _render_sessions()
         + _render_quorum()
         + _render_cost_ledger()

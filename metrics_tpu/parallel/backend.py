@@ -42,6 +42,16 @@ class SyncBackend(ABC):
     def gather(self, x: jax.Array, group: Optional[Any] = None) -> List[jax.Array]:
         """Return ``[x_rank0, x_rank1, ...]``, identical on every rank."""
 
+    def stream(self, x: jax.Array, source: int = 0, group: Optional[Any] = None) -> jax.Array:
+        """Broadcast ``source``'s value to every rank — the fleet's
+        migration transfer primitive. Built on :meth:`gather`, so it
+        inherits whatever transport the backend uses, and it is
+        **exact-tier only**: the payload (a uint8 envelope byte blob)
+        travels verbatim, never through the quantized sync path — a
+        migrated tenant's state must arrive bit-identical, checksummed,
+        or not at all."""
+        return self.gather(x, group=group)[source]
+
 
 class SingleProcessBackend(SyncBackend):
     """Trivial backend for one process: gather returns ``[x]``."""

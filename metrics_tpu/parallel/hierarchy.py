@@ -206,6 +206,14 @@ class QuorumSnapshot:
         """Slices whose contribution is NOT in the served state."""
         return self.num_slices - len(self.slices_present)
 
+    @property
+    def lost_ranks(self) -> Tuple[int, ...]:
+        """Ranks absent from the served state — the complement of
+        ``ranks_present`` over ``range(world_size)``. The fleet's
+        evacuation trigger maps these to shards hosted on the dead
+        processes."""
+        return tuple(sorted(set(range(self.world_size)) - set(self.ranks_present)))
+
     def as_dict(self) -> Dict[str, Any]:
         return {
             "world_size": self.world_size,

@@ -37,6 +37,7 @@ in ``MetricCollection.state_dict``). :func:`write_envelope` /
 any dtype JAX produces (bfloat16 included — arrays travel as raw bytes and
 are rebuilt from the spec).
 """
+import io
 import json
 import os
 import zlib
@@ -57,7 +58,9 @@ __all__ = [
     "CheckpointCorruptionError",
     "CheckpointMismatchError",
     "atomic_file",
+    "envelope_from_bytes",
     "envelope_from_pairs",
+    "envelope_to_bytes",
     "save_envelope",
     "load_envelope",
     "write_envelope",
@@ -173,7 +176,7 @@ def save_envelope(obj: Any, persistent_only: bool = False) -> Dict[str, Any]:
 
 
 def envelope_from_pairs(
-    pairs: List[Tuple[str, Any]], metric_type: str = "snapshot"
+    pairs: List[Tuple[str, Any]], metric_type: str = "snapshot", fmt: str = ENVELOPE_FORMAT
 ) -> Dict[str, Any]:
     """Build a validated envelope from pre-captured ``(key, value)``
     pairs instead of a live metric — the background-checkpoint path
@@ -181,19 +184,23 @@ def envelope_from_pairs(
     a barrier on the serve thread, and THIS call (the device→host fetch
     plus checksumming) runs later, on the writer. ``metric_type`` is the
     informational type label the live path records; pass the original
-    object's class name so resumed journals read identically."""
+    object's class name so resumed journals read identically. ``fmt``
+    lets a sibling artifact family (the fleet's per-tenant migration
+    envelope) reuse the spec/checksum machinery under its own format
+    marker, so a tenant envelope can never be mistaken for a full
+    checkpoint (or vice versa) by a strict load."""
     payload = {
         k: ([_np(x) for x in v] if isinstance(v, list) else _np(v))
         for k, v in pairs
     }
-    return _assemble_envelope(payload, metric_type, complete=True)
+    return _assemble_envelope(payload, metric_type, complete=True, fmt=fmt)
 
 
 def _assemble_envelope(
-    payload: Dict[str, Any], metric_type: str, complete: bool
+    payload: Dict[str, Any], metric_type: str, complete: bool, fmt: str = ENVELOPE_FORMAT
 ) -> Dict[str, Any]:
     return {
-        "format": ENVELOPE_FORMAT,
+        "format": fmt,
         "schema_version": SCHEMA_VERSION,
         "metric_type": metric_type,
         "complete": complete,
@@ -206,11 +213,11 @@ def _assemble_envelope(
 # ----------------------------------------------------------------------
 # load
 # ----------------------------------------------------------------------
-def _validate_envelope(envelope: Any) -> None:
-    if not isinstance(envelope, dict) or envelope.get("format") != ENVELOPE_FORMAT:
+def _validate_envelope(envelope: Any, fmt: str = ENVELOPE_FORMAT) -> None:
+    if not isinstance(envelope, dict) or envelope.get("format") != fmt:
         raise _reject(
             CheckpointSchemaError(
-                "not a metrics_tpu state envelope (missing/unknown 'format'"
+                f"not a {fmt} envelope (missing/unknown 'format'"
                 " marker); raw state dicts load via load_state_dict()"
             )
         )
@@ -360,12 +367,11 @@ def atomic_file(path: Any) -> Iterator[Any]:
         raise
 
 
-def write_envelope(path: Any, envelope: Dict[str, Any]) -> None:
-    """Serialize an envelope to one ``.npz`` file, **atomically**: the bytes
-    go to ``<path>.tmp`` and are fsync'd before an ``os.replace`` over
-    ``path``, so a crash mid-write can never leave a torn envelope at the
-    target path (see :func:`atomic_file`). Arrays are stored as raw bytes
-    and rebuilt from the spec, so every JAX dtype (bfloat16 included)
+def _pack_arrays(envelope: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Flatten an envelope into named raw-byte uint8 arrays (the on-wire
+    / on-disk form shared by :func:`write_envelope` and
+    :func:`envelope_to_bytes`). Arrays are stored as raw bytes and
+    rebuilt from the spec, so every JAX dtype (bfloat16 included)
     survives the trip without pickling."""
     header = {k: envelope[k] for k in envelope if k != "payload"}
     arrays = {"__header__": np.frombuffer(json.dumps(header).encode(), dtype=np.uint8)}
@@ -375,8 +381,47 @@ def write_envelope(path: Any, envelope: Dict[str, Any]) -> None:
                 arrays[f"l::{key}::{i}"] = np.frombuffer(_np(v).tobytes(), dtype=np.uint8)
         else:
             arrays[f"a::{key}"] = np.frombuffer(_np(val).tobytes(), dtype=np.uint8)
+    return arrays
+
+
+def write_envelope(path: Any, envelope: Dict[str, Any]) -> None:
+    """Serialize an envelope to one ``.npz`` file, **atomically**: the bytes
+    go to ``<path>.tmp`` and are fsync'd before an ``os.replace`` over
+    ``path``, so a crash mid-write can never leave a torn envelope at the
+    target path (see :func:`atomic_file`)."""
     with atomic_file(path) as f:
-        np.savez(f, **arrays)
+        np.savez(f, **_pack_arrays(envelope))
+
+
+def envelope_to_bytes(envelope: Dict[str, Any]) -> bytes:
+    """Serialize an envelope to a self-contained ``bytes`` blob — the
+    same ``.npz`` layout :func:`write_envelope` puts on disk, but
+    in-memory, so an envelope can travel over a sync backend (the
+    fleet's migration wire format). The checksum rides inside the
+    header, so :func:`envelope_from_bytes` + a validating load detect
+    any corruption picked up in transit."""
+    buf = io.BytesIO()
+    np.savez(buf, **_pack_arrays(envelope))
+    return buf.getvalue()
+
+
+def envelope_from_bytes(raw: bytes) -> Dict[str, Any]:
+    """Decode a blob produced by :func:`envelope_to_bytes`. Structural
+    decoding only (like :func:`read_envelope`); checksum/spec validation
+    happens at load time. Undecodable bytes raise
+    :class:`CheckpointCorruptionError`."""
+    try:
+        with np.load(io.BytesIO(bytes(raw))) as data:
+            return _decode_npz(data, "<bytes>")
+    except CheckpointError:
+        raise
+    except Exception as err:
+        raise _reject(
+            CheckpointCorruptionError(
+                f"envelope bytes are unreadable (corrupted in transit?):"
+                f" {type(err).__name__}: {err}"
+            )
+        ) from err
 
 
 def read_envelope(path: Any) -> Dict[str, Any]:
@@ -403,40 +448,44 @@ def _read_envelope(path: Any) -> Dict[str, Any]:
     # own the fd: np.load(path) leaks its file object when zipfile decoding
     # raises mid-construction (torn files), tripping ResourceWarnings
     with open(path, "rb") as fobj, np.load(fobj) as data:
-        if "__header__" not in data:
-            raise _reject(
-                CheckpointSchemaError(f"{path!r} is not a metrics_tpu envelope file")
-            )
-        try:
-            header = json.loads(bytes(data["__header__"]).decode())
-        except (UnicodeDecodeError, json.JSONDecodeError) as err:
-            raise _reject(
-                CheckpointCorruptionError(f"envelope header is unreadable: {err}")
-            ) from err
-        spec = header.get("spec", {})
-        payload: Dict[str, Any] = {}
-        for name in data.files:
-            if name == "__header__":
-                continue
-            kind, _, rest = name.partition("::")
-            if kind == "a":
-                s = spec.get(rest)
-                if s is None:
-                    raise _reject(
-                        CheckpointCorruptionError(f"payload entry {rest!r} has no spec")
-                    )
-                payload[rest] = _decode(data[name], s["dtype"], s["shape"])
-            elif kind == "l":
-                key, _, idx = rest.rpartition("::")
-                s = spec.get(key)
-                if s is None:
-                    raise _reject(
-                        CheckpointCorruptionError(f"payload entry {key!r} has no spec")
-                    )
-                i = int(idx)
-                payload.setdefault(key, {})[i] = _decode(
-                    data[name], s["dtype"][i], s["shape"][i]
+        return _decode_npz(data, path)
+
+
+def _decode_npz(data: Any, origin: Any) -> Dict[str, Any]:
+    if "__header__" not in data:
+        raise _reject(
+            CheckpointSchemaError(f"{origin!r} is not a metrics_tpu envelope file")
+        )
+    try:
+        header = json.loads(bytes(data["__header__"]).decode())
+    except (UnicodeDecodeError, json.JSONDecodeError) as err:
+        raise _reject(
+            CheckpointCorruptionError(f"envelope header is unreadable: {err}")
+        ) from err
+    spec = header.get("spec", {})
+    payload: Dict[str, Any] = {}
+    for name in data.files:
+        if name == "__header__":
+            continue
+        kind, _, rest = name.partition("::")
+        if kind == "a":
+            s = spec.get(rest)
+            if s is None:
+                raise _reject(
+                    CheckpointCorruptionError(f"payload entry {rest!r} has no spec")
                 )
+            payload[rest] = _decode(data[name], s["dtype"], s["shape"])
+        elif kind == "l":
+            key, _, idx = rest.rpartition("::")
+            s = spec.get(key)
+            if s is None:
+                raise _reject(
+                    CheckpointCorruptionError(f"payload entry {key!r} has no spec")
+                )
+            i = int(idx)
+            payload.setdefault(key, {})[i] = _decode(
+                data[name], s["dtype"][i], s["shape"][i]
+            )
     for key, val in list(payload.items()):
         if isinstance(val, dict):  # reassemble list states in index order
             payload[key] = [val[i] for i in sorted(val)]

@@ -39,6 +39,7 @@ __all__ = [
     "simulated_pods",
     "failing_engine_compile",
     "corrupt_envelope",
+    "kill_at_migration_phase",
     "preempt_at_step",
     "slow_consumer",
     "torn_write",
@@ -431,6 +432,48 @@ def corrupt_envelope(envelope: Dict[str, Any], mode: str = "payload") -> Dict[st
 # ----------------------------------------------------------------------
 # 5. durable-session faults (preemption, torn files, cursor skew)
 # ----------------------------------------------------------------------
+@contextmanager
+def kill_at_migration_phase(
+    coordinator: Any, phase: str, after: int = 0
+) -> Iterator[Dict[str, int]]:
+    """SIGKILL-simulate a process death at the START of one tenant-
+    migration protocol phase (``"prepare"``, ``"in_flight"``,
+    ``"pre_commit"`` or ``"pre_gc"`` — see the state-machine table in
+    :mod:`metrics_tpu.fleet.migration`): the coordinator raises
+    :class:`Preempted` the moment a handoff enters ``phase``, after
+    skipping the first ``after`` entries (so a kill can land mid-
+    rebalance, N successful moves in). Everything durably written before
+    that instant — the staged envelope, the ``prepared`` record, the
+    target's committed generation — is exactly what a real kill leaves;
+    drive recovery by rebuilding the shards from their journals
+    (``FleetShard.restore``) and calling
+    ``MigrationCoordinator.recover()``, which must land every tenant on
+    exactly one side. ``info`` reports ``seen`` (phase entries observed)
+    and ``kills``."""
+    from metrics_tpu.fleet.migration import MigrationCoordinator
+
+    if phase not in MigrationCoordinator.PHASES:
+        raise ValueError(
+            f"phase must be one of {MigrationCoordinator.PHASES}, got {phase!r}"
+        )
+    info = {"seen": 0, "kills": 0}
+
+    def dying(ph: str, txn: str) -> None:
+        if ph == phase:
+            info["seen"] += 1
+            if info["seen"] > int(after):
+                info["kills"] += 1
+                raise Preempted(
+                    f"injected kill at migration phase {ph!r} (txn {txn})"
+                )
+
+    coordinator._phase = dying
+    try:
+        yield info
+    finally:
+        del coordinator._phase  # uncover the class-level no-op hook
+
+
 @contextmanager
 def preempt_at_step(
     session: Any, step: int, during: str = "step"

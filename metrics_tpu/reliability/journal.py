@@ -159,6 +159,16 @@ class CheckpointJournal:
                 recs.append({"generation": int(m.group(1)), "cursor": None})
         return sorted(recs, key=lambda r: int(r["generation"]))
 
+    def newest_generation(self) -> Optional[int]:
+        """Number of the newest generation present on disk, or None when
+        the journal is empty. The fleet's pre-GC gate: a migration source
+        may delete its copy of a tenant only after the target journal
+        reports a generation committed at-or-after the handoff — this is
+        the durability witness that makes the two-phase handoff
+        exactly-once."""
+        recs = self.records()
+        return int(recs[-1]["generation"]) if recs else None
+
     def cursors_on_disk(self) -> List[int]:
         """The step cursors of the generations that are actually LOADABLE
         (oldest → newest) — what multi-host resume agreement intersects

@@ -284,6 +284,20 @@ class EvalSession:
             self.checkpoint()
         return value
 
+    def adopt_cursor(self, cursor: int) -> int:
+        """Fast-forward the replay guard to ``cursor`` without feeding
+        batches — the fleet-migration import path: a tenant arriving with
+        its state already covering steps ``<= cursor`` must have those
+        steps treated as replays here too, or a resumed stream would
+        double-count them. Only moves forward (a stale cursor cannot
+        rewind coverage the state already has). Returns the resulting
+        cursor."""
+        cursor = int(cursor)
+        if cursor > self.cursor:
+            self.cursor = cursor
+            self.metric._session_cursor = cursor
+        return self.cursor
+
     def _step_with_deadline(self, args: tuple, kwargs: dict):
         """Run one forward on an abandonable daemon worker
         (:func:`~metrics_tpu.reliability.sync._attempt` — the same

@@ -145,6 +145,7 @@ class IngestQueue:
             "admitted_rows": 0,
             "shed_rows": 0,
             "shed_healthy_rows": 0,
+            "drained_rows": 0,
             "dispatches": 0,
         }
 
@@ -444,6 +445,33 @@ class IngestQueue:
         when more rows arrive, or are read off :attr:`buffered_rows`)."""
         self._dispatch_ready_waves()
         return self.buffered_rows
+
+    def drain_tenant(self, tenant: int) -> Optional[List[np.ndarray]]:
+        """Pop EVERYTHING buffered for one tenant and return it as one
+        concatenated array per input position (arrival order preserved),
+        or None when nothing is buffered. This is the migration escape
+        hatch: rows admitted for a tenant that is then removed mid-stream
+        would otherwise sit stranded until a shed policy drops them —
+        admitted rows must either dispatch here or travel with the
+        tenant, never silently vanish. Draining frees buffer budget, so
+        blocked submitters are woken."""
+        tid = int(tenant)
+        with self._lock:
+            buf = self._buffers.pop(tid, None)
+            if not buf:
+                return None
+            rows = sum(int(c[0].shape[0]) for _, c, _ in buf)
+            self._buffered_rows -= rows
+            self.stats["drained_rows"] += rows
+            self._lock_cond.notify_all()
+        out = [
+            np.concatenate([c[i] for _, c, _ in buf], axis=0)
+            for i in range(self._n_arrays)
+        ]
+        if _obs.enabled():
+            _obs.get().count("serving.ingest.drained_rows", rows)
+            _obs.get().gauge("serving.ingest.buffered_rows", self.buffered_rows)
+        return out
 
     @property
     def buffered_rows(self) -> int:

@@ -129,6 +129,12 @@ BOUND_LEGS: Dict[str, Tuple[str, float]] = {
     # work) must be ≤ 0.5× the blocking path's at 1M rows — the
     # double-buffered dispatch provably overlaps the model step
     "serving_overhead_ratio": ("max", 0.5),
+    # elastic-fleet placement churn (ISSUE 18): adding a 3rd shard to a
+    # 2-shard, 10k-tenant placement must re-home ~1/3 of the keys
+    # (rendezvous hashing's minimal-churn property; 0.45 leaves noise
+    # headroom). A higher ratio means membership changes reshuffle the
+    # fleet — the property that makes live rebalancing affordable is gone
+    "fleet_churn_ratio_10k": ("max", 0.45),
 }
 
 

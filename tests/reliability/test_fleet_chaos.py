@@ -1,0 +1,246 @@
+"""Chaos bed for the elastic fleet (ISSUE 18): a 10k-tenant fleet is
+killed at EVERY migration phase (prepare / in-flight / pre-commit /
+pre-GC) and again mid-rebalance while a third shard joins. After each
+kill the whole fleet is rebuilt from disk — a fresh "process" — and
+``MigrationCoordinator.recover()`` must drive every stranded handoff to
+exactly one side. The acceptance bar, verbatim from the issue:
+
+* every tenant lives on exactly ONE shard after every kill+recovery,
+  never lost, never double-counted;
+* a naively resubmitted full stream (replay guard riding the migrated
+  cursors) leaves every tenant's state bit-identical to a never-migrated
+  twin fleet fed the same rows;
+* each injected kill writes exactly ONE ``fleet_migration_interrupted``
+  flight dump;
+* a healthy (kill-free) run keeps every ``fleet.*`` failure counter at
+  zero and writes zero dumps.
+"""
+import glob
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import MeanSquaredError
+from metrics_tpu.fleet import (
+    FleetPlacement,
+    FleetRebalancer,
+    FleetShard,
+    MigrationCoordinator,
+)
+from metrics_tpu.reliability.faultinject import Preempted, kill_at_migration_phase
+
+pytestmark = pytest.mark.chaos
+
+N = 10_000
+NAMES = ["s0", "s1"]
+
+
+def _rows(keys, step):
+    """Deterministic per-(tenant, step) row batch: two samples per step."""
+    keys = np.asarray(keys, dtype=np.float64)
+    preds = np.stack(
+        [keys * 1e-4 + step * 0.125, keys * 1e-4 - step * 0.0625], 1
+    ).astype(np.float32)
+    target = np.stack([keys * 2e-4, np.zeros_like(keys)], 1).astype(np.float32)
+    return preds, target
+
+
+def _build(root, names, n=N):
+    placement = FleetPlacement(names)
+    shards = {
+        nm: FleetShard(nm, MeanSquaredError(), os.path.join(root, nm)) for nm in names
+    }
+    keys_by = {nm: [] for nm in names}
+    for k in range(n):
+        keys_by[placement.assign(k)].append(k)
+    for nm, keys in keys_by.items():
+        if keys:
+            shards[nm].add_tenants(keys)
+    return placement, shards
+
+
+def _reopen(root, names):
+    """A fresh process: rebuild every shard from its journal alone."""
+    shards = {}
+    for nm in names:
+        sh = FleetShard(nm, MeanSquaredError(), os.path.join(root, nm))
+        sh.restore()
+        shards[nm] = sh
+    return shards
+
+
+def _feed(shards, steps):
+    for step in steps:
+        for sh in shards.values():
+            keys = list(sh.tenants())
+            if keys:
+                sh.submit_wave(step, keys, *_rows(keys, step))
+
+
+def _state_by_key(shards, n=N):
+    """Vectorized per-tenant state fetch keyed by fleet-wide tenant key.
+    Doubles as the exactly-once assertion: every key on exactly one
+    shard, none lost, none duplicated."""
+    out = {}
+    filled = np.zeros(n, dtype=bool)
+    for sh in shards.values():
+        keys = np.asarray(sh.tenants(), dtype=np.int64)
+        if keys.size == 0:
+            continue
+        assert not filled[keys].any(), f"tenants double-counted on {sh.name!r}"
+        filled[keys] = True
+        slots = np.asarray([sh.slot_of(int(k)) for k in keys])
+        for member, states in sh.cohort._states.items():
+            for sname, arr in states.items():
+                arr = np.asarray(arr)
+                dest = out.setdefault(
+                    f"{member}.{sname}", np.zeros((n,) + arr.shape[1:], arr.dtype)
+                )
+                dest[keys] = arr[slots]
+    assert filled.all(), f"{int((~filled).sum())} tenants lost"
+    return out
+
+
+def _dumps(fd):
+    return sorted(glob.glob(os.path.join(fd, "*.json")))
+
+
+def test_kill_at_every_phase_and_mid_rebalance_10k():
+    with tempfile.TemporaryDirectory() as d:
+        vroot, troot = os.path.join(d, "victim"), os.path.join(d, "twin")
+
+        # the victim fleet: 10k tenants over two shards, four steps
+        # folded and durable before any fault is injected
+        placement, shards = _build(vroot, NAMES)
+        _feed(shards, range(4))
+        for sh in shards.values():
+            sh.checkpoint()
+
+        # the never-migrated control twin (same placement, same rows)
+        _twin_placement, twin = _build(troot, NAMES)
+        _feed(twin, range(4))
+
+        # ------------------------------------------------------------------
+        # one kill per protocol phase; fresh process + recover() after each
+        # ------------------------------------------------------------------
+        for i, phase in enumerate(MigrationCoordinator.PHASES):
+            coord = MigrationCoordinator(placement, list(shards.values()))
+            victim = shards["s0"].tenants()[i]
+            with tempfile.TemporaryDirectory() as fd:
+                obs.enable_flight(fd)
+                try:
+                    with kill_at_migration_phase(coord, phase) as info:
+                        with pytest.raises(Preempted):
+                            coord.migrate(victim, "s1")
+                    assert info["kills"] == 1
+                    # exactly ONE flight dump per injected kill
+                    dumps = _dumps(fd)
+                    assert len(dumps) == 1, (phase, dumps)
+                    with open(dumps[0]) as f:
+                        blob = f.read()
+                    assert "fleet_migration_interrupted" in blob
+                    assert phase in blob
+                finally:
+                    obs.disable_flight()
+
+            # the process dies: rebuild everything from durable state
+            placement = FleetPlacement(NAMES)
+            shards = _reopen(vroot, NAMES)
+            coord = MigrationCoordinator(placement, list(shards.values()))
+            outcomes = coord.recover()
+
+            if phase == "prepare":
+                # killed before anything durable — nothing to recover
+                assert outcomes == []
+                assert shards["s0"].has_tenant(victim)
+            elif phase in ("in_flight", "pre_commit"):
+                # prepared but no target generation → abort: tenant home
+                assert [o[1] for o in outcomes] == ["aborted"]
+                assert shards["s0"].has_tenant(victim)
+                assert not shards["s1"].has_tenant(victim)
+            else:  # pre_gc: the target's generation was durable → finish
+                assert [o[1] for o in outcomes] == ["completed"]
+                assert shards["s1"].has_tenant(victim)
+                assert not shards["s0"].has_tenant(victim)
+            assert coord.recover() == []  # recovery is idempotent
+            _state_by_key(shards)  # every tenant on exactly one shard
+
+        # ------------------------------------------------------------------
+        # kill mid-rebalance: a third shard joins, converge() dies on its
+        # 4th move's pre-commit
+        # ------------------------------------------------------------------
+        names3 = NAMES + ["s2"]
+        shards["s2"] = FleetShard("s2", MeanSquaredError(), os.path.join(vroot, "s2"))
+        placement.add_shard("s2")
+        coord = MigrationCoordinator(placement, list(shards.values()))
+        reb = FleetRebalancer(coord)
+        with tempfile.TemporaryDirectory() as fd:
+            obs.enable_flight(fd)
+            try:
+                with kill_at_migration_phase(coord, "pre_commit", after=3) as info:
+                    with pytest.raises(Preempted):
+                        reb.converge(max_moves=8)
+                assert info["kills"] == 1
+                assert len(_dumps(fd)) == 1  # the 3 completed moves dump nothing
+            finally:
+                obs.disable_flight()
+
+        placement = FleetPlacement(names3)
+        shards = _reopen(vroot, names3)
+        assert len(shards["s2"]) == 3  # the completed moves survived the kill
+        coord = MigrationCoordinator(placement, list(shards.values()))
+        outcomes = coord.recover()
+        assert [o[1] for o in outcomes] == ["aborted"]
+        _state_by_key(shards)
+
+        # finish a bounded slice of the reshard cleanly, then serve on
+        assert FleetRebalancer(coord).converge(max_moves=12) == 12
+        assert len(shards["s2"]) == 15
+        _state_by_key(shards)
+
+        # ------------------------------------------------------------------
+        # the resumed stream: resubmit EVERYTHING from step 0 — migrated
+        # cursors make steps 0..3 exact no-ops, steps 4..5 fold once
+        # ------------------------------------------------------------------
+        _feed(shards, range(6))
+        skipped = sum(sh.stats["replays_skipped"] for sh in shards.values())
+        assert skipped == 4 * N  # four already-covered steps × every tenant
+        assert all(
+            sh.cursor_of(k) == 5 for sh in shards.values() for k in sh.tenants()
+        )
+
+        _feed(twin, [4, 5])  # the control just keeps streaming
+
+        # bit-identical, tenant by tenant, across the whole fleet
+        got = _state_by_key(shards)
+        want = _state_by_key(twin)
+        assert set(got) == set(want)
+        for sname in want:
+            np.testing.assert_array_equal(got[sname], want[sname], err_msg=sname)
+
+
+def test_healthy_fleet_run_zero_failure_counters_zero_dumps():
+    obs.enable()
+    with tempfile.TemporaryDirectory() as d, tempfile.TemporaryDirectory() as fd:
+        obs.enable_flight(fd)
+        try:
+            placement, shards = _build(d, NAMES, n=64)
+            _feed(shards, range(2))
+            for sh in shards.values():
+                sh.checkpoint()
+            coord = MigrationCoordinator(placement, list(shards.values()))
+            for key in list(shards["s0"].tenants())[:3]:
+                assert coord.migrate(key, "s1") is not None
+            assert coord.recover() == []  # nothing stranded
+
+            counters = obs.get().counters
+            assert counters.get("fleet.migrations_failed", 0) == 0
+            assert counters.get("fleet.evacuations", 0) == 0
+            assert counters.get("fleet.migrations_done", 0) == 3
+            assert _dumps(fd) == []
+            _state_by_key(shards, n=64)
+        finally:
+            obs.disable_flight()

@@ -1,0 +1,346 @@
+"""Elastic-fleet migration machinery (ISSUE 18): portable tenant
+envelopes across every metric family (list/"cat" states and ``__qres``
+error-feedback residuals included), rendezvous placement properties,
+shard capacity growth/shrink on both sides of a handoff, the
+IngestQueue drain-into-envelope path (admitted rows must not strand),
+and the ``metrics_tpu_fleet_*`` export families.
+
+The kill-point protocol itself is proven by the chaos bed
+(``test_fleet_chaos.py``); this module pins the building blocks.
+"""
+import glob
+import os
+import tempfile
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import metrics_tpu.observability as obs
+from metrics_tpu import MeanAbsoluteError, MeanSquaredError, Metric, MetricCohort
+from metrics_tpu.fleet import (
+    TENANT_ENVELOPE_FORMAT,
+    FleetPlacement,
+    FleetShard,
+    MigrationCoordinator,
+    adopt_into,
+    open_tenant_envelope,
+    tenant_envelope,
+)
+from metrics_tpu.observability.exporter import (
+    parse_prometheus_text,
+    render_exposition,
+)
+from metrics_tpu.reliability import faultinject as fi
+from metrics_tpu.reliability.checkpoint import (
+    CheckpointCorruptionError,
+    CheckpointMismatchError,
+)
+from metrics_tpu.serving import IngestQueue
+from tests.reliability.test_roundtrips import CASES, _values_equal
+
+pytestmark = pytest.mark.chaos
+
+
+# ----------------------------------------------------------------------
+# 1. the tenant envelope: every family rides, bit-identical
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize(
+    "name,factory,args", [(n, f, a) for n, f, a in CASES], ids=[c[0] for c in CASES]
+)
+def test_tenant_envelope_roundtrip_every_family(name, factory, args):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m = factory()
+        m.update(*args)
+        m.update(*args)  # two batches: list ("cat") states get len-2 lists
+
+        env = tenant_envelope(m, 4242, cursor=7)
+        assert env["format"] == TENANT_ENVELOPE_FORMAT
+        key, cursor, payload, pending = open_tenant_envelope(env)
+        assert (key, cursor, pending) == (4242, 7, None)
+        assert payload  # the state universe rode along
+
+        m2 = factory()
+        assert adopt_into(m2, env) == 7
+        # the replay guard fast-forwarded: step 7 must now be a no-op
+        assert m2._session_cursor == 7
+        _values_equal(m.compute(), m2.compute(), name)
+
+
+def test_tenant_envelope_rejects_foreign_metric():
+    m = MeanSquaredError()
+    m.update(jnp.ones(4), jnp.zeros(4))
+    env = tenant_envelope(m, 1)
+    with pytest.raises(CheckpointMismatchError, match="does not fit"):
+        adopt_into(MeanAbsoluteError(), env)
+
+
+def test_tenant_envelope_checksum_catches_bit_rot():
+    m = MeanSquaredError()
+    m.update(jnp.ones(4), jnp.zeros(4))
+    env = tenant_envelope(m, 1)
+    bad = fi.corrupt_envelope(env, mode="payload")
+    with pytest.raises(CheckpointCorruptionError):
+        open_tenant_envelope(bad)
+
+
+def test_cat_state_tenant_stays_eager_and_portable():
+    """Curve metrics (list states) never enter a cohort — they migrate as
+    standalone eager tenants, list chunks preserved chunk-for-chunk."""
+    from metrics_tpu import AUROC
+
+    preds = jnp.asarray(np.random.RandomState(7).rand(16).astype(np.float32))
+    target = jnp.asarray(np.random.RandomState(8).randint(2, size=16))
+    m = AUROC()
+    m.update(preds, target)
+    m.update(preds, target)
+    list_states = [k for k, v in m._defaults.items() if isinstance(v, list)]
+    assert list_states, "AUROC should carry list states"
+
+    m2 = AUROC()
+    adopt_into(m2, tenant_envelope(m, 9, cursor=1))
+    for sname in list_states:
+        src_chunks, dst_chunks = getattr(m, sname), getattr(m2, sname)
+        assert len(dst_chunks) == len(src_chunks) == 2
+        for a, b in zip(src_chunks, dst_chunks):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _values_equal(m.compute(), m2.compute(), "AUROC")
+
+
+class _Int8Hist(Metric):
+    """A quantized-sync-tier state: its ``hist__qres`` error-feedback
+    residual is REAL accumulated state and must ride the envelope."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state(
+            "hist",
+            default=jnp.zeros((8,), dtype=jnp.float32),
+            dist_reduce_fx="sum",
+            sync_precision="int8",
+        )
+
+    def update(self, x):
+        self.hist = self.hist + jnp.asarray(x, dtype=jnp.float32)
+
+    def compute(self):
+        return self.hist
+
+
+def test_int8_residual_rides_the_envelope():
+    m = _Int8Hist()
+    m.update(jnp.arange(8.0))
+    m.hist__qres = jnp.full((8,), 0.25, dtype=jnp.float32)
+
+    m2 = _Int8Hist()
+    adopt_into(m2, tenant_envelope(m, 3))
+    np.testing.assert_array_equal(np.asarray(m2.hist), np.arange(8.0, dtype=np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(m2.hist__qres), np.full((8,), 0.25, dtype=np.float32)
+    )
+
+
+# ----------------------------------------------------------------------
+# 2. rendezvous placement
+# ----------------------------------------------------------------------
+def test_placement_is_deterministic_and_minimal_churn():
+    names = ["shard-0", "shard-1", "shard-2"]
+    a, b = FleetPlacement(names), FleetPlacement(list(reversed(names)))
+    keys = list(range(2000))
+    # deterministic across processes AND insertion orders
+    assert [a.assign(k) for k in keys] == [b.assign(k) for k in keys]
+
+    before = {k: a.assign(k) for k in keys}
+    a.add_shard("shard-3")
+    moved = [k for k in keys if a.assign(k) != before[k]]
+    # every moved key landed on the NEW shard, and only ~1/N moved
+    assert all(a.assign(k) == "shard-3" for k in moved)
+    assert 0 < len(moved) / len(keys) < 0.45
+
+
+def test_placement_overrides_follow_migrations():
+    p = FleetPlacement(["a", "b"])
+    key = next(k for k in range(64) if p.assign(k) == "a")
+    g0 = p.generation
+    p.record_location(key, "b")
+    assert p.locate(key) == "b" and key in p.overrides
+    assert p.generation > g0
+    # recording the HOME shard clears the override instead of storing it
+    p.record_location(key, "a")
+    assert key not in p.overrides and p.locate(key) == "a"
+    with pytest.raises(RuntimeError):
+        FleetPlacement([]).assign(0)
+
+
+# ----------------------------------------------------------------------
+# 3. shard handoffs: capacity grows/shrinks on both sides, state exact
+# ----------------------------------------------------------------------
+def _rows(keys, step):
+    keys = np.asarray(keys, dtype=np.float64)
+    preds = np.stack([keys * 1e-3 + step, keys * 1e-3 - step], 1).astype(np.float32)
+    target = np.stack([keys * 2e-3, np.zeros_like(keys)], 1).astype(np.float32)
+    return jnp.asarray(preds), jnp.asarray(target)
+
+
+def test_migration_grows_target_and_shrinks_source_capacity():
+    with tempfile.TemporaryDirectory() as d:
+        src = FleetShard("src", MeanSquaredError(), os.path.join(d, "src"))
+        dst = FleetShard("dst", MeanSquaredError(), os.path.join(d, "dst"))
+        keys = list(range(9))
+        src.add_tenants(keys)
+        for step in range(3):
+            src.submit_wave(step, keys, *_rows(keys, step))
+        src.checkpoint()
+        cap_src0, cap_dst0 = src.cohort.capacity, dst.cohort.capacity
+        assert cap_src0 >= 9 and cap_dst0 < 8
+
+        placement = FleetPlacement(["src", "dst"])
+        coord = MigrationCoordinator(placement, [src, dst])
+        for k in keys[:8]:
+            assert coord.migrate(k, "dst") is not None
+        # the target grew to hold 8; the source keeps its bucket warm
+        # (capacity never shrinks eagerly — the compiled program stays
+        # hot for the next admission wave)
+        assert dst.cohort.capacity > cap_dst0 and src.cohort.capacity == cap_src0
+        assert (len(src), len(dst)) == (1, 8)
+
+        # the moved states are exact vs a never-migrated twin
+        twin = FleetShard("twin", MeanSquaredError(), os.path.join(d, "twin"))
+        twin.add_tenants(keys)
+        for step in range(3):
+            twin.submit_wave(step, keys, *_rows(keys, step))
+        for k in keys:
+            shard = dst if dst.has_tenant(k) else src
+            got = shard.cohort.tenant_collection(shard.slot_of(k)).compute()
+            want = twin.cohort.tenant_collection(twin.slot_of(k)).compute()
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+            assert shard.cursor_of(k) == 2  # the replay cursor moved too
+
+        # durable on both sides: fresh processes rebuild the same fleet
+        src2 = FleetShard("src", MeanSquaredError(), os.path.join(d, "src"))
+        dst2 = FleetShard("dst", MeanSquaredError(), os.path.join(d, "dst"))
+        assert src2.restore() and dst2.restore()
+        assert src2.tenants() == src.tenants()
+        assert dst2.tenants() == dst.tenants()
+        assert all(dst2.cursor_of(k) == 2 for k in dst2.tenants())
+
+
+def test_restore_shrinks_an_overgrown_shard():
+    """The load path resizes DOWN too: a shard that grew past its
+    checkpointed capacity snaps back to the durable generation."""
+    with tempfile.TemporaryDirectory() as d:
+        tiny = FleetShard("tiny", MeanSquaredError(), os.path.join(d, "tiny"))
+        tiny.add_tenants([5, 6])
+        tiny.submit_wave(0, [5, 6], *_rows([5, 6], 0))
+        tiny.checkpoint()
+        small_cap = tiny.cohort.capacity
+
+        grown = FleetShard("tiny", MeanSquaredError(), os.path.join(d, "tiny"))
+        grown.add_tenants(range(100, 114))
+        assert grown.cohort.capacity > small_cap
+        assert grown.restore()
+        assert grown.cohort.capacity == small_cap
+        assert grown.tenants() == (5, 6)
+        assert grown.cursor_of(5) == 0
+
+
+def test_replay_guard_survives_migration():
+    with tempfile.TemporaryDirectory() as d:
+        src = FleetShard("src", MeanSquaredError(), os.path.join(d, "src"))
+        dst = FleetShard("dst", MeanSquaredError(), os.path.join(d, "dst"))
+        src.add_tenants([0, 1])
+        for step in range(2):
+            src.submit_wave(step, [0, 1], *_rows([0, 1], step))
+        coord = MigrationCoordinator(FleetPlacement(["src", "dst"]), [src, dst])
+        coord.migrate(1, "dst")
+        before = np.asarray(dst.cohort.tenant_collection(dst.slot_of(1)).compute())
+        # re-feeding the already-folded steps is an exact no-op on the target
+        for step in range(2):
+            dst.submit_wave(step, [1], *_rows([1], step))
+        assert dst.stats["replays_skipped"] == 2
+        np.testing.assert_array_equal(
+            np.asarray(dst.cohort.tenant_collection(dst.slot_of(1)).compute()), before
+        )
+
+
+# ----------------------------------------------------------------------
+# 4. ingest drain: admitted-but-undispatched rows ride the envelope
+# ----------------------------------------------------------------------
+def test_buffered_ingest_rows_migrate_instead_of_stranding():
+    with tempfile.TemporaryDirectory() as d:
+        src = FleetShard("src", MeanSquaredError(), os.path.join(d, "src"))
+        dst = FleetShard("dst", MeanSquaredError(), os.path.join(d, "dst"))
+        src.add_tenants([0, 1])
+        src.queue = IngestQueue(src.cohort, rows_per_step=64)
+        dst.queue = IngestQueue(dst.cohort, rows_per_step=64)
+
+        slot = src.slot_of(1)
+        preds = np.asarray([0.5, 0.25], dtype=np.float32)
+        target = np.asarray([0.0, 1.0], dtype=np.float32)
+        src.queue.submit(np.full(2, slot, dtype=np.int32), preds, target)
+        assert src.queue.buffered_rows == 2
+
+        coord = MigrationCoordinator(FleetPlacement(["src", "dst"]), [src, dst])
+        coord.migrate(1, "dst")
+        # drained out of the source queue, resubmitted into the target's
+        assert src.queue.buffered_rows == 0
+        assert src.queue.stats["drained_rows"] == 2
+        assert dst.queue.buffered_rows == 2
+
+        # a queue-less target stashes them typed instead of dropping them
+        src.add_tenant(7)
+        src.queue.submit(
+            np.full(1, src.slot_of(7), dtype=np.int32), preds[:1], target[:1]
+        )
+        dst.queue = None
+        coord.migrate(7, "dst")
+        (p_rows, t_rows) = dst.pending_rows[7]
+        np.testing.assert_array_equal(p_rows, preds[:1])
+        np.testing.assert_array_equal(t_rows, target[:1])
+
+
+def test_drain_tenant_is_exact_and_ordered():
+    cohort = MetricCohort(MeanSquaredError(), tenants=2)
+    q = IngestQueue(cohort, rows_per_step=64)
+    q.submit(np.zeros(2, dtype=np.int32), np.asarray([1.0, 2.0]), np.asarray([0.0, 0.0]))
+    q.submit(np.zeros(1, dtype=np.int32), np.asarray([3.0]), np.asarray([0.0]))
+    rows = q.drain_tenant(0)
+    np.testing.assert_array_equal(rows[0], np.asarray([1.0, 2.0, 3.0]))
+    assert q.buffered_rows == 0 and q.stats["drained_rows"] == 3
+    assert q.drain_tenant(0) is None  # empty drain is a typed no-op
+
+
+# ----------------------------------------------------------------------
+# 5. the export surface
+# ----------------------------------------------------------------------
+def test_exporter_renders_fleet_families():
+    obs.enable()
+    with tempfile.TemporaryDirectory() as d:
+        src = FleetShard("src", MeanSquaredError(), os.path.join(d, "src"))
+        dst = FleetShard("dst", MeanSquaredError(), os.path.join(d, "dst"))
+        src.add_tenants([0, 1, 2])
+        placement = FleetPlacement(["src", "dst"])
+        coord = MigrationCoordinator(placement, [src, dst])
+        coord.migrate(0, "dst")
+
+        samples = parse_prometheus_text(render_exposition())
+        fid = str(coord.export_id)
+        gen = {
+            tuple(sorted(lbl.items())): v
+            for lbl, v in samples["metrics_tpu_fleet_placement_generation"]
+        }
+        assert gen[(("fleet", fid),)] == float(placement.generation)
+        mig = {
+            lbl["shard"]: v
+            for lbl, v in samples["metrics_tpu_fleet_migrations_total"]
+            if lbl["fleet"] == fid
+        }
+        assert mig == {"src": 1.0, "dst": 1.0}
+        inflight = {
+            lbl["shard"]: v
+            for lbl, v in samples["metrics_tpu_fleet_tenants_in_flight"]
+            if lbl["fleet"] == fid
+        }
+        assert set(inflight.values()) == {0.0}  # nothing mid-handoff at rest
