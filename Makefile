@@ -1,4 +1,4 @@
-.PHONY: ci lint san test test-tpu test-tpu-suite doctest bench bench-sync bench-cohort bench-fleet serve-bench sentinel serve-metrics dryrun fuzz fuzz-sharded chaos clean
+.PHONY: ci lint san test test-tpu test-tpu-suite doctest bench bench-sync bench-cohort bench-fleet bench-failover serve-bench sentinel serve-metrics dryrun fuzz fuzz-sharded chaos clean
 
 ci:
 	# the full CI gate as one machine-runnable target (mirrors
@@ -155,6 +155,19 @@ bench-fleet:
 	tail -n 1 bench_fleet.txt > bench_fleet.json
 	python scripts/perf_sentinel.py --current bench_fleet.json --strict-bounds --out SENTINEL_fleet.json
 
+bench-failover:
+	# shard-failure resilience legs (~3 min at 10k tenants): steady-state
+	# replication lag after a delta cycle (0 by contract), delta-cycle and
+	# failover-to-first-wave timings (advisory), and the strict
+	# redelivery-exactness bound (failover_rows_redelivered_10k == 0.0:
+	# the ingest window redelivers the dead shard's post-watermark rows
+	# exactly once onto the promoted owners). Writes
+	# SENTINEL_failover.json; CI uploads bench_failover.json + the chaos
+	# flight dumps as artifacts.
+	METRICS_TPU_FLIGHT=flight-dumps python bench.py --leg-failover | tee bench_failover.txt
+	tail -n 1 bench_failover.txt > bench_failover.json
+	python scripts/perf_sentinel.py --current bench_failover.json --strict-bounds --out SENTINEL_failover.json
+
 serve-bench:
 	# continuous-serving legs (~2 min): steady-state per-step metric
 	# overhead of a live serve loop at 1M rows — blocking forward vs the
@@ -230,4 +243,5 @@ clean:
 	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json bench_cohort.txt bench_cohort.json ANALYSIS_current.json numerics_evidence.json
 	rm -f bench_serving.txt bench_serving.json SENTINEL_serving.json metrics_scrape_serving.txt cost_ledger.json
 	rm -f bench_fleet.txt bench_fleet.json SENTINEL_fleet.json
+	rm -f bench_failover.txt bench_failover.json SENTINEL_failover.json
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -753,6 +753,204 @@ def _bench_fleet() -> dict:
     }
 
 
+def _failover_leg() -> None:
+    """``--leg-failover-child``: shard-failure resilience at fleet scale.
+
+    A 3-shard, 10k-tenant fleet with leases, follower replication and a
+    redelivery-window ingest queue armed, driven through the full
+    failure protocol. Figures: (1) **steady-state replication lag**
+    (tenant·step units) right after a delta cycle — the contract says 0:
+    every committed step is follower-durable; (2) **delta replication
+    ms**: one incremental cycle (committed-but-unreplicated steps only)
+    across all three shards; (3) **failover-to-first-wave ms**: wall
+    time from initiating failover (fence + promote from replicated
+    envelopes + placement re-pin) until the first redelivered wave has
+    folded on the promoted owner; (4) **redelivery exactness**: rows the
+    ingest window redelivers versus the rows the dead shard had folded
+    past the replication watermark — the deviation |redelivered /
+    expected - 1| is 0 by construction (retention is per-wave and the
+    replay guard folds each step exactly once), and is the leg the
+    sentinel bounds."""
+    import os
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from metrics_tpu import MeanSquaredError
+    from metrics_tpu.fleet import (
+        FleetPlacement,
+        FleetRebalancer,
+        FleetShard,
+        LeaseAuthority,
+        MigrationCoordinator,
+        ShardReplicator,
+    )
+    from metrics_tpu.serving import IngestQueue
+
+    n = int(os.environ.get("BENCH_FAILOVER_TENANTS", 10_000))
+    rows_per_step = 2
+    feat = 8
+    names = ["s0", "s1", "s2"]
+    root = tempfile.mkdtemp(prefix="bench-failover-")
+    placement = FleetPlacement(names)
+    shards = {
+        nm: FleetShard(nm, MeanSquaredError(), os.path.join(root, nm))
+        for nm in names
+    }
+    by_shard: dict = {nm: [] for nm in names}
+    for k in range(n):
+        by_shard[placement.assign(k)].append(k)
+    for nm, sh in shards.items():
+        sh.add_tenants(by_shard[nm])
+    coord = MigrationCoordinator(placement, shards.values())
+    # the leg drives failover explicitly (fence + promote), not via TTL
+    # expiry — a long TTL keeps CPU-scale wall time from fencing the
+    # healthy phase (a real deployment renews on every heartbeat)
+    auth = LeaseAuthority(ttl_s=3600.0)
+    for sh in shards.values():
+        sh.attach_lease(auth)
+    rep = ShardReplicator(coord, authority=auth)
+    reb = FleetRebalancer(coord, replicator=rep, authority=auth)
+
+    def _wave(keys, step):
+        base = np.asarray(keys, dtype=np.float64)[:, None, None]
+        preds = (base * 1e-4 + step * 0.125 + np.arange(feat) * 0.01).astype(
+            np.float32
+        )
+        preds = np.broadcast_to(preds, (len(keys), rows_per_step, feat)).copy()
+        target = np.broadcast_to(
+            (base * 2e-4).astype(np.float32), preds.shape
+        ).copy()
+        return preds, target
+
+    def _feed(step, only=None):
+        for nm, sh in shards.items():
+            if only is not None and nm not in only:
+                continue
+            keys = by_shard[nm]
+            sh.submit_wave(step, keys, *_wave(keys, step))
+
+    # steady state: two committed+replicated steps (the first cycle ships
+    # the full envelopes and warms every program), then a committed delta
+    for step in (0, 1):
+        _feed(step)
+    for sh in shards.values():
+        sh.checkpoint()
+    for sh in shards.values():
+        rep.replicate(sh)
+    for step in (2, 3):
+        _feed(step)
+    for sh in shards.values():
+        sh.checkpoint()
+    t0 = time.perf_counter()
+    for sh in shards.values():
+        rep.replicate(sh)
+    delta_ms = (time.perf_counter() - t0) * 1e3
+    print("FAILOVER_REPLICATE_DELTA_MS", delta_ms)
+    print("FAILOVER_STEADY_LAG", rep.lag())
+
+    # the victim's post-watermark waves (steps 4-5) arrive through an
+    # ingest queue with a redelivery window — the rows a real deployment
+    # would still hold in the serving tier when the shard dies
+    dead = "s0"
+    dead_keys = by_shard[dead]
+    # the queue tags rows with the cohort's slot ids (its routing
+    # contract); keep the slot→fleet-key map so redelivery can resubmit
+    # under the fleet keys the promoted owner knows
+    slot_of = {k: shards[dead].slot_of(k) for k in dead_keys}
+    key_of = {s: k for k, s in slot_of.items()}
+    q = IngestQueue(
+        shards[dead].cohort,
+        rows_per_step=rows_per_step,
+        coalesce_max=1,
+        redelivery_window=8,
+    )
+    for step in (4, 5):
+        preds, target = _wave(dead_keys, step)
+        ids = np.repeat(
+            np.asarray([slot_of[k] for k in dead_keys], dtype=np.int64),
+            rows_per_step,
+        )
+        q.submit(ids, preds.reshape(-1, feat), target.reshape(-1, feat))
+        _feed(step, only=[nm for nm in names if nm != dead])
+
+    # kill + failover: fence the stale owner, promote the follower from
+    # its replicated envelopes (watermark = step 3), re-pin placement,
+    # then redeliver the retained waves — the replay guard admits exactly
+    # steps 4-5 and the first folded wave stops the clock
+    first_wave_ms = [None]
+
+    def _resubmit(tids, *arrs):
+        step = 4 + _resubmit.waves
+        _resubmit.waves += 1
+        order = np.argsort(np.asarray(tids), kind="stable")
+        keys = [key_of[int(s)] for s in np.asarray(tids)[order][::rows_per_step]]
+        blocks = [
+            np.asarray(a)[order].reshape(len(keys), rows_per_step, -1)
+            for a in arrs
+        ]
+        # followers are per-tenant rendezvous rank-2: the dead shard's
+        # tenants promote onto BOTH survivors, so route by current owner
+        owners: dict = {}
+        for j, k in enumerate(keys):
+            owners.setdefault(coord.find_tenant(k), []).append(j)
+        for nm, idxs in owners.items():
+            coord.shards[nm].submit_wave(
+                step, [keys[j] for j in idxs], *[b[idxs] for b in blocks]
+            )
+        if first_wave_ms[0] is None:
+            first_wave_ms[0] = (time.perf_counter() - t0) * 1e3
+
+    _resubmit.waves = 0
+    t0 = time.perf_counter()
+    reb.failover(dead)
+    redelivered = q.redeliver(submit=_resubmit)
+    print("FAILOVER_TO_FIRST_WAVE_MS", first_wave_ms[0])
+    print("FAILOVER_ROWS_REDELIVERED", redelivered)
+    expected = 2 * len(dead_keys) * rows_per_step
+    print("FAILOVER_REDELIVERY_DEVIATION", abs(redelivered / expected - 1.0))
+
+
+def _bench_failover() -> dict:
+    """Parent assembly of the failover leg (CPU-forced subprocess, same
+    pattern as the other legs): the sentinel-bounded
+    ``failover_rows_redelivered_10k`` redelivery-exactness deviation
+    (== 0.0: the ingest window redelivers the dead shard's
+    post-watermark rows exactly once) plus the advisory steady-state
+    lag, delta-replication and failover-to-first-wave timings."""
+    import os
+    import subprocess
+
+    here = os.path.abspath(__file__)
+    proc = subprocess.run(
+        [sys.executable, here, "--leg-failover-child"],
+        capture_output=True, text=True, timeout=1800, cwd=os.path.dirname(here),
+    )
+    out = _leg_stdout(proc, "failover")
+    return {
+        "fleet_replication_steady_lag": round(
+            float(_marker_values(out, "FAILOVER_STEADY_LAG", "failover")[0]), 1
+        ),
+        "fleet_replication_delta_ms": round(
+            float(_marker_values(out, "FAILOVER_REPLICATE_DELTA_MS", "failover")[0]), 3
+        ),
+        "fleet_failover_to_first_wave_ms": round(
+            float(_marker_values(out, "FAILOVER_TO_FIRST_WAVE_MS", "failover")[0]), 3
+        ),
+        "fleet_failover_rows_redelivered": round(
+            float(_marker_values(out, "FAILOVER_ROWS_REDELIVERED", "failover")[0]), 1
+        ),
+        "failover_rows_redelivered_10k": round(
+            float(
+                _marker_values(out, "FAILOVER_REDELIVERY_DEVIATION", "failover")[0]
+            ),
+            6,
+        ),
+    }
+
+
 def _serving_leg() -> None:
     """``--leg-serving-child``: steady-state per-step metric overhead of a
     live serve loop, blocking vs async pipeline, at 1M rows.
@@ -1608,6 +1806,34 @@ def main() -> None:
         return
     if "--leg-fleet-child" in sys.argv:
         _fleet_leg()
+        return
+    if "--leg-failover-child" in sys.argv:
+        _failover_leg()
+        return
+    if "--leg-failover" in sys.argv:
+        # failover legs only (make bench-failover): shard-failure
+        # resilience at 10k tenants — steady-state replication lag,
+        # delta-cycle and failover-to-first-wave timings, and the
+        # sentinel-bounded redelivery-exactness deviation
+        # (failover_rows_redelivered_10k == 0.0). Same one-JSON-line
+        # contract, platform pinned "cpu" (the legs are CPU-forced by
+        # design).
+        result = {
+            "metric": "failover legs only (bench.py --leg-failover)",
+            "platform": "cpu",
+        }
+        failover_failed = None
+        try:
+            result.update(_bench_failover())
+        except Exception as err:
+            failover_failed = err
+            print(f"ERROR: failover leg failed ({err!r})", file=sys.stderr)
+        print(json.dumps(result))
+        if failover_failed is not None:
+            # the redelivery-exactness deviation IS the point of
+            # --leg-failover; a missing leg would make the sentinel's
+            # bound gate vacuously green
+            raise SystemExit(1)
         return
     if "--leg-fleet" in sys.argv:
         # fleet legs only (make bench-fleet): rebalance cost at 10k

@@ -11,6 +11,7 @@ jax.config.update("jax_platforms", "cpu")
 
 import metrics_tpu
 import metrics_tpu.analysis as A
+import metrics_tpu.fleet as FL
 import metrics_tpu.functional as F
 import metrics_tpu.observability as O
 import metrics_tpu.parallel as P
@@ -72,6 +73,15 @@ def main() -> None:
     ]
     lines += [f"- **`{n}`** — {d}" for n, d in _classes(S)]
     lines += [f"- **`{n}`** — {d}" for n, d in _functions(S)]
+    lines += ["", "## Elastic fleet (`metrics_tpu.fleet`)", ""]
+    lines += [
+        "See `docs/reliability.md` (\"Elastic fleet\" and \"Shard failure &"
+        " failover\") for the two-phase migration protocol, the lease state"
+        " machine, replication/failover semantics, and the chaos evidence.",
+        "",
+    ]
+    lines += [f"- **`{n}`** — {d}" for n, d in _classes(FL)]
+    lines += [f"- **`{n}`** — {d}" for n, d in _functions(FL)]
     lines += ["", "## Static analysis (`metrics_tpu.analysis`)", ""]
     lines += [
         "See `docs/static_analysis.md` for the rule catalog (MTA001-MTA012,"

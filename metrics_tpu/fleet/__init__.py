@@ -1,24 +1,43 @@
-"""Elastic fleet: tenant→shard placement, live migration, resharding.
+"""Elastic fleet: tenant→shard placement, live migration, resharding,
+replication + fenced failover.
 
 A single :class:`~metrics_tpu.cohort.MetricCohort` makes N tenants one
-process's property; this package makes them a *fleet's*. Three layers,
+process's property; this package makes them a *fleet's*. Five layers,
 each usable alone:
 
 * :mod:`~metrics_tpu.fleet.placement` — :class:`FleetPlacement`,
   minimal-churn rendezvous hashing with a live-move override table so
-  streams follow their tenant mid-migration;
+  streams follow their tenant mid-migration (rank-2 of the same weight
+  order names each tenant's replication follower);
 * :mod:`~metrics_tpu.fleet.migration` — :class:`FleetShard` (cohort +
   journal + tenant bookkeeping) and :class:`MigrationCoordinator`, the
   two-phase, chaos-proven exactly-once handoff built on checksummed
   :func:`tenant_envelope` transfers;
+* :mod:`~metrics_tpu.fleet.lease` — :class:`LeaseAuthority`, leased
+  ownership with epoch fencing: a partitioned old owner cannot commit
+  generations or acknowledge waves under a stale epoch
+  (:class:`StaleEpochError` — typed refusal, never a silent merge);
+* :mod:`~metrics_tpu.fleet.replication` — :class:`ShardReplicator` +
+  :class:`ReplicaStore`, continuous post-commit delta replication of
+  tenant envelopes to each tenant's rendezvous follower, with
+  follower-durable watermarks;
 * :mod:`~metrics_tpu.fleet.rebalancer` — :class:`FleetRebalancer`,
-  capacity-driven split/merge and quorum-driven evacuation, expressed
-  entirely as batches of ordinary migrations.
+  capacity-driven split/merge, quorum-driven evacuation, and
+  replica-promoting failover of dead shards.
 
-See docs/reliability.md ("Elastic fleet") for the handoff state machine
-and the rebalancing playbook, and ``tests/reliability/test_fleet_chaos.py``
-for the kill-at-every-phase proof.
+See docs/reliability.md ("Elastic fleet", "Shard failure & failover")
+for the handoff and lease state machines,
+``tests/reliability/test_fleet_chaos.py`` for the kill-at-every-phase
+proof, and ``tests/reliability/test_fleet_failover.py`` for the
+kill-anywhere → failover → bit-identical-twin proof.
 """
+from metrics_tpu.fleet.lease import (
+    LeaseAuthority,
+    LeaseError,
+    LeaseExpiredError,
+    ShardLease,
+    StaleEpochError,
+)
 from metrics_tpu.fleet.migration import (
     TENANT_ENVELOPE_FORMAT,
     FleetShard,
@@ -29,13 +48,21 @@ from metrics_tpu.fleet.migration import (
 )
 from metrics_tpu.fleet.placement import FleetPlacement
 from metrics_tpu.fleet.rebalancer import FleetRebalancer
+from metrics_tpu.fleet.replication import ReplicaStore, ShardReplicator
 
 __all__ = [
     "TENANT_ENVELOPE_FORMAT",
     "FleetPlacement",
     "FleetRebalancer",
     "FleetShard",
+    "LeaseAuthority",
+    "LeaseError",
+    "LeaseExpiredError",
     "MigrationCoordinator",
+    "ReplicaStore",
+    "ShardLease",
+    "ShardReplicator",
+    "StaleEpochError",
     "adopt_into",
     "open_tenant_envelope",
     "tenant_envelope",

@@ -56,6 +56,7 @@ from metrics_tpu.metric import (
     _decode_session_cursor,
     _encode_session_cursor,
 )
+from metrics_tpu.fleet.lease import LeaseError
 from metrics_tpu.observability import exporter as _exporter
 from metrics_tpu.observability import flight as _flight
 from metrics_tpu.observability import telemetry as _obs
@@ -216,12 +217,62 @@ class FleetShard:
         self._tenants: Dict[int, int] = {}  # tenant key -> cohort slot
         self._cursors: Dict[int, int] = {}  # tenant key -> replay cursor
         self.pending_rows: Dict[int, List[np.ndarray]] = {}
+        # scratch cohort for partial waves: admitted tenants fold through
+        # the SAME vmapped program as a full wave (gather → vmap →
+        # scatter), never an eager per-tenant loop — eager folds are not
+        # bit-identical to the vmapped fold, and failover convergence
+        # depends on every resubmit path folding identically
+        self._subwave: Optional[MetricCohort] = None
+        self.lease: Optional[Any] = None
+        self.authority: Optional[Any] = None
         self.stats: Dict[str, int] = {
             "migrations_in": 0,
             "migrations_out": 0,
             "replays_skipped": 0,
+            "fenced_writes": 0,
             "waves": 0,
         }
+
+    # ------------------------------------------------------------------
+    # leased ownership (epoch fencing — see metrics_tpu.fleet.lease)
+    # ------------------------------------------------------------------
+    def attach_lease(self, authority: Any, holder: Optional[str] = None) -> Any:
+        """Acquire this shard's ownership lease from ``authority`` and arm
+        fencing: from here on every generation commit and every wave ack
+        validates the lease first, and a stale/expired epoch is refused
+        with a typed error + one flight dump. Shards never attached stay
+        unfenced (the single-owner deployments that need no authority)."""
+        self.authority = authority
+        self.lease = authority.acquire(self.name, holder=holder)
+        return self.lease
+
+    @property
+    def epoch(self) -> int:
+        """The ownership epoch this shard writes under (-1 = unleased)."""
+        return self.lease.epoch if self.lease is not None else -1
+
+    def _check_fence(self, what: str) -> None:
+        """The fence: refuse ``what`` unless the held lease is current.
+        The refusal is LOUD and typed — counter + one flight dump + the
+        :class:`~metrics_tpu.fleet.lease.LeaseError` re-raised — and the
+        write never happens, so a fenced timeline cannot merge."""
+        if self.authority is None or self.lease is None:
+            return
+        try:
+            self.authority.check(self.lease)
+        except LeaseError as err:
+            self.stats["fenced_writes"] += 1
+            if _obs.enabled():
+                _obs.get().count("fleet.lease.fenced_writes")
+            _flight.dump_on_failure(
+                "fleet_fenced_write",
+                shard=self.name,
+                what=what,
+                held_epoch=self.lease.epoch,
+                current_epoch=self.authority.current_epoch(self.name),
+                error=f"{type(err).__name__}: {err}",
+            )
+            raise
 
     # ------------------------------------------------------------------
     # membership
@@ -270,6 +321,32 @@ class FleetShard:
         self.pending_rows.pop(key, None)
         return self.cohort.remove_tenant(slot, return_state=return_state)
 
+    def _subwave_cohort(self, m: int) -> MetricCohort:
+        """The scratch cohort partial waves fold through: same template
+        (hence the same compiled per-lane program), membership resized to
+        ``m`` live tenants. Kept across waves so its engine's per-capacity
+        program cache is warm — resubmit storms after a failover retrace
+        at most once per capacity bucket."""
+        sub = self._subwave
+        if sub is None:
+            template: Any = (
+                deepcopy(self.cohort._template["metric"])
+                if self.cohort._single
+                else {n: deepcopy(t) for n, t in self.cohort._template.items()}
+            )
+            sub = MetricCohort(
+                template, tenants=m, track_health=self.cohort._track_health
+            )
+            self._subwave = sub
+            return sub
+        have = len(sub)
+        if have < m:
+            sub.add_tenants(m - have)
+        elif have > m:
+            for slot in list(sub.tenant_ids())[m:]:
+                sub.remove_tenant(slot)
+        return sub
+
     # ------------------------------------------------------------------
     # the replay-guarded stream
     # ------------------------------------------------------------------
@@ -281,8 +358,14 @@ class FleetShard:
         resubmitted-from-scratch stream after a migration fold each step
         exactly once. When every key is admitted and the wave covers the
         whole shard, the fold is the cohort's single vmapped dispatch;
-        partial waves fold eagerly per tenant (bit-identical by the
-        cohort's parity contract)."""
+        partial waves gather the admitted tenants' stacked rows into a
+        scratch cohort, run the SAME vmapped program over the sub-batch,
+        and scatter the folded rows back — per-lane the vmapped fold is
+        bit-stable across batch sizes, so a partial wave is bit-identical
+        to the full-shard dispatch (an eager per-tenant fold is NOT, and
+        would break failover convergence). Leased shards fence first: a
+        stale-epoch owner cannot acknowledge a wave."""
+        self._check_fence("wave_ack")
         step_index = int(step_index)
         keys = [int(k) for k in keys]
         for k in keys:
@@ -305,11 +388,19 @@ class FleetShard:
             order = [slot_pos[slot] for slot in live]
             value = self.cohort.forward(*[jnp.asarray(a)[jnp.asarray(order)] for a in arrays])
         else:
-            for i in admitted:
-                slot = self._tenants[keys[i]]
-                col = self.cohort.tenant_collection(slot)
-                col.update(*[np.asarray(a)[i] for a in arrays])
-                self.cohort._adopt_state(slot, self.cohort._extract_states(col))
+            sub = self._subwave_cohort(len(admitted))
+            src = jnp.asarray(np.asarray([self._tenants[keys[i]] for i in admitted]))
+            dst = jnp.asarray(np.asarray(sub.tenant_ids()))
+            for name, d in self.cohort._states.items():
+                sd = sub._states[name]
+                for sname, v in d.items():
+                    sd[sname] = sd[sname].at[dst].set(v[src])
+            take = jnp.asarray(np.asarray(admitted))
+            sub.forward(*[jnp.asarray(a)[take] for a in arrays])
+            for name, d in sub._states.items():
+                cd = self.cohort._states[name]
+                for sname, v in d.items():
+                    cd[sname] = cd[sname].at[src].set(v[dst])
         for i in admitted:
             self._cursors[keys[i]] = step_index
         self.stats["waves"] += 1
@@ -335,10 +426,18 @@ class FleetShard:
 
     def checkpoint(self, note: Optional[str] = None) -> Dict[str, Any]:
         """Commit the shard (stacked state + slot mask + tenant/cursor
-        tables) as one journal generation; returns the manifest record."""
+        tables) as one journal generation; returns the manifest record.
+        Leased shards fence first — a stale-epoch owner cannot commit —
+        and stamp their epoch into the manifest record."""
+        self._check_fence("commit")
         env = envelope_from_pairs(self._named_states(), metric_type="FleetShard")
         cursor = max(self._cursors.values(), default=-1)
-        return self.journal.commit(env, cursor=cursor, note=note)
+        return self.journal.commit(
+            env,
+            cursor=cursor,
+            note=note,
+            epoch=self.epoch if self.lease is not None else None,
+        )
 
     def restore(self) -> bool:
         """Rebuild the shard from its newest loadable generation; False
@@ -386,8 +485,11 @@ class FleetShard:
 
     def record_migration(self, txn: str, status: str, **fields: Any) -> Dict[str, Any]:
         """Append one durable protocol record (atomic rewrite of the
-        per-shard log; latest status per txn wins on replay)."""
+        per-shard log; latest status per txn wins on replay). Leased
+        shards stamp their ownership epoch into every record."""
         records = self.migration_records()
+        if self.lease is not None and "epoch" not in fields:
+            fields["epoch"] = self.epoch
         rec = {"txn": str(txn), "status": str(status), **fields}
         records.append(rec)
         atomic_write_json(self.migration_log_path, {"records": records})
@@ -431,6 +533,7 @@ class MigrationCoordinator:
         self.placement = placement
         self.shards: Dict[str, FleetShard] = {s.name: s for s in shards}
         self.backend = backend
+        self.replicator: Optional[Any] = None  # set by ShardReplicator
         self._seq = 0
         self._in_flight: Dict[str, int] = {}
         self._last_phase: Optional[str] = None
@@ -480,6 +583,10 @@ class MigrationCoordinator:
             return None
         src = self.shards[src_name]
         dst = self.shards[str(dst_name)]
+        # fence BEFORE any durable effect: a stale-epoch owner must not
+        # even stage a prepare record (one typed refusal, one dump — from
+        # _check_fence — not a second migration-interrupted dump)
+        src._check_fence("migrate")
         txn = f"mig-{self._seq:06d}-t{key}"
         self._seq += 1
         self._last_phase = None
@@ -570,6 +677,17 @@ class MigrationCoordinator:
         self.stats["migrations"] += 1
         if _obs.enabled():
             _obs.get().count("fleet.migrations_done")
+        if self.replicator is not None:
+            # the tenant's replica under its OLD primary is now a stale
+            # artifact (the new owner replicates under its own name) —
+            # drop it so a later failover of the old primary cannot even
+            # consider it. Best-effort: promotion double-checks ownership.
+            follower = self.replicator.follower_of(key, src.name)
+            if follower is not None and follower in self.shards:
+                try:
+                    self.replicator._store(follower, src.name).discard(key)
+                except Exception:  # noqa: BLE001 — GC must not fail a handoff
+                    pass
         try:
             os.remove(src.mig_path(txn))
         except OSError:

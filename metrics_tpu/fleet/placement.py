@@ -90,6 +90,29 @@ class FleetPlacement:
             raise RuntimeError("placement has no shards")
         return max(self._shards, key=lambda s: _weight(s, key))
 
+    def assign_ranked(self, key: int, n: Optional[int] = None) -> Tuple[str, ...]:
+        """Every shard in descending rendezvous-weight order for ``key``
+        (truncated to the first ``n``). Rank 1 is :meth:`assign`; rank 2
+        is the key's natural **follower** — the shard replication streams
+        its deltas to, and the shard that already holds a near-minimal
+        share of promoted keys when rank 1 dies (HRW's minimal-churn
+        property applies rank by rank)."""
+        if not self._shards:
+            raise RuntimeError("placement has no shards")
+        order = sorted(self._shards, key=lambda s: _weight(s, key), reverse=True)
+        return tuple(order if n is None else order[: int(n)])
+
+    def follower(self, key: int, primary: Optional[str] = None) -> Optional[str]:
+        """The rank-2 rendezvous choice for ``key`` — the first shard in
+        the weight order that is not ``primary`` (default: the rank-1
+        assignment). None when the placement has fewer than two shards
+        (a fleet with no one to replicate to)."""
+        primary = str(primary) if primary is not None else self.assign(key)
+        for name in self.assign_ranked(key):
+            if name != primary:
+                return name
+        return None
+
     def locate(self, key: int) -> str:
         """Where ``key`` lives right now: the migration override when a
         move pinned one, else :meth:`assign`. Streams route off THIS."""

@@ -190,6 +190,10 @@ def _render_fleet() -> List[str]:
             )
             in_flight = coord.in_flight_by_shard()
             migrations = coord.migrations_by_shard()
+            replicator = getattr(coord, "replicator", None)
+            lag_by_shard = (
+                replicator.lag_by_shard() if replicator is not None else {}
+            )
             for name in sorted(coord.shards):
                 slabel = f'{flabel},shard="{_escape_label(name)}"'
                 fam.sample(
@@ -201,6 +205,31 @@ def _render_fleet() -> List[str]:
                     "metrics_tpu_fleet_tenants_in_flight",
                     slabel,
                     in_flight.get(name, 0),
+                )
+                # ownership epoch: -1 = unleased (fencing not armed)
+                fam.sample(
+                    "metrics_tpu_fleet_shard_epoch",
+                    slabel,
+                    getattr(coord.shards[name], "epoch", -1),
+                )
+                if replicator is not None:
+                    # NOT metrics_tpu_fleet_replication_lag: that family
+                    # name belongs to the registry gauge
+                    # fleet.replication.lag (whole-fleet); this one is
+                    # per shard, reconstructed at scrape time
+                    fam.sample(
+                        "metrics_tpu_fleet_shard_replication_lag",
+                        slabel,
+                        lag_by_shard.get(name, 0),
+                    )
+            if replicator is not None:
+                # monotonic by construction (like migrations_total) but
+                # reconstructed state, not the fleet.failovers counter —
+                # whose registry family already owns the _total name
+                fam.sample(
+                    "metrics_tpu_fleet_failovers",
+                    flabel,
+                    replicator.stats.get("failovers", 0),
                 )
         except Exception as err:  # noqa: BLE001 — a scrape must answer
             fam.degrade(f"fleet {fid}", err)

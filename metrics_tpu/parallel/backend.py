@@ -16,7 +16,7 @@ distinct sync regimes, both covered here and in :mod:`metrics_tpu.parallel.colle
 backends may interpret (e.g. a mesh axis name or a subset of processes).
 """
 from abc import ABC, abstractmethod
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Tuple
 
 import jax
 
@@ -51,6 +51,28 @@ class SyncBackend(ABC):
         migrated tenant's state must arrive bit-identical, checksummed,
         or not at all."""
         return self.gather(x, group=group)[source]
+
+    def stream_acked(
+        self, x: jax.Array, source: int = 0, group: Optional[Any] = None
+    ) -> Tuple[jax.Array, int]:
+        """:meth:`stream` plus a delivery-acknowledgement count — the
+        replication layer's primitive. Built on gather's rendezvous
+        semantics: a rank only returns once the collective completed, so
+        returning at all means every participating rank holds the
+        payload, and the ack count is the completed group's world size.
+        A replicator treating ``acks < world_size`` (a degraded
+        hierarchical exchange) as retryable gets at-least-once delivery
+        without a second protocol."""
+        return self.stream(x, source=source, group=group), self.world_size
+
+    def heartbeat(self) -> Tuple[int, ...]:
+        """The ranks currently reachable over this transport — the lease
+        authority's liveness probe (see
+        :meth:`metrics_tpu.fleet.LeaseAuthority.heartbeat`). A flat
+        backend has no partial-membership signal, so the default reports
+        the full world; hierarchical backends override this with the
+        last quorum's observed membership."""
+        return tuple(range(self.world_size))
 
 
 class SingleProcessBackend(SyncBackend):

@@ -413,6 +413,19 @@ class HierarchicalSyncBackend(SyncBackend):
             self, self.gather_level0(x, group=group), self.gather_level1, group
         )
 
+    def heartbeat(self) -> Tuple[int, ...]:
+        """Rank liveness from the last quorum this process observed: a
+        hierarchical exchange that degraded (dropped pods, lost ranks)
+        leaves its :class:`QuorumSnapshot` behind, and THAT membership —
+        not the static topology — is what a lease authority should renew
+        against. Before any exchange has run there is no evidence of
+        trouble, so the full world reports present (the base-class
+        default)."""
+        q = last_quorum()
+        if q is not None:
+            return tuple(q.ranks_present)
+        return tuple(range(self.world_size))
+
 
 # ---------------------------------------------------------------------------
 # the two-level reduction engine

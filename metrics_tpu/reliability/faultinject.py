@@ -30,6 +30,9 @@ from metrics_tpu.parallel.backend import (
 __all__ = [
     "FaultInjected",
     "Preempted",
+    "TransportPartitioned",
+    "expire_lease",
+    "partition_transport",
     "poison",
     "nonfinite_updates",
     "flaky_sync_backend",
@@ -57,6 +60,17 @@ class Preempted(FaultInjected):
     """Raised by :func:`preempt_at_step`: the process "died" here. A test
     catches it, abandons the session object, and drives recovery purely
     from what reached disk — the same evidence a real SIGKILL leaves."""
+
+
+class TransportPartitioned(FaultInjected):
+    """Raised by :func:`partition_transport` (and
+    ``kill_at_migration_phase(mode="partition")``): the network between
+    this process and its peers is unreachable, but the process itself
+    SURVIVES — in-memory state intact, durable state intact, and every
+    transport call failing until the partition heals. The recovery
+    semantics a test must prove are therefore different from
+    :class:`Preempted`: no rebuild-from-disk, just a coordinator whose
+    live objects retry/recover once the transport returns."""
 
 
 # ----------------------------------------------------------------------
@@ -432,9 +446,75 @@ def corrupt_envelope(envelope: Dict[str, Any], mode: str = "payload") -> Dict[st
 # ----------------------------------------------------------------------
 # 5. durable-session faults (preemption, torn files, cursor skew)
 # ----------------------------------------------------------------------
+class _PartitionedBackend(SyncBackend):
+    """A transport with the cable cut: every collective raises
+    :class:`TransportPartitioned` until :meth:`heal`, after which calls
+    pass through to the wrapped backend unchanged."""
+
+    def __init__(self, inner: Optional[SyncBackend]):
+        self.inner = inner
+        self.healed = False
+        self.calls = 0
+
+    @property
+    def world_size(self) -> int:
+        return self.inner.world_size if self.inner is not None else 1
+
+    @property
+    def rank(self) -> int:
+        return self.inner.rank if self.inner is not None else 0
+
+    def heal(self) -> None:
+        self.healed = True
+
+    def _check(self, what: str) -> None:
+        if not self.healed:
+            self.calls += 1
+            raise TransportPartitioned(
+                f"injected network partition: {what} unreachable"
+            )
+
+    def gather(self, x: Any, group: Optional[Any] = None) -> List[Any]:
+        self._check("gather")
+        return self.inner.gather(x, group=group)
+
+    def heartbeat(self):
+        self._check("heartbeat")
+        return self.inner.heartbeat()
+
+
+@contextmanager
+def partition_transport(owner: Any, attr: str = "backend") -> Iterator[Dict[str, Any]]:
+    """Cut the network under ``owner.<attr>`` (a coordinator's or
+    replicator's :class:`SyncBackend`): every collective on it raises
+    :class:`TransportPartitioned` until ``info["heal"]()`` runs — the
+    partition healing WITHOUT the context exiting, so a test can drive
+    the blocked → healed → recovered sequence inside one block. Exit
+    restores the original backend object exactly. ``info`` reports
+    ``calls`` (transport attempts refused) and ``heal``."""
+    inner = getattr(owner, attr)
+    wrapper = _PartitionedBackend(inner)
+    setattr(owner, attr, wrapper)
+    info: Dict[str, Any] = {"heal": wrapper.heal, "wrapper": wrapper, "calls": 0}
+    try:
+        yield info
+    finally:
+        info["calls"] = wrapper.calls
+        setattr(owner, attr, inner)
+
+
+def expire_lease(authority: Any, shard: str) -> None:
+    """Force ``shard``'s lease past its TTL on ``authority`` — the
+    lease-loss drill: the next ``FleetRebalancer.check_failover()`` must
+    treat the shard as dead and promote its followers, and any write the
+    old owner attempts before re-acquiring must be refused typed
+    (``LeaseExpiredError`` → one ``fleet_fenced_write`` dump)."""
+    authority.expire(str(shard))
+
+
 @contextmanager
 def kill_at_migration_phase(
-    coordinator: Any, phase: str, after: int = 0
+    coordinator: Any, phase: str, after: int = 0, mode: str = "kill"
 ) -> Iterator[Dict[str, int]]:
     """SIGKILL-simulate a process death at the START of one tenant-
     migration protocol phase (``"prepare"``, ``"in_flight"``,
@@ -449,20 +529,44 @@ def kill_at_migration_phase(
     (``FleetShard.restore``) and calling
     ``MigrationCoordinator.recover()``, which must land every tenant on
     exactly one side. ``info`` reports ``seen`` (phase entries observed)
-    and ``kills``."""
+    and ``kills``.
+
+    ``mode="partition"`` injects a network partition instead of a death:
+    entering ``phase`` raises :class:`TransportPartitioned`, and the
+    coordinator's sync backend (when it has one) keeps refusing every
+    collective until ``info["heal"]()`` runs or the context exits. The
+    coordinator OBJECT survives with its in-memory state intact — the
+    recovery a test must prove is ``recover()`` on the LIVE objects after
+    the heal, not a rebuild from disk."""
     from metrics_tpu.fleet.migration import MigrationCoordinator
 
     if phase not in MigrationCoordinator.PHASES:
         raise ValueError(
             f"phase must be one of {MigrationCoordinator.PHASES}, got {phase!r}"
         )
-    info = {"seen": 0, "kills": 0}
+    if mode not in ("kill", "partition"):
+        raise ValueError(f"mode must be 'kill' or 'partition', got {mode!r}")
+    inner_backend = coordinator.backend
+    info: Dict[str, Any] = {"seen": 0, "kills": 0}
+
+    def heal() -> None:
+        if isinstance(coordinator.backend, _PartitionedBackend):
+            coordinator.backend.heal()
+        coordinator.backend = inner_backend
+
+    info["heal"] = heal
 
     def dying(ph: str, txn: str) -> None:
-        if ph == phase:
+        if ph == phase and coordinator.backend is inner_backend:
             info["seen"] += 1
             if info["seen"] > int(after):
                 info["kills"] += 1
+                if mode == "partition":
+                    if inner_backend is not None:
+                        coordinator.backend = _PartitionedBackend(inner_backend)
+                    raise TransportPartitioned(
+                        f"injected partition at migration phase {ph!r} (txn {txn})"
+                    )
                 raise Preempted(
                     f"injected kill at migration phase {ph!r} (txn {txn})"
                 )
@@ -472,6 +576,7 @@ def kill_at_migration_phase(
         yield info
     finally:
         del coordinator._phase  # uncover the class-level no-op hook
+        coordinator.backend = inner_backend
 
 
 @contextmanager

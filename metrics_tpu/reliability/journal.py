@@ -203,12 +203,19 @@ class CheckpointJournal:
     # commit + GC
     # ------------------------------------------------------------------
     def commit(
-        self, envelope: Dict[str, Any], cursor: int, note: Optional[str] = None
+        self,
+        envelope: Dict[str, Any],
+        cursor: int,
+        note: Optional[str] = None,
+        epoch: Optional[int] = None,
     ) -> Dict[str, Any]:
         """Durably persist ``envelope`` as the next generation and return
         its manifest record. Write order is the crash-safety argument:
         envelope (atomic) → manifest (atomic) → GC; dying between any two
-        steps leaves a valid journal."""
+        steps leaves a valid journal. ``epoch`` is the writer's ownership
+        epoch (leased fleets — see :mod:`metrics_tpu.fleet.lease`):
+        recorded in the manifest so a forensic read of a fenced shard's
+        journal shows which grant wrote each generation."""
         records = self.records()
         generation = (int(records[-1]["generation"]) + 1) if records else 1
         with _trace.span(
@@ -223,6 +230,8 @@ class CheckpointJournal:
         }
         if note:
             record["note"] = note
+        if epoch is not None:
+            record["epoch"] = int(epoch)
         records.append(record)
         keep = records[-self.keep_last:]
         with _trace.span("journal.rotate", phase="checkpoint", generation=generation):

@@ -81,6 +81,14 @@ class IngestQueue:
             dispatches (forwarded to :meth:`MetricCohort.health`).
         block_timeout_s: ``block`` policy wait bound before
             :class:`IngestOverflowError`.
+        redelivery_window: waves retained AFTER dispatch for at-least-once
+            redelivery (0 disables). This is the fleet-failover seam: a
+            promoted replica holds tenant state only up to the last
+            replicated watermark; :meth:`redeliver` replays the retained
+            waves and the shard's replay guard drops whatever the replica
+            already covered, so the promoted shard converges without the
+            stream's source rewinding. :meth:`ack_watermark` releases
+            waves once replication has made them durable at the follower.
 
     Usage::
 
@@ -99,6 +107,7 @@ class IngestQueue:
         coalesce_max: int = 4,
         stale_after: int = 16,
         block_timeout_s: float = 30.0,
+        redelivery_window: int = 0,
     ):
         if policy not in _POLICIES:
             raise ValueError(f"policy must be one of {_POLICIES}, got {policy!r}")
@@ -141,12 +150,19 @@ class IngestQueue:
         self._buffered_rows = 0
         self._n_arrays: Optional[int] = None
         self._unhealthy: set = set()
+        self.redelivery_window = max(0, int(redelivery_window))
+        # (wave_seq, flat_tenant_ids, flat_arrays) per retained wave,
+        # oldest first; mutated only under the wave lock (retention rides
+        # the dispatch) or the buffer lock (ack/redeliver bookkeeping)
+        self._retained: deque = deque()
+        self._wave_seq = 0
         self.stats: Dict[str, int] = {
             "admitted_rows": 0,
             "shed_rows": 0,
             "shed_healthy_rows": 0,
             "drained_rows": 0,
             "dispatches": 0,
+            "redelivered_rows": 0,
         }
 
     # ------------------------------------------------------------------
@@ -405,10 +421,10 @@ class IngestQueue:
         the downstream dispatch, and — through an async pipeline — the
         eventual write-back all link back to their ingest chunks."""
         pos = {tid: i for i, tid in enumerate(live)}
-        pieces: List[Tuple[int, int, List[np.ndarray], Any]] = []
+        pieces: List[Tuple[int, int, List[np.ndarray], Any, int]] = []
         for tid in live:
             for seq, chunk, flow in per_tenant[tid]:
-                pieces.append((seq, pos[tid], chunk, flow))
+                pieces.append((seq, pos[tid], chunk, flow, tid))
         pieces.sort(key=lambda p: p[0])
         flows = tuple(sorted({p[3] for p in pieces if p[3] is not None}))
         # flow_scope(None) pins nothing; the span helper is a null
@@ -421,10 +437,10 @@ class IngestQueue:
 
     def _route_and_dispatch(self, pieces, live) -> None:
         flat_ids = np.concatenate(
-            [np.full(c[0].shape[0], p, dtype=np.int32) for _, p, c, _ in pieces]
+            [np.full(c[0].shape[0], p, dtype=np.int32) for _, p, c, *_ in pieces]
         )
         flat_arrays = [
-            np.concatenate([c[i] for _, _, c, _ in pieces], axis=0)
+            np.concatenate([c[i] for _, _, c, *_ in pieces], axis=0)
             for i in range(self._n_arrays)
         ]
         routed = route_rows(
@@ -437,6 +453,20 @@ class IngestQueue:
         if _obs.enabled():
             _obs.get().count("serving.ingest.dispatches")
             _obs.get().gauge("serving.ingest.buffered_rows", self._buffered_rows)
+        self._wave_seq += 1
+        if self.redelivery_window:
+            # retain the wave's flat rows under their ORIGINAL tenant ids
+            # (positions are wave-local; redelivery re-routes from scratch)
+            flat_tids = np.concatenate(
+                [np.full(c[0].shape[0], t, dtype=np.int64) for _, _, c, _, t in pieces]
+            )
+            self._retained.append((self._wave_seq, flat_tids, flat_arrays))
+            while len(self._retained) > self.redelivery_window:
+                self._retained.popleft()
+            if _obs.enabled():
+                _obs.get().gauge(
+                    "serving.ingest.redelivery_depth", len(self._retained)
+                )
         self._target(*routed)
 
     def flush(self) -> int:
@@ -472,6 +502,53 @@ class IngestQueue:
             _obs.get().count("serving.ingest.drained_rows", rows)
             _obs.get().gauge("serving.ingest.buffered_rows", self.buffered_rows)
         return out
+
+    # ------------------------------------------------------------------
+    # redelivery (failover convergence seam)
+    # ------------------------------------------------------------------
+    @property
+    def last_wave_seq(self) -> int:
+        """Monotonic sequence number of the most recently dispatched wave
+        (0 before any dispatch) — what replication records as its
+        watermark and later hands to :meth:`ack_watermark`."""
+        with self._wave_lock:
+            return self._wave_seq
+
+    def ack_watermark(self, seq: int) -> int:
+        """Release retained waves with sequence ``<= seq`` — replication
+        confirmed everything up to that wave durable at the follower, so
+        redelivery can never need it again. Returns waves still retained."""
+        with self._wave_lock:
+            while self._retained and self._retained[0][0] <= int(seq):
+                self._retained.popleft()
+            depth = len(self._retained)
+        if _obs.enabled():
+            _obs.get().gauge("serving.ingest.redelivery_depth", depth)
+        return depth
+
+    def redeliver(self, submit: Optional[Any] = None, after_seq: int = 0) -> int:
+        """Re-submit every retained wave with sequence ``> after_seq``, in
+        dispatch order, through ``submit(tenant_ids, *arrays)`` (default:
+        this queue's own :meth:`submit` — the post-failover pattern passes
+        the promoted fleet's ingest path instead). The receiving shard's
+        replay guard deduplicates anything the replica already covered;
+        redelivery is at-least-once by construction, exactly-once by the
+        guard. Returns rows redelivered."""
+        with self._wave_lock:
+            waves = [
+                (s, tids, arrs)
+                for s, tids, arrs in self._retained
+                if s > int(after_seq)
+            ]
+        sink = submit if submit is not None else self.submit
+        rows = 0
+        for _, tids, arrs in waves:
+            sink(tids, *arrs)
+            rows += int(tids.shape[0])
+        self.stats["redelivered_rows"] += rows
+        if rows and _obs.enabled():
+            _obs.get().count("serving.ingest.redelivered_rows", rows)
+        return rows
 
     @property
     def buffered_rows(self) -> int:

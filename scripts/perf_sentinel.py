@@ -95,6 +95,12 @@ DEFAULT_LEG_THRESHOLDS: Dict[str, float] = {
     # the noisiest thing the bench measures, so the ratio is generous;
     # the leg exists to make cold-start visible per round, not to gate
     "serving_cold_first_dispatch_ms": 2.5,
+    # shard-failure resilience timings (ISSUE 19): envelope shipping and
+    # promotion are journal/file-system-bound, so the ratios are generous
+    # advisory context; the DETERMINISTIC gate for failover is the
+    # failover_rows_redelivered_10k bound leg below
+    "fleet_replication_delta_ms": 2.5,
+    "fleet_failover_to_first_wave_ms": 2.5,
 }
 
 # absolute bound legs: non-millisecond metrics where the gate is a fixed
@@ -135,6 +141,14 @@ BOUND_LEGS: Dict[str, Tuple[str, float]] = {
     # headroom). A higher ratio means membership changes reshuffle the
     # fleet — the property that makes live rebalancing affordable is gone
     "fleet_churn_ratio_10k": ("max", 0.45),
+    # shard-failure redelivery exactness (ISSUE 19): after a 10k-tenant
+    # failover, the ingest window's redelivered rows must equal the rows
+    # the dead shard folded past the replication watermark EXACTLY —
+    # the leg is the deviation |redelivered / expected - 1|, 0.0 by the
+    # exactly-once contract (retention is per-wave; the replay guard
+    # admits each step once). Any nonzero value is rows lost (< 1) or
+    # double-counted (> 1) across a failover — a soundness regression
+    "failover_rows_redelivered_10k": ("max", 0.0),
 }
 
 
