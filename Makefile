@@ -58,15 +58,18 @@ lint:
 	# two-generation double-buffer safety, overflow/absorption horizons +
 	# measured cancellation error budgets + scale-equivariance vs
 	# NUMERICS_BASELINE.json), pass 2 lints the source tree for repo
-	# invariants incl. thread-shared-state (MTL106) and stale
-	# suppressions; writes ANALYSIS.json atomically WITH the per-family
-	# program fingerprints the CI drift sentinel diffs against, and
-	# refreshes both committed baselines (seam: intended crossing DROPS;
-	# numerics: horizons up / budgets down only — both refuse a red
-	# audit, so a regression must be fixed or hand-edited in review).
+	# invariants incl. thread-shared-state (MTL106), stale suppressions
+	# and non-atomic durability (MTL107), and pass 6 model-checks the
+	# fleet protocol itself (crash-consistency + epoch fencing vs
+	# PROTOCOL_BASELINE.json, counterexample schedules on red); writes
+	# ANALYSIS.json atomically WITH the per-family program fingerprints
+	# the CI drift sentinel diffs against, and refreshes the committed
+	# baselines (seam: intended crossing DROPS; numerics: horizons up /
+	# budgets down only; protocol: coverage floors up only — all refuse a
+	# red audit, so a regression must be fixed or hand-edited in review).
 	# Also pinned in tier-1 via tests/analysis/test_lint_clean.py.
 	# Rule catalog: docs/static_analysis.md
-	python scripts/lint_metrics.py --strict --fingerprints --refresh-seam-baseline --refresh-numerics-baseline
+	python scripts/lint_metrics.py --strict --fingerprints --refresh-seam-baseline --refresh-numerics-baseline --refresh-protocol-baseline
 
 san:
 	# MetricSan-armed test pass: the runtime sanitizer behind the static
@@ -240,7 +243,7 @@ dryrun:
 
 clean:
 	rm -rf .pytest_cache .jax_cache flight-dumps bench-traces san-flight-dumps
-	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json bench_cohort.txt bench_cohort.json ANALYSIS_current.json numerics_evidence.json
+	rm -f bench_current.txt bench_current.json bench_sync.txt bench_sync.json bench_cohort.txt bench_cohort.json ANALYSIS_current.json numerics_evidence.json protocol_evidence.json
 	rm -f bench_serving.txt bench_serving.json SENTINEL_serving.json metrics_scrape_serving.txt cost_ledger.json
 	rm -f bench_fleet.txt bench_fleet.json SENTINEL_fleet.json
 	rm -f bench_failover.txt bench_failover.json SENTINEL_failover.json

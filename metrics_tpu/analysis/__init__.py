@@ -1,7 +1,7 @@
 """Static analysis for metric programs: catch the bad program before it
 dispatches, not after it corrupts an epoch.
 
-Five passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
+Six passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
 
 * **Pass 1 — program audit** (:mod:`metrics_tpu.analysis.program`):
   abstractly traces each metric's ``update`` and, for engine-eligible
@@ -45,6 +45,20 @@ Five passes, one rule namespace (see :mod:`metrics_tpu.analysis.rules`):
   (MTA012) — all gated against the committed ``NUMERICS_BASELINE.json``
   (refresh tightens only, refuses red). The runtime twin is
   ``StateGuard(overflow_margin=...)``.
+* **Pass 6 — fleet-protocol model checking**
+  (:mod:`metrics_tpu.analysis.protocol`): a deterministic explorer
+  drives the REAL migration/lease/replication/failover code over small
+  on-disk fleets, enumerating every phase-boundary kill, double kill,
+  partition, and recovery permutation with memoized durable-state-hash
+  pruning — exactly-one-owner / no-lost-tenant / cursors-monotone /
+  no-double-count / GC-only-after-durable on every path (MTA013), a
+  stale-epoch owner's writes interleaved against failover promotion
+  with manifest-epoch monotonicity as the linearizability witness
+  (MTA014), and the MTL107 durability lint leg (non-atomic writes,
+  rename-without-fsync) contributed to pass 2 — all gated against the
+  committed tighten-only ``PROTOCOL_BASELINE.json``. A violation's
+  finding carries the minimal failing schedule as a replayable
+  counterexample.
 
 The runtime counterpart is **MetricSan**
 (:mod:`metrics_tpu.analysis.sanitizer`): ``METRICS_TPU_SAN=1`` or
@@ -93,6 +107,15 @@ from metrics_tpu.analysis.numerics import (  # noqa: F401
     state_horizons,
 )
 from metrics_tpu.analysis.lint import lint_file, lint_paths  # noqa: F401
+from metrics_tpu.analysis.protocol import (  # noqa: F401
+    check_protocol,
+    counterexample_report,
+    durability_findings,
+    explore_crash_consistency,
+    explore_fencing,
+    load_protocol_baseline,
+    tighten_protocol_baseline,
+)
 from metrics_tpu.analysis.sanitizer import (  # noqa: F401
     MetricSan,
     MetricSanError,
@@ -116,10 +139,15 @@ __all__ = [
     "check_host_seam",
     "check_lifecycle",
     "check_numerics",
+    "check_protocol",
     "check_replica_equivalence",
+    "counterexample_report",
     "disable_san",
+    "durability_findings",
     "enable_san",
     "equivariance_verdict",
+    "explore_crash_consistency",
+    "explore_fencing",
     "eval_jaxpr_intervals",
     "fingerprint_jaxpr",
     "hint_for_watch_key",
@@ -129,10 +157,12 @@ __all__ = [
     "lint_file",
     "lint_paths",
     "load_numerics_baseline",
+    "load_protocol_baseline",
     "load_seam_baseline",
     "measure_error_budget",
     "register_threadsan_target",
     "san_scope",
     "state_horizons",
     "thread_shared_model",
+    "tighten_protocol_baseline",
 ]

@@ -12,12 +12,15 @@ else".
 Never export these from the package root; they exist for the analyzer's
 test bed and for documentation of what each rule means in code.
 """
+import json
+import os
 import threading
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from metrics_tpu.fleet import FleetShard, MigrationCoordinator
 from metrics_tpu.metric import Metric
 
 __all__ = [
@@ -28,11 +31,13 @@ __all__ = [
     "DonatedAlias",
     "DoubleBufferAliaser",
     "EpsilonThresholdAUROC",
+    "GcBeforeDurableCoordinator",
     "HostReadOfDonated",
     "HostSyncUpdate",
     "Int32RowCounter",
     "MeanWithoutCount",
     "NarrowAccumulator",
+    "NonAtomicManifestWriter",
     "NonCommutativeMerge",
     "NonIdentityReset",
     "OrphanResidual",
@@ -40,6 +45,7 @@ __all__ = [
     "SeamRegressor",
     "StaleSuppression",
     "SuppressedNarrowAccumulator",
+    "UnfencedCheckpointShard",
     "UnlockedSharedCounter",
     "UnownedLoader",
     "UnscaledInt8Psum",
@@ -557,3 +563,59 @@ class BlockScaledQuantizedSync(Metric):
 
     def compute(self) -> jax.Array:
         return jnp.sum(self.hist)
+
+
+class GcBeforeDurableCoordinator(MigrationCoordinator):
+    """MTA013: a migration coordinator that skips the phase-3 target
+    commit — the source still GCs the tenant in ``pre_gc``, so the ONLY
+    durable copy of the tenant's state is deleted before the target has
+    written one. Every live object looks healthy (the in-memory handoff
+    completed); the first reopen-from-disk loses the tenant. Exactly the
+    bug class the crash-consistency explorer's base-case schedule
+    (``migrate runs to completion`` → reopen → invariants) exists to
+    catch — no kill required, the protocol itself is unsound."""
+
+    def _commit_target(self, dst, txn):
+        # the elided durability step: pre_gc's newest-generation guard
+        # still passes off the SEED-era checkpoint, so nothing trips at
+        # migration time — only the explorer's reopen sees the loss
+        pass
+
+
+class UnfencedCheckpointShard(FleetShard):
+    """MTA014: a shard whose write path skips the epoch fence. After
+    failover bumps the authority's epoch, this stale owner's checkpoint /
+    wave / replication / migration writes sail through where a fenced
+    shard dies with :class:`~metrics_tpu.fleet.lease.StaleEpochError` —
+    the fencing explorer observes the un-refused write (and, for the
+    durable paths, changed bytes under a fenced epoch) at every
+    interleaving point against promotion."""
+
+    def _check_fence(self, what: str) -> None:
+        # the missing fence: a real shard routes every write through
+        # authority.check(lease) and re-raises typed
+        pass
+
+
+class NonAtomicManifestWriter:
+    """MTL107: a manifest writer with both non-atomic patterns — a
+    write-mode ``open()`` straight at a durable path (a kill mid-write
+    leaves torn JSON where readers expect a manifest) and an
+    ``os.rename`` with no ``os.fsync`` ordered before it (the NAME goes
+    durable while the bytes sit in the page cache). The in-tree allows
+    keep the repo gate green; ``tests/analysis`` strips them and re-lints
+    to pin that the unsuppressed source fires exactly MTL107."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+
+    def write(self, records) -> str:
+        path = os.path.join(self.directory, "MANIFEST.json")
+        # metrics-tpu: allow(MTL107) — deliberate: the broken fixture
+        with open(path, "w") as f:
+            json.dump({"records": list(records)}, f, indent=1)
+        return path
+
+    def publish(self, tmp: str, path: str) -> None:
+        # metrics-tpu: allow(MTL107) — deliberate: the broken fixture
+        os.rename(tmp, path)

@@ -440,6 +440,13 @@ def lint_source(source: str, rel_path: str) -> List[Finding]:
     from metrics_tpu.analysis.concurrency import thread_findings
 
     linter.findings.extend(thread_findings(tree, rel_path))
+    # pass-6 lint leg (MTL107): durability analysis — write-mode open()
+    # outside the atomic primitives and rename-without-fsync orderings
+    # (analysis/protocol.py), routed through the same suppression
+    # machinery so the primitives' own internals carry audited allows
+    from metrics_tpu.analysis.protocol import durability_findings
+
+    linter.findings.extend(durability_findings(tree, rel_path))
     base_allow = parse_allow_comments(source)
     allow = {line: set(rules) for line, rules in base_allow.items()}
     # provenance: effective (line, rule) -> the comment line that grants it

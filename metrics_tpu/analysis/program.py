@@ -1063,7 +1063,7 @@ def audit_registry(
 
     report = {
         "schema": "metrics_tpu.analysis_report",
-        "version": 3,
+        "version": 4,
         "rules": {rid: r.to_dict() for rid, r in sorted(RULES.items())},
         "families": families,
         # the AST leg of the seam audit: where each host<->device crossing

@@ -314,6 +314,51 @@ MTA012 = rule(
     " oracle test at scale 1.0 and mis-scores real traffic at 1e-3.",
 )
 
+# ---------------------------------------------------------------------------
+# pass 6 — fleet-protocol model checking (exhaustive crash/interleaving
+# exploration over the REAL migration/lease/replication/failover code)
+# ---------------------------------------------------------------------------
+MTA013 = rule(
+    "MTA013",
+    "crash-consistency",
+    "protocol",
+    "An explored crash schedule of the two-phase tenant-migration protocol"
+    " — a kill, double kill, or partition injected at a phase boundary,"
+    " followed by a rebuild-from-disk in some recovery order and"
+    " `MigrationCoordinator.recover()` — leaves a tenant owned by zero or"
+    " two shards, regresses a replay cursor, double-folds a replayed wave,"
+    " or GCs the source copy before the target's generation is durable.",
+    "Chaos tests sample hand-picked kill points; the protocol explorer"
+    " enumerates EVERY phase-boundary fault × recovery permutation over"
+    " small real fleets (memoizing by durable-state hash so equivalent"
+    " crash states are explored once) and asserts the exactly-once"
+    " contract on every path: exactly-one-owner, no-lost-tenant, cursors"
+    " monotone under full-stream replay, journal-GC-only-after-durable."
+    " A violation carries the minimal failing schedule as a counterexample"
+    " — the repro script for the bug, not just its existence. Coverage is"
+    " gated against PROTOCOL_BASELINE.json (tighten-only): explored-state"
+    " regressions flag, so the state space can only grow.",
+)
+
+MTA014 = rule(
+    "MTA014",
+    "fencing-linearizability",
+    "protocol",
+    "A stale-epoch owner's write (checkpoint, wave ack, replication"
+    " shipment, or migration) interleaved against failover promotion"
+    " becomes durable, or a shard's committed manifest records a"
+    " non-monotone ownership epoch.",
+    "Epoch fencing is only as good as its worst interleaving: the old"
+    " owner may attempt its write after the fence but before promotion,"
+    " mid-promotion, or after the fleet has moved on — and in every case"
+    " the write must die typed (StaleEpochError/LeaseExpiredError) with"
+    " nothing durable. The explorer drives the REAL lease/replication/"
+    " failover code through each interleaving point and then audits every"
+    " journal manifest for epoch monotonicity — the linearizability"
+    " witness: if epochs only ever grow in committed records, no fenced"
+    " writer ever won a race it should have lost.",
+)
+
 
 # ---------------------------------------------------------------------------
 # pass 2 — repo-invariant lint (AST)
@@ -390,6 +435,29 @@ MTL106 = rule(
     " flagged attrs), which flight-dumps one `metricsan_thread_race` per"
     " (class, attr) when a cross-thread unsynchronized write actually"
     " happens.",
+)
+
+
+MTL107 = rule(
+    "MTL107",
+    "non-atomic-durability",
+    "lint",
+    "A file write in `metrics_tpu/` that bypasses the atomic tmp+fsync+"
+    "rename primitives (`journal.atomic_write_json` / `checkpoint."
+    "atomic_file`): a write-mode `open()` outside them, or an `os.rename`/"
+    "`os.replace` with no `os.fsync` ordered before it in the same"
+    " function.",
+    "Every durability claim in the reliability layer rests on one write"
+    " discipline: write to a temp file, fsync it, rename over the target."
+    " A bare `open(path, 'w')` can tear on a kill and leave a half-written"
+    " artifact a reader then trusts; a rename without a preceding fsync"
+    " can land the NAME durably while the BYTES are still in the page"
+    " cache — the classic crash leaves a zero-length or stale file at the"
+    " final path. Both failure modes pass every test and only appear on"
+    " power cuts, so the discipline must be a lint, not a code review"
+    " habit. The primitives' own internals and deliberate torn-write"
+    " injectors carry `# metrics-tpu: allow(MTL107)` with rationales, and"
+    " MTL105 audits those suppressions for staleness like any other.",
 )
 
 

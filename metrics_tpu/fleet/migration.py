@@ -523,6 +523,11 @@ class MigrationCoordinator:
     for the protocol and its kill-point analysis)."""
 
     PHASES: Tuple[str, ...] = ("prepare", "in_flight", "pre_commit", "pre_gc")
+    # every point the _phase seam fires at: the four protocol phases plus
+    # the per-txn entry into recover() — the enumerable yield-point
+    # schedule the protocol explorer (analysis pass 6) and
+    # faultinject.kill_at_migration_phase drive
+    YIELD_POINTS: Tuple[str, ...] = PHASES + ("recover",)
 
     def __init__(
         self,
@@ -552,6 +557,13 @@ class MigrationCoordinator:
         """No-op hook invoked at the START of each protocol phase —
         ``faultinject.kill_at_migration_phase`` patches exactly this to
         prove the kill-point table in the module docstring."""
+
+    def _commit_target(self, dst: FleetShard, txn: str) -> None:
+        """Phase-3 target commit, as a named seam: the durability step the
+        pre-gc guard depends on. The protocol explorer's broken-by-design
+        fixture elides exactly this to prove MTA013 catches
+        GC-before-durable."""
+        dst.checkpoint(note=f"fleet-commit:{txn}")
 
     def _enter_phase(self, phase: str, txn: str) -> None:
         # _last_phase is set BEFORE the hook fires so the failure dump
@@ -628,7 +640,7 @@ class MigrationCoordinator:
                 state=_nest_rows(tuple(dst.cohort._template), payload),
                 cursor=cursor,
             )
-            dst.checkpoint(note=f"fleet-commit:{txn}")
+            self._commit_target(dst, txn)
             dst.record_migration(txn, "committed", tenant=key, src=src.name)
             if wire_pending:
                 dst.adopt_pending(key, wire_pending)
@@ -714,6 +726,10 @@ class MigrationCoordinator:
         for src in list(self.shards.values()):
             for rec in self._open_prepared(src):
                 txn, key = str(rec["txn"]), int(rec["tenant"])
+                # the recovery yield point: a kill HERE is the re-entrant
+                # recover() drill — nothing replayed yet for this txn, so
+                # the durable facts the next recover() reads are unchanged
+                self._enter_phase("recover", txn)
                 dst = self.shards.get(str(rec.get("dst")))
                 if dst is not None and dst.has_tenant(key):
                     # target generation was durable → finish the removal

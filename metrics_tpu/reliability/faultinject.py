@@ -517,8 +517,9 @@ def kill_at_migration_phase(
     coordinator: Any, phase: str, after: int = 0, mode: str = "kill"
 ) -> Iterator[Dict[str, int]]:
     """SIGKILL-simulate a process death at the START of one tenant-
-    migration protocol phase (``"prepare"``, ``"in_flight"``,
-    ``"pre_commit"`` or ``"pre_gc"`` — see the state-machine table in
+    migration protocol yield point (``"prepare"``, ``"in_flight"``,
+    ``"pre_commit"``, ``"pre_gc"``, or the per-txn ``"recover"`` entry —
+    see the state-machine table in
     :mod:`metrics_tpu.fleet.migration`): the coordinator raises
     :class:`Preempted` the moment a handoff enters ``phase``, after
     skipping the first ``after`` entries (so a kill can land mid-
@@ -540,9 +541,9 @@ def kill_at_migration_phase(
     the heal, not a rebuild from disk."""
     from metrics_tpu.fleet.migration import MigrationCoordinator
 
-    if phase not in MigrationCoordinator.PHASES:
+    if phase not in MigrationCoordinator.YIELD_POINTS:
         raise ValueError(
-            f"phase must be one of {MigrationCoordinator.PHASES}, got {phase!r}"
+            f"phase must be one of {MigrationCoordinator.YIELD_POINTS}, got {phase!r}"
         )
     if mode not in ("kill", "partition"):
         raise ValueError(f"mode must be 'kill' or 'partition', got {mode!r}")
@@ -626,6 +627,9 @@ def preempt_at_step(
             # at <gen>.npz.tmp, target path untouched, manifest untouched
             records = bg._journal.records()
             nxt = (int(records[-1]["generation"]) + 1) if records else 1
+            # metrics-tpu: allow(MTL107) — the torn write is the POINT:
+            # this injector manufactures the exact carcass a non-atomic
+            # writer leaves, so recovery tests can prove it is ignored
             with open(bg._journal._gen_path(nxt) + ".tmp", "wb") as f:
                 f.write(b"PK\x03\x04torn-mid-write")
             info["torn_writes"] += 1

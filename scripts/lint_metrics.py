@@ -20,8 +20,13 @@ and numerical soundness: per-state overflow/ulp-absorption horizons
 scale-equivariance probes (MTA012) — gated against the committed
 ``NUMERICS_BASELINE.json``. Pass 2
 (:func:`metrics_tpu.analysis.lint_paths`) lints the ``metrics_tpu``
-source tree for the repo invariants (MTL101-MTL106). ``--strict`` folds
-every pass into the exit code.
+source tree for the repo invariants (MTL101-MTL107). Pass 6
+(:func:`metrics_tpu.analysis.check_protocol`) model-checks the fleet
+protocol: every migration crash point × recovery order and every
+stale-epoch write × failover interleaving explored over the REAL
+coordinator/lease/replication/failover code (MTA013/MTA014), gated
+against the committed tighten-only ``PROTOCOL_BASELINE.json``.
+``--strict`` folds every pass into the exit code.
 
 ``--refresh-seam-baseline`` rewrites the committed ``SEAM_BASELINE.json``
 from the fresh audit (registry families only; fixture entries like
@@ -30,9 +35,11 @@ it when a seam change is INTENDED, e.g. after folding a sync leg
 in-program lowers a family's crossing count, so the improvement is gated
 against backsliding. ``--refresh-numerics-baseline`` does the same for
 ``NUMERICS_BASELINE.json``, IMPROVEMENTS only (horizons up, budgets
-down); both refuse to rewrite over a red or partial audit, so a
-regression must be fixed — or the baseline hand-edited in review — never
-laundered by a rerun.
+down); ``--refresh-protocol-baseline`` tightens the committed
+``PROTOCOL_BASELINE.json`` from the fresh exploration (coverage counters
+only grow; fixture entries preserved). All three refuse to rewrite over
+a red or partial run, so a regression must be fixed — or the baseline
+hand-edited in review — never laundered by a rerun.
 
 ``--fingerprints`` adds per-family jaxpr digests (ops × dtypes × shapes
 × static params of the update and compiled-step programs) to the report
@@ -157,6 +164,51 @@ def refresh_numerics_baseline(
     )
 
 
+def refresh_protocol_baseline(path: str, protocol: dict, skipped: bool) -> str:
+    """Apply (or refuse) one ``--refresh-protocol-baseline`` request and
+    return the human-readable outcome line. Same refusal ladder as the
+    seam/numerics baselines: a skipped pass has no coverage to merge, a
+    red exploration would launder a violated invariant (or a coverage
+    regression) into the committed file, and a missing file means
+    bootstrap-by-hand (the committed file carries the fixture entries).
+    A permitted refresh is TIGHTEN-ONLY: per-scenario coverage counters
+    take ``max(committed, fresh)`` via
+    :func:`metrics_tpu.analysis.tighten_protocol_baseline`."""
+    from metrics_tpu.analysis import tighten_protocol_baseline
+    from metrics_tpu.reliability.journal import atomic_write_json
+
+    if skipped:
+        return (
+            "protocol baseline NOT refreshed: --skip-protocol runs have no"
+            " exploration to merge; refresh requires the full pass"
+        )
+    findings = protocol["summary"]["findings"]
+    if findings:
+        return (
+            "protocol baseline NOT refreshed: the exploration reported"
+            f" {findings} unsuppressed finding(s); fix them (or hand-edit"
+            " PROTOCOL_BASELINE.json for an intended coverage change)"
+            " and re-run"
+        )
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            baseline = json.load(fh)
+    except (OSError, ValueError) as err:
+        return (
+            f"protocol baseline NOT refreshed: {path} is missing or"
+            f" unreadable ({err}); restore the committed file (git checkout)"
+            " before refreshing"
+        )
+    fresh = protocol["evidence"]["baseline_entries"]
+    baseline, pruned = tighten_protocol_baseline(baseline, fresh)
+    atomic_write_json(path, baseline)
+    return (
+        f"refreshed {path} ({len(fresh)} scenario entries"
+        + (f"; pruned {pruned}" if pruned else "")
+        + ")"
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--strict", action="store_true",
@@ -167,6 +219,8 @@ def main(argv=None) -> int:
                     help="pass 2 only (no metric tracing)")
     ap.add_argument("--skip-lint", action="store_true",
                     help="passes 1+3 only (no AST lint)")
+    ap.add_argument("--skip-protocol", action="store_true",
+                    help="skip pass 6 (no fleet-protocol exploration)")
     ap.add_argument("--no-quantized", action="store_true",
                     help="skip the sync_precision=int8/bf16 variant audits")
     ap.add_argument("--no-cohort", action="store_true",
@@ -195,12 +249,19 @@ def main(argv=None) -> int:
                          " only, fixture entries preserved, retired families"
                          " pruned; refuses a red or partial audit). Default"
                          " path: NUMERICS_BASELINE.json")
+    ap.add_argument("--refresh-protocol-baseline", nargs="?",
+                    const="PROTOCOL_BASELINE.json", default=None, metavar="PATH",
+                    help="tighten the committed protocol-exploration baseline"
+                         " from this run's coverage (TIGHTEN-ONLY: states/"
+                         "schedules/crash-point counters can only grow;"
+                         " fixture entries preserved; refuses a red or"
+                         " skipped pass). Default path: PROTOCOL_BASELINE.json")
     args = ap.parse_args(argv)
 
     from metrics_tpu.analysis import audit_registry, lint_paths
     from metrics_tpu.reliability.journal import atomic_write_json
 
-    report = {"schema": "metrics_tpu.analysis_report", "version": 3}
+    report = {"schema": "metrics_tpu.analysis_report", "version": 4}
     unsuppressed = 0
     fingerprints = args.fingerprints or args.diff_fingerprints is not None
 
@@ -386,6 +447,41 @@ def main(argv=None) -> int:
         )
         for f in live:
             print(f"  {f.rule} {f.subject}: {f.message}")
+
+    if not args.skip_protocol:
+        from metrics_tpu.analysis import check_protocol
+
+        protocol = check_protocol()
+        report["protocol"] = protocol
+        # schema v4: protocol evidence rides a top-level evidence dict
+        # (states explored, schedules, crash points, verdicts)
+        report.setdefault("evidence", {})["protocol"] = protocol["evidence"]
+        unsuppressed += protocol["summary"]["findings"]
+        print(
+            f"pass 6 (protocol): {protocol['summary']['states_explored']}"
+            f" durable states over {protocol['summary']['schedules']}"
+            f" schedules, {protocol['summary']['findings']} findings"
+        )
+        for f in protocol["findings"]:
+            print(f"  {f['rule']} {f['subject']}: {f['message']}")
+        if protocol["findings"]:
+            from metrics_tpu.analysis import counterexample_report
+
+            print(counterexample_report(protocol["findings"]), end="")
+        if args.refresh_protocol_baseline is not None:
+            ppath = args.refresh_protocol_baseline
+            if ppath == "PROTOCOL_BASELINE.json":
+                # the bare default names the COMMITTED baseline at the repo
+                # root regardless of CWD; an explicit path stays caller-relative
+                ppath = os.path.join(
+                    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "PROTOCOL_BASELINE.json",
+                )
+            print(refresh_protocol_baseline(ppath, protocol, skipped=False))
+    elif args.refresh_protocol_baseline is not None:
+        print(refresh_protocol_baseline(
+            args.refresh_protocol_baseline, {}, skipped=True
+        ))
 
     report["summary"] = {"unsuppressed_findings": unsuppressed}
     if args.json != "-":

@@ -132,8 +132,9 @@ def main() -> None:
     if "--write" in sys.argv:
         path = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                             f"PROFILE_{platform}.json")
-        with open(path, "w") as f:
-            json.dump(out, f, indent=1)
+        from metrics_tpu.reliability.journal import atomic_write_json
+
+        atomic_write_json(path, out)
         print(f"wrote {path}", file=sys.stderr)
 
 

@@ -63,7 +63,8 @@ def main(argv=None) -> int:
 
     import metrics_tpu as M
     import metrics_tpu.observability as obs
-    from metrics_tpu.reliability.journal import CheckpointJournal
+    from metrics_tpu.reliability.checkpoint import atomic_file
+    from metrics_tpu.reliability.journal import CheckpointJournal, atomic_write_json
     from metrics_tpu.serving import (
         AsyncServingEngine,
         BackgroundCheckpointer,
@@ -110,20 +111,19 @@ def main(argv=None) -> int:
     os.makedirs(args.trace_out, exist_ok=True)
     trace_path = os.path.join(args.trace_out, "serving_flow.perfetto.json")
     blob = obs.get_tracer().to_perfetto()
-    with open(trace_path, "w") as f:
-        json.dump(blob, f)
+    atomic_write_json(trace_path, blob)
 
     scrape = urllib.request.urlopen(exporter.url, timeout=5).read().decode()
-    with open(args.out, "w") as f:
-        f.write(scrape)
+    with atomic_file(args.out) as f:
+        f.write(scrape.encode())
     healthz = json.loads(
         urllib.request.urlopen(
             exporter.url.replace("/metrics", "/healthz"), timeout=5
         ).read()
     )
 
-    with open(args.ledger_out, "w") as f:
-        f.write(obs.get_ledger().to_json(indent=1))
+    with atomic_file(args.ledger_out) as f:
+        f.write(obs.get_ledger().to_json(indent=1).encode())
 
     pipe.close()
     obs.disable_exporter()

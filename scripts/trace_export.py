@@ -46,6 +46,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from metrics_tpu.observability.trace import spans_to_perfetto  # noqa: E402
+from metrics_tpu.reliability.journal import atomic_write_json  # noqa: E402
 
 
 def flight_to_perfetto(dump: dict) -> dict:
@@ -209,8 +210,7 @@ def main(argv=None) -> int:
         out = args.output or (
             os.path.splitext(args.inputs[0])[0] + ".merged.perfetto.json"
         )
-        with open(out, "w") as f:
-            json.dump(merged, f)
+        atomic_write_json(out, merged)
         print(
             f"wrote {out} (ranks {merged['metadata']['merged_ranks']},"
             f" anchored on step {merged['metadata']['anchor_step']})"
@@ -222,8 +222,7 @@ def main(argv=None) -> int:
         with open(path) as f:
             blob = json.load(f)
         out = args.output or (os.path.splitext(path)[0] + ".perfetto.json")
-        with open(out, "w") as f:
-            json.dump(convert(blob), f)
+        atomic_write_json(out, convert(blob))
         print(f"wrote {out}")
     return 0
 

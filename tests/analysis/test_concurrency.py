@@ -396,5 +396,5 @@ def test_evidence_rides_the_report_schema(registry_report):
     eager = registry_report["families"]["AUROC"]
     assert eager["engine_eligible"] is False
     assert set(eager["evidence"]) == {"numerics"}
-    assert registry_report["version"] == 3
+    assert registry_report["version"] == 4  # v4: pass 6 (evidence["protocol"])
     assert registry_report["host_seam_sites"]

@@ -44,27 +44,35 @@ def test_repo_lint_has_zero_unsuppressed_findings():
 def test_suppressions_are_rare_and_deliberate():
     """The suppressed bucket is an allowlist, not a loophole: it should
     stay small, and every entry must be an MTL101/MTL104 design exception
-    (host staging in the sharded streams, in-program mesh reductions) or
-    the deliberately-broken MTL106 thread-race fixture (which must stay
-    broken to keep proving the rule; ThreadSan's drill depends on it).
+    (host staging in the sharded streams, in-program mesh reductions), a
+    deliberately-broken fixture kept broken to keep proving its rule
+    (MTL106 thread race, MTL107 non-atomic manifest writer), or one of
+    the audited MTL107 primitives-and-injectors allows (atomic_file's own
+    tmp write, the at-exit telemetry fallback, the torn-write injector).
     Growing it means either a real fix was skipped or the rule needs to
     learn a new idiom."""
     findings = [f for f in lint_paths() if f.suppressed]
-    assert len(findings) <= 10, [str(f) for f in findings]
-    assert {f.rule for f in findings} <= {"MTL101", "MTL104", "MTL106"}
+    assert len(findings) <= 15, [str(f) for f in findings]
+    assert {f.rule for f in findings} <= {"MTL101", "MTL104", "MTL106", "MTL107"}
     mtl106 = [f for f in findings if f.rule == "MTL106"]
     assert all("fixtures.py" in f.subject for f in mtl106), [str(f) for f in mtl106]
+    mtl107 = [f for f in findings if f.rule == "MTL107"]
+    allowed_homes = ("fixtures.py", "checkpoint.py", "telemetry.py", "faultinject.py")
+    assert all(
+        any(home in f.subject for home in allowed_homes) for f in mtl107
+    ), [str(f) for f in mtl107]
 
 
 def test_report_schema_is_stable(registry_report):
     report = registry_report
     assert report["schema"] == "metrics_tpu.analysis_report"
-    assert report["version"] == 3  # v3: pass 5 (evidence["numerics"])
+    assert report["version"] == 4  # v4: pass 6 (evidence["protocol"])
     assert set(report["rules"]) == {
         "MTA001", "MTA002", "MTA003", "MTA004",
         "MTA005", "MTA006", "MTA007", "MTA008", "MTA009",
-        "MTA010", "MTA011", "MTA012",
+        "MTA010", "MTA011", "MTA012", "MTA013", "MTA014",
         "MTL101", "MTL102", "MTL103", "MTL104", "MTL105", "MTL106",
+        "MTL107",
     }
     for entry in report["families"].values():
         assert set(entry) == {

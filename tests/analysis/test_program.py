@@ -353,6 +353,42 @@ def test_hint_name_keying_caveat_extends_to_pass4(fixture):
     assert hint_for_watch_key(f"engine[{fixture.__name__}]") is not None
 
 
+@pytest.mark.parametrize("rule", ["MTA013", "MTA014"], ids=["MTA013", "MTA014"])
+def test_hint_name_keying_caveat_extends_to_pass6(rule):
+    """The name-keyed caveat, re-pinned for the pass-6 protocol rules: the
+    explorer registers its findings under the driven class's bare name, so
+    a watchdog key naming the coordinator/shard class hints the protocol
+    violation — and a same-named clean class re-explored afterwards clears
+    it (latest audit wins), exactly like the metric-audit rules."""
+    from metrics_tpu.analysis.protocol import (
+        explore_crash_consistency,
+        explore_fencing,
+    )
+    from metrics_tpu.fleet import FleetShard, MigrationCoordinator
+
+    if rule == "MTA013":
+        broken, base = fx.GcBeforeDurableCoordinator, MigrationCoordinator
+        explore = lambda cls: explore_crash_consistency(  # noqa: E731
+            coordinator_cls=cls, modes=("none",)
+        )
+    else:
+        broken, base = fx.UnfencedCheckpointShard, FleetShard
+        explore = lambda cls: explore_fencing(  # noqa: E731
+            shard_cls=cls, writes=("checkpoint",), points=("after_fence",)
+        )
+
+    explore(broken)
+    hint = hint_for_watch_key(broken.__name__)
+    assert hint is not None and rule in hint
+
+    clean = type(broken.__name__, (base,), {})
+    explore(clean)
+    assert hint_for_watch_key(broken.__name__) is None
+
+    explore(broken)
+    assert hint_for_watch_key(broken.__name__) is not None
+
+
 def test_hint_name_keying_caveat_latest_audit_wins():
     """The documented caveat, now pinned: the hint lookup is keyed by bare
     class name and reflects the MOST RECENT audit of any class with that

@@ -1,0 +1,351 @@
+"""Pass 6 (fleet-protocol model checker): the explorer's exhaustive
+coverage pins, the broken-by-design fixtures tripping exactly their
+rules, the tighten-only PROTOCOL_BASELINE gate, counterexample
+reporting, telemetry, and the CPU state/time perf budget.
+
+The full explorers run ONCE per test session (module-scoped fixtures —
+the coverage pins, the perf budget, and the clean-verdict pins all read
+the same run): determinism of the scrubbed durable-state fingerprint is
+itself part of the contract, so re-running them would only re-prove the
+same counts.
+"""
+import json
+import os
+import time
+
+import pytest
+
+from metrics_tpu.analysis import fixtures as fx
+from metrics_tpu.analysis.protocol import (
+    _baseline_findings,
+    build_protocol_entry,
+    check_protocol,
+    counterexample_report,
+    explore_crash_consistency,
+    explore_fencing,
+    load_protocol_baseline,
+    tighten_protocol_baseline,
+)
+from metrics_tpu.analysis.rules import RULES
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture(scope="module")
+def crash_run():
+    t0 = time.monotonic()
+    evidence, findings = explore_crash_consistency()
+    return evidence, findings, time.monotonic() - t0
+
+
+@pytest.fixture(scope="module")
+def fence_run():
+    t0 = time.monotonic()
+    evidence, findings = explore_fencing()
+    return evidence, findings, time.monotonic() - t0
+
+
+# ----------------------------------------------------------------------
+# MTA013: exhaustive crash-consistency coverage, clean in-tree
+# ----------------------------------------------------------------------
+def test_crash_explorer_exhaustive_and_clean(crash_run):
+    """The acceptance pin: all 4 migration phases × {single kill, double
+    kill, partition} × both recovery permutations — plus the no-fault
+    base case — explored with ZERO violations on the real coordinator."""
+    evidence, findings, _ = crash_run
+    assert findings == [], [str(f) for f in findings]
+    assert evidence["phases"] == ["prepare", "in_flight", "pre_commit", "pre_gc"]
+    assert set(evidence["modes"]) == {"none", "kill", "double_kill", "partition"}
+    assert evidence["recovery_orders"] == 2
+    # 1 base case + 4 phases x 3 fault modes x 2 recovery orders
+    assert evidence["schedules"] == 25
+    # every phase x mode pair actually crashed (the injector fired), and
+    # the re-entrant recover() yield point was reached by the double kill
+    for phase in evidence["phases"]:
+        for mode in ("kill", "double_kill", "partition"):
+            assert f"{phase}/{mode}" in evidence["crash_points"]
+    assert "recover/kill" in evidence["crash_points"]
+    assert set(evidence["invariants"]) == {
+        "exactly-one-owner", "no-lost-tenant", "cursor-monotone",
+        "no-double-count", "gc-only-after-durable", "recover-idempotent",
+    }
+    # memoization prunes: distinct durable states < schedules
+    assert 0 < evidence["states_explored"] < evidence["schedules"]
+    assert evidence["explored"] + evidence["pruned"] == evidence["schedules"]
+
+
+def test_fencing_explorer_exhaustive_and_clean(fence_run):
+    evidence, findings, _ = fence_run
+    assert findings == [], [str(f) for f in findings]
+    assert set(evidence["writes"]) == {
+        "checkpoint", "submit_wave", "replicate", "migrate"}
+    assert set(evidence["points"]) == {
+        "after_fence", "after_promote", "after_failover", "expired"}
+    assert evidence["schedules"] == 16
+    assert evidence["stale_writes_checked"] == 16
+
+
+def test_protocol_explorer_bounded(crash_run, fence_run):
+    """The perf guard: the in-tree protocols' full state space stays
+    under a fixed state/time budget on CPU, so tier-1 never balloons.
+    The state bound also catches a fingerprint regression (wall-clock
+    leaking back in explodes distinct-state counts run to run)."""
+    crash_ev, _, crash_s = crash_run
+    fence_ev, _, fence_s = fence_run
+    assert crash_ev["states_explored"] <= 32
+    assert fence_ev["states_explored"] <= 16
+    assert crash_s < 120.0, f"crash exploration took {crash_s:.1f}s"
+    assert fence_s < 120.0, f"fencing exploration took {fence_s:.1f}s"
+
+
+def test_explorer_is_deterministic_on_reduced_scope():
+    """Same schedule space → same durable-state census, twice. Pins the
+    wall-clock scrubbing in the fingerprint (written_at stamps, npz zip
+    mtimes) that makes the baseline counters comparable across runs."""
+    runs = [
+        explore_crash_consistency(modes=("none", "kill"), phases=("in_flight",))[0]
+        for _ in range(2)
+    ]
+    assert runs[0]["states_explored"] == runs[1]["states_explored"]
+    assert runs[0]["crash_points"] == runs[1]["crash_points"]
+
+
+# ----------------------------------------------------------------------
+# fixtures: each trips exactly its rule
+# ----------------------------------------------------------------------
+def test_gc_before_durable_fixture_trips_exactly_mta013():
+    """The GC-before-durable coordinator loses the tenant on the NO-FAULT
+    schedule: the protocol itself is unsound, no kill required — and the
+    counterexample names the minimal failing schedule."""
+    _, findings = explore_crash_consistency(
+        coordinator_cls=fx.GcBeforeDurableCoordinator, modes=("none",))
+    assert findings and {f.rule for f in findings} == {"MTA013"}
+    minimal = min(findings, key=lambda f: len(f.detail["schedule"]))
+    assert minimal.detail["invariant"] in ("no-lost-tenant", "gc-only-after-durable")
+    assert any("runs to completion" in s for s in minimal.detail["schedule"])
+
+
+def test_gc_before_durable_self_heals_under_kill():
+    """The flip side that makes the fixture surgical: a kill at the
+    pre-GC boundary is SURVIVED even by the broken coordinator — recovery
+    refuses the non-durable commit and aborts the txn home. Only
+    completion-shaped schedules (the base case, or a healed partition
+    whose live recovery finishes the handoff) reach the unsound GC."""
+    _, findings = explore_crash_consistency(
+        coordinator_cls=fx.GcBeforeDurableCoordinator,
+        modes=("kill",), phases=("pre_gc",))
+    assert findings == [], [str(f) for f in findings]
+
+
+def test_gc_before_durable_caught_under_partition_too():
+    _, findings = explore_crash_consistency(
+        coordinator_cls=fx.GcBeforeDurableCoordinator,
+        modes=("partition",), phases=("pre_gc",))
+    assert findings and {f.rule for f in findings} == {"MTA013"}
+
+
+def test_unfenced_shard_fixture_trips_exactly_mta014():
+    _, findings = explore_fencing(shard_cls=fx.UnfencedCheckpointShard)
+    assert findings and {f.rule for f in findings} == {"MTA014"}
+    # both halves of the contract are refuted somewhere in the space:
+    # the write is not refused, and (on durable paths) it lands on disk
+    invariants = {f.detail["invariant"] for f in findings}
+    assert "fenced-write-refused" in invariants
+    assert "no-fenced-durability" in invariants
+
+
+def test_non_atomic_manifest_writer_fixture_trips_exactly_mtl107():
+    """In-tree the fixture's allows keep the gate green; stripped, its
+    source fires exactly MTL107 — once per pattern."""
+    import inspect
+    import textwrap
+
+    from metrics_tpu.analysis.lint import lint_source
+
+    src = "import json\nimport os\n" + textwrap.dedent(
+        inspect.getsource(fx.NonAtomicManifestWriter))
+    rel = "metrics_tpu/analysis/fixtures.py"
+    in_tree = lint_source(src, rel)
+    assert all(f.suppressed for f in in_tree if f.rule == "MTL107")
+
+    stripped = "\n".join(
+        line for line in src.splitlines() if "metrics-tpu: allow" not in line)
+    fired = [f for f in lint_source(stripped, rel) if not f.suppressed]
+    assert fired and {f.rule for f in fired} == {"MTL107"}
+    assert {f.detail["pattern"] for f in fired} == {
+        "non-atomic-open", "rename-without-fsync"}
+
+
+def test_mtl107_respects_fsync_before_rename():
+    """The real atomic primitive's shape — fsync ordered before
+    os.replace in the same function — must NOT flag."""
+    from metrics_tpu.analysis.lint import lint_source
+
+    clean = (
+        "import os\n"
+        "def publish(tmp, path):\n"
+        "    f = os.open(tmp, os.O_RDONLY)\n"
+        "    os.fsync(f)\n"
+        "    os.close(f)\n"
+        "    os.replace(tmp, path)\n"
+    )
+    assert [f for f in lint_source(clean, "metrics_tpu/x.py")
+            if f.rule == "MTL107"] == []
+
+
+def test_mtl107_scopes_fsync_per_function():
+    """An fsync in ANOTHER function does not sanctify this one's rename."""
+    from metrics_tpu.analysis.lint import lint_source
+
+    src = (
+        "import os\n"
+        "def a(f):\n"
+        "    os.fsync(f)\n"
+        "def b(tmp, path):\n"
+        "    os.rename(tmp, path)\n"
+    )
+    fired = [f for f in lint_source(src, "metrics_tpu/x.py")
+             if f.rule == "MTL107"]
+    assert len(fired) == 1 and fired[0].detail["pattern"] == "rename-without-fsync"
+
+
+# ----------------------------------------------------------------------
+# the committed tighten-only baseline
+# ----------------------------------------------------------------------
+def test_committed_baseline_matches_fresh_exploration(crash_run, fence_run):
+    """PROTOCOL_BASELINE.json is committed, covers both scenarios, and
+    the fresh run meets every committed coverage floor (the gate's green
+    direction)."""
+    baseline = load_protocol_baseline(os.path.join(_REPO, "PROTOCOL_BASELINE.json"))
+    assert baseline.get("schema") == "metrics_tpu.protocol_baseline"
+    entries = baseline["entries"]
+    assert {"crash_consistency", "fencing"} <= set(entries)
+    assert set(baseline["fixtures"]) == {
+        "GcBeforeDurableCoordinator", "NonAtomicManifestWriter",
+        "UnfencedCheckpointShard"}
+    fresh = {
+        "crash_consistency": build_protocol_entry(crash_run[0]),
+        "fencing": build_protocol_entry(fence_run[0]),
+    }
+    assert _baseline_findings(fresh, baseline) == []
+
+
+def test_baseline_gate_flags_coverage_regression():
+    baseline = {
+        "schema": "metrics_tpu.protocol_baseline",
+        "entries": {"crash_consistency": {
+            "states_explored": 99, "schedules": 99, "crash_points": 99}},
+    }
+    fresh = {"crash_consistency": {
+        "states_explored": 6, "schedules": 25, "crash_points": 14}}
+    findings = _baseline_findings(fresh, baseline)
+    assert findings and all(f.rule == "MTA013" for f in findings)
+    assert all("tighten-only" in f.message for f in findings)
+
+
+def test_tighten_only_merge_preserves_fixtures_and_prunes():
+    baseline = {
+        "fixtures": ["GcBeforeDurableCoordinator"],
+        "entries": {
+            "crash_consistency": {
+                "states_explored": 10, "schedules": 5, "crash_points": 3},
+            "GcBeforeDurableCoordinator": {
+                "expected_rule": "MTA013", "min_violations": 1},
+            "retired_scenario": {"states_explored": 1, "schedules": 1,
+                                 "crash_points": 1},
+        },
+    }
+    fresh = {"crash_consistency": {
+        "states_explored": 6, "schedules": 25, "crash_points": 14}}
+    merged, pruned = tighten_protocol_baseline(baseline, fresh)
+    entry = merged["entries"]["crash_consistency"]
+    # tighten-only: each counter is max(committed, fresh)
+    assert entry == {"states_explored": 10, "schedules": 25, "crash_points": 14}
+    # fixture entries survive verbatim; retired scenarios are pruned
+    assert merged["entries"]["GcBeforeDurableCoordinator"] == {
+        "expected_rule": "MTA013", "min_violations": 1}
+    assert pruned == ["retired_scenario"]
+
+
+def test_refresh_refusal_ladder(tmp_path):
+    """scripts/lint_metrics.refresh_protocol_baseline refuses skipped
+    passes, red explorations, and missing committed files — a regression
+    is never laundered by a rerun."""
+    import sys
+
+    sys.path.insert(0, os.path.join(_REPO, "scripts"))
+    try:
+        from lint_metrics import refresh_protocol_baseline
+    finally:
+        sys.path.pop(0)
+
+    assert "NOT refreshed" in refresh_protocol_baseline(
+        str(tmp_path / "x.json"), {}, skipped=True)
+    red = {"summary": {"findings": 2}, "evidence": {"baseline_entries": {}}}
+    assert "NOT refreshed" in refresh_protocol_baseline(
+        str(tmp_path / "x.json"), red, skipped=False)
+    green = {"summary": {"findings": 0},
+             "evidence": {"baseline_entries": {"crash_consistency": {
+                 "states_explored": 6, "schedules": 25, "crash_points": 14}}}}
+    assert "NOT refreshed" in refresh_protocol_baseline(
+        str(tmp_path / "missing.json"), green, skipped=False)
+
+    path = tmp_path / "PROTOCOL_BASELINE.json"
+    path.write_text(json.dumps({
+        "schema": "metrics_tpu.protocol_baseline",
+        "fixtures": [],
+        "entries": {"crash_consistency": {
+            "states_explored": 2, "schedules": 2, "crash_points": 2}},
+    }))
+    out = refresh_protocol_baseline(str(path), green, skipped=False)
+    assert "refreshed" in out and "NOT" not in out
+    merged = json.loads(path.read_text())
+    assert merged["entries"]["crash_consistency"]["schedules"] == 25
+
+
+# ----------------------------------------------------------------------
+# check_protocol: the pass-6 entry point (report payload + telemetry)
+# ----------------------------------------------------------------------
+def test_check_protocol_clean_payload_and_telemetry():
+    """Healthy tree: zero findings, evidence rides the v4 report shape,
+    the states-explored gauge is set, and the healthy-run-zero violations
+    counter is NOT emitted."""
+    import metrics_tpu.observability as obs
+
+    obs.enable()
+    try:
+        result = check_protocol(
+            baseline_path=os.path.join(_REPO, "PROTOCOL_BASELINE.json"))
+        snap = obs.get().snapshot()
+    finally:
+        obs.disable()
+    assert result["summary"]["findings"] == 0
+    assert result["summary"]["violations"] == 0
+    assert {"crash_consistency", "fencing", "baseline_entries",
+            "states_explored"} <= set(result["evidence"])
+    assert result["findings"] == []
+    assert snap["gauges"]["analysis.protocol.states_explored"] > 0
+    assert "analysis.protocol.violations" not in snap["counters"]
+
+
+def test_counterexample_report_minimal_first():
+    _, findings = explore_crash_consistency(
+        coordinator_cls=fx.GcBeforeDurableCoordinator,
+        modes=("none", "kill"), phases=("pre_gc",))
+    report = counterexample_report(findings)
+    assert "counterexample" in report and "minimal schedule first" in report
+    # the base-case (shortest) schedule leads the report
+    head = report.splitlines()[1]
+    assert "[0]" in head and "MTA013" in head
+    lengths = [len(f.detail["schedule"]) for f in findings]
+    first = report.split("[0]")[1].split("[1]")[0] if "[1]" in report else report
+    assert str(min(lengths) - 1) + ". " in first  # steps numbered from 0
+
+    assert counterexample_report([]).startswith("protocol explorer: no")
+
+
+def test_rules_registered():
+    for rid, slug in (("MTA013", "crash-consistency"),
+                      ("MTA014", "fencing-linearizability"),
+                      ("MTL107", "non-atomic-durability")):
+        assert rid in RULES and RULES[rid].slug == slug
